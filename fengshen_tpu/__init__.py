@@ -1,0 +1,19 @@
+"""fengshen_tpu — a TPU-native training & inference framework.
+
+Re-implements the capabilities of the reference ``fengshen`` framework
+(IDEA-CCNL/Fengshenbang-LM, surveyed in SURVEY.md) with a TPU-first design:
+
+- ``parallel``: jax.sharding.Mesh + GSPMD partition rules replace the reference's
+  Megatron ``mpu`` process groups (reference: fengshen/models/megatron/mpu/).
+- ``ops``: XLA/Pallas compute kernels replace the reference's CUDA fused kernels
+  (reference: fengshen/models/megatron/fused_kernels/).
+- ``trainer``: a jit-compiled training loop replaces PyTorch Lightning + DeepSpeed
+  (reference: fengshen/strategies/megatron_deepspeed.py).
+- ``models``: the model zoo (reference: fengshen/models/).
+- ``data``: host-sharded input pipeline with resumable samplers
+  (reference: fengshen/data/).
+- ``pipelines``/``cli``/``api``: task pipelines, console entry point, REST serving
+  (reference: fengshen/pipelines, fengshen/cli, fengshen/API).
+"""
+
+__version__ = "0.1.0"

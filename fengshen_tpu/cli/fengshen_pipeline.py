@@ -1,0 +1,67 @@
+"""`fengshen-pipeline` console entry point.
+
+Same CLI contract as the reference
+(reference: fengshen/cli/fengshen_pipeline.py:7-30):
+
+    fengshen-pipeline <task> <train|predict> --model ... --datasets ... [text]
+
+The task name resolves to ``fengshen_tpu.pipelines.<task>.Pipeline``
+dynamically, so adding a pipeline module automatically extends the CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+
+def _resolve_pipeline(task: str):
+    try:
+        module = importlib.import_module(f"fengshen_tpu.pipelines.{task}")
+    except ModuleNotFoundError as e:
+        from fengshen_tpu import pipelines
+        available = getattr(pipelines, "TASKS", [])
+        raise SystemExit(
+            f"unknown task {task!r} ({e}); available tasks: "
+            f"{', '.join(available) or '(none registered)'}")
+    if not hasattr(module, "Pipeline"):
+        raise SystemExit(
+            f"pipeline module fengshen_tpu.pipelines.{task} has no Pipeline")
+    return module.Pipeline
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2:
+        print("usage: fengshen-pipeline <task> <train|predict> "
+              "[--model M] [--datasets D] [pipeline args...] [text]",
+              file=sys.stderr)
+        return 2
+    task, mode, rest = argv[0], argv[1], argv[2:]
+    if mode not in ("train", "predict"):
+        print(f"unknown mode {mode!r}; expected train or predict",
+              file=sys.stderr)
+        return 2
+
+    pipeline_cls = _resolve_pipeline(task)
+
+    parser = argparse.ArgumentParser(prog=f"fengshen-pipeline {task} {mode}")
+    parser.add_argument("--model", type=str, default=None)
+    parser.add_argument("--datasets", type=str, default=None)
+    parser.add_argument("text", nargs="*", default=[])
+    if hasattr(pipeline_cls, "add_pipeline_specific_args"):
+        parser = pipeline_cls.add_pipeline_specific_args(parser)
+    args = parser.parse_args(rest)
+
+    pipeline = pipeline_cls(args=args, model=args.model)
+    if mode == "train":
+        pipeline.train(args.datasets)
+    else:
+        for line in (args.text or sys.stdin):
+            print(pipeline(line.strip()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+"""Compute ops: XLA-first kernels with Pallas for the hot paths.
+
+TPU-native replacement for the reference's native-kernel tier
+(reference: fengshen/models/megatron/fused_kernels/ CUDA softmax/layernorm,
+fengshen/models/megatron/layers/flash_attention.py, and the DeepSpeed sparse
+attention configs in layers/utils.py:187-289). XLA already fuses
+scale+mask+softmax and layernorm chains; Pallas kernels cover flash/splash
+attention and block-sparse layouts.
+"""
+
+from fengshen_tpu.ops.norms import RMSNorm, LayerNorm, ScaleNorm, get_norm
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.rotary import rotary_cos_sin, apply_rotary_pos_emb
+from fengshen_tpu.ops.alibi import alibi_slopes, alibi_bias
+from fengshen_tpu.ops.masks import (
+    causal_mask,
+    sliding_window_mask,
+    bigbird_mask,
+    longformer_mask,
+    make_attention_bias,
+)
+from fengshen_tpu.ops.attention import dot_product_attention
+
+__all__ = [
+    "RMSNorm", "LayerNorm", "ScaleNorm", "get_norm",
+    "get_activation",
+    "rotary_cos_sin", "apply_rotary_pos_emb",
+    "alibi_slopes", "alibi_bias",
+    "causal_mask", "sliding_window_mask", "bigbird_mask", "longformer_mask",
+    "make_attention_bias",
+    "dot_product_attention",
+]

@@ -1,0 +1,54 @@
+"""Activation functions.
+
+Reference: fengshen/models/megatron/layers/activations.py:27-132
+(`get_activation` over gelu/geglu/relu/softsign/swish/mish/silu plus a
+torchscript-fused bias_gelu). On TPU, XLA fuses bias+activation into the
+producing matmul, so there is no separate "fused bias-gelu" path — the plain
+composition compiles to the fused kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def geglu_split(x):
+    """GEGLU gating over a doubled feature dim
+    (reference: layers/activations.py GEGLU module)."""
+    a, b = jnp.split(x, 2, axis=-1)
+    return a * jax.nn.gelu(b)
+
+
+_ACTIVATIONS: dict[str, Callable] = {
+    "gelu": jax.nn.gelu,
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "geglu": geglu_split,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "mish": mish,
+    "tanh": jnp.tanh,
+}
+
+
+def get_activation(name: str) -> Callable:
+    """Dispatch by name (reference: layers/activations.py:27-59)."""
+    try:
+        return _ACTIVATIONS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")
+
+
+def is_gated(name: str) -> bool:
+    """Gated activations double the up-projection width
+    (reference: layers/transformer.py:89-94 geglu ff_dim scaling)."""
+    return name.lower() in ("geglu",)

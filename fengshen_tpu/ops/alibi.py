@@ -1,0 +1,43 @@
+"""ALiBi attention bias.
+
+Reference: fengshen/models/megatron/layers/positional_embeddings.py:90-173
+(`AliBi` with cached bias and TP-rank-aware slope slicing). Under GSPMD the
+head dim is sharded by the compiler, so no explicit rank slicing is needed —
+we just build the full [H, Sq, Sk] bias and let XLA partition it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """Per-head slopes (reference: positional_embeddings.py:100-123 —
+    power-of-two geometric slopes with interpolation for non-pow2 counts)."""
+
+    def pow2_slopes(n: int) -> list[float]:
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        slopes = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(num_heads))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2][: num_heads - closest]
+        slopes = slopes + extra
+    return jnp.asarray(slopes, dtype=jnp.float32)
+
+
+def alibi_bias(num_heads: int, q_len: int, k_len: int,
+               dtype=jnp.float32) -> jax.Array:
+    """[H, Sq, Sk] additive bias: slope * -(relative distance)
+    (reference: positional_embeddings.py:125-173)."""
+    slopes = alibi_slopes(num_heads)
+    q_pos = jnp.arange(k_len - q_len, k_len)[:, None]
+    k_pos = jnp.arange(k_len)[None, :]
+    distance = -jnp.abs(q_pos - k_pos).astype(jnp.float32)
+    return (slopes[:, None, None] * distance[None]).astype(dtype)

@@ -1,0 +1,85 @@
+"""Scaled dot-product attention.
+
+Replaces the reference's attention compute stack: baddbmm QK^T with a
+preallocated buffer + FusedScaleMaskSoftmax CUDA kernel + context bmm
+(reference: fengshen/models/megatron/layers/transformer.py:307-456 and
+layers/fused_softmax.py:24-205), and the flash-attention CUDA binding
+(reference: layers/flash_attention.py:107-185).
+
+On TPU the dense path is a single fused XLA HLO chain (matmul→scale→mask→
+softmax→matmul hits the MXU with the softmax fused in between); the
+`impl="flash"` path dispatches to the Pallas flash kernel in
+fengshen_tpu.ops.flash_attention for long sequences, and `impl="ring"` to
+sequence-parallel ring attention in fengshen_tpu.ops.ring_attention.
+
+Numerics: softmax statistics are always computed in fp32, mirroring the
+reference's fp32-upcast fallback rule (reference:
+layers/fused_softmax.py:184-200) so loss curves are comparable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_attention(q, k, v, bias, dropout_rng, dropout_rate, deterministic):
+    """q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; bias broadcastable to
+    [B, H, Sq, Sk]. Returns [B, Sq, H, D]."""
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    # [B, H, Sq, Sk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          bias: Optional[jax.Array] = None,
+                          mask: Optional[jax.Array] = None,
+                          dropout_rng: Optional[jax.Array] = None,
+                          dropout_rate: float = 0.0,
+                          deterministic: bool = True,
+                          impl: str = "dense") -> jax.Array:
+    """Attention entry point with per-layer impl dispatch.
+
+    `impl` mirrors the reference's per-layer `attention_config` selection of
+    dense / flash / sparse kernels
+    (reference: layers/transformer.py:259-268). Sparse layouts are expressed
+    as `mask` (see fengshen_tpu.ops.masks) and run on either backend.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: bool broadcastable to
+    [B, H, Sq, Sk] (True = attend); bias: additive, same broadcast.
+    """
+    if mask is not None:
+        neg = jnp.asarray(-1e9, dtype=jnp.float32)
+        mask_bias = jnp.where(mask, 0.0, neg)
+        bias = mask_bias if bias is None else bias + mask_bias
+
+    if impl in ("dense", "sparse"):
+        return _dense_attention(q, k, v, bias, dropout_rng, dropout_rate,
+                                deterministic)
+    if impl == "flash":
+        from fengshen_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, bias=bias,
+                               dropout_rng=dropout_rng,
+                               dropout_rate=dropout_rate,
+                               deterministic=deterministic)
+    if impl == "ring":
+        if bias is not None:
+            raise ValueError("impl='ring' supports causal masking only; "
+                             "express other patterns via impl='dense'")
+        from fengshen_tpu.ops.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, causal=True)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,148 @@
+"""Memory-efficient (flash-style) exact attention.
+
+TPU-native replacement for the reference's flash-attention CUDA binding
+(reference: fengshen/models/megatron/layers/flash_attention.py:107-185 wraps
+flash_attn_cuda.fwd/bwd). Two tiers:
+
+1. `blockwise_attention` — O(S) memory exact attention via online softmax
+   over k/v blocks with `lax.scan`. Pure XLA: runs on TPU and on the CPU
+   test backend, differentiable, and XLA fuses each block's
+   matmul→rescale→matmul chain onto the MXU. Causal masking is computed
+   per k-block from indices — no dense [Sq, Sk] bias is ever materialised.
+2. On real TPU, `flash_attention` prefers the Pallas fused kernel
+   (fengshen_tpu.ops.pallas.flash_attention) when shapes are tile-aligned,
+   mirroring the reference's `is_kernel_available` dispatch
+   (reference: fengshen/models/megatron/layers/fused_softmax.py:148-168).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        bias: Optional[jax.Array] = None,
+                        causal: bool = False,
+                        block_size: int = 512) -> jax.Array:
+    """Online-softmax attention. q: [B, Sq, H, D], k/v: [B, Sk, H, D],
+    bias broadcastable to [B, H, Sq, Sk]. Returns [B, Sq, H, D].
+
+    Prefer `causal=True` over passing a causal bias: the mask is then
+    computed per block from indices, keeping memory O(Sq·block) instead of
+    O(Sq·Sk).
+    """
+    batch, q_len, num_heads, head_dim = q.shape
+    k_len = k.shape[1]
+    blk = min(block_size, k_len)
+    pad = (blk - k_len % blk) % blk
+    if pad:  # pad k/v to a block multiple; padding is masked by position
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if bias is not None:
+            bias = jnp.broadcast_to(
+                bias.astype(jnp.float32),
+                bias.shape[:-2] + (q_len, k_len))
+            bias = jnp.pad(bias, ((0, 0),) * (bias.ndim - 1) + ((0, pad),),
+                           constant_values=_NEG_INF)
+    padded_len = k_len + pad
+
+    n_blocks = padded_len // blk
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+    # global positions; q is assumed right-aligned with k (Sq suffix of Sk),
+    # matching the KV-cache decode convention
+    q_pos = jnp.arange(k_len - q_len, k_len)
+
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            bias.shape[:-2] + (q_len, padded_len))
+        bias_blocks = jnp.moveaxis(
+            bias.reshape(bias.shape[:-1] + (n_blocks, blk)), -2, 0)
+    k_blocks = jnp.moveaxis(
+        k.reshape(batch, n_blocks, blk, num_heads, head_dim), 1, 0)
+    v_blocks = jnp.moveaxis(
+        v.reshape(batch, n_blocks, blk, num_heads, head_dim), 1, 0)
+    blk_idx = jnp.arange(n_blocks)
+
+    def step(carry, xs):
+        acc, row_max, row_sum = carry
+        if bias is not None:
+            bi, k_blk, v_blk, b_blk = xs
+        else:
+            bi, k_blk, v_blk = xs
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            scores = scores + b_blk
+        k_pos = bi * blk + jnp.arange(blk)
+        if causal:
+            allowed = (k_pos[None, :] <= q_pos[:, None]) & \
+                (k_pos[None, :] < k_len)
+        else:
+            allowed = jnp.broadcast_to(k_pos[None, :] < k_len, (q_len, blk))
+        scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+        blk_max = scores.max(axis=-1)                       # [B,H,Sq]
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
+        # fully-masked blocks contribute nothing (probs underflow to 0 at
+        # exp(_NEG_INF - max))
+        new_sum = row_sum * correction + probs.sum(axis=-1)
+        blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_blk.dtype),
+                             v_blk).astype(jnp.float32)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
+        return (acc, new_max, new_sum), None
+
+    acc0 = jnp.zeros((batch, q_len, num_heads, head_dim), jnp.float32)
+    max0 = jnp.full((batch, num_heads, q_len), _NEG_INF, jnp.float32)
+    sum0 = jnp.zeros((batch, num_heads, q_len), jnp.float32)
+
+    xs = (blk_idx, k_blocks, v_blocks)
+    if bias is not None:
+        xs = xs + (bias_blocks,)
+
+    (acc, _, row_sum), _ = jax.lax.scan(step, (acc0, max0, sum0), xs)
+    out = acc / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    bias: Optional[jax.Array] = None,
+                    dropout_rng=None, dropout_rate: float = 0.0,
+                    deterministic: bool = True,
+                    block_size: int = 512,
+                    causal: bool = False) -> jax.Array:
+    """Flash attention with kernel dispatch.
+
+    Attention dropout is not supported on the flash path (same restriction
+    as the reference's flash branch, which bypasses the softmax-dropout,
+    reference: layers/transformer.py:270-279) — callers fall back to dense
+    when dropout is active.
+    """
+    if not deterministic and dropout_rate > 0.0:
+        raise ValueError("flash attention path does not support attention "
+                         "dropout; use impl='dense'")
+    if _pallas_eligible(q, k, v, bias, causal):
+        from fengshen_tpu.ops.pallas.flash_attention import (
+            pallas_flash_attention)
+        return pallas_flash_attention(q, k, v, causal)
+    return blockwise_attention(q, k, v, bias=bias, causal=causal,
+                               block_size=block_size)
+
+
+def _pallas_eligible(q, k, v, bias, causal) -> bool:
+    """Kernel-eligibility check in the spirit of the reference's
+    `FusedScaleMaskSoftmax.is_kernel_available`
+    (reference: layers/fused_softmax.py:148-168)."""
+    if bias is not None:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    _, q_len, _, head_dim = q.shape
+    k_len = k.shape[1]
+    return (head_dim % 128 == 0 and q_len % 128 == 0 and k_len % 128 == 0)

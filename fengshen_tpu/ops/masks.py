@@ -1,0 +1,129 @@
+"""Attention mask / sparsity layouts.
+
+Covers two reference surfaces:
+- dense causal/padding mask builders
+  (reference: fengshen/models/megatron/layers/utils.py:26-63
+  `get_attn_mask`/`get_ltor_masks_and_position_ids`);
+- the DeepSpeed block-sparse layouts (fixed, variable, local sliding window,
+  bigbird, bslongformer) that the reference configures via
+  `configure_sparse_attention`
+  (reference: fengshen/models/megatron/layers/utils.py:187-289).
+
+All masks here are boolean [.., Sq, Sk] with True = "may attend"; they are
+turned into additive biases by `make_attention_bias`. Dense-with-mask is the
+baseline implementation; the Pallas splash-attention path consumes the same
+layouts as block masks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def causal_mask(q_len: int, k_len: Optional[int] = None) -> jax.Array:
+    """Lower-triangular [Sq, Sk] (reference: layers/utils.py:26-35)."""
+    k_len = k_len or q_len
+    q_pos = jnp.arange(k_len - q_len, k_len)[:, None]
+    k_pos = jnp.arange(k_len)[None, :]
+    return k_pos <= q_pos
+
+
+def sliding_window_mask(q_len: int, window: int,
+                        k_len: Optional[int] = None,
+                        causal: bool = True) -> jax.Array:
+    """Local sliding-window layout (reference: DeepSpeed
+    LocalSlidingWindowSparsityConfig via layers/utils.py:253-259; also the
+    Longformer family's window attention,
+    reference: fengshen/models/longformer/modeling_longformer.py)."""
+    k_len = k_len or q_len
+    q_pos = jnp.arange(k_len - q_len, k_len)[:, None]
+    k_pos = jnp.arange(k_len)[None, :]
+    diff = q_pos - k_pos
+    if causal:
+        return (diff >= 0) & (diff < window)
+    return jnp.abs(diff) < window
+
+
+def bigbird_mask(seq_len: int, block: int, num_random_blocks: int,
+                 num_global_blocks: int, num_window_blocks: int,
+                 seed: int = 0, causal: bool = False) -> jax.Array:
+    """BigBird layout: global + window + random blocks
+    (reference: DeepSpeed BigBirdSparsityConfig via layers/utils.py:260-267).
+    Static (trace-time) construction — the layout is a compile-time constant,
+    as block-sparse layouts must be for XLA.
+    """
+    assert seq_len % block == 0, "seq_len must be a multiple of block"
+    n = seq_len // block
+    rng = np.random.RandomState(seed)
+    layout = np.zeros((n, n), dtype=bool)
+    # window
+    for off in range(-(num_window_blocks // 2), num_window_blocks // 2 + 1):
+        idx = np.arange(max(0, -off), min(n, n - off))
+        layout[idx, idx + off] = True
+    # global rows+cols
+    g = num_global_blocks
+    layout[:g, :] = True
+    layout[:, :g] = True
+    # random per row
+    for i in range(n):
+        choices = rng.choice(n, size=min(num_random_blocks, n), replace=False)
+        layout[i, choices] = True
+    if causal:
+        layout &= np.tril(np.ones((n, n), dtype=bool))
+    return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
+
+
+def longformer_mask(seq_len: int, block: int, num_window_blocks: int,
+                    global_block_indices: tuple[int, ...] = (0,),
+                    causal: bool = False) -> jax.Array:
+    """BSLongformer layout: sliding window + designated global blocks
+    (reference: DeepSpeed BSLongformerSparsityConfig via
+    layers/utils.py:268-275)."""
+    assert seq_len % block == 0
+    n = seq_len // block
+    layout = np.zeros((n, n), dtype=bool)
+    for off in range(-(num_window_blocks // 2), num_window_blocks // 2 + 1):
+        idx = np.arange(max(0, -off), min(n, n - off))
+        layout[idx, idx + off] = True
+    for gi in global_block_indices:
+        layout[gi, :] = True
+        layout[:, gi] = True
+    if causal:
+        layout &= np.tril(np.ones((n, n), dtype=bool))
+    return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
+
+
+def fixed_sparsity_mask(seq_len: int, block: int, num_local_blocks: int,
+                        num_global_blocks: int = 1,
+                        causal: bool = True) -> jax.Array:
+    """Fixed layout à la Sparse Transformers: local stripes + periodic global
+    columns (reference: DeepSpeed FixedSparsityConfig via
+    layers/utils.py:236-244)."""
+    assert seq_len % block == 0
+    n = seq_len // block
+    layout = np.zeros((n, n), dtype=bool)
+    stride = num_local_blocks
+    for i in range(n):
+        blk_start = (i // stride) * stride
+        layout[i, blk_start:i + 1] = True          # local window
+        layout[i, stride - num_global_blocks::stride] = True  # global cols
+    if causal:
+        layout &= np.tril(np.ones((n, n), dtype=bool))
+    else:
+        layout |= layout.T
+    return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
+
+
+def make_attention_bias(mask: Optional[jax.Array],
+                        dtype=jnp.float32,
+                        neg: float = -1e9) -> Optional[jax.Array]:
+    """bool mask → additive bias (True→0, False→-inf-ish). The fp32-sized
+    negative mirrors the reference's mask-fill value handling in its softmax
+    fallback (reference: layers/fused_softmax.py:184-200)."""
+    if mask is None:
+        return None
+    return jnp.where(mask, 0.0, neg).astype(dtype)

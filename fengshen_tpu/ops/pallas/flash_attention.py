@@ -1,0 +1,153 @@
+"""Pallas TPU flash-attention kernel.
+
+The TPU-native replacement for the reference's flash-attention CUDA binding
+(reference: fengshen/models/megatron/layers/flash_attention.py wraps
+flash_attn_cuda.fwd/bwd). Forward fused kernel: online softmax with k/v
+streamed block-by-block through VMEM via the grid (memory per program is
+O(blk_q + blk_k), never O(Sk)), running statistics held in VMEM scratch
+across the innermost (k-block) grid dimension — TPU grids execute
+sequentially, so scratch persists between k steps of the same q block.
+
+The backward pass recomputes through the differentiable XLA blockwise
+implementation via `jax.custom_vjp` (flash-style recompute, trading FLOPs
+for HBM traffic like `jax.checkpoint`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      acc_ref, max_ref, sum_ref,
+                      *, blk_k: int, causal: bool, scale: float,
+                      n_kblocks: int):
+    # q_ref/o_ref: [1, blk_q, D]; k_ref/v_ref: [1, blk_k, D]
+    _, blk_q, head_dim = q_ref.shape
+    q_idx = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = q_idx * blk_q
+    k_start = kb * blk_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        max_ref[:] = jnp.full_like(max_ref, _NEG_INF)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [blk_q, blk_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+        row_max = max_ref[:, 0]
+        blk_max = scores.max(axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[:, None])
+        sum_ref[:, 0] = sum_ref[:, 0] * correction + probs.sum(axis=-1)
+        max_ref[:, 0] = new_max
+        acc_ref[:] = acc_ref[:] * correction[:, None] + jax.lax.dot_general(
+            probs, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip blocks strictly above the causal diagonal
+        pl.when(k_start <= q_start + blk_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        out = acc_ref[:] / jnp.maximum(sum_ref[:, 0], 1e-30)[:, None]
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           causal: bool = False,
+                           blk_q: int = 256, blk_k: int = 256,
+                           interpret: bool = False) -> jax.Array:
+    """q: [B, Sq, H, D], k/v: [B, Sk, H, D] → [B, Sq, H, D].
+
+    Requires Sq % blk_q == 0, Sk % blk_k == 0 (the `_pallas_eligible`
+    dispatch in ops.flash_attention guarantees tile-aligned shapes, in the
+    spirit of the reference's fused-kernel availability check,
+    reference: fengshen/models/megatron/layers/fused_softmax.py:148-168).
+    """
+    return _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret=False):
+    batch, q_len, num_heads, head_dim = q.shape
+    k_len = k.shape[1]
+    blk_q = min(blk_q, q_len)
+    blk_k = min(blk_k, k_len)
+    assert q_len % blk_q == 0 and k_len % blk_k == 0
+    scale = float(1.0 / (head_dim ** 0.5))
+    n_kblocks = k_len // blk_k
+
+    # [B, S, H, D] -> [B*H, S, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(-1, x.shape[1], x.shape[3])
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, causal=causal,
+                               scale=scale, n_kblocks=n_kblocks)
+    out = pl.pallas_call(
+        kernel,
+        grid=(qb.shape[0], q_len // blk_q, n_kblocks),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, head_dim), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, head_dim), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((blk_q, 1), jnp.float32),         # running max
+            pltpu.VMEM((blk_q, 1), jnp.float32),         # running sum
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+    return (out.reshape(batch, num_heads, q_len, head_dim)
+               .transpose(0, 2, 1, 3))
+
+
+def _flash_fwd_vjp(q, k, v, causal, blk_q, blk_k, interpret):
+    out = _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, blk_q, blk_k, interpret, res, g):
+    q, k, v = res
+    # recompute through the XLA blockwise path, which is differentiable
+    from fengshen_tpu.ops.flash_attention import blockwise_attention
+
+    def f(q_, k_, v_):
+        return blockwise_attention(q_, k_, v_, causal=causal,
+                                   block_size=blk_k)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+pallas_flash_attention.defvjp(_flash_fwd_vjp, _flash_bwd)

@@ -1,0 +1,109 @@
+"""Ring attention — sequence/context parallelism over the ICI mesh.
+
+The reference has **no** sequence parallelism (SURVEY.md §5.7: max context is
+per-device, flash/sparse kernels only scale the constant factor). This module
+fills that gap the TPU-native way: the sequence dim is sharded over the
+'sequence' mesh axis, and k/v shards rotate around the ring with
+`jax.lax.ppermute` while each device accumulates its queries' attention with
+an online softmax — compute overlaps the ICI transfer and per-device memory
+stays O(S/ring) (Liu et al., Ring Attention with Blockwise Transformers).
+
+`ring_attention` is the shard_map-body (axis_name in scope);
+`ring_attention_sharded` wraps it for callers holding globally-sharded
+arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from fengshen_tpu.parallel.mesh import BATCH_AXES, SEQUENCE_AXIS, get_mesh
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SEQUENCE_AXIS,
+                   causal: bool = True) -> jax.Array:
+    """Attention over a sequence-sharded batch; call inside shard_map.
+
+    q/k/v: local shards [B, S_local, H, D]. The local shard index along
+    `axis_name` determines global positions (contiguous layout: shard i holds
+    positions [i*S_local, (i+1)*S_local)).
+    """
+    ring_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    batch, s_local, num_heads, head_dim = q.shape
+    scale = 1.0 / jnp.sqrt(head_dim).astype(jnp.float32)
+
+    q_pos = my_idx * s_local + jnp.arange(s_local)  # global query positions
+
+    acc = jnp.zeros((batch, s_local, num_heads, head_dim), jnp.float32)
+    row_max = jnp.full((batch, num_heads, s_local), _NEG_INF, jnp.float32)
+    row_sum = jnp.zeros((batch, num_heads, s_local), jnp.float32)
+
+    def body(step, carry):
+        acc, row_max, row_sum, k_cur, v_cur = carry
+        # shard that k_cur originated from
+        src_idx = (my_idx - step) % ring_size
+        k_pos = src_idx * s_local + jnp.arange(s_local)
+
+        scores = _block_scores(q, k_cur, scale)  # [B,H,Sq,Sk]
+        if causal:
+            allowed = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+
+        blk_max = scores.max(axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
+        new_sum = row_sum * correction + probs.sum(axis=-1)
+        blk_out = jnp.einsum("bhqk,bkhd->bqhd",
+                             probs.astype(v_cur.dtype), v_cur
+                             ).astype(jnp.float32)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
+
+        # rotate k/v to the next device; overlap with the next step's compute
+        perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, new_max, new_sum, k_next, v_next)
+
+    carry = (acc, row_max, row_sum, k, v)
+    carry = jax.lax.fori_loop(0, ring_size, body, carry)
+    acc, row_max, row_sum, _, _ = carry
+
+    # fully-masked rows (can happen for the first queries under causal with
+    # padding) keep sum==0; guard the divide
+    denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Optional[Mesh] = None,
+                           causal: bool = True) -> jax.Array:
+    """shard_map wrapper: q/k/v globally [B, S, H, D], sequence dim sharded
+    over the 'sequence' axis, batch over the batch axes."""
+    mesh = mesh or get_mesh()
+    if mesh is None or SEQUENCE_AXIS not in mesh.shape or \
+            mesh.shape[SEQUENCE_AXIS] == 1:
+        from fengshen_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+
+    spec = P(BATCH_AXES, SEQUENCE_AXIS, None, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=SEQUENCE_AXIS, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

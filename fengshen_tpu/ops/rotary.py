@@ -1,0 +1,61 @@
+"""Rotary position embeddings.
+
+Reference: fengshen/models/megatron/layers/positional_embeddings.py:38-88
+(`RotaryEmbedding` with cached cos/sin, `apply_rotary_pos_emb` gathered by
+position_ids, partial-rotary via `rotary_pct`,
+layers/transformer.py:240-257). Implemented as pure functions — the cos/sin
+table is computed inside jit where XLA constant-folds / fuses it; no mutable
+cache needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rotary_cos_sin(positions: jax.Array, dim: int, base: float = 10000.0,
+                   dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for integer `positions` [..., S] over rotary dim `dim`.
+
+    Returns (cos, sin) of shape [..., S, dim] using the half-rotation
+    (rotate_half) convention — the same layout the reference uses
+    (reference: positional_embeddings.py:70-76).
+    """
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., S, dim/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)           # [..., S, dim]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q: jax.Array, k: jax.Array,
+                         positions: jax.Array,
+                         rotary_dim: Optional[int] = None,
+                         base: float = 10000.0) -> tuple[jax.Array, jax.Array]:
+    """Apply RoPE to q/k of shape [B, S, H, D] with positions [B, S].
+
+    `rotary_dim < D` gives the partial-rotary behaviour of the reference's
+    `rotary_pct` (reference: layers/transformer.py:240-257: split into
+    rot/pass components, rotate, re-concat).
+    """
+    head_dim = q.shape[-1]
+    rotary_dim = rotary_dim or head_dim
+    cos, sin = rotary_cos_sin(positions, rotary_dim, base=base, dtype=q.dtype)
+    cos = cos[:, :, None, :]  # [B, S, 1, rotary_dim]
+    sin = sin[:, :, None, :]
+
+    def rot(x):
+        if rotary_dim == head_dim:
+            return x * cos + _rotate_half(x) * sin
+        x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
+        x_rot = x_rot * cos + _rotate_half(x_rot) * sin
+        return jnp.concatenate([x_rot, x_pass], axis=-1)
+
+    return rot(q), rot(k)

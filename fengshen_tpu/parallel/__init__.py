@@ -1,0 +1,51 @@
+"""Parallelism core: device mesh + GSPMD partition rules.
+
+TPU-native replacement for the reference's Megatron ``mpu`` package
+(reference: fengshen/models/megatron/mpu/__init__.py:17-54). Process groups
+become mesh axes; ``ColumnParallelLinear``/``RowParallelLinear`` collapse into
+PartitionSpec rules; NCCL collectives become XLA collectives emitted by GSPMD.
+"""
+
+from fengshen_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    get_mesh,
+    set_mesh,
+    mesh_shape_for_devices,
+    DATA_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+    EXPERT_AXIS,
+    BATCH_AXES,
+)
+from fengshen_tpu.parallel.partition import (
+    match_partition_rules,
+    make_shardings,
+    with_sharding_constraint,
+    named_sharding,
+    shard_batch_spec,
+    tree_paths,
+)
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "get_mesh",
+    "set_mesh",
+    "mesh_shape_for_devices",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "SEQUENCE_AXIS",
+    "TENSOR_AXIS",
+    "EXPERT_AXIS",
+    "BATCH_AXES",
+    "match_partition_rules",
+    "make_shardings",
+    "with_sharding_constraint",
+    "named_sharding",
+    "shard_batch_spec",
+    "tree_paths",
+    "vocab_parallel_cross_entropy",
+]

@@ -1,0 +1,140 @@
+"""Partition rules: regex-on-param-path → PartitionSpec.
+
+This module is where the reference's TP layer classes collapse into data:
+``ColumnParallelLinear`` (output-dim shard), ``RowParallelLinear`` (input-dim
+shard) and ``VocabParallelEmbedding`` (vocab-dim shard)
+(reference: fengshen/models/megatron/mpu/layers.py:55-470) become
+PartitionSpec entries matched by parameter path. GSPMD then inserts the
+collectives the reference implemented by hand as autograd Functions
+(reference: fengshen/models/megatron/mpu/mappings.py:110-172) — the backward
+duals come from autodiff for free.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fengshen_tpu.parallel.mesh import BATCH_AXES, get_mesh
+
+
+def tree_paths(tree: Any) -> Any:
+    """Pytree of '/'-joined string paths with the same structure as `tree`."""
+
+    def _name(entry) -> str:
+        if isinstance(entry, jax.tree_util.DictKey):
+            return str(entry.key)
+        if isinstance(entry, jax.tree_util.SequenceKey):
+            return str(entry.idx)
+        if isinstance(entry, jax.tree_util.GetAttrKey):
+            return str(entry.name)
+        if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+            return str(entry.key)
+        return str(entry)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = ["/".join(_name(k) for k in path) for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, paths)
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], tree: Any) -> Any:
+    """Map every leaf of `tree` to the PartitionSpec of the first rule whose
+    regex matches its path. Scalars are always replicated.
+
+    The rules table plays the role of the reference's per-layer
+    ``model_parallel``/``partition_dim`` weight attributes
+    (reference: fengshen/models/megatron/mpu/layers.py:42-52).
+    """
+    paths = tree_paths(tree)
+
+    def assign(path: str, leaf: Any) -> P:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, path) is not None:
+                return spec
+        raise ValueError(f"no partition rule matched parameter path: {path!r}")
+
+    return jax.tree_util.tree_map(assign, paths, tree)
+
+
+def _spec_fits(spec: P, mesh: Mesh, shape: tuple[int, ...]) -> P:
+    """Drop sharded dims that do not divide evenly (tiny test configs)."""
+    out = []
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            out.append(None)
+            continue
+        axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = int(np.prod([mesh.shape[a] for a in axes_t]))
+        if dim < len(shape) and shape[dim] % size == 0:
+            out.append(axes)
+        else:
+            out.append(None)
+    return P(*out)
+
+
+def make_shardings(rules_or_specs: Any,
+                   tree: Any,
+                   mesh: Optional[Mesh] = None) -> Any:
+    """Pytree of NamedSharding for `tree`.
+
+    `rules_or_specs` is either a rules table (list of (regex, spec)) or an
+    already-matched pytree of PartitionSpecs.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("no mesh installed; call make_mesh()/set_mesh() first")
+    if isinstance(rules_or_specs, (list, tuple)) and rules_or_specs and isinstance(
+            rules_or_specs[0], tuple):
+        specs = match_partition_rules(rules_or_specs, tree)
+    else:
+        specs = rules_or_specs
+
+    def to_sharding(spec: P, leaf: Any) -> NamedSharding:
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _spec_fits(spec, mesh, tuple(shape)))
+
+    return jax.tree_util.tree_map(to_sharding, specs, tree,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("no mesh installed")
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch_spec(ndim: int, sequence_axis: Optional[int] = None) -> P:
+    """PartitionSpec for a batch tensor: batch dim over the batch axes
+    (data×fsdp — the reference's data-parallel group), optionally the
+    sequence dim over 'sequence' (context parallelism)."""
+    spec: list = [BATCH_AXES] + [None] * (ndim - 1)
+    if sequence_axis is not None and 0 < sequence_axis < ndim:
+        spec[sequence_axis] = "sequence"
+    return P(*spec)
+
+
+def with_sharding_constraint(x: Any, spec: P, mesh: Optional[Mesh] = None):
+    """`jax.lax.with_sharding_constraint` that degrades to identity when no
+    mesh is installed (pure single-device/unit-test path).
+
+    Used inside model code where the reference called its collective region
+    mappings (reference: fengshen/models/megatron/mpu/mappings.py:29-193).
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+
+    def constrain(leaf):
+        fitted = _spec_fits(spec, mesh, tuple(leaf.shape))
+        return jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, fitted))
+
+    return jax.tree_util.tree_map(constrain, x)

@@ -1,0 +1,10 @@
+"""Task pipelines (reference: fengshen/pipelines/).
+
+Each submodule exposes a ``Pipeline`` class with the reference's contract:
+``__init__(args, model=...)``, ``train(datasets)``, ``__call__(text)`` and
+``add_pipeline_specific_args(parser)``
+(reference: fengshen/pipelines/text_classification.py:134-234).
+"""
+
+#: registered task names — kept in sync with the submodules
+TASKS: list[str] = []

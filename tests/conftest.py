@@ -1,0 +1,43 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Must set env before jax initialises its backends — conftest is imported
+before any test module, so this is the earliest reliable hook.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The environment may pre-register an accelerator plugin via sitecustomize
+# and force jax_platforms programmatically; override it back to CPU before
+# any backend initialises so tests always run on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """2x2x1x2 (data, fsdp, sequence, tensor) mesh on 8 CPU devices."""
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    mesh = make_mesh(MeshConfig(data=2, fsdp=2, sequence=1, tensor=2))
+    set_mesh(mesh)
+    yield mesh
+    set_mesh(None)
+
+
+@pytest.fixture
+def mesh_seq4():
+    """1x1x4x2 mesh exercising sequence parallelism."""
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1, sequence=4, tensor=2))
+    set_mesh(mesh)
+    yield mesh
+    set_mesh(None)

@@ -1,0 +1,206 @@
+"""Numeric tests for the ops tier against plain-jnp references.
+
+Mirrors the role of the reference's kernel test
+(reference: fengshen/models/megatron/fused_kernels/tests/test_fused_kernels.py
+— fused kernel vs torch softmax elementwise closeness), but runs on the CPU
+XLA backend so it is CI-able.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.ops import (
+    dot_product_attention, causal_mask, sliding_window_mask, bigbird_mask,
+    make_attention_bias, rotary_cos_sin, apply_rotary_pos_emb, alibi_slopes,
+    alibi_bias, get_activation, RMSNorm, LayerNorm, get_norm,
+)
+from fengshen_tpu.ops.flash_attention import blockwise_attention
+
+
+def _ref_attention(q, k, v, bias=None):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(d)
+    if bias is not None:
+        s = s + bias
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    return q, k, v
+
+
+def test_dense_attention_matches_reference(qkv):
+    q, k, v = qkv
+    out = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(out, _ref_attention(q, k, v), atol=1e-5)
+
+
+def test_dense_attention_causal(qkv):
+    q, k, v = qkv
+    mask = causal_mask(16)[None, None]
+    out = dot_product_attention(q, k, v, mask=mask)
+    ref = _ref_attention(q, k, v, bias=make_attention_bias(mask))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # causality: output at position t must not depend on k/v after t
+    v2 = v.at[:, -1].set(99.0)
+    out2 = dot_product_attention(q, k, v2, mask=mask)
+    np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-5)
+
+
+def test_blockwise_attention_matches_dense(qkv):
+    q, k, v = qkv
+    ref = _ref_attention(q, k, v)
+    out = blockwise_attention(q, k, v, block_size=4)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_blockwise_attention_with_bias_and_ragged_block(qkv):
+    q, k, v = qkv
+    bias = make_attention_bias(causal_mask(16)[None, None])
+    ref = _ref_attention(q, k, v, bias)
+    out = blockwise_attention(q, k, v, bias=bias, block_size=5)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_rotary_norm_preserving():
+    q = jnp.ones((1, 8, 2, 16))
+    k = jnp.ones((1, 8, 2, 16))
+    pos = jnp.arange(8)[None]
+    q2, k2 = apply_rotary_pos_emb(q, k, pos)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(q2, axis=-1), jnp.linalg.norm(q, axis=-1), atol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(q2[:, 0], q[:, 0], atol=1e-6)
+
+
+def test_rotary_partial():
+    q = jnp.asarray(np.random.RandomState(1).randn(1, 4, 2, 16), jnp.float32)
+    pos = jnp.arange(4)[None]
+    q2, _ = apply_rotary_pos_emb(q, q, pos, rotary_dim=8)
+    # pass-through dims untouched (reference: transformer.py:240-257)
+    np.testing.assert_allclose(q2[..., 8:], q[..., 8:], atol=1e-6)
+
+
+def test_rotary_relative_property():
+    # attention score q_i . k_j after rope depends only on i-j
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 10, 1, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 10, 1, 16), jnp.float32)
+    qa = jnp.tile(q[:, :1], (1, 10, 1, 1))
+    ka = jnp.tile(k[:, :1], (1, 10, 1, 1))
+    pos = jnp.arange(10)[None]
+    q2, k2 = apply_rotary_pos_emb(qa, ka, pos)
+    s = jnp.einsum("bqhd,bkhd->bqk", q2, k2)[0]
+    for off in range(1, 5):
+        np.testing.assert_allclose(s[0, off], s[3, 3 + off], atol=1e-4)
+
+
+def test_alibi_slopes_pow2():
+    s = alibi_slopes(8)
+    assert s.shape == (8,)
+    np.testing.assert_allclose(s[0], 2 ** -1.0, atol=1e-6)
+    b = alibi_bias(8, 4, 4)
+    assert b.shape == (8, 4, 4)
+    np.testing.assert_allclose(np.diagonal(b, axis1=1, axis2=2), 0.0)
+
+
+def test_alibi_slopes_non_pow2():
+    s = alibi_slopes(12)
+    assert s.shape == (12,)
+    assert np.all(np.asarray(s) > 0)
+
+
+def test_masks_shapes():
+    m = sliding_window_mask(8, 3)
+    assert bool(m[5, 3]) and bool(m[5, 5]) and not bool(m[5, 2]) \
+        and not bool(m[5, 6])
+    bb = bigbird_mask(16, 4, num_random_blocks=1, num_global_blocks=1,
+                      num_window_blocks=3)
+    assert bb.shape == (16, 16)
+    assert bool(bb[0, 15])  # global row
+
+
+def test_activations():
+    x = jnp.linspace(-2, 2, 8)
+    for name in ["gelu", "relu", "silu", "mish", "softsign", "swish"]:
+        y = get_activation(name)(x)
+        assert y.shape == x.shape
+    g = get_activation("geglu")(jnp.ones((2, 8)))
+    assert g.shape == (2, 4)
+
+
+def test_rmsnorm_matches_formula():
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 4, 8), jnp.float32)
+    mod = RMSNorm(epsilon=1e-6)
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    ref = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, atol=1e-5)
+
+
+def test_layernorm_bf16_stats_fp32():
+    x = (jnp.asarray(np.random.RandomState(4).randn(2, 8), jnp.float32) * 100
+         ).astype(jnp.bfloat16)
+    mod = LayerNorm()
+    params = mod.init(jax.random.PRNGKey(0), x)
+    y = mod.apply(params, x)
+    assert y.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(y, dtype=np.float32)).all()
+
+
+def test_get_norm_dispatch():
+    assert isinstance(get_norm("rmsnorm"), RMSNorm)
+    assert isinstance(get_norm("layernorm"), LayerNorm)
+    with pytest.raises(ValueError):
+        get_norm("nope")
+
+
+def test_blockwise_attention_causal_param(qkv):
+    q, k, v = qkv
+    bias = make_attention_bias(causal_mask(16)[None, None])
+    ref = _ref_attention(q, k, v, bias)
+    out = blockwise_attention(q, k, v, causal=True, block_size=4)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_blockwise_attention_decode_alignment():
+    # Sq < Sk: queries are the suffix of the keys (KV-cache decode shape)
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 2, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 10, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 10, 2, 8), jnp.float32)
+    ref = _ref_attention(q, k, v, make_attention_bias(
+        causal_mask(2, 10)[None, None]))
+    out = blockwise_attention(q, k, v, causal=True, block_size=4)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_pallas_flash_interpret_matches_dense(qkv):
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = qkv
+    ref = _ref_attention(q, k, v)
+    out = pallas_flash_attention(q, k, v, False, 8, 8, True)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+    refc = _ref_attention(q, k, v, make_attention_bias(
+        causal_mask(16)[None, None]))
+    outc = pallas_flash_attention(q, k, v, True, 8, 8, True)
+    np.testing.assert_allclose(outc, refc, atol=1e-4)
+
+
+def test_attention_ring_impl_no_mesh_falls_back(qkv):
+    from fengshen_tpu.parallel import set_mesh
+    set_mesh(None)
+    q, k, v = qkv
+    out = dot_product_attention(q, k, v, impl="ring")
+    ref = _ref_attention(q, k, v, make_attention_bias(
+        causal_mask(16)[None, None]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

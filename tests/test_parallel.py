@@ -1,0 +1,147 @@
+"""Parallelism-core tests on the virtual 8-device CPU mesh.
+
+This is the single-host multi-device TP simulation the reference never had
+(SURVEY.md §4: multi-node is exercised only via SLURM scripts there).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.parallel import (
+    MeshConfig, make_mesh, set_mesh, match_partition_rules, make_shardings,
+    with_sharding_constraint, shard_batch_spec, vocab_parallel_cross_entropy,
+)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.ops.ring_attention import ring_attention_sharded
+
+
+def test_mesh_shapes():
+    cfg = MeshConfig(data=-1, fsdp=2, sequence=1, tensor=2)
+    assert cfg.resolve(8) == (2, 2, 1, 2)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=2, tensor=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(tensor=3).resolve(8)
+
+
+def test_mesh_build(mesh8):
+    assert dict(mesh8.shape) == {"data": 2, "fsdp": 2, "sequence": 1,
+                                 "tensor": 2}
+
+
+def test_match_partition_rules():
+    tree = {
+        "embed": {"embedding": jnp.zeros((100, 16))},
+        "layer_0": {"attn": {"qkv": {"kernel": jnp.zeros((16, 48))}},
+                    "mlp": {"w2": {"kernel": jnp.zeros((64, 16))}}},
+        "norm": {"scale": jnp.zeros((16,))},
+        "step": jnp.zeros(()),
+    }
+    rules = [
+        ("embed/embedding", P("tensor", None)),
+        ("qkv/kernel", P(None, "tensor")),
+        ("w2/kernel", P("tensor", None)),
+        ("norm", P(None)),
+    ]
+    specs = match_partition_rules(rules, tree)
+    assert specs["embed"]["embedding"] == P("tensor", None)
+    assert specs["layer_0"]["attn"]["qkv"]["kernel"] == P(None, "tensor")
+    assert specs["layer_0"]["mlp"]["w2"]["kernel"] == P("tensor", None)
+    assert specs["step"] == P()  # scalar always replicated
+
+
+def test_match_partition_rules_unmatched_raises():
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules([("x", P())], {"y": jnp.zeros((4, 4))})
+
+
+def test_make_shardings_places_params(mesh8):
+    tree = {"w": jnp.zeros((8, 16)), "b": jnp.zeros((16,))}
+    rules = [("w", P(None, "tensor")), ("b", P(None))]
+    shardings = make_shardings(rules, tree, mesh8)
+    placed = jax.device_put(tree, shardings)
+    assert placed["w"].sharding.spec == P(None, "tensor")
+    # uneven dim falls back to replicated rather than erroring
+    tree2 = {"w": jnp.zeros((8, 15)), "b": jnp.zeros((15,))}
+    sh2 = make_shardings(rules, tree2, mesh8)
+    placed2 = jax.device_put(tree2, sh2)
+    assert placed2["w"].sharding.spec == P(None, None)
+
+
+def test_with_sharding_constraint_no_mesh():
+    set_mesh(None)
+    x = jnp.ones((4, 4))
+    y = with_sharding_constraint(x, P("data", None))
+    np.testing.assert_allclose(x, y)
+
+
+def test_shard_batch_spec():
+    assert shard_batch_spec(2) == P(("data", "fsdp"), None)
+    assert shard_batch_spec(3, sequence_axis=1) == \
+        P(("data", "fsdp"), "sequence", None)
+
+
+def test_stable_cross_entropy_matches_logsoftmax():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 6, 32), jnp.float32)
+    targets = jnp.asarray(rng.randint(0, 32, (4, 6)))
+    targets = targets.at[:, -2:].set(-100)  # ignore tail
+    loss, n = stable_cross_entropy(logits, targets)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    valid = np.asarray(targets) != -100
+    ref = -np.asarray(lp)[np.nonzero(valid) +
+                          (np.asarray(targets)[valid],)].mean()
+    np.testing.assert_allclose(loss, ref, atol=1e-5)
+    assert int(n) == valid.sum()
+
+
+def test_vocab_parallel_ce_matches_replicated(mesh8):
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(2, 8, 64), jnp.float32)
+    targets = jnp.asarray(rng.randint(0, 64, (2, 8)))
+    targets = targets.at[0, :3].set(-100)
+    ref, _ = stable_cross_entropy(logits, targets)
+    loss, n = vocab_parallel_cross_entropy(logits, targets, mesh8)
+    np.testing.assert_allclose(loss, ref, atol=1e-5)
+
+
+def test_vocab_parallel_ce_grad_matches(mesh8):
+    rng = np.random.RandomState(2)
+    logits = jnp.asarray(rng.randn(2, 4, 64), jnp.float32)
+    targets = jnp.asarray(rng.randint(0, 64, (2, 4)))
+
+    def loss_rep(lg):
+        return stable_cross_entropy(lg, targets)[0]
+
+    def loss_par(lg):
+        return vocab_parallel_cross_entropy(lg, targets, mesh8)[0]
+
+    g_ref = jax.grad(loss_rep)(logits)
+    g_par = jax.grad(loss_par)(logits)
+    np.testing.assert_allclose(g_par, g_ref, atol=1e-5)
+
+
+def test_ring_attention_matches_dense(mesh_seq4):
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+
+    from fengshen_tpu.ops import dot_product_attention, causal_mask
+    ref = dot_product_attention(q, k, v, mask=causal_mask(16)[None, None])
+    out = ring_attention_sharded(q, k, v, mesh_seq4, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_attention_non_causal(mesh_seq4):
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    from fengshen_tpu.ops import dot_product_attention
+    ref = dot_product_attention(q, k, v)
+    out = ring_attention_sharded(q, k, v, mesh_seq4, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
