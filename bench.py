@@ -1,0 +1,89 @@
+"""Benchmark: LLaMA causal-LM training throughput + MFU on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no throughput numbers (BASELINE.md), so
+`vs_baseline` is measured-MFU / 0.40 — the north-star MFU target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main() -> None:
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+    from fengshen_tpu.trainer.trainer import PEAK_FLOPS
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=n_dev, fsdp=1, sequence=1, tensor=1))
+    set_mesh(mesh)
+
+    # ~300M-param LLaMA slice; bf16 compute, fp32 params/adam
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=16, num_attention_heads=16,
+        max_position_embeddings=1024, dtype="bfloat16",
+        attention_impl="flash", scan_layers=True,
+        gradient_checkpointing=True)
+    model = LlamaForCausalLM(config)
+    batch, seq = 8 * n_dev, 1024
+
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"])(rng)
+    tx = optax.adamw(1e-4, weight_decay=0.1)
+    opt_state = jax.jit(tx.init)(params)
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, config.vocab_size - 1, (batch, seq)), jnp.int32)
+
+    def loss_fn(p, ids):
+        logits = model.apply({"params": p}, ids)
+        loss, _ = stable_cross_entropy(logits[:, :-1], ids[:, 1:])
+        return loss
+
+    @jax.jit
+    def step(p, o, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, loss
+
+    # warmup / compile
+    params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * n_steps
+    tps = tokens / dt
+    n_params = sum(int(np.prod(p.shape)) for p in
+                   jax.tree_util.tree_leaves(params))
+    flops_per_token = 6.0 * n_params + 12.0 * config.num_hidden_layers * \
+        config.hidden_size * seq  # attention term
+    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
+    mfu = tps * flops_per_token / (peak * n_dev)
+
+    print(json.dumps({
+        "metric": "llama300m_train_tokens_per_sec_per_chip",
+        "value": round(tps / n_dev, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

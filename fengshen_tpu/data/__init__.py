@@ -1,0 +1,9 @@
+"""Data layer (reference: fengshen/data/, SURVEY.md §2.6)."""
+
+from fengshen_tpu.data.universal_sampler import (PretrainingSampler,
+                                                 PretrainingRandomSampler)
+from fengshen_tpu.data.universal_datamodule import (UniversalDataModule,
+                                                    DataLoader)
+
+__all__ = ["PretrainingSampler", "PretrainingRandomSampler",
+           "UniversalDataModule", "DataLoader"]

@@ -1,0 +1,25 @@
+"""Dataset registry — the `fs_datasets` equivalent.
+
+The reference's `fengshen/data/fs_datasets/` is the hub-hosted Chinese
+dataset wrapper collection (empty in the surveyed snapshot but referenced by
+`universal_datamodule.py:59`, SURVEY.md §2.6). Here it is a name registry:
+names map either to local loader callables registered via
+`register_dataset`, or fall through to HF `datasets.load_dataset`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_dataset(name: str, loader: Callable) -> None:
+    _REGISTRY[name] = loader
+
+
+def load_dataset(name: str, num_proc: int = 1, **kwargs):
+    if name in _REGISTRY:
+        return _REGISTRY[name](num_proc=num_proc, **kwargs)
+    import datasets as hf_datasets
+    return hf_datasets.load_dataset(name, **kwargs)

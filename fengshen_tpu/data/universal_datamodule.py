@@ -1,0 +1,222 @@
+"""UniversalDataModule — one datamodule for every workload.
+
+Port of the reference's universal datamodule
+(reference: fengshen/data/universal_datamodule/universal_datamodule.py:20-189):
+three dataset sources (passed-in datasets dict, a named dataset from the
+registry, or raw json/csv files via HF `datasets`), resumable Megatron-style
+samplers, and DP-rank-aware sharding. The torch DataLoader machinery is
+replaced by a small host-side loader producing numpy batches for
+`jax.device_put` (device transfer/prefetch is the trainer's job).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from fengshen_tpu.data.universal_sampler import (PretrainingRandomSampler,
+                                                 PretrainingSampler)
+
+
+def get_consumed_samples(trainer_or_model: Any, global_batch: int) -> int:
+    """Reference: universal_datamodule.py:8-17 — prefer the checkpointed
+    `consumed_samples`, else derive from global_step × global batch."""
+    consumed = getattr(trainer_or_model, "consumed_samples", None)
+    if consumed is not None:
+        return int(consumed)
+    step = getattr(trainer_or_model, "global_step", 0)
+    return int(step * global_batch)
+
+
+def _default_collate(samples: list) -> dict:
+    """Stack dict-of-arrays samples into a numpy batch."""
+    if not samples:
+        return {}
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples])
+                for k in first}
+    return {"batch": np.stack([np.asarray(s) for s in samples])}
+
+
+class DataLoader:
+    """Sampler-driven host loader yielding numpy batches."""
+
+    def __init__(self, dataset, sampler, collate_fn: Optional[Callable] = None,
+                 global_batch_size: int = 1):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fn = collate_fn or _default_collate
+        self.global_batch_size = global_batch_size
+        self.num_samples = len(dataset)
+
+    def __len__(self) -> int:
+        return max(1, self.num_samples // self.global_batch_size)
+
+    def __iter__(self):
+        for indices in self.sampler:
+            yield self.collate_fn([self.dataset[int(i)] for i in indices])
+
+    def peek(self):
+        """A shape-representative batch WITHOUT advancing the (stateful)
+        sampler — used by the trainer to derive batch specs."""
+        micro = getattr(self.sampler, "micro_batch_size", None) or \
+            getattr(self.sampler, "batch", 1)
+        n = min(micro, self.num_samples)
+        return self.collate_fn([self.dataset[i % self.num_samples]
+                                for i in range(n)])
+
+
+class _SimpleBatchSampler:
+    """Plain epoch sampler (shuffled or not) used when resumability is not
+    requested — the analog of Lightning's default DistributedSampler path
+    (reference: universal_datamodule.py:134-160)."""
+
+    def __init__(self, total: int, batch: int, rank: int, world: int,
+                 shuffle: bool, seed: int = 0, drop_last: bool = True):
+        self.total, self.batch = total, batch
+        self.rank, self.world = rank, world
+        self.shuffle, self.seed = shuffle, seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        order = np.arange(self.total)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch
+                                          ).permutation(self.total)
+        global_batch = self.batch * self.world
+        usable = self.total - self.total % global_batch if self.drop_last \
+            else self.total
+        for start in range(0, usable, global_batch):
+            chunk = order[start:start + global_batch]
+            mine = chunk[self.rank * self.batch:(self.rank + 1) * self.batch]
+            if len(mine):
+                yield list(mine)
+
+
+class UniversalDataModule:
+    @staticmethod
+    def add_data_specific_args(parent_args: argparse.ArgumentParser):
+        """Reference: universal_datamodule.py:21-44 (same flag names)."""
+        parser = parent_args.add_argument_group("Universal DataModule")
+        parser.add_argument("--num_workers", default=8, type=int)
+        parser.add_argument("--dataloader_workers", default=2, type=int)
+        parser.add_argument("--train_batchsize", default=16, type=int)
+        parser.add_argument("--val_batchsize", default=16, type=int)
+        parser.add_argument("--test_batchsize", default=16, type=int)
+        parser.add_argument("--datasets_name", type=str, default=None)
+        parser.add_argument("--train_datasets_field", type=str,
+                            default="train")
+        parser.add_argument("--val_datasets_field", type=str,
+                            default="validation")
+        parser.add_argument("--test_datasets_field", type=str, default="test")
+        parser.add_argument("--train_file", type=str, default=None)
+        parser.add_argument("--val_file", type=str, default=None)
+        parser.add_argument("--test_file", type=str, default=None)
+        parser.add_argument("--raw_file_type", type=str, default="json")
+        parser.add_argument("--sampler_type", type=str, default="random",
+                            choices=["single", "random"])
+        parser.add_argument("--use_mpu", action="store_true", default=False)
+        return parent_args
+
+    def __init__(self, tokenizer=None, collate_fn: Optional[Callable] = None,
+                 args=None, datasets: Optional[dict] = None, **kwargs):
+        self.tokenizer = tokenizer
+        self.collate_fn = collate_fn
+        self.args = args
+        self.trainer = None  # set by Trainer.fit for consumed_samples
+        if datasets is not None:
+            self.datasets = datasets
+        elif getattr(args, "datasets_name", None) is not None:
+            from fengshen_tpu.data.fs_datasets import load_dataset
+            self.datasets = load_dataset(
+                args.datasets_name,
+                num_proc=getattr(args, "num_workers", 1))
+        elif getattr(args, "train_file", None) is not None:
+            import datasets as hf_datasets
+            file_type = getattr(args, "raw_file_type", "json")
+            data_files = {}
+            for split, attr in (("train", "train_file"),
+                                ("validation", "val_file"),
+                                ("test", "test_file")):
+                if getattr(args, attr, None):
+                    data_files[split] = getattr(args, attr)
+            self.datasets = hf_datasets.load_dataset(
+                file_type, data_files=data_files)
+        else:
+            self.datasets = {}
+
+    # -- dp topology -----------------------------------------------------
+    def _dp_info(self) -> tuple[int, int]:
+        from fengshen_tpu.parallel.mesh import (data_parallel_rank,
+                                                data_parallel_world_size,
+                                                get_mesh)
+        mesh = get_mesh()
+        if mesh is None:
+            return 0, 1
+        return data_parallel_rank(mesh), data_parallel_world_size(mesh)
+
+    # -- loaders ---------------------------------------------------------
+    def _make_loader(self, split_field: str, batch_size: int,
+                     resumable: bool, shuffle: bool):
+        ds = self.datasets.get(split_field) if hasattr(
+            self.datasets, "get") else self.datasets[split_field]
+        if ds is None:
+            return None
+        rank, world = self._dp_info()
+        consumed = get_consumed_samples(self.trainer, batch_size * world) \
+            if resumable and self.trainer is not None else 0
+        if resumable:
+            sampler_type = getattr(self.args, "sampler_type", "random")
+            if sampler_type == "random":
+                sampler = PretrainingRandomSampler(
+                    total_samples=len(ds), consumed_samples=consumed,
+                    micro_batch_size=batch_size, data_parallel_rank=rank,
+                    data_parallel_size=world,
+                    epoch_seed=getattr(self.args, "seed", 42))
+            else:
+                sampler = PretrainingSampler(
+                    total_samples=len(ds), consumed_samples=consumed,
+                    micro_batch_size=batch_size, data_parallel_rank=rank,
+                    data_parallel_size=world)
+        else:
+            sampler = _SimpleBatchSampler(
+                len(ds), batch_size, rank, world, shuffle,
+                seed=getattr(self.args, "seed", 42))
+        return DataLoader(ds, sampler, self.collate_fn,
+                          global_batch_size=batch_size * world)
+
+    def train_dataloader(self):
+        return self._make_loader(
+            getattr(self.args, "train_datasets_field", "train"),
+            getattr(self.args, "train_batchsize", 16),
+            resumable=True, shuffle=True)
+
+    def val_dataloader(self):
+        field = getattr(self.args, "val_datasets_field", "validation")
+        if not self._has_split(field):
+            return None
+        return self._make_loader(field,
+                                 getattr(self.args, "val_batchsize", 16),
+                                 resumable=False, shuffle=False)
+
+    def test_dataloader(self):
+        field = getattr(self.args, "test_datasets_field", "test")
+        if not self._has_split(field):
+            return None
+        return self._make_loader(field,
+                                 getattr(self.args, "test_batchsize", 16),
+                                 resumable=False, shuffle=False)
+
+    def _has_split(self, field: str) -> bool:
+        try:
+            return field in self.datasets and \
+                self.datasets[field] is not None
+        except TypeError:
+            return False

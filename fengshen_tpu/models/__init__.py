@@ -1,0 +1,6 @@
+"""Model zoo (reference: fengshen/models/ — 25 sub-packages, SURVEY.md §2.5).
+
+Each family lives in its own subpackage with an HF-style ``XConfig`` +
+flax module + torch→jax weight importer. Shared optimizer/scheduler
+factories live in ``model_utils`` (reference: fengshen/models/model_utils.py).
+"""

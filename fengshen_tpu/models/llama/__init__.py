@@ -1,0 +1,8 @@
+"""LLaMA / Ziya family (reference: fengshen/models/llama/ — the reference's
+only tensor-parallel model, SURVEY.md §2.5)."""
+
+from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
+from fengshen_tpu.models.llama.modeling_llama import (LlamaModel,
+                                                      LlamaForCausalLM)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]

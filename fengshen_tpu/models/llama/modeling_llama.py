@@ -1,0 +1,309 @@
+"""LLaMA in flax, TPU-first.
+
+Functional parity with the reference's TP LLaMA
+(reference: fengshen/models/llama/modeling_llama.py:97-405, built from
+megatron ``Embedding`` + ``ParallelTransformerLayer`` + ``ParallelLinear``):
+RMSNorm pre-norm, rotary, SwiGLU with `multiple_of` rounding, causal LM head,
+KV-cache generation. The Megatron TP layer classes collapse into
+PARTITION_RULES below — GSPMD inserts the collectives the reference coded as
+autograd Functions (SURVEY.md §2.1), and `parallel_output` (reference:
+modeling_llama.py:246-264) disappears: the loss consumes sharded logits via
+vocab-parallel CE.
+
+Parameter naming matches HF's LlamaForCausalLM so torch checkpoints import
+by path mapping (see convert.py), replacing the reference's offline TP
+resharding scripts (reference: fengshen/utils/llama_convert/*, SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.masks import causal_mask
+from fengshen_tpu.ops.norms import RMSNorm
+from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+#: Megatron-equivalent sharding layout (reference: mpu/layers.py:55-470 —
+#: vocab-parallel embedding, column-parallel QKV/gate/up, row-parallel
+#: o_proj/down). flax Dense kernels are [in, out]: column-parallel shards
+#: out ('tensor'), row-parallel shards in ('tensor'); 'fsdp' takes the
+#: other dim (ZeRO-3-style param sharding).
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("embed_tokens/embedding", P("tensor", "fsdp")),
+    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", P("fsdp", "tensor")),
+    (r"(o_proj|down_proj)/kernel", P("tensor", "fsdp")),
+    ("lm_head/kernel", P("fsdp", "tensor")),
+    ("norm", P(None)),
+    (".*", P(None)),
+]
+
+#: rules for scan_layers=True — stacked layer params carry a leading [L]
+#: dim, so the layer-internal dims shift right by one
+SCAN_PARTITION_RULES: list[tuple[str, P]] = [
+    ("embed_tokens/embedding", P("tensor", "fsdp")),
+    (r"layers/.*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel",
+     P(None, "fsdp", "tensor")),
+    (r"layers/.*(o_proj|down_proj)/kernel", P(None, "tensor", "fsdp")),
+    ("lm_head/kernel", P("fsdp", "tensor")),
+    ("norm", P(None)),
+    (".*", P(None)),
+]
+
+
+def _dt(config: LlamaConfig):
+    return jnp.dtype(config.dtype)
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU (reference: LLaMAParallelMLP,
+    fengshen/models/megatron/layers/transformer.py:571-623)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        inter = cfg.intermediate_size
+        if inter is None:
+            # 2/3·4h rounded up to multiple_of (reference: :589-590)
+            inter = int(2 * 4 * cfg.hidden_size / 3)
+            inter = cfg.multiple_of * (
+                (inter + cfg.multiple_of - 1) // cfg.multiple_of)
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        gate = dense(inter, "gate_proj")(x)
+        up = dense(inter, "up_proj")(x)
+        h = nn.silu(gate) * up
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        return dense(cfg.hidden_size, "down_proj")(h)
+
+
+class LlamaAttention(nn.Module):
+    """Rotary MHA/GQA with KV cache (reference: ParallelSelfAttention,
+    fengshen/models/megatron/layers/transformer.py:175-568; KV-cache concat
+    for generation at :529-537)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, position_ids=None,
+                 init_cache: bool = False, deterministic: bool = True):
+        cfg = self.config
+        n_heads, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
+        head_dim = cfg.head_dim
+        batch, seq, _ = hidden.shape
+
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        q = dense(n_heads * head_dim, "q_proj")(hidden)
+        k = dense(n_kv * head_dim, "k_proj")(hidden)
+        v = dense(n_kv * head_dim, "v_proj")(hidden)
+        q = q.reshape(batch, seq, n_heads, head_dim)
+        k = k.reshape(batch, seq, n_kv, head_dim)
+        v = v.reshape(batch, seq, n_kv, head_dim)
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        q, k = apply_rotary_pos_emb(q, k, position_ids, base=cfg.rope_theta)
+
+        is_decode = self.has_variable("cache", "cached_key") or init_cache
+        if is_decode:
+            k, v, mask = self._update_cache(k, v, attention_mask)
+            mask = mask[:, None]  # [B, 1, Sq, max_len]
+        else:
+            mask = causal_mask(seq, k.shape[1])[None, None]
+            if attention_mask is not None:
+                mask = mask & attention_mask[:, None, None, :].astype(bool)
+
+        if n_kv != n_heads:  # GQA: repeat kv heads
+            rep = n_heads // n_kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        impl = cfg.attention_impl
+        if impl in ("flash", "ring") and (mask is not None and
+                                          attention_mask is not None):
+            impl = "dense"  # padding masks need the dense path
+        if impl in ("flash", "ring") and not is_decode:
+            if impl == "flash":
+                from fengshen_tpu.ops.flash_attention import flash_attention
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                out = dot_product_attention(q, k, v, impl="ring")
+        else:
+            out = dot_product_attention(q, k, v, mask=mask)
+
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = out.reshape(batch, seq, n_heads * head_dim)
+        return dense(cfg.hidden_size, "o_proj")(out)
+
+    def _update_cache(self, k, v, attention_mask):
+        """flax mutable-cache decode (same role as the reference's KV concat,
+        reference: transformer.py:529-537, but with static shapes for XLA:
+        the cache is preallocated at max length and updated in place)."""
+        cfg = self.config
+        batch, seq, n_kv, head_dim = k.shape
+        max_len = cfg.max_position_embeddings
+        # when the variables are being created (the init_cache=True init
+        # pass), skip the update so the returned cache starts at index 0
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (batch, max_len, n_kv, head_dim), k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (batch, max_len, n_kv, head_dim), v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+        if not is_initialized:
+            valid = jnp.broadcast_to(
+                (jnp.arange(max_len) < seq)[None, None],
+                (batch, seq, max_len))
+            return k, v, valid[:, :, :seq]
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k,
+                                             (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v,
+                                             (0, idx, 0, 0))
+        cached_k.value, cached_v.value = k_all, v_all
+        cache_index.value = idx + seq
+        # per-query causal validity: query t (global position idx+t) sees
+        # cache positions ≤ idx+t  → [B, Sq, max_len]
+        q_pos = idx + jnp.arange(seq)
+        valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+        valid = jnp.broadcast_to(valid[None], (batch, seq, max_len))
+        if attention_mask is not None:
+            # left-padded batches mask out pad positions of the prompt
+            pad = jnp.ones((attention_mask.shape[0],
+                            max_len - attention_mask.shape[1]),
+                           attention_mask.dtype)
+            full = jnp.concatenate([attention_mask, pad], axis=1)
+            valid = valid & full[:, None, :].astype(bool)
+        return k_all, v_all, valid
+
+
+class LlamaDecoderLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        h = RMSNorm(epsilon=cfg.rms_norm_eps, name="input_layernorm")(hidden)
+        h = LlamaAttention(cfg, name="self_attn")(
+            h, attention_mask, position_ids, init_cache, deterministic)
+        hidden = hidden + h
+        h = RMSNorm(epsilon=cfg.rms_norm_eps,
+                    name="post_attention_layernorm")(hidden)
+        h = LlamaMLP(cfg, name="mlp")(h)
+        return hidden + h
+
+
+class _ScanDecoderLayer(nn.Module):
+    """nn.scan body: (carry, _) → (carry, None)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, position_ids, init_cache,
+                 deterministic):
+        out = LlamaDecoderLayer(self.config, name="layer")(
+            hidden, attention_mask, position_ids, init_cache, deterministic)
+        return out, None
+
+
+class LlamaModel(nn.Module):
+    """Decoder stack (reference: fengshen/models/llama/modeling_llama.py:97-236)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         dtype=_dt(cfg),
+                         param_dtype=jnp.dtype(cfg.param_dtype),
+                         embedding_init=nn.initializers.normal(
+                             cfg.initializer_range),
+                         name="embed_tokens")
+        hidden = embed(input_ids)
+        hidden = with_sharding_constraint(
+            hidden, P(BATCH_AXES, "sequence", None))
+
+        if cfg.scan_layers:
+            body = _ScanDecoderLayer
+            if cfg.gradient_checkpointing:
+                body = nn.remat(
+                    body, static_argnums=(4, 5),
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                    prevent_cse=False)
+            scan = nn.scan(
+                body,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers)
+            hidden, _ = scan(cfg, name="layers")(
+                hidden, attention_mask, position_ids, init_cache,
+                deterministic)
+        else:
+            layer_cls = LlamaDecoderLayer
+            if cfg.gradient_checkpointing:
+                layer_cls = nn.remat(
+                    layer_cls, static_argnums=(4, 5),
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.num_hidden_layers):
+                hidden = layer_cls(cfg, name=f"layers_{i}")(
+                    hidden, attention_mask, position_ids, init_cache,
+                    deterministic)
+        return RMSNorm(epsilon=cfg.rms_norm_eps, name="norm")(hidden)
+
+
+class LlamaForCausalLM(nn.Module):
+    """LM head on the stack (reference: modeling_llama.py:239-405)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        hidden = LlamaModel(cfg, name="model")(
+            input_ids, attention_mask, position_ids, init_cache,
+            deterministic)
+        if cfg.tie_word_embeddings:
+            embedding = self.variables["params"]["model"]["embed_tokens"][
+                "embedding"]
+            logits = hidden @ embedding.T.astype(hidden.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=_dt(cfg),
+                              param_dtype=jnp.dtype(cfg.param_dtype),
+                              kernel_init=nn.initializers.normal(
+                                  cfg.initializer_range),
+                              name="lm_head")(hidden)
+        return logits
+
+    # -- convenience -----------------------------------------------------
+    def init_params(self, rng, seq_len: int = 8):
+        ids = jnp.zeros((1, seq_len), jnp.int32)
+        return self.init(rng, ids)["params"]
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES

@@ -1,0 +1,153 @@
+"""Optimizer / LR-scheduler factory and shared argparse groups.
+
+Port of the reference's shared model utilities
+(reference: fengshen/models/model_utils.py:13-209):
+- `add_module_args` — the canonical hyperparameter flag group (:13-28)
+- no-decay parameter grouping (:39-47)
+- `configure_optimizers` — optimizer + scheduler selection (:50-98)
+- schedulers: polynomial / constant / cosine + custom inverse_square_root
+  and Direct_LR passthrough (:101-192)
+- `get_total_steps` (:194-209)
+
+TPU-native differences: `optax.adamw` replaces FusedAdam/DeepSpeedCPUAdam
+(XLA already fuses the update), and "CPU offload" of optimizer state is a
+sharding/placement decision (see trainer), not a different optimizer.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+import jax
+import optax
+
+
+def add_module_args(parent_parser: argparse.ArgumentParser):
+    """Reference: fengshen/models/model_utils.py:13-28 (same flag names)."""
+    parser = parent_parser.add_argument_group("Basic Module")
+    parser.add_argument("--learning_rate", default=5e-5, type=float)
+    parser.add_argument("--min_learning_rate", default=1e-7, type=float)
+    parser.add_argument("--lr_decay_steps", default=0, type=int)
+    parser.add_argument("--lr_decay_ratio", default=1.0, type=float)
+    parser.add_argument("--warmup_steps", default=0, type=int)
+    parser.add_argument("--warmup_ratio", default=0.1, type=float)
+    parser.add_argument("--weight_decay", default=1e-1, type=float)
+    parser.add_argument("--adam_beta1", default=0.9, type=float)
+    parser.add_argument("--adam_beta2", default=0.999, type=float)
+    parser.add_argument("--adam_epsilon", default=1e-8, type=float)
+    parser.add_argument("--model_path", default=None, type=str)
+    parser.add_argument(
+        "--scheduler_type", default="polynomial", type=str,
+        choices=["polynomial", "constant", "cosine", "inverse_sqrt",
+                 "constant_with_warmup", "direct"])
+    return parent_parser
+
+
+def add_inverse_square_args(parent_parser: argparse.ArgumentParser):
+    """Reference: fengshen/models/model_utils.py:31-36."""
+    parser = parent_parser.add_argument_group("Inverse Square")
+    parser.add_argument("--warmup_min_lr", default=1e-9, type=float)
+    parser.add_argument("--warmup_max_lr", default=1e-4, type=float)
+    return parent_parser
+
+
+NO_DECAY_PATTERNS = ("bias", "scale", "layernorm", "layer_norm", "ln_",
+                     "norm")
+
+
+def decay_mask_fn(params: Any) -> Any:
+    """True where weight decay applies. Port of the no-decay grouping
+    (reference: fengshen/models/model_utils.py:39-47 — biases and LayerNorm
+    weights are excluded)."""
+    from fengshen_tpu.parallel.partition import tree_paths
+    paths = tree_paths(params)
+
+    def keep(path: str, leaf) -> bool:
+        low = path.lower()
+        if any(p in low for p in NO_DECAY_PATTERNS):
+            return False
+        return getattr(leaf, "ndim", 0) >= 2
+
+    return jax.tree_util.tree_map(keep, paths, params)
+
+
+def get_scheduler(args, total_steps: int) -> optax.Schedule:
+    """LR schedule factory (reference: fengshen/models/model_utils.py:85-192).
+
+    warmup_steps wins over warmup_ratio, as in the reference's
+    `get_warmup_steps` (:194-198).
+    """
+    lr = args.learning_rate
+    warmup = args.warmup_steps if args.warmup_steps > 0 else int(
+        args.warmup_ratio * total_steps)
+    decay_steps = args.lr_decay_steps if getattr(
+        args, "lr_decay_steps", 0) > 0 else total_steps
+    stype = getattr(args, "scheduler_type", "polynomial")
+
+    if stype == "direct":
+        # Direct_LR: constant lr, no warmup (reference custom scheduler)
+        return optax.constant_schedule(lr)
+    if stype in ("constant", "constant_with_warmup"):
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(warmup, 1)),
+             optax.constant_schedule(lr)], [warmup])
+    if stype == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup,
+            decay_steps=decay_steps,
+            end_value=getattr(args, "min_learning_rate", 0.0))
+    if stype == "inverse_sqrt":
+        warmup_min = getattr(args, "warmup_min_lr", 1e-9)
+        warmup_max = getattr(args, "warmup_max_lr", lr)
+
+        def inv_sqrt(step):
+            w = max(warmup, 1)
+            warm = warmup_min + (warmup_max - warmup_min) * (step / w)
+            decay = warmup_max * (w ** 0.5) / (jax.numpy.maximum(
+                step, 1) ** 0.5)
+            return jax.numpy.where(step < w, warm, decay)
+
+        return inv_sqrt
+    # polynomial (HF get_polynomial_decay_schedule_with_warmup parity)
+    end_lr = getattr(args, "min_learning_rate", 0.0)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, max(warmup, 1)),
+         optax.polynomial_schedule(
+             init_value=lr, end_value=end_lr, power=1.0,
+             transition_steps=max(decay_steps - warmup, 1))],
+        [warmup])
+
+
+def configure_optimizers(args, total_steps: int,
+                         params: Optional[Any] = None
+                         ) -> tuple[optax.GradientTransformation,
+                                    optax.Schedule]:
+    """Optimizer factory (reference: fengshen/models/model_utils.py:50-98).
+
+    Returns (tx, schedule). `params` enables the no-decay mask; without it
+    decay applies everywhere (callers should pass params).
+    """
+    schedule = get_scheduler(args, total_steps)
+    mask = decay_mask_fn(params) if params is not None else None
+    tx = optax.adamw(
+        learning_rate=schedule,
+        b1=getattr(args, "adam_beta1", 0.9),
+        b2=getattr(args, "adam_beta2", 0.999),
+        eps=getattr(args, "adam_epsilon", 1e-8),
+        weight_decay=getattr(args, "weight_decay", 0.0),
+        mask=mask,
+    )
+    if getattr(args, "gradient_clip_val", 0.0):
+        tx = optax.chain(
+            optax.clip_by_global_norm(args.gradient_clip_val), tx)
+    return tx, schedule
+
+
+def get_total_steps(args, dataset_len: int, world_batch: int) -> int:
+    """Total optimizer steps (reference: fengshen/models/model_utils.py:194-209,
+    mpu-aware world size folded into `world_batch` by the caller)."""
+    if getattr(args, "max_steps", 0) and args.max_steps > 0:
+        return args.max_steps
+    epochs = getattr(args, "max_epochs", 1) or 1
+    return max(1, epochs * dataset_len // max(world_batch, 1))

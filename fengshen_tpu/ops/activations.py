@@ -27,7 +27,9 @@ def geglu_split(x):
 
 
 _ACTIVATIONS: dict[str, Callable] = {
-    "gelu": jax.nn.gelu,
+    # "gelu" is the exact erf form (torch F.gelu default); the tanh
+    # approximation is "gelu_new", matching HF naming
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
     "geglu": geglu_split,
     "relu": jax.nn.relu,

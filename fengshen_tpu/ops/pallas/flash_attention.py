@@ -28,12 +28,14 @@ _NEG_INF = -1e30
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
                       acc_ref, max_ref, sum_ref,
                       *, blk_k: int, causal: bool, scale: float,
-                      n_kblocks: int):
+                      n_kblocks: int, q_offset: int):
     # q_ref/o_ref: [1, blk_q, D]; k_ref/v_ref: [1, blk_k, D]
+    # q_offset = k_len - q_len: queries are right-aligned with keys (the
+    # KV-cache decode convention, same as ops.flash_attention.blockwise)
     _, blk_q, head_dim = q_ref.shape
     q_idx = pl.program_id(1)
     kb = pl.program_id(2)
-    q_start = q_idx * blk_q
+    q_start = q_offset + q_idx * blk_q
     k_start = kb * blk_k
 
     @pl.when(kb == 0)
@@ -109,7 +111,8 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret=False):
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
 
     kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, causal=causal,
-                               scale=scale, n_kblocks=n_kblocks)
+                               scale=scale, n_kblocks=n_kblocks,
+                               q_offset=k_len - q_len)
     out = pl.pallas_call(
         kernel,
         grid=(qb.shape[0], q_len // blk_q, n_kblocks),

@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from fengshen_tpu.parallel.mesh import BATCH_AXES, SEQUENCE_AXIS, get_mesh
 
@@ -101,9 +101,16 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
         from fengshen_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal)
 
-    spec = P(BATCH_AXES, SEQUENCE_AXIS, None, None)
+    # fit the batch spec to the actual shape (init passes batch=1, which is
+    # not divisible by the batch axes — replicate instead)
+    from fengshen_tpu.parallel.partition import _spec_fits
+    spec = _spec_fits(P(BATCH_AXES, SEQUENCE_AXIS, None, None), mesh,
+                      tuple(q.shape))
+    if SEQUENCE_AXIS not in jax.tree_util.tree_leaves(tuple(spec)):
+        from fengshen_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
     fn = shard_map(
         partial(ring_attention, axis_name=SEQUENCE_AXIS, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)

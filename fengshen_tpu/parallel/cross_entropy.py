@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from fengshen_tpu.parallel.mesh import TENSOR_AXIS, get_mesh
 
@@ -92,7 +92,7 @@ def vocab_parallel_cross_entropy(logits: jax.Array, targets: jax.Array,
         mesh=mesh,
         in_specs=(logits_spec, batch_spec),
         out_specs=batch_spec,
-        check_rep=False,
+        check_vma=False,
     )(logits, targets)
 
     valid = targets != ignore_index

@@ -1,0 +1,17 @@
+"""Trainer: the jit-compiled training loop.
+
+Replaces the reference's PyTorch Lightning + DeepSpeed strategy stack
+(reference: fengshen/strategies/megatron_deepspeed.py and the Lightning
+Trainer wiring in every example, e.g.
+fengshen/examples/ziya_llama/finetune_ziya_llama.py:222-227). The
+LightningModule contract (training_step / validation_step /
+configure_optimizers / setup) maps onto ``TrainModule``; DeepSpeed ZeRO maps
+onto optimizer-state sharding over the mesh's batch axes; activation
+checkpointing maps onto ``jax.checkpoint`` policies inside the models.
+"""
+
+from fengshen_tpu.trainer.module import TrainModule
+from fengshen_tpu.trainer.train_state import TrainState
+from fengshen_tpu.trainer.trainer import Trainer, add_trainer_args
+
+__all__ = ["TrainModule", "TrainState", "Trainer", "add_trainer_args"]

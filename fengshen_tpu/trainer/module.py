@@ -1,0 +1,83 @@
+"""TrainModule — the LightningModule-equivalent contract.
+
+The reference's doctrine (reference: fengshen/README.md:70-78) is that every
+workload is a LightningDataModule + LightningModule + callbacks. The
+TPU-native contract keeps the same shape but is functional: the module owns
+the flax model, the loss, the partition rules, and the optimizer config; the
+Trainer owns jit, sharding, the step loop, checkpointing and logging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models import model_utils
+
+
+class TrainModule:
+    """Subclass and implement `init_params` and `training_loss`.
+
+    Mapping from the reference's LightningModule methods
+    (e.g. fengshen/examples/ziya_llama/finetune_ziya_llama.py:98-182):
+    - ``setup`` → ``setup`` (called once before fit)
+    - ``training_step`` → ``training_loss`` (pure: params, batch, rng →
+      (loss, metrics))
+    - ``validation_step`` → ``validation_loss``
+    - ``configure_optimizers`` → ``configure_optimizers`` (optax)
+    - checkpoint hooks → trainer-managed (orbax)
+    """
+
+    def __init__(self, args: Any):
+        self.args = args
+
+    # -- model -----------------------------------------------------------
+    def setup(self, stage: str = "fit") -> None:
+        """Build/load the model; reference loads per-TP-rank HF shards here
+        (finetune_ziya_llama.py:102-107) — we load once, resharded on
+        device_put."""
+
+    def init_params(self, rng: jax.Array) -> Any:
+        raise NotImplementedError
+
+    # -- losses ----------------------------------------------------------
+    def training_loss(self, params: Any, batch: Any, rng: jax.Array
+                      ) -> tuple[jax.Array, dict]:
+        raise NotImplementedError
+
+    def validation_loss(self, params: Any, batch: Any, rng: jax.Array
+                        ) -> tuple[jax.Array, dict]:
+        return self.training_loss(params, batch, rng)
+
+    # -- parallelism -----------------------------------------------------
+    def partition_rules(self) -> list[tuple[str, P]]:
+        """Default: replicate everything (pure data parallel)."""
+        return [(".*", P(None))]
+
+    def batch_spec(self, batch: Any) -> Any:
+        """PartitionSpec pytree for a batch; default shards dim0 over the
+        batch axes."""
+        from fengshen_tpu.parallel.partition import shard_batch_spec
+        return jax.tree_util.tree_map(
+            lambda x: shard_batch_spec(np.ndim(x)), batch)
+
+    # -- optimization ----------------------------------------------------
+    def configure_optimizers(self, total_steps: int, params: Any = None):
+        return model_utils.configure_optimizers(self.args, total_steps,
+                                                params)
+
+    # -- accounting ------------------------------------------------------
+    def flops_per_token(self) -> Optional[float]:
+        """Forward+backward FLOPs per token (6·N for dense decoders); used
+        for the MFU metric the reference never measured (SURVEY.md §5.1)."""
+        return None
+
+    def tokens_in_batch(self, batch: Any) -> int:
+        for key in ("input_ids", "tokens"):
+            if isinstance(batch, dict) and key in batch:
+                return int(np.prod(np.shape(batch[key])))
+        leaves = jax.tree_util.tree_leaves(batch)
+        return int(np.prod(np.shape(leaves[0]))) if leaves else 0

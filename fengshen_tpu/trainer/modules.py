@@ -1,0 +1,63 @@
+"""Reusable TrainModules for common objectives."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class CausalLMModule(TrainModule):
+    """Causal-LM training: shift-by-one CE with -100 label masking.
+
+    The objective of the reference's GPT2/LLaMA workloads
+    (reference: fengshen/examples/ziya_llama/finetune_ziya_llama.py:133-148,
+    loss at fengshen/models/llama/modeling_llama.py:334-339). The logits→loss
+    path uses vocab-parallel CE so TP never all-gathers the [B,S,V] logits.
+    """
+
+    def __init__(self, args: Any, model, config):
+        super().__init__(args)
+        self.model = model
+        self.config = config
+
+    def init_params(self, rng):
+        seq = min(getattr(self.args, "max_seq_length", 32), 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        labels = batch.get("labels", batch["input_ids"])
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False)
+        shifted_logits = logits[:, :-1]
+        shifted_labels = labels[:, 1:]
+        loss, n_tokens = vocab_parallel_cross_entropy(
+            shifted_logits, shifted_labels)
+        acc = (shifted_logits.argmax(-1) == shifted_labels)
+        valid = shifted_labels != -100
+        acc = (acc * valid).sum() / jnp.maximum(valid.sum(), 1)
+        return loss, {"acc": acc, "n_tokens": n_tokens}
+
+    def partition_rules(self):
+        if hasattr(self.model, "partition_rules"):
+            return self.model.partition_rules()
+        return super().partition_rules()
+
+    def flops_per_token(self) -> Optional[float]:
+        cfg = self.config
+        if hasattr(cfg, "hidden_size") and hasattr(cfg, "num_hidden_layers"):
+            h, l = cfg.hidden_size, cfg.num_hidden_layers
+            inter = getattr(cfg, "intermediate_size", 4 * h) or 4 * h
+            v = getattr(cfg, "vocab_size", 0)
+            per_layer = 4 * h * h + 2 * h * inter + h * inter
+            return 6.0 * (l * per_layer + h * v)
+        return None

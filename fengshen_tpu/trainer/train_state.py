@@ -1,0 +1,66 @@
+"""Train state pytree + sharded initialisation."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fengshen_tpu.parallel.partition import (match_partition_rules,
+                                             make_shardings)
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter + params + optimizer state.
+
+    The reference's equivalent is the DeepSpeedEngine wrapping module +
+    FusedAdam (reference: fengshen/strategies/megatron_deepspeed.py:302-320);
+    here it is a plain pytree so jit/pjit can shard and donate it.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state,
+                                                self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)
+
+    @classmethod
+    def create(cls, apply_fn, params, tx):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+
+
+def state_shardings(rules, state: Any, mesh: Mesh):
+    """NamedShardings for a whole TrainState (or its eval_shape).
+
+    Matching runs on flattened paths, so optimizer-state entries (mu/nu
+    mirror the param tree with param names embedded in the path) pick up the
+    same specs as their parameters — this is the ZeRO analog: optimizer
+    moments shard wherever the weights shard, plus whatever the rules put on
+    the batch axes (reference capability: DeepSpeed ZeRO stages 1-3,
+    fengshen/strategies/megatron_deepspeed.py:55-104).
+    """
+    return make_shardings(match_partition_rules(rules, state), state, mesh)
+
+
+def create_sharded_state(init_fn: Callable[[], TrainState], rules,
+                         mesh: Mesh) -> tuple[TrainState, Any]:
+    """jit `init_fn` with out_shardings from `rules` so parameters are
+    created directly on their target devices (never materialised on one
+    host — the analog of the reference's CPU-vs-GPU init switch,
+    reference: fengshen/models/megatron/mpu/initialize.py:47-54)."""
+    abstract = jax.eval_shape(init_fn)
+    shardings = state_shardings(rules, abstract, mesh)
+    state = jax.jit(init_fn, out_shardings=shardings)()
+    return state, shardings

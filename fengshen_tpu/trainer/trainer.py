@@ -1,0 +1,279 @@
+"""The Trainer: sharded jit train loop with accumulation, logging, ckpt.
+
+Replaces `pl.Trainer` + DeepSpeedStrategy
+(reference: fengshen/strategies/megatron_deepspeed.py; Lightning flag surface
+via `Trainer.add_argparse_args` used in every example,
+e.g. fengshen/examples/ziya_llama/finetune_ziya_llama.py:191). The argparse
+group below keeps the reference's flag names so example scripts port
+unchanged (SURVEY.md §5.6 UX-preservation requirement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fengshen_tpu.parallel.mesh import MeshConfig, make_mesh, set_mesh
+from fengshen_tpu.parallel.partition import make_shardings
+from fengshen_tpu.trainer.module import TrainModule
+from fengshen_tpu.trainer.train_state import (TrainState,
+                                              create_sharded_state,
+                                              state_shardings)
+
+#: peak bf16 FLOP/s per chip, for MFU (the metric BASELINE.md demands and
+#: the reference never measured)
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def add_trainer_args(parent_parser: argparse.ArgumentParser):
+    """Lightning-Trainer-compatible flag subset actually used by the
+    reference examples (SURVEY.md §2.9 pattern)."""
+    parser = parent_parser.add_argument_group("Trainer")
+    parser.add_argument("--max_steps", default=-1, type=int)
+    parser.add_argument("--max_epochs", default=1, type=int)
+    parser.add_argument("--val_check_interval", default=0, type=float,
+                        help="steps between validation runs (0 = per epoch)")
+    parser.add_argument("--limit_val_batches", default=0, type=int)
+    parser.add_argument("--log_every_n_steps", default=10, type=int)
+    parser.add_argument("--accumulate_grad_batches", default=1, type=int)
+    parser.add_argument("--gradient_clip_val", default=0.0, type=float)
+    parser.add_argument("--precision", default="bf16", type=str,
+                        choices=["bf16", "fp32", "16", "32", "bf16-mixed"])
+    parser.add_argument("--seed", default=42, type=int)
+    parser.add_argument("--default_root_dir", default="./runs", type=str)
+    # mesh flags (replaces strategy=... + DeepSpeed JSON)
+    MeshConfig.add_argparse_args(parent_parser)
+    return parent_parser
+
+
+class Trainer:
+    def __init__(self, args: Any, mesh_config: Optional[MeshConfig] = None,
+                 logger: Optional[Any] = None):
+        self.args = args
+        self.mesh_config = mesh_config or MeshConfig.from_argparse_args(args)
+        self.mesh = make_mesh(self.mesh_config)
+        set_mesh(self.mesh)
+        self.logger = logger
+        self.global_step = 0
+        self.consumed_samples = 0
+        self.callbacks: list = []
+        self._log_path = os.path.join(
+            getattr(args, "default_root_dir", "./runs"), "metrics.jsonl")
+
+    # -- step compilation ------------------------------------------------
+    def _build_train_step(self, module: TrainModule, state_sh, batch_spec):
+        accum = max(int(getattr(self.args, "accumulate_grad_batches", 1)), 1)
+        mesh = self.mesh
+
+        def loss_fn(params, batch, rng):
+            loss, metrics = module.training_loss(params, batch, rng)
+            return loss, metrics
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def train_step(state: TrainState, batch, rng):
+            rng = jax.random.fold_in(rng, state.step)
+            if accum == 1:
+                (loss, metrics), grads = grad_fn(state.params, batch, rng)
+            else:
+                def micro(carry, mb):
+                    acc_grads, acc_loss, i = carry
+                    (l, m), g = grad_fn(state.params, mb,
+                                        jax.random.fold_in(rng, i))
+                    acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, g)
+                    return (acc_grads, acc_loss + l, i + 1), m
+
+                batch = jax.tree_util.tree_map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum) +
+                                        x.shape[1:]), batch)
+                zero = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                (grads, loss, _), metrics = jax.lax.scan(
+                    micro, (zero, 0.0, 0), batch)
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
+                metrics = jax.tree_util.tree_map(
+                    lambda m: m.mean() if jnp.issubdtype(m.dtype,
+                                                         jnp.floating)
+                    else m[-1], metrics)
+            grad_norm = optax.global_norm(grads)
+            new_state = state.apply_gradients(grads)
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = grad_norm
+            return new_state, metrics
+
+        batch_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), batch_spec,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(
+            train_step,
+            in_shardings=(state_sh, batch_shardings, None),
+            out_shardings=(state_sh, None),
+            donate_argnums=(0,),
+        ), batch_shardings
+
+    # -- fit -------------------------------------------------------------
+    def fit(self, module: TrainModule, datamodule) -> TrainState:
+        args = self.args
+        module.setup("fit")
+        # wire the datamodule so resumable samplers can read
+        # consumed_samples (reference: universal_datamodule.py:8-17)
+        datamodule.trainer = self
+        rng = jax.random.PRNGKey(getattr(args, "seed", 42))
+
+        meta_loader = datamodule.train_dataloader()
+        dataset_len = getattr(meta_loader, "num_samples",
+                              None) or len(meta_loader)
+        world_batch = getattr(meta_loader, "global_batch_size", 1)
+        from fengshen_tpu.models.model_utils import get_total_steps
+        total_steps = get_total_steps(args, dataset_len, world_batch)
+
+        # build sharded state (peek never advances the stateful sampler)
+        sample_batch = meta_loader.peek() if hasattr(meta_loader, "peek") \
+            else next(iter(meta_loader))
+        rules = module.partition_rules()
+
+        def init_fn():
+            params = module.init_params(rng)
+            tx, _ = module.configure_optimizers(total_steps, params)
+            return TrainState.create(
+                apply_fn=getattr(module, "model", None) and
+                module.model.apply or (lambda *a, **k: None),
+                params=params, tx=tx)
+
+        state, state_sh = create_sharded_state(init_fn, rules, self.mesh)
+        _, self._schedule = module.configure_optimizers(total_steps,
+                                                        state.params)
+
+        # restore (updates self.global_step / self.consumed_samples)
+        ckpt_cb = next((c for c in self.callbacks
+                        if hasattr(c, "maybe_restore")), None)
+        if ckpt_cb is not None:
+            state = ckpt_cb.maybe_restore(state, self)
+        # (re)create the train loader AFTER restore so the resumable
+        # sampler starts from the restored consumed_samples
+        train_loader = datamodule.train_dataloader()
+
+        batch_spec = module.batch_spec(sample_batch)
+        step_fn, batch_sh = self._build_train_step(module, state_sh,
+                                                   batch_spec)
+
+        n_params = sum(np.prod(p.shape) for p in
+                       jax.tree_util.tree_leaves(state.params))
+        self._log({"event": "fit_start", "n_params": int(n_params),
+                   "total_steps": int(total_steps),
+                   "mesh": dict(self.mesh.shape)})
+
+        flops_per_tok = module.flops_per_token() or 6.0 * float(n_params)
+        peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, None)
+        max_steps = getattr(args, "max_steps", -1)
+        if max_steps is None or max_steps <= 0:
+            max_steps = total_steps
+        log_every = max(int(getattr(args, "log_every_n_steps", 10)), 1)
+        val_interval = int(getattr(args, "val_check_interval", 0) or 0)
+
+        t_last = time.perf_counter()
+        tokens_since = 0
+        epoch = 0
+        done = False
+        while not done:
+            if hasattr(train_loader, "set_epoch"):
+                train_loader.set_epoch(epoch)
+            for batch in train_loader:
+                device_batch = jax.device_put(batch, batch_sh)
+                state, metrics = step_fn(state, device_batch, rng)
+                self.global_step = int(self.global_step) + 1
+                self.consumed_samples += world_batch
+                tokens_since += module.tokens_in_batch(batch)
+
+                if self.global_step % log_every == 0:
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    now = time.perf_counter()
+                    dt = now - t_last
+                    tps = tokens_since / dt if dt > 0 else 0.0
+                    entry = {"step": self.global_step,
+                             "lr": float(self._schedule(self.global_step)),
+                             "tokens_per_sec": tps,
+                             "consumed_samples": self.consumed_samples,
+                             **metrics}
+                    if peak:
+                        entry["mfu"] = (tps * flops_per_tok /
+                                        (peak * len(jax.devices())))
+                    self._log(entry)
+                    t_last, tokens_since = now, 0
+
+                if val_interval and self.global_step % val_interval == 0:
+                    self._run_validation(module, datamodule, state, rng)
+                for cb in self.callbacks:
+                    if hasattr(cb, "on_train_step_end"):
+                        cb.on_train_step_end(self, state)
+                if self.global_step >= max_steps:
+                    done = True
+                    break
+            epoch += 1
+            if getattr(args, "max_epochs", 1) and \
+                    epoch >= max(getattr(args, "max_epochs", 1), 1):
+                done = True
+            if not val_interval:
+                self._run_validation(module, datamodule, state, rng)
+
+        for cb in self.callbacks:
+            if hasattr(cb, "on_fit_end"):
+                cb.on_fit_end(self, state)
+        self._log({"event": "fit_end", "step": self.global_step})
+        return state
+
+    # -- validation ------------------------------------------------------
+    def _run_validation(self, module, datamodule, state, rng):
+        loader = getattr(datamodule, "val_dataloader", lambda: None)()
+        if loader is None:
+            return
+        losses, limit = [], getattr(self.args, "limit_val_batches", 0)
+        # cache the compiled val step across invocations
+        if getattr(self, "_val_fn_module", None) is not module:
+            self._val_fn = jax.jit(module.validation_loss)
+            self._val_fn_module = module
+        val_fn = self._val_fn
+        for i, batch in enumerate(loader):
+            if limit and i >= limit:
+                break
+            loss, _ = val_fn(state.params, batch, rng)
+            losses.append(float(loss))
+        if losses:
+            self._log({"step": self.global_step,
+                       "val_loss": float(np.mean(losses))})
+
+    # -- logging ---------------------------------------------------------
+    def _log(self, entry: dict) -> None:
+        if jax.process_index() != 0:
+            return
+        os.makedirs(os.path.dirname(self._log_path), exist_ok=True)
+        with open(self._log_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        msg = " ".join(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                       for k, v in entry.items())
+        print(f"[fengshen-tpu] {msg}", flush=True)
+        if self.logger is not None and hasattr(self.logger, "log_metrics"):
+            self.logger.log_metrics(
+                {k: v for k, v in entry.items()
+                 if isinstance(v, (int, float))},
+                step=entry.get("step"))

@@ -204,3 +204,26 @@ def test_attention_ring_impl_no_mesh_falls_back(qkv):
     ref = _ref_attention(q, k, v, make_attention_bias(
         causal_mask(16)[None, None]))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_pallas_flash_decode_alignment_interpret():
+    # q_len < k_len must use right-aligned (decode) causal convention,
+    # matching blockwise_attention
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 8, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
+    ref = blockwise_attention(q, k, v, causal=True, block_size=8)
+    out = pallas_flash_attention(q, k, v, True, 8, 8, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_gelu_exact_vs_tanh():
+    x = jnp.linspace(-3, 3, 64)
+    exact = get_activation("gelu")(x)
+    import scipy.special as sp
+    ref = np.asarray(x) * 0.5 * (1 + sp.erf(np.asarray(x) / np.sqrt(2)))
+    np.testing.assert_allclose(np.asarray(exact), ref, atol=1e-6)
+    approx = get_activation("gelu_new")(x)
+    assert float(jnp.abs(exact - approx).max()) > 1e-5
