@@ -1,0 +1,14 @@
+"""Pretraining sample-construction utilities
+(reference: fengshen/data/data_utils/)."""
+
+from fengshen_tpu.data.data_utils.sentence_split import ChineseSentenceSplitter
+from fengshen_tpu.data.data_utils.sop_utils import get_a_and_b_segments
+from fengshen_tpu.data.data_utils.truncate_utils import truncate_segments
+from fengshen_tpu.data.data_utils.token_type_utils import (
+    create_tokens_and_tokentypes)
+from fengshen_tpu.data.data_utils.mask_utils import (
+    create_masked_lm_predictions, MaskedLmInstance)
+
+__all__ = ["ChineseSentenceSplitter", "get_a_and_b_segments",
+           "truncate_segments", "create_tokens_and_tokentypes",
+           "create_masked_lm_predictions", "MaskedLmInstance"]

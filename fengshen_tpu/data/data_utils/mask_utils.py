@@ -1,0 +1,128 @@
+"""Masked-LM sample construction with whole-word masking.
+
+Behavioural port of the reference's MLM utilities
+(reference: fengshen/data/data_utils/mask_utils.py:18-285
+`create_masked_lm_predictions` — whole-word masking via jieba for Chinese,
+bert- and t5-style masking). 80/10/10 mask/random/keep split for bert style;
+t5 style replaces each chosen span with a growing mask (handled by the T5
+data module on top of the span selection here).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Optional
+
+MaskedLmInstance = collections.namedtuple("MaskedLmInstance",
+                                          ["index", "label"])
+
+
+def is_start_piece(piece: str) -> bool:
+    """WordPiece continuation check (##-prefix convention)."""
+    return not piece.startswith("##")
+
+
+def whole_word_spans(tokens: list[str],
+                     vocab_id_to_token: Optional[dict] = None,
+                     zh_tokenizer: Optional[Callable] = None
+                     ) -> list[list[int]]:
+    """Group token indices into maskable word units.
+
+    For Chinese, each wordpiece is a character; jieba word segmentation over
+    the reconstructed text groups adjacent characters into words
+    (reference: mask_utils.py whole-word masking via jieba).
+    """
+    if zh_tokenizer is not None:
+        text = "".join(t[2:] if t.startswith("##") else t for t in tokens)
+        words = list(zh_tokenizer(text))
+        spans: list[list[int]] = []
+        ti = 0
+        for w in words:
+            span: list[int] = []
+            consumed = 0
+            while ti < len(tokens) and consumed < len(w):
+                piece = tokens[ti]
+                plain = piece[2:] if piece.startswith("##") else piece
+                span.append(ti)
+                consumed += len(plain)
+                ti += 1
+            if span:
+                spans.append(span)
+        while ti < len(tokens):  # tail safety
+            spans.append([ti])
+            ti += 1
+        return spans
+
+    spans = []
+    for i, tok in enumerate(tokens):
+        if is_start_piece(tok) or not spans:
+            spans.append([i])
+        else:
+            spans[-1].append(i)
+    return spans
+
+
+def create_masked_lm_predictions(
+        tokens: list[int],
+        vocab_id_list: list[int],
+        vocab_id_to_token_dict: dict,
+        masked_lm_prob: float,
+        cls_id: int, sep_id: int, mask_id: int,
+        max_predictions_per_seq: int,
+        np_rng,
+        masking_style: str = "bert",
+        zh_tokenizer: Optional[Callable] = None,
+        do_whole_word_mask: bool = True,
+        ) -> tuple[list[int], list[int], list[int]]:
+    """Returns (output_tokens, masked_positions, masked_labels).
+
+    Reference contract: fengshen/data/data_utils/mask_utils.py:18-285.
+    """
+    special = {cls_id, sep_id}
+    token_strs = [vocab_id_to_token_dict.get(t, str(t)) for t in tokens]
+
+    # candidate word units (skip specials)
+    if do_whole_word_mask:
+        units = whole_word_spans(token_strs, vocab_id_to_token_dict,
+                                 zh_tokenizer)
+        cand_units = [u for u in units
+                      if all(tokens[i] not in special for i in u)]
+    else:
+        cand_units = [[i] for i, t in enumerate(tokens) if t not in special]
+
+    num_to_predict = min(
+        max_predictions_per_seq,
+        max(1, int(round(len(tokens) * masked_lm_prob))))
+
+    order = np_rng.permutation(len(cand_units))
+    output = list(tokens)
+    masked: list[MaskedLmInstance] = []
+    covered: set[int] = set()
+    for ui in order:
+        unit = cand_units[int(ui)]
+        if len(masked) + len(unit) > num_to_predict:
+            continue
+        if any(i in covered for i in unit):
+            continue
+        covered.update(unit)
+        for i in unit:
+            masked.append(MaskedLmInstance(index=i, label=tokens[i]))
+            if masking_style == "bert":
+                r = np_rng.random()
+                if r < 0.8:
+                    output[i] = mask_id
+                elif r < 0.9:
+                    output[i] = int(vocab_id_list[
+                        np_rng.randint(0, len(vocab_id_list))])
+                # else keep original
+            elif masking_style == "t5":
+                output[i] = mask_id
+            else:
+                raise ValueError(f"unknown masking style {masking_style!r}")
+        if len(masked) >= num_to_predict:
+            break
+
+    masked.sort(key=lambda x: x.index)
+    positions = [m.index for m in masked]
+    labels = [m.label for m in masked]
+    return output, positions, labels

@@ -1,0 +1,30 @@
+"""Chinese sentence splitting
+(reference: fengshen/data/data_utils/sentence_split.py:4 —
+`ChineseSentenceSplitter`)."""
+
+from __future__ import annotations
+
+import re
+
+# sentence-final punctuation (with closing quotes attached)
+_SPLIT_PATTERN = re.compile(
+    r'([。！？\?!…]+[”’"\']?)')
+
+
+class ChineseSentenceSplitter:
+    """Split text into sentences on Chinese terminal punctuation, keeping
+    the punctuation attached to its sentence."""
+
+    def tokenize(self, text: str) -> list[str]:
+        pieces = _SPLIT_PATTERN.split(text)
+        sentences: list[str] = []
+        for i in range(0, len(pieces) - 1, 2):
+            sent = (pieces[i] + pieces[i + 1]).strip()
+            if sent:
+                sentences.append(sent)
+        tail = pieces[-1].strip() if len(pieces) % 2 == 1 else ""
+        if tail:
+            sentences.append(tail)
+        return sentences
+
+    __call__ = tokenize

@@ -1,0 +1,24 @@
+"""Pair truncation
+(reference: fengshen/data/data_utils/truncate_utils.py `truncate_segments`)."""
+
+from __future__ import annotations
+
+
+def truncate_segments(tokens_a: list, tokens_b: list, len_a: int, len_b: int,
+                      max_num_tokens: int, np_rng) -> bool:
+    """Trim the pair to max_num_tokens, randomly from front or back of the
+    longer segment each round. Returns True if anything was truncated."""
+    truncated = False
+    while len_a + len_b > max_num_tokens:
+        if len_a > len_b:
+            tokens, length = tokens_a, len_a
+            len_a -= 1
+        else:
+            tokens, length = tokens_b, len_b
+            len_b -= 1
+        if np_rng.random() < 0.5:
+            del tokens[0]
+        else:
+            tokens.pop()
+        truncated = True
+    return truncated

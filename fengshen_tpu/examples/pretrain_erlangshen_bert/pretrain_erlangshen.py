@@ -1,0 +1,185 @@
+"""Erlangshen-MegatronBert pretraining: MLM (whole-word, jieba) + SOP.
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_erlangshen_bert/
+pretrain_erlangshen.py:35-237): the ErLangShenCollator pipeline
+(ChineseSentenceSplitter → SOP pairing → truncation → [CLS]/[SEP] assembly →
+whole-word MLM → padding with -100 labels) and a pretrain module whose loss
+is MLM CE + sentence-order CE. Run:
+
+    python -m fengshen_tpu.examples.pretrain_erlangshen_bert.pretrain_erlangshen \
+        --train_file corpus.json --model_path <bert-dir> --max_steps 1000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.data_utils import (ChineseSentenceSplitter,
+                                          create_masked_lm_predictions,
+                                          create_tokens_and_tokentypes,
+                                          get_a_and_b_segments,
+                                          truncate_segments)
+from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
+                                               MegatronBertForPreTraining)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class ErLangShenCollator:
+    """text → MLM+SOP sample (reference: pretrain_erlangshen.py:35-123)."""
+
+    tokenizer: Any
+    max_seq_length: int = 512
+    masked_lm_prob: float = 0.15
+    content_key: str = "text"
+    seed: int = 42
+    zh_tokenizer: Optional[Any] = None
+
+    def __post_init__(self):
+        self.splitter = ChineseSentenceSplitter()
+        self.np_rng = np.random.RandomState(self.seed)
+        if self.zh_tokenizer is None:
+            try:
+                import jieba
+                self.zh_tokenizer = jieba.lcut
+            except ImportError:
+                self.zh_tokenizer = None
+        vocab = self.tokenizer.get_vocab()
+        self.vocab_id_list = list(vocab.values())
+        self.vocab_id_to_token = {v: k for k, v in vocab.items()}
+        self.cls_id = self.tokenizer.cls_token_id
+        self.sep_id = self.tokenizer.sep_token_id
+        self.mask_id = self.tokenizer.mask_token_id
+        self.pad_id = self.tokenizer.pad_token_id or 0
+
+    def _encode_sentences(self, text: str) -> list[list[int]]:
+        sentences = self.splitter.tokenize(text)
+        return [self.tokenizer.encode(s, add_special_tokens=False)
+                for s in sentences if s]
+
+    def __call__(self, samples: list[dict]) -> dict:
+        batch = {"input_ids": [], "attention_mask": [], "token_type_ids": [],
+                 "labels": [], "next_sentence_label": []}
+        max_len = self.max_seq_length
+        for sample in samples:
+            sents = self._encode_sentences(sample[self.content_key])
+            sents = [s for s in sents if s]
+            if len(sents) < 2:  # single sentence: split in half for SOP
+                flat = sents[0] if sents else [self.mask_id]
+                half = max(len(flat) // 2, 1)
+                sents = [flat[:half], flat[half:] or [flat[-1]]]
+            a, b, is_random = get_a_and_b_segments(sents, self.np_rng)
+            truncate_segments(a, b, len(a), len(b), max_len - 3, self.np_rng)
+            tokens, tokentypes = create_tokens_and_tokentypes(
+                a, b, self.cls_id, self.sep_id)
+            masked_tokens, positions, labels = create_masked_lm_predictions(
+                tokens, self.vocab_id_list, self.vocab_id_to_token,
+                self.masked_lm_prob, self.cls_id, self.sep_id, self.mask_id,
+                max_predictions_per_seq=int(
+                    self.masked_lm_prob * max_len) + 1,
+                np_rng=self.np_rng, zh_tokenizer=self.zh_tokenizer)
+            mlm_labels = [-100] * len(tokens)
+            for pos, label in zip(positions, labels):
+                mlm_labels[pos] = label
+
+            pad = max_len - len(masked_tokens)
+            batch["input_ids"].append(masked_tokens + [self.pad_id] * pad)
+            batch["attention_mask"].append([1] * len(masked_tokens) +
+                                           [0] * pad)
+            batch["token_type_ids"].append(tokentypes + [0] * pad)
+            batch["labels"].append(mlm_labels + [-100] * pad)
+            batch["next_sentence_label"].append(int(is_random))
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class ErLangShenBert(TrainModule):
+    """Reference: pretrain_erlangshen.py:126-197."""
+
+    def __init__(self, args, config: Optional[MegatronBertConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = MegatronBertConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = MegatronBertForPreTraining(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("Erlangshen Bert")
+        parser.add_argument("--masked_lm_prob", type=float, default=0.15)
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        mlm_logits, sop_logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            deterministic=False, rngs={"dropout": rng})
+        mlm_loss, n_tokens = stable_cross_entropy(mlm_logits,
+                                                  batch["labels"])
+        sop_loss, _ = stable_cross_entropy(
+            sop_logits[:, None, :], batch["next_sentence_label"][:, None])
+        # mlm accuracy over masked positions (reference logs mlm_acc,
+        # reference: pretrain_erlangshen.py:147-160)
+        valid = batch["labels"] != -100
+        acc = ((mlm_logits.argmax(-1) == batch["labels"]) * valid).sum() \
+            / jnp.maximum(valid.sum(), 1)
+        return mlm_loss + sop_loss, {"mlm_loss": mlm_loss,
+                                     "sop_loss": sop_loss,
+                                     "mlm_acc": acc,
+                                     "n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+    def flops_per_token(self):
+        cfg = self.config
+        per_layer = 4 * cfg.hidden_size ** 2 + \
+            2 * cfg.hidden_size * cfg.intermediate_size
+        return 6.0 * (cfg.num_hidden_layers * per_layer +
+                      cfg.hidden_size * cfg.vocab_size)
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = ErLangShenBert.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = ErLangShenCollator(tokenizer,
+                                  max_seq_length=args.max_seq_length,
+                                  masked_lm_prob=args.masked_lm_prob)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = ErLangShenBert(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

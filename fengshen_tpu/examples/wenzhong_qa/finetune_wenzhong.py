@@ -1,0 +1,89 @@
+"""Wenzhong-GPT2 causal-LM finetune (QA).
+
+Port of reference: fengshen/examples/wenzhong_qa/finetune_wenzhong.py —
+GPT2 causal finetune on question/answer json with the
+"Question:...Answer:..." format.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from fengshen_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from fengshen_tpu.trainer.modules import CausalLMModule
+
+
+@dataclass
+class WenzhongQACollator:
+    tokenizer: Any
+    max_seq_length: int = 512
+    question_key: str = "question"
+    answer_key: str = "answer"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        batch = {"input_ids": [], "attention_mask": [], "labels": []}
+        pad_id = self.tokenizer.pad_token_id or 0
+        eos_id = self.tokenizer.eos_token_id
+        for s in samples:
+            text = f"Question:{s[self.question_key]} Answer:"
+            q_ids = self.tokenizer.encode(text, add_special_tokens=False)
+            a_ids = self.tokenizer.encode(str(s[self.answer_key]),
+                                          add_special_tokens=False)
+            if eos_id is not None:
+                a_ids = a_ids + [eos_id]
+            ids = (q_ids + a_ids)[: self.max_seq_length]
+            labels = ([-100] * len(q_ids) + a_ids)[: self.max_seq_length]
+            pad = self.max_seq_length - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["labels"].append(labels + [-100] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class Wenzhong(CausalLMModule):
+    def __init__(self, args, config: Optional[GPT2Config] = None):
+        if config is None and getattr(args, "model_path", None):
+            config = GPT2Config.from_pretrained(args.model_path)
+        model = GPT2LMHeadModel(config)
+        super().__init__(args, model, config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("Wenzhong QA")
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        return parent_parser
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = Wenzhong.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = WenzhongQACollator(tokenizer,
+                                  max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = Wenzhong(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Ziya-LLaMA SFT — the reference's north-star tensor-parallel workload.
+
+Port of reference: fengshen/examples/ziya_llama/finetune_ziya_llama.py:
+the LlamaSFTCollator ("<human>:" / "<bot>:" prompt format, -100-masked
+prompt labels, right padding, :35-85), the Llama LightningModule
+(:98-182), and the argparse composition (:185-230). The reference's
+DeepSpeedStrategy(tensor_model_parallel_size=8) + per-rank `part_{i}` shard
+dirs become mesh flags + one logical checkpoint resharded at load.
+
+Run (training):
+    python -m fengshen_tpu.examples.ziya_llama.finetune_ziya_llama \
+        --model_path <hf-llama-dir> --train_file sft.json \
+        --tensor_model_parallel_size 8 --max_seq_length 1024 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from fengshen_tpu.trainer.modules import CausalLMModule
+
+
+@dataclass
+class LlamaSFTCollator:
+    """Reference: finetune_ziya_llama.py:35-85 — prompt
+    '<human>:{q}\\n<bot>:{a}', prompt tokens label-masked to -100,
+    right-padded to max_seq_length."""
+
+    tokenizer: Any
+    max_seq_length: int = 1024
+    prompt_key: str = "query"
+    answer_key: str = "answer"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        batch = {"input_ids": [], "attention_mask": [], "labels": []}
+        pad_id = self.tokenizer.pad_token_id or 0
+        eos_id = self.tokenizer.eos_token_id
+        for s in samples:
+            prompt = f"<human>:{s[self.prompt_key].strip()}\n<bot>:"
+            prompt_ids = self.tokenizer.encode(prompt)
+            answer_ids = self.tokenizer.encode(
+                s[self.answer_key], add_special_tokens=False)
+            if eos_id is not None:
+                answer_ids = answer_ids + [eos_id]
+            ids = (prompt_ids + answer_ids)[: self.max_seq_length]
+            labels = ([-100] * len(prompt_ids) + answer_ids)[
+                : self.max_seq_length]
+            pad = self.max_seq_length - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["labels"].append(labels + [-100] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class Llama(CausalLMModule):
+    """Reference: finetune_ziya_llama.py:98-182."""
+
+    def __init__(self, args, config: Optional[LlamaConfig] = None):
+        if config is None and getattr(args, "model_path", None):
+            config = LlamaConfig.from_pretrained(args.model_path)
+        model = LlamaForCausalLM(config)
+        super().__init__(args, model, config)
+        self._pretrained_params = None
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("Ziya Llama")
+        parser.add_argument("--max_seq_length", type=int, default=1024)
+        parser.add_argument("--prompt_key", type=str, default="query")
+        parser.add_argument("--answer_key", type=str, default="answer")
+        return parent_parser
+
+    def setup(self, stage: str = "fit") -> None:
+        """Load pretrained HF weights once (replaces the reference's
+        per-TP-rank `part_{i}` dirs, finetune_ziya_llama.py:102-107)."""
+        path = getattr(self.args, "model_path", None)
+        if path:
+            import os
+            if any(os.path.exists(os.path.join(path, f))
+                   for f in ("pytorch_model.bin", "model.safetensors",
+                             "pytorch_model.bin.index.json",
+                             "model.safetensors.index.json")):
+                from fengshen_tpu.models.llama.convert import (
+                    load_hf_pretrained)
+                _, self._pretrained_params = load_hf_pretrained(
+                    path, self.config)
+
+    def init_params(self, rng):
+        if self._pretrained_params is not None:
+            import jax.numpy as jnp
+            dtype = jnp.dtype(self.config.param_dtype)
+            return jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, dtype), self._pretrained_params)
+        return super().init_params(rng)
+
+    def predict_step(self, params, batch, rng=None, **gen_kwargs):
+        """Reference: finetune_ziya_llama.py:155-176 → llama_generate."""
+        from fengshen_tpu.utils.generate import generate
+        return generate(self.model, params, batch["input_ids"],
+                        attention_mask=batch.get("attention_mask"),
+                        eos_token_id=self.config.eos_token_id,
+                        pad_token_id=self.config.pad_token_id,
+                        rng=rng, **gen_kwargs)
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = Llama.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = LlamaSFTCollator(tokenizer,
+                                max_seq_length=args.max_seq_length,
+                                prompt_key=args.prompt_key,
+                                answer_key=args.answer_key)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = Llama(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+"""GPT-2 / Wenzhong family.
+
+The reference uses HF GPT2 directly for Wenzhong
+(reference: fengshen/examples/wenzhong_qa/finetune_wenzhong.py); here it is
+a native flax implementation with an HF torch weight importer.
+"""
+
+from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
+from fengshen_tpu.models.gpt2.modeling_gpt2 import GPT2Model, GPT2LMHeadModel
+
+__all__ = ["GPT2Config", "GPT2Model", "GPT2LMHeadModel"]

@@ -1,0 +1,79 @@
+"""torch(HF) → jax weights for GPT-2.
+
+HF GPT2 uses Conv1D modules whose `weight` is already [in, out], so kernels
+map without transpose; LayerNorm weight→scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: GPT2Config) -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    def conv(prefix):
+        return {"kernel": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    def layer_tree(i: int) -> dict:
+        pre = f"transformer.h.{i}"
+        return {
+            "ln_1": ln(f"{pre}.ln_1"),
+            "ln_2": ln(f"{pre}.ln_2"),
+            "attn": {"c_attn": conv(f"{pre}.attn.c_attn"),
+                     "c_proj": conv(f"{pre}.attn.c_proj")},
+            "c_fc": conv(f"{pre}.mlp.c_fc"),
+            "c_proj": conv(f"{pre}.mlp.c_proj"),
+        }
+
+    params: dict = {"transformer": {
+        "wte": {"embedding": t("transformer.wte.weight")},
+        "wpe": {"embedding": t("transformer.wpe.weight")},
+        "ln_f": ln("transformer.ln_f"),
+    }}
+    if config.scan_layers:
+        import jax
+        trees = [layer_tree(i) for i in range(config.n_layer)]
+        params["transformer"]["h"] = {"block": jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *trees)}
+    else:
+        for i in range(config.n_layer):
+            params["transformer"][f"h_{i}"] = layer_tree(i)
+    return params
+
+
+def load_hf_pretrained(path: str, config: GPT2Config | None = None):
+    import glob
+    import os
+
+    import torch
+
+    config = config or GPT2Config.from_pretrained(path)
+    state: dict = {}
+    st_files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+        for f in st_files:
+            with safe_open(f, framework="pt") as sf:
+                for key in sf.keys():
+                    state[key] = sf.get_tensor(key)
+    else:
+        for f in sorted(glob.glob(os.path.join(path, "pytorch_model*.bin"))):
+            state.update(torch.load(f, map_location="cpu",
+                                    weights_only=True))
+    if not any(k.startswith("transformer.") for k in state):
+        state = {f"transformer.{k}": v for k, v in state.items()
+                 if not k.startswith("lm_head")}
+    return config, torch_to_params(state, config)

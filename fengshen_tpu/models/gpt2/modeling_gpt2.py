@@ -1,0 +1,226 @@
+"""GPT-2 in flax, HF-weight-compatible.
+
+Wenzhong is an HF GPT2 checkpoint
+(reference: fengshen/examples/wenzhong_qa/finetune_wenzhong.py uses
+GPT2LMHeadModel from transformers). Parameter paths mirror the HF torch
+layout (transformer/wte, h_{i}/attn/c_attn, ...) so state_dicts import by
+direct mapping (HF Conv1D already stores kernels [in, out] — no transpose).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.masks import causal_mask
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("wte/embedding", P("tensor", "fsdp")),
+    ("wpe/embedding", P(None, None)),
+    (r"(c_attn|c_fc)/kernel", P("fsdp", "tensor")),
+    (r"c_proj/kernel", P("tensor", "fsdp")),
+    ("ln_", P(None)),
+    (".*", P(None)),
+]
+
+SCAN_PARTITION_RULES: list[tuple[str, P]] = [
+    ("wte/embedding", P("tensor", "fsdp")),
+    ("wpe/embedding", P(None, None)),
+    (r"h/.*(c_attn|c_fc)/kernel", P(None, "fsdp", "tensor")),
+    (r"h/.*c_proj/kernel", P(None, "tensor", "fsdp")),
+    ("ln_", P(None)),
+    (".*", P(None)),
+]
+
+
+def _dt(config: GPT2Config):
+    return jnp.dtype(config.dtype)
+
+
+class GPT2Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.n_head, cfg.head_dim
+
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        qkv = dense(3 * cfg.n_embd, "c_attn")(hidden)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(batch, seq, n_head, head_dim)
+        k = k.reshape(batch, seq, n_head, head_dim)
+        v = v.reshape(batch, seq, n_head, head_dim)
+
+        is_decode = self.has_variable("cache", "cached_key") or init_cache
+        if is_decode:
+            k, v, mask = self._update_cache(k, v, attention_mask)
+            mask = mask[:, None]
+        else:
+            mask = causal_mask(seq, k.shape[1])[None, None]
+            if attention_mask is not None:
+                mask = mask & attention_mask[:, None, None, :].astype(bool)
+
+        drop_rng = None
+        if not deterministic and cfg.attn_pdrop > 0.0:
+            drop_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, mask=mask, dropout_rng=drop_rng,
+            dropout_rate=cfg.attn_pdrop, deterministic=deterministic)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = out.reshape(batch, seq, cfg.n_embd)
+        out = dense(cfg.n_embd, "c_proj")(out)
+        return nn.Dropout(cfg.resid_pdrop)(out, deterministic=deterministic)
+
+    def _update_cache(self, k, v, attention_mask):
+        cfg = self.config
+        batch, seq, n_head, head_dim = k.shape
+        max_len = cfg.n_positions
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (batch, max_len, n_head, head_dim), k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (batch, max_len, n_head, head_dim), v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+        if not is_initialized:
+            valid = jnp.broadcast_to(
+                (jnp.arange(max_len) < seq)[None, None],
+                (batch, seq, max_len))
+            return k, v, valid[:, :, :seq]
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k,
+                                             (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v,
+                                             (0, idx, 0, 0))
+        cached_k.value, cached_v.value = k_all, v_all
+        cache_index.value = idx + seq
+        q_pos = idx + jnp.arange(seq)
+        valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+        valid = jnp.broadcast_to(valid[None], (batch, seq, max_len))
+        if attention_mask is not None:
+            pad = jnp.ones((attention_mask.shape[0],
+                            max_len - attention_mask.shape[1]),
+                           attention_mask.dtype)
+            full = jnp.concatenate([attention_mask, pad], axis=1)
+            valid = valid & full[:, None, :].astype(bool)
+        return k_all, v_all, valid
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        h = LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(hidden)
+        h = GPT2Attention(cfg, name="attn")(
+            h, attention_mask, position_ids, init_cache, deterministic)
+        hidden = hidden + h
+        h = LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(hidden)
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        h = dense(cfg.inner_dim, "c_fc")(h)
+        h = get_activation(cfg.activation_function)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = dense(cfg.n_embd, "c_proj")(h)
+        h = nn.Dropout(cfg.resid_pdrop)(h, deterministic=deterministic)
+        return hidden + h
+
+
+class _ScanGPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, position_ids, init_cache,
+                 deterministic):
+        out = GPT2Block(self.config, name="block")(
+            hidden, attention_mask, position_ids, init_cache, deterministic)
+        return out, None
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        cfg = self.config
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=_dt(cfg),
+                       param_dtype=jnp.dtype(cfg.param_dtype),
+                       embedding_init=nn.initializers.normal(
+                           cfg.initializer_range), name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=_dt(cfg),
+                       param_dtype=jnp.dtype(cfg.param_dtype),
+                       embedding_init=nn.initializers.normal(
+                           cfg.initializer_range), name="wpe")
+        if position_ids is None:
+            position_ids = jnp.arange(input_ids.shape[1])[None, :]
+        hidden = wte(input_ids) + wpe(position_ids)
+        hidden = nn.Dropout(cfg.embd_pdrop)(hidden,
+                                            deterministic=deterministic)
+        hidden = with_sharding_constraint(
+            hidden, P(BATCH_AXES, "sequence", None))
+
+        if cfg.scan_layers:
+            body = _ScanGPT2Block
+            if cfg.gradient_checkpointing:
+                body = nn.remat(body, static_argnums=(4, 5),
+                                policy=jax.checkpoint_policies
+                                .nothing_saveable, prevent_cse=False)
+            scan = nn.scan(body, variable_axes={"params": 0, "cache": 0},
+                           split_rngs={"params": True, "dropout": True},
+                           in_axes=(nn.broadcast,) * 4, length=cfg.n_layer)
+            hidden, _ = scan(cfg, name="h")(
+                hidden, attention_mask, position_ids, init_cache,
+                deterministic)
+        else:
+            block_cls = GPT2Block
+            if cfg.gradient_checkpointing:
+                block_cls = nn.remat(
+                    GPT2Block, static_argnums=(4, 5),
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.n_layer):
+                hidden = block_cls(cfg, name=f"h_{i}")(
+                    hidden, attention_mask, position_ids, init_cache,
+                    deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="ln_f")(hidden)
+
+
+class GPT2LMHeadModel(nn.Module):
+    """LM head tied to wte (HF GPT2LMHeadModel semantics)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
+        hidden = GPT2Model(self.config, name="transformer")(
+            input_ids, attention_mask, position_ids, init_cache,
+            deterministic)
+        wte = self.variables["params"]["transformer"]["wte"]["embedding"]
+        return hidden @ wte.T.astype(hidden.dtype)
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES

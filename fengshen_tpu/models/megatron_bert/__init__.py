@@ -1,0 +1,19 @@
+"""MegatronBert / Erlangshen family.
+
+The reference trains Erlangshen with HF's MegatronBertForPreTraining
+(reference: fengshen/examples/pretrain_erlangshen_bert/
+pretrain_erlangshen.py:2-6,141); here it is a native flax implementation
+(pre-LN Megatron residual ordering) with an HF torch weight importer.
+"""
+
+from fengshen_tpu.models.megatron_bert.configuration_megatron_bert import (
+    MegatronBertConfig)
+from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
+    MegatronBertModel, MegatronBertForPreTraining, MegatronBertForMaskedLM,
+    MegatronBertForSequenceClassification,
+    MegatronBertForTokenClassification)
+
+__all__ = ["MegatronBertConfig", "MegatronBertModel",
+           "MegatronBertForPreTraining", "MegatronBertForMaskedLM",
+           "MegatronBertForSequenceClassification",
+           "MegatronBertForTokenClassification"]

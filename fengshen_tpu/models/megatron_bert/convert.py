@@ -1,0 +1,85 @@
+"""torch(HF) → jax weights for MegatronBert."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.megatron_bert.configuration_megatron_bert import (
+    MegatronBertConfig)
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: MegatronBertConfig,
+                    head: str = "pretraining") -> dict:
+    """Map HF MegatronBert* state_dict → flax params.
+
+    torch Linear [out, in] → kernel.T; LayerNorm weight → scale.
+    `head` ∈ {pretraining, masked_lm, sequence_classification,
+    token_classification, none}.
+    """
+
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    def layer_tree(i: int) -> dict:
+        pre = f"bert.encoder.layer.{i}"
+        return {
+            "attention_ln": ln(f"{pre}.attention.ln"),
+            "self": {"query": lin(f"{pre}.attention.self.query"),
+                     "key": lin(f"{pre}.attention.self.key"),
+                     "value": lin(f"{pre}.attention.self.value")},
+            "attention_output_dense": lin(f"{pre}.attention.output.dense"),
+            "ln": ln(f"{pre}.ln"),
+            "intermediate_dense": lin(f"{pre}.intermediate.dense"),
+            "output_dense": lin(f"{pre}.output.dense"),
+        }
+
+    bert: dict = {
+        "word_embeddings": {
+            "embedding": t("bert.embeddings.word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": t("bert.embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding": t("bert.embeddings.token_type_embeddings.weight")},
+        "ln": ln("bert.encoder.ln"),
+    }
+    if config.scan_layers:
+        import jax
+        trees = [layer_tree(i) for i in range(config.num_hidden_layers)]
+        bert["layer"] = {"block": jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *trees)}
+    else:
+        for i in range(config.num_hidden_layers):
+            bert[f"layer_{i}"] = layer_tree(i)
+    if "bert.pooler.dense.weight" in state_dict:
+        bert["pooler"] = lin("bert.pooler.dense")
+
+    params: dict = {"bert": bert}
+    if head in ("pretraining", "masked_lm") and \
+            "cls.predictions.transform.dense.weight" in state_dict:
+        params["cls_predictions"] = {
+            "transform_dense": lin("cls.predictions.transform.dense"),
+            "transform_ln": ln("cls.predictions.transform.LayerNorm"),
+            "bias": t("cls.predictions.bias"),
+        }
+    if head == "pretraining" and \
+            "cls.seq_relationship.weight" in state_dict:
+        params["cls_seq_relationship"] = lin("cls.seq_relationship")
+    if head == "sequence_classification" and "classifier.weight" in \
+            state_dict:
+        params["classifier"] = lin("classifier")
+    if head == "token_classification" and "classifier.weight" in state_dict:
+        params["classifier"] = lin("classifier")
+    return params

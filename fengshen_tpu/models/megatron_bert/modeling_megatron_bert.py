@@ -1,0 +1,284 @@
+"""MegatronBert in flax (pre-LN residual ordering), HF-weight-compatible.
+
+Layer semantics match HF's MegatronBert (itself NVIDIA Megatron-derived):
+attention = dense(self(ln(h))) + h; ffn = dense(act(dense(ln(h)))) + h; a
+final encoder LayerNorm; embeddings = word+pos+tokentype then dropout (the
+embedding LayerNorm of vanilla BERT moved into the first layer's pre-LN).
+The pretrain head is MLM + sentence-order (the reference trains SOP via its
+Erlangshen collator, reference: fengshen/examples/pretrain_erlangshen_bert/
+pretrain_erlangshen.py:35-123).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.megatron_bert.configuration_megatron_bert import (
+    MegatronBertConfig)
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", "fsdp")),
+    ("(position|token_type)_embeddings/embedding", P(None, None)),
+    (r"(query|key|value)/kernel", P("fsdp", "tensor")),
+    (r"attention/output_dense/kernel", P("tensor", "fsdp")),
+    (r"intermediate_dense/kernel", P("fsdp", "tensor")),
+    (r"output_dense/kernel", P("tensor", "fsdp")),
+    (r"(pooler|transform|seq_relationship|classifier)", P(None)),
+    ("ln", P(None)),
+    (".*", P(None)),
+]
+
+SCAN_PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", "fsdp")),
+    ("(position|token_type)_embeddings/embedding", P(None, None)),
+    (r"layer/.*(query|key|value)/kernel", P(None, "fsdp", "tensor")),
+    (r"layer/.*attention/output_dense/kernel", P(None, "tensor", "fsdp")),
+    (r"layer/.*intermediate_dense/kernel", P(None, "fsdp", "tensor")),
+    (r"layer/.*output_dense/kernel", P(None, "tensor", "fsdp")),
+    (r"(pooler|transform|seq_relationship|classifier)", P(None)),
+    ("ln", P(None)),
+    (".*", P(None)),
+]
+
+
+def _dt(config):
+    return jnp.dtype(config.dtype)
+
+
+def _dense(cfg, feats, name):
+    return nn.Dense(feats, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+class MegatronBertSelfAttention(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(cfg, cfg.hidden_size, "query")(hidden)
+        k = _dense(cfg, cfg.hidden_size, "key")(hidden)
+        v = _dense(cfg, cfg.hidden_size, "value")(hidden)
+        q = q.reshape(batch, seq, n_head, head_dim)
+        k = k.reshape(batch, seq, n_head, head_dim)
+        v = v.reshape(batch, seq, n_head, head_dim)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        drop_rng = None
+        if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
+            drop_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, mask=mask, dropout_rng=drop_rng,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            deterministic=deterministic)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        return out.reshape(batch, seq, cfg.hidden_size)
+
+
+class MegatronBertLayer(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        # attention: residual + dense(dropout(self(ln(h))))
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="attention_ln")(hidden)
+        h = MegatronBertSelfAttention(cfg, name="self")(
+            h, attention_mask, deterministic)
+        h = _dense(cfg, cfg.hidden_size, "attention_output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        hidden = hidden + h
+        # ffn: residual + dense(dropout(act(dense(ln(h)))))
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="ln")(hidden)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(h)
+        h = get_activation(cfg.hidden_act)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return hidden + h
+
+
+class _ScanBertLayer(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, deterministic):
+        out = MegatronBertLayer(self.config, name="block")(
+            hidden, attention_mask, deterministic)
+        return out, None
+
+
+class MegatronBertModel(nn.Module):
+    config: MegatronBertConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+
+        embed = lambda n, v, name: nn.Embed(  # noqa: E731
+            n, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        hidden = embed(cfg.vocab_size, cfg.hidden_size,
+                       "word_embeddings")(input_ids) \
+            + embed(cfg.max_position_embeddings, cfg.hidden_size,
+                    "position_embeddings")(position_ids) \
+            + embed(cfg.type_vocab_size, cfg.hidden_size,
+                    "token_type_embeddings")(token_type_ids)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+        hidden = with_sharding_constraint(
+            hidden, P(BATCH_AXES, "sequence", None))
+
+        if cfg.scan_layers:
+            body = _ScanBertLayer
+            if cfg.gradient_checkpointing:
+                body = nn.remat(body, static_argnums=(3,),
+                                policy=jax.checkpoint_policies
+                                .nothing_saveable, prevent_cse=False)
+            scan = nn.scan(body, variable_axes={"params": 0},
+                           split_rngs={"params": True, "dropout": True},
+                           in_axes=(nn.broadcast,) * 2,
+                           length=cfg.num_hidden_layers)
+            hidden, _ = scan(cfg, name="layer")(hidden, attention_mask,
+                                                deterministic)
+        else:
+            layer_cls = MegatronBertLayer
+            if cfg.gradient_checkpointing:
+                layer_cls = nn.remat(
+                    layer_cls, static_argnums=(3,),
+                    policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.num_hidden_layers):
+                hidden = layer_cls(cfg, name=f"layer_{i}")(
+                    hidden, attention_mask, deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps, name="ln")(hidden)
+
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+
+class MLMHead(nn.Module):
+    """cls.predictions: transform (dense+act+LN) + tied decoder + bias."""
+
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, hidden, word_embedding):
+        cfg = self.config
+        h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        logits = h @ word_embedding.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+
+class MegatronBertForPreTraining(nn.Module):
+    """MLM + sentence-order head (the Erlangshen pretrain objective)."""
+
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        hidden, pooled = MegatronBertModel(self.config, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids,
+            deterministic)
+        wte = self.variables["params"]["bert"]["word_embeddings"][
+            "embedding"]
+        mlm_logits = MLMHead(self.config, name="cls_predictions")(
+            hidden, wte)
+        sop_logits = _dense(self.config, 2, "cls_seq_relationship")(pooled)
+        return mlm_logits, sop_logits
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES
+
+
+class MegatronBertForMaskedLM(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        hidden, _ = MegatronBertModel(self.config, add_pooling_layer=False,
+                                      name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids,
+            deterministic)
+        wte = self.variables["params"]["bert"]["word_embeddings"][
+            "embedding"]
+        return MLMHead(self.config, name="cls_predictions")(hidden, wte)
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES
+
+
+class MegatronBertForSequenceClassification(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        cfg = self.config
+        _, pooled = MegatronBertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids,
+            deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(pooled)
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES
+
+
+class MegatronBertForTokenClassification(nn.Module):
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        cfg = self.config
+        hidden, _ = MegatronBertModel(cfg, add_pooling_layer=False,
+                                      name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids,
+            deterministic)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(hidden)
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES

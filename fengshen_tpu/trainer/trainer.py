@@ -79,7 +79,8 @@ class Trainer:
             getattr(args, "default_root_dir", "./runs"), "metrics.jsonl")
 
     # -- step compilation ------------------------------------------------
-    def _build_train_step(self, module: TrainModule, state_sh, batch_spec):
+    def _build_train_step(self, module: TrainModule, state_sh, batch_spec,
+                          sample_batch=None):
         accum = max(int(getattr(self.args, "accumulate_grad_batches", 1)), 1)
         mesh = self.mesh
 
@@ -121,9 +122,22 @@ class Trainer:
             metrics["grad_norm"] = grad_norm
             return new_state, metrics
 
-        batch_shardings = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(mesh, spec), batch_spec,
-            is_leaf=lambda x: isinstance(x, P))
+        # fit specs to actual shapes: a debug batch smaller than the batch
+        # axes degrades to replicated instead of erroring
+        from fengshen_tpu.parallel.partition import _spec_fits
+
+        def to_sharding(spec, leaf):
+            shape = tuple(np.shape(leaf)) if leaf is not None else ()
+            return NamedSharding(mesh, _spec_fits(spec, mesh, shape))
+
+        if sample_batch is not None:
+            batch_shardings = jax.tree_util.tree_map(
+                to_sharding, batch_spec, sample_batch,
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            batch_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), batch_spec,
+                is_leaf=lambda x: isinstance(x, P))
         return jax.jit(
             train_step,
             in_shardings=(state_sh, batch_shardings, None),
@@ -175,7 +189,7 @@ class Trainer:
 
         batch_spec = module.batch_spec(sample_batch)
         step_fn, batch_sh = self._build_train_step(module, state_sh,
-                                                   batch_spec)
+                                                   batch_spec, sample_batch)
 
         n_params = sum(np.prod(p.shape) for p in
                        jax.tree_util.tree_leaves(state.params))

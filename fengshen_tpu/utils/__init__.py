@@ -1,0 +1,10 @@
+"""Shared utilities (reference: fengshen/utils/)."""
+
+from fengshen_tpu.utils.universal_checkpoint import UniversalCheckpoint
+from fengshen_tpu.utils.generate import (top_k_logits, top_p_logits,
+                                         sample_sequence_batch, generate)
+from fengshen_tpu.utils.chinese import chinese_char_tokenize, is_chinese_char
+
+__all__ = ["UniversalCheckpoint", "top_k_logits", "top_p_logits",
+           "sample_sequence_batch", "generate", "chinese_char_tokenize",
+           "is_chinese_char"]

@@ -1,0 +1,28 @@
+"""Chinese text helpers (reference: fengshen/utils/utils.py:6-56)."""
+
+from __future__ import annotations
+
+_CJK_RANGES = (
+    (0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0x20000, 0x2A6DF),
+    (0x2A700, 0x2B73F), (0x2B740, 0x2B81F), (0x2B820, 0x2CEAF),
+    (0xF900, 0xFAFF), (0x2F800, 0x2FA1F),
+)
+
+
+def is_chinese_char(cp: int) -> bool:
+    """CJK codepoint check (reference: utils.py:20-38 — the BERT ranges)."""
+    return any(lo <= cp <= hi for lo, hi in _CJK_RANGES)
+
+
+def chinese_char_tokenize(line: str) -> str:
+    """Insert spaces around CJK chars so a word tokenizer splits them
+    (reference: utils.py:41-56)."""
+    out = []
+    for ch in line:
+        if is_chinese_char(ord(ch)):
+            out.append(" ")
+            out.append(ch)
+            out.append(" ")
+        else:
+            out.append(ch)
+    return "".join(out)

@@ -1,0 +1,126 @@
+"""UniversalCheckpoint — orbax-backed checkpoint callback.
+
+Port of the reference's Lightning ModelCheckpoint subclass
+(reference: fengshen/utils/universal_checkpoint.py:5-41): argparse-configured
+monitor/mode/save_top_k/every_n_train_steps/save_ckpt_path/load_ckpt_path,
+and the same silently-skip-missing-load behaviour (:38-41).
+
+TPU-native: one LOGICAL checkpoint of sharded arrays (orbax) instead of
+per-rank DeepSpeed engine shards — restoring onto a different mesh reshards
+automatically, which obsoletes the reference's offline TP reshard tooling
+(reference: fengshen/utils/llama_convert/convert_fs_llama_tp.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class UniversalCheckpoint:
+    @staticmethod
+    def add_argparse_args(parent_parser: argparse.ArgumentParser):
+        """Reference: universal_checkpoint.py:6-23 (same flag names)."""
+        parser = parent_parser.add_argument_group("universal checkpoint")
+        parser.add_argument("--monitor", default="step", type=str)
+        parser.add_argument("--mode", default="max", type=str)
+        parser.add_argument("--save_ckpt_path", default="./ckpt/", type=str)
+        parser.add_argument("--load_ckpt_path", default="./ckpt/", type=str)
+        parser.add_argument("--filename", default="model-{step:02d}",
+                            type=str)
+        parser.add_argument("--save_last", action="store_true", default=False)
+        parser.add_argument("--save_top_k", default=3, type=int)
+        parser.add_argument("--every_n_train_steps", default=None, type=int)
+        parser.add_argument("--save_weights_only", action="store_true",
+                            default=False)
+        parser.add_argument("--every_n_epochs", default=None, type=int)
+        parser.add_argument("--save_on_train_epoch_end", action="store_true",
+                            default=None)
+        return parent_parser
+
+    def __init__(self, args):
+        self.args = args
+        self.save_path = os.path.abspath(
+            getattr(args, "save_ckpt_path", "./ckpt/"))
+        self.load_path = getattr(args, "load_ckpt_path", None)
+        every_n = getattr(args, "every_n_train_steps", None)
+        self.every_n_train_steps = int(every_n) if every_n else 0
+        self._manager: Optional[ocp.CheckpointManager] = None
+
+    # -- manager -----------------------------------------------------------
+    def _get_manager(self) -> ocp.CheckpointManager:
+        if self._manager is None:
+            top_k = getattr(self.args, "save_top_k", 3)
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=None if top_k in (-1, None) else max(top_k, 1),
+                enable_async_checkpointing=False)
+            self._manager = ocp.CheckpointManager(self.save_path,
+                                                  options=options)
+        return self._manager
+
+    # -- save ---------------------------------------------------------------
+    def save(self, state: Any, trainer: Any) -> None:
+        step = int(trainer.global_step)
+        payload = {"params": state.params}
+        if not getattr(self.args, "save_weights_only", False):
+            payload["opt_state"] = state.opt_state
+        meta = {"global_step": step,
+                "consumed_samples": int(trainer.consumed_samples),
+                "global_samples": int(trainer.consumed_samples)}
+        self._get_manager().save(
+            step, args=ocp.args.Composite(
+                state=ocp.args.StandardSave(payload),
+                meta=ocp.args.JsonSave(meta)))
+        self._get_manager().wait_until_finished()
+
+    # -- restore -------------------------------------------------------------
+    def maybe_restore(self, state: Any, trainer: Any) -> Any:
+        """Silently skip a missing load path, exactly like the reference
+        (reference: universal_checkpoint.py:38-41)."""
+        path = self.load_path
+        if not path or not os.path.isdir(path):
+            return state
+        mgr = ocp.CheckpointManager(os.path.abspath(path))
+        step = mgr.latest_step()
+        if step is None:
+            return state
+
+        payload = {"params": state.params}
+        if not getattr(self.args, "save_weights_only", False):
+            payload["opt_state"] = state.opt_state
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=(
+                x.sharding if hasattr(x, "sharding") else None)),
+            payload)
+        restored = mgr.restore(
+            step, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore()))
+        meta = restored["meta"]
+        # restore loop counters the way the reference's on_load_checkpoint
+        # does (reference: examples/pretrain_erlangshen_bert/
+        # pretrain_erlangshen.py:192-197)
+        trainer.global_step = int(meta["global_step"])
+        trainer.consumed_samples = int(meta["consumed_samples"])
+        new = state.replace(params=restored["state"]["params"],
+                            step=jax.numpy.asarray(meta["global_step"],
+                                                   jax.numpy.int32))
+        if "opt_state" in payload and "opt_state" in restored["state"]:
+            new = new.replace(opt_state=restored["state"]["opt_state"])
+        return new
+
+    # -- trainer hooks --------------------------------------------------------
+    def on_train_step_end(self, trainer: Any, state: Any) -> None:
+        if self.every_n_train_steps and \
+                trainer.global_step % self.every_n_train_steps == 0:
+            self.save(state, trainer)
+
+    def on_fit_end(self, trainer: Any, state: Any) -> None:
+        if getattr(self.args, "save_last", False) or \
+                not self.every_n_train_steps:
+            self.save(state, trainer)
