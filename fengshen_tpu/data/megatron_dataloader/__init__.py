@@ -1,0 +1,11 @@
+"""Megatron-style indexed datasets for TB-scale corpora
+(reference: fengshen/data/megatron_dataloader/)."""
+
+from fengshen_tpu.data.megatron_dataloader.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from fengshen_tpu.data.megatron_dataloader.blendable_dataset import (
+    BlendableDataset)
+from fengshen_tpu.data.megatron_dataloader.gpt_dataset import GPTDataset
+
+__all__ = ["MMapIndexedDataset", "MMapIndexedDatasetBuilder",
+           "BlendableDataset", "GPTDataset"]

@@ -1,0 +1,34 @@
+"""Weighted multi-corpus blending
+(reference: fengshen/data/megatron_dataloader/blendable_dataset.py:26-64,
+indices built by the native `build_blending_indices`)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from fengshen_tpu.data.megatron_dataloader.helpers import (
+    build_blending_indices)
+
+
+class BlendableDataset:
+    def __init__(self, datasets: Sequence, weights: Sequence[float],
+                 size: int | None = None):
+        if len(datasets) != len(weights):
+            raise ValueError("datasets and weights length mismatch")
+        self.datasets = list(datasets)
+        if size is None:
+            size = sum(len(d) for d in datasets)
+        self.size = size
+        w = np.asarray(weights, np.float64)
+        self.dataset_index, self.dataset_sample_index = \
+            build_blending_indices(w, size)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int):
+        d = int(self.dataset_index[idx])
+        s = int(self.dataset_sample_index[idx]) % len(self.datasets[d])
+        return self.datasets[d][s]

@@ -1,0 +1,103 @@
+"""GPT pretraining dataset: contiguous-token packing over an indexed corpus.
+
+Reference: the GPT path of
+fengshen/data/megatron_dataloader/dataset_utils.py:504-788 — the
+`build_sample_idx` index plus the `.npy` cache contract of
+`get_samples_mapping` (:731-788): index maps are built once (natively),
+cached next to the data, and mmapped by every subsequent run. Unlike the
+reference (which deleted the cross-rank barrier and requires the cache to be
+prebuilt, :763-776), cache building here is atomic (tmp + rename) so
+concurrent hosts race safely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+import numpy as np
+
+from fengshen_tpu.data.megatron_dataloader.helpers import build_sample_idx
+from fengshen_tpu.data.megatron_dataloader.indexed_dataset import (
+    MMapIndexedDataset)
+
+
+class GPTDataset:
+    """Packs documents into fixed seq_length training samples."""
+
+    def __init__(self, indexed: MMapIndexedDataset, seq_length: int,
+                 seed: int = 0, num_epochs: int = 1,
+                 documents: Optional[np.ndarray] = None,
+                 cache_dir: Optional[str] = None,
+                 name: str = "gpt"):
+        self.indexed = indexed
+        self.seq_length = seq_length
+        if documents is None:
+            documents = np.arange(len(indexed.doc_idx) - 1, dtype=np.int32)
+        rng = np.random.RandomState(seed)
+
+        # shuffled document order, repeated per epoch
+        doc_idx_parts = []
+        for _ in range(num_epochs):
+            doc_idx_parts.append(rng.permutation(documents).astype(np.int32))
+        doc_order = np.concatenate(doc_idx_parts)
+
+        # document order → sequence order (documents may span sequences;
+        # here one document == one indexed sequence, doc_idx maps ranges)
+        seq_order = []
+        for d in doc_order:
+            lo, hi = int(indexed.doc_idx[d]), int(indexed.doc_idx[d + 1])
+            seq_order.extend(range(lo, hi))
+        self.seq_order = np.asarray(seq_order, np.int32)
+        sizes = np.asarray(indexed.sizes, np.int32)
+
+        self.sample_idx = self._cached_sample_idx(
+            sizes, self.seq_order, seq_length, num_epochs, seed, cache_dir,
+            name)
+
+    def _cached_sample_idx(self, sizes, seq_order, seq_length, num_epochs,
+                           seed, cache_dir, name) -> np.ndarray:
+        if cache_dir is None:
+            return build_sample_idx(sizes, seq_order, seq_length,
+                                    num_epochs,
+                                    int(sizes[seq_order].sum()))
+        key = hashlib.md5(
+            f"{name}-{seq_length}-{num_epochs}-{seed}-"
+            f"{len(seq_order)}".encode()).hexdigest()[:16]
+        cache = os.path.join(cache_dir, f"{name}_sample_idx_{key}.npy")
+        if os.path.exists(cache):
+            return np.load(cache, mmap_mode="r")
+        idx = build_sample_idx(sizes, seq_order, seq_length, num_epochs,
+                               int(sizes[seq_order].sum()))
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = cache[:-len(".npy")] + f".tmp{os.getpid()}.npy"
+        np.save(tmp, idx)
+        os.replace(tmp, cache)  # atomic: concurrent builders race safely
+        return idx
+
+    def __len__(self) -> int:
+        return len(self.sample_idx) - 1
+
+    def __getitem__(self, idx: int) -> dict:
+        doc_f, off_f = self.sample_idx[idx]
+        doc_l, off_l = self.sample_idx[idx + 1]
+        if doc_f == doc_l:
+            tokens = self.indexed.get(int(self.seq_order[doc_f]),
+                                      offset=int(off_f),
+                                      length=int(off_l - off_f))
+            parts = [tokens]
+        else:
+            parts = [self.indexed.get(int(self.seq_order[doc_f]),
+                                      offset=int(off_f))]
+            for d in range(int(doc_f) + 1, int(doc_l)):
+                parts.append(self.indexed[int(self.seq_order[d])])
+            if off_l > 0 and doc_l < len(self.seq_order):
+                parts.append(self.indexed.get(int(self.seq_order[doc_l]),
+                                              length=int(off_l)))
+        tokens = np.concatenate(parts)
+        tokens = tokens[: self.seq_length + 1]
+        if len(tokens) < self.seq_length + 1:
+            tokens = np.pad(tokens, (0, self.seq_length + 1 - len(tokens)))
+        return {"input_ids": tokens[:-1].astype(np.int32),
+                "labels": tokens[1:].astype(np.int32)}

@@ -1,0 +1,8 @@
+"""T5 pretraining data (reference: fengshen/data/t5_dataloader/)."""
+
+from fengshen_tpu.data.t5_dataloader.t5_datasets import (
+    compute_input_and_target_lengths, random_spans_noise_mask,
+    T5SpanCorruptionCollator)
+
+__all__ = ["compute_input_and_target_lengths", "random_spans_noise_mask",
+           "T5SpanCorruptionCollator"]

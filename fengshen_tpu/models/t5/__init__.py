@@ -1,0 +1,11 @@
+"""T5 / Randeng family (reference: fengshen/models/megatron_t5/ — Randeng
+encoder-decoder with Megatron-style LN placement, plus the HF-T5-based
+examples pretrain_t5/qa_t5/mt5_summary)."""
+
+from fengshen_tpu.models.t5.configuration_t5 import T5Config
+from fengshen_tpu.models.t5.modeling_t5 import (T5Model,
+                                                T5ForConditionalGeneration,
+                                                T5EncoderModel)
+
+__all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration",
+           "T5EncoderModel"]

@@ -1,0 +1,65 @@
+"""torch(HF) → jax weights for T5."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.t5.configuration_t5 import T5Config
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config: T5Config) -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T}
+
+    def stack_tree(side: str, n_layers: int, causal: bool) -> dict:
+        out: dict = {"final_layer_norm": {
+            "scale": t(f"{side}.final_layer_norm.weight")}}
+        for i in range(n_layers):
+            pre = f"{side}.block.{i}.layer"
+            blk: dict = {
+                "ln_self": {"scale": t(f"{pre}.0.layer_norm.weight")},
+                "self_attention": {
+                    proj: lin(f"{pre}.0.SelfAttention.{proj}")
+                    for proj in ("q", "k", "v", "o")},
+            }
+            if i == 0:
+                blk["self_attention"]["relative_attention_bias"] = {
+                    "embedding":
+                        t(f"{pre}.0.SelfAttention."
+                          f"relative_attention_bias.weight")}
+            ff_idx = 2 if causal else 1
+            if causal:
+                blk["ln_cross"] = {
+                    "scale": t(f"{pre}.1.layer_norm.weight")}
+                blk["cross_attention"] = {
+                    proj: lin(f"{pre}.1.EncDecAttention.{proj}")
+                    for proj in ("q", "k", "v", "o")}
+            blk["ln_ff"] = {"scale": t(f"{pre}.{ff_idx}.layer_norm.weight")}
+            ff = {}
+            if config.is_gated_act:
+                ff["wi_0"] = lin(f"{pre}.{ff_idx}.DenseReluDense.wi_0")
+                ff["wi_1"] = lin(f"{pre}.{ff_idx}.DenseReluDense.wi_1")
+            else:
+                ff["wi"] = lin(f"{pre}.{ff_idx}.DenseReluDense.wi")
+            ff["wo"] = lin(f"{pre}.{ff_idx}.DenseReluDense.wo")
+            blk["ff"] = ff
+            out[f"block_{i}"] = blk
+        return out
+
+    params: dict = {"model": {
+        "shared": {"embedding": t("shared.weight")},
+        "encoder": stack_tree("encoder", config.num_layers, causal=False),
+        "decoder": stack_tree("decoder", config.num_decoder_layers,
+                              causal=True),
+    }}
+    if not config.tie_word_embeddings and "lm_head.weight" in state_dict:
+        params["lm_head"] = {"kernel": t("lm_head.weight").T}
+    return params
