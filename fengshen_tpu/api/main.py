@@ -1,0 +1,90 @@
+"""REST serving: JSON config → pipeline → FastAPI POST endpoint.
+
+Port of reference: fengshen/API/main.py:12-75 + API/utils.py — a config
+file names the task/model/server options; the server instantiates the
+matching pipeline and exposes `POST /api/<task>`; CORS enabled; run with
+uvicorn. FastAPI/uvicorn are optional deps — gated at call time.
+
+    python -m fengshen_tpu.api.main --config text_classification.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import importlib
+import json
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """Reference: fengshen/API/utils.py config dataclasses."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    log_level: str = "info"
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    task: str = "text_classification"
+    model: Optional[str] = None
+    pipeline_args: dict = dataclasses.field(default_factory=dict)
+
+
+def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
+    with open(path) as f:
+        raw = json.load(f)
+    server = ServerConfig(**raw.get("SERVER", {}))
+    pipeline = PipelineConfig(
+        task=raw.get("PIPELINE", {}).get("task", "text_classification"),
+        model=raw.get("PIPELINE", {}).get("model"),
+        pipeline_args={k: v for k, v in raw.get("PIPELINE", {}).items()
+                       if k not in ("task", "model")})
+    return server, pipeline
+
+
+def build_app(pipeline_cfg: PipelineConfig, pipeline=None):
+    """Create the FastAPI app around a pipeline instance."""
+    from fastapi import FastAPI
+    from fastapi.middleware.cors import CORSMiddleware
+    from pydantic import BaseModel
+
+    if pipeline is None:
+        module = importlib.import_module(
+            f"fengshen_tpu.pipelines.{pipeline_cfg.task}")
+        pipeline = module.Pipeline(args=None, model=pipeline_cfg.model,
+                                   **pipeline_cfg.pipeline_args)
+
+    app = FastAPI()
+    app.add_middleware(CORSMiddleware, allow_origins=["*"],
+                       allow_methods=["*"], allow_headers=["*"])
+
+    class Request(BaseModel):
+        input_text: str
+
+    @app.post(f"/api/{pipeline_cfg.task}")
+    def run(req: Request) -> Any:
+        return {"result": pipeline(req.input_text)}
+
+    @app.get("/healthz")
+    def healthz():
+        return {"status": "ok", "task": pipeline_cfg.task}
+
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True, type=str)
+    args = parser.parse_args(argv)
+    server_cfg, pipeline_cfg = load_config(args.config)
+    app = build_app(pipeline_cfg)
+    import uvicorn
+    uvicorn.run(app, host=server_cfg.host, port=server_cfg.port,
+                log_level=server_cfg.log_level)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Metrics (reference: fengshen/metric/)."""
+
+from fengshen_tpu.metrics.metric import (metrics_mlm_acc, EntityScore,
+                                         SeqEntityScore)
+from fengshen_tpu.metrics.utils_ner import (get_entities, bert_extract_item)
+
+__all__ = ["metrics_mlm_acc", "EntityScore", "SeqEntityScore",
+           "get_entities", "bert_extract_item"]

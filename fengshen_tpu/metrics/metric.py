@@ -1,0 +1,87 @@
+"""Task metrics.
+
+Port of reference: fengshen/metric/metric.py:10-110 — `metrics_mlm_acc`,
+`EntityScore` (span sets), `SeqEntityScore` (BIO-decoded P/R/F1).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from fengshen_tpu.metrics.utils_ner import get_entities
+
+
+def metrics_mlm_acc(logits, labels, ignore_index: int = -100) -> float:
+    """Accuracy over non-ignored MLM positions
+    (reference: metric.py:10-25)."""
+    logits = np.asarray(logits)
+    labels = np.asarray(labels)
+    preds = logits.argmax(-1)
+    valid = labels != ignore_index
+    if valid.sum() == 0:
+        return 0.0
+    return float(((preds == labels) & valid).sum() / valid.sum())
+
+
+class _ScoreBase:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.origins: list = []
+        self.founds: list = []
+        self.rights: list = []
+
+    @staticmethod
+    def _prf(origin: int, found: int, right: int):
+        recall = 0.0 if origin == 0 else right / origin
+        precision = 0.0 if found == 0 else right / found
+        f1 = 0.0 if recall + precision == 0 else \
+            2 * precision * recall / (precision + recall)
+        return round(recall, 4), round(precision, 4), round(f1, 4)
+
+    def result(self):
+        class_info = {}
+        origin_counter = Counter(x[0] for x in self.origins)
+        found_counter = Counter(x[0] for x in self.founds)
+        right_counter = Counter(x[0] for x in self.rights)
+        for label, count in origin_counter.items():
+            found = found_counter.get(label, 0)
+            right = right_counter.get(label, 0)
+            recall, precision, f1 = self._prf(count, found, right)
+            class_info[label] = {"acc": precision, "recall": recall,
+                                 "f1": f1}
+        recall, precision, f1 = self._prf(len(self.origins),
+                                          len(self.founds),
+                                          len(self.rights))
+        return {"acc": precision, "recall": recall, "f1": f1}, class_info
+
+
+class EntityScore(_ScoreBase):
+    """Set-match span scoring (reference: metric.py EntityScore)."""
+
+    def update(self, true_subject: list, pred_subject: list):
+        self.origins.extend(true_subject)
+        self.founds.extend(pred_subject)
+        self.rights.extend([p for p in pred_subject if p in true_subject])
+
+
+class SeqEntityScore(_ScoreBase):
+    """BIO/BIOS-decoded sequence scoring
+    (reference: metric.py SeqEntityScore)."""
+
+    def __init__(self, id2label, markup: str = "bios"):
+        self.id2label = id2label
+        self.markup = markup
+        super().__init__()
+
+    def update(self, label_paths: list, pred_paths: list):
+        for labels, preds in zip(label_paths, pred_paths):
+            label_entities = get_entities(labels, self.id2label, self.markup)
+            pred_entities = get_entities(preds, self.id2label, self.markup)
+            self.origins.extend(label_entities)
+            self.founds.extend(pred_entities)
+            self.rights.extend(
+                [p for p in pred_entities if p in label_entities])

@@ -1,0 +1,86 @@
+"""NER tag-sequence decoding.
+
+Port of reference: fengshen/metric/utils_ner.py:103-250 — BIO/BIOS chunk
+extraction and span-head/tail pairing (`bert_extract_item`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+
+def get_entity_bio(seq: Sequence, id2label=None) -> list[list]:
+    """[(type, start, end)] from a BIO tag sequence."""
+    chunks: list[list] = []
+    chunk = [-1, -1, -1]
+    for i, tag in enumerate(seq):
+        if not isinstance(tag, str):
+            tag = id2label[tag] if id2label else str(tag)
+        if tag.startswith("B-"):
+            if chunk[2] != -1:
+                chunks.append(chunk[:])
+            chunk = [tag.split("-", 1)[1], i, i]
+        elif tag.startswith("I-") and chunk[1] != -1:
+            if tag.split("-", 1)[1] == chunk[0]:
+                chunk[2] = i
+        else:
+            if chunk[2] != -1:
+                chunks.append(chunk[:])
+            chunk = [-1, -1, -1]
+    if chunk[2] != -1:
+        chunks.append(chunk[:])
+    return chunks
+
+
+def get_entity_bios(seq: Sequence, id2label=None) -> list[list]:
+    """[(type, start, end)] from a BIOS tag sequence (S- singletons)."""
+    chunks: list[list] = []
+    chunk = [-1, -1, -1]
+    for i, tag in enumerate(seq):
+        if not isinstance(tag, str):
+            tag = id2label[tag] if id2label else str(tag)
+        if tag.startswith("S-"):
+            if chunk[2] != -1:
+                chunks.append(chunk[:])
+            chunks.append([tag.split("-", 1)[1], i, i])
+            chunk = [-1, -1, -1]
+        elif tag.startswith("B-"):
+            if chunk[2] != -1:
+                chunks.append(chunk[:])
+            chunk = [tag.split("-", 1)[1], i, i]
+        elif tag.startswith("I-") and chunk[1] != -1:
+            if tag.split("-", 1)[1] == chunk[0]:
+                chunk[2] = i
+        else:
+            if chunk[2] != -1:
+                chunks.append(chunk[:])
+            chunk = [-1, -1, -1]
+    if chunk[2] != -1:
+        chunks.append(chunk[:])
+    return chunks
+
+
+def get_entities(seq, id2label=None, markup: str = "bios"):
+    """Reference: utils_ner.py get_entities dispatch."""
+    assert markup in ("bio", "bios")
+    if markup == "bio":
+        return get_entity_bio(seq, id2label)
+    return get_entity_bios(seq, id2label)
+
+
+def bert_extract_item(start_logits, end_logits) -> list[tuple]:
+    """Pair span-head/tail predictions
+    (reference: utils_ner.py bert_extract_item): for each start position
+    with a non-O label, find the nearest end position with the same label."""
+    import numpy as np
+    S = []
+    start_pred = np.asarray(start_logits).argmax(-1)[1:-1]
+    end_pred = np.asarray(end_logits).argmax(-1)[1:-1]
+    for i, s_l in enumerate(start_pred):
+        if s_l == 0:
+            continue
+        for j, e_l in enumerate(end_pred[i:]):
+            if s_l == e_l:
+                S.append((int(s_l), i, i + j))
+                break
+    return S

@@ -1,0 +1,9 @@
+"""Sequence-tagging models (reference: fengshen/models/tagging_models/ —
+BertLinear / BertCrf / BertSpan / BertBiaffine over a BERT encoder, with the
+CRF layer at tagging_models/layers/crf.py)."""
+
+from fengshen_tpu.models.tagging.crf import CRF
+from fengshen_tpu.models.tagging.modeling_tagging import (
+    BertLinear, BertCrf, BertSpan, BertBiaffine)
+
+__all__ = ["CRF", "BertLinear", "BertCrf", "BertSpan", "BertBiaffine"]

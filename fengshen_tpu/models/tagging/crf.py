@@ -1,0 +1,106 @@
+"""Linear-chain CRF in jax.
+
+Port of the reference's torch CRF
+(reference: fengshen/models/tagging_models/layers/crf.py — forward
+log-likelihood with masked sequences and Viterbi decode). Both the forward
+algorithm and Viterbi run as `lax.scan` over time — compiler-friendly, no
+per-step Python.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CRF(nn.Module):
+    num_tags: int
+
+    def setup(self):
+        self.start_transitions = self.param(
+            "start_transitions", nn.initializers.uniform(0.1),
+            (self.num_tags,))
+        self.end_transitions = self.param(
+            "end_transitions", nn.initializers.uniform(0.1),
+            (self.num_tags,))
+        self.transitions = self.param(
+            "transitions", nn.initializers.uniform(0.1),
+            (self.num_tags, self.num_tags))
+
+    def __call__(self, emissions, tags, mask=None):
+        """Negative mean log-likelihood. emissions [B,S,T], tags [B,S],
+        mask [B,S] (1 = real token)."""
+        if mask is None:
+            mask = jnp.ones(tags.shape, jnp.int32)
+        numerator = self._score(emissions, tags, mask)
+        denominator = self._normalizer(emissions, mask)
+        return -(numerator - denominator).mean()
+
+    def _score(self, emissions, tags, mask):
+        batch, seq, _ = emissions.shape
+        maskf = mask.astype(jnp.float32)
+        first_emit = jnp.take_along_axis(
+            emissions[:, 0], tags[:, 0, None], axis=-1)[:, 0]
+        score = self.start_transitions[tags[:, 0]] + first_emit
+
+        def step(carry, t):
+            score, prev_tag = carry
+            emit = jnp.take_along_axis(
+                emissions[:, t], tags[:, t, None], axis=-1)[:, 0]
+            trans = self.transitions[prev_tag, tags[:, t]]
+            score = score + (emit + trans) * maskf[:, t]
+            prev_tag = jnp.where(mask[:, t] > 0, tags[:, t], prev_tag)
+            return (score, prev_tag), None
+
+        (score, last_tag), _ = jax.lax.scan(
+            step, (score, tags[:, 0]), jnp.arange(1, seq))
+        return score + self.end_transitions[last_tag]
+
+    def _normalizer(self, emissions, mask):
+        batch, seq, n = emissions.shape
+        alpha = self.start_transitions[None] + emissions[:, 0]
+
+        def step(alpha, t):
+            # [B, prev, next]
+            scores = alpha[:, :, None] + self.transitions[None] + \
+                emissions[:, t][:, None, :]
+            new_alpha = jax.nn.logsumexp(scores, axis=1)
+            keep = mask[:, t, None] > 0
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha, jnp.arange(1, seq))
+        return jax.nn.logsumexp(alpha + self.end_transitions[None], axis=-1)
+
+    def decode(self, emissions, mask=None):
+        """Viterbi best paths [B, S] (pad positions hold tag 0)."""
+        batch, seq, n = emissions.shape
+        if mask is None:
+            mask = jnp.ones((batch, seq), jnp.int32)
+        score = self.start_transitions[None] + emissions[:, 0]
+
+        def forward(score, t):
+            # [B, prev, next]
+            cand = score[:, :, None] + self.transitions[None] + \
+                emissions[:, t][:, None, :]
+            best_prev = cand.argmax(axis=1)
+            best_score = cand.max(axis=1)
+            keep = mask[:, t, None] > 0
+            new_score = jnp.where(keep, best_score, score)
+            # when masked, point back to self so backtrack is a no-op
+            best_prev = jnp.where(keep, best_prev,
+                                  jnp.arange(n)[None, :])
+            return new_score, best_prev
+
+        score, history = jax.lax.scan(forward, score, jnp.arange(1, seq))
+        last = (score + self.end_transitions[None]).argmax(-1)
+
+        def backward(tag, backptr):
+            prev = jnp.take_along_axis(backptr, tag[:, None], axis=-1)[:, 0]
+            return prev, tag
+
+        # ys[i] = tag at time i+1; final carry = tag at time 0
+        tag0, tags_rest = jax.lax.scan(backward, last, history, reverse=True)
+        tags = jnp.concatenate([tag0[:, None], tags_rest.transpose(1, 0)],
+                               axis=1)
+        return tags * mask

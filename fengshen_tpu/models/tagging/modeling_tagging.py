@@ -1,0 +1,120 @@
+"""Tagging heads over a MegatronBert encoder.
+
+Port of reference: fengshen/models/tagging_models/ — `BertLinear`
+(token-softmax), `BertCrf` (CRF decode), `BertSpan` (start/end pointers),
+`BertBiaffine` (span biaffine scorer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
+                                               MegatronBertModel)
+from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
+    PARTITION_RULES, SCAN_PARTITION_RULES, _dense)
+from fengshen_tpu.models.tagging.crf import CRF
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+
+class _TaggingBase(nn.Module):
+    config: MegatronBertConfig
+    num_labels: int = 9
+
+    def partition_rules(self):
+        return SCAN_PARTITION_RULES if self.config.scan_layers \
+            else PARTITION_RULES
+
+    def _encode(self, input_ids, attention_mask, token_type_ids,
+                deterministic):
+        hidden, _ = MegatronBertModel(self.config, add_pooling_layer=False,
+                                      name="bert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        return nn.Dropout(self.config.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+
+
+class BertLinear(_TaggingBase):
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 labels=None, deterministic=True):
+        hidden = self._encode(input_ids, attention_mask, token_type_ids,
+                              deterministic)
+        logits = _dense(self.config, self.num_labels, "classifier")(hidden)
+        if labels is None:
+            return logits
+        loss, _ = stable_cross_entropy(logits, labels)
+        return loss, logits
+
+
+class BertCrf(_TaggingBase):
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 labels=None, decode: bool = False, deterministic=True):
+        hidden = self._encode(input_ids, attention_mask, token_type_ids,
+                              deterministic)
+        logits = _dense(self.config, self.num_labels, "classifier")(hidden)
+        crf = CRF(self.num_labels, name="crf")
+        if decode:
+            return crf.decode(logits, attention_mask)
+        if labels is None:
+            return logits
+        safe_labels = jnp.where(labels == -100, 0, labels)
+        mask = attention_mask if attention_mask is not None else \
+            jnp.ones(labels.shape, jnp.int32)
+        mask = mask * (labels != -100)
+        loss = crf(logits, safe_labels, mask)
+        return loss, logits
+
+
+class BertSpan(_TaggingBase):
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 start_labels=None, end_labels=None, deterministic=True):
+        hidden = self._encode(input_ids, attention_mask, token_type_ids,
+                              deterministic)
+        start_logits = _dense(self.config, self.num_labels,
+                              "start_classifier")(hidden)
+        end_logits = _dense(self.config, self.num_labels,
+                            "end_classifier")(hidden)
+        if start_labels is None:
+            return start_logits, end_logits
+        s_loss, _ = stable_cross_entropy(start_logits, start_labels)
+        e_loss, _ = stable_cross_entropy(end_logits, end_labels)
+        return (s_loss + e_loss) / 2, (start_logits, end_logits)
+
+
+class BertBiaffine(_TaggingBase):
+    """Span scorer: per-span label logits via a biaffine form
+    (reference: tagging_models BertBiaffine; also the Triaffine pattern of
+    UniEX, reference: fengshen/models/uniex/)."""
+
+    biaffine_size: int = 128
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 span_labels=None, deterministic=True):
+        cfg = self.config
+        hidden = self._encode(input_ids, attention_mask, token_type_ids,
+                              deterministic)
+        start = jax.nn.gelu(_dense(cfg, self.biaffine_size, "start_mlp")(
+            hidden))
+        end = jax.nn.gelu(_dense(cfg, self.biaffine_size, "end_mlp")(
+            hidden))
+        U = self.param("biaffine_u", nn.initializers.normal(0.02),
+                       (self.biaffine_size + 1, self.num_labels,
+                        self.biaffine_size + 1), jnp.float32)
+        ones_s = jnp.ones(start.shape[:-1] + (1,), start.dtype)
+        start = jnp.concatenate([start, ones_s], axis=-1)
+        end = jnp.concatenate([end, ones_s], axis=-1)
+        # [B, Si, L, Sj]
+        logits = jnp.einsum("bid,dle,bje->bilj", start,
+                            U.astype(start.dtype), end)
+        logits = logits.transpose(0, 1, 3, 2)  # [B, Si, Sj, L]
+        if span_labels is None:
+            return logits
+        loss, _ = stable_cross_entropy(logits, span_labels)
+        return loss, logits

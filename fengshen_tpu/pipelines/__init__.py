@@ -7,4 +7,4 @@ Each submodule exposes a ``Pipeline`` class with the reference's contract:
 """
 
 #: registered task names — kept in sync with the submodules
-TASKS: list[str] = []
+TASKS: list[str] = ["text_classification", "sequence_tagging"]

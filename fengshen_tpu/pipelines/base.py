@@ -1,0 +1,5 @@
+"""Pipeline base constants and shared task-training scaffolding
+(reference: fengshen/pipelines/base.py:1-2)."""
+
+_CONFIG_MODEL_TYPE = "fengshen_model_type"
+_CONFIG_TOKENIZER_TYPE = "fengshen_tokenizer_type"

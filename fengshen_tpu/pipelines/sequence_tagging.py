@@ -1,0 +1,169 @@
+"""Sequence-tagging (NER) pipeline.
+
+Port of reference: fengshen/pipelines/sequence_tagging.py:42-313 — same
+train/__call__ contract with BIO decoding of predictions into entities.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.metrics.utils_ner import get_entities
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+from fengshen_tpu.models.tagging import BertLinear, BertCrf
+from fengshen_tpu.trainer.module import TrainModule
+
+_model_dict = {
+    "bert-linear": BertLinear,
+    "bert-crf": BertCrf,
+}
+
+
+@dataclass
+class _TaggingCollator:
+    tokenizer: Any
+    label2id: dict
+    max_length: int = 256
+    text_name: str = "text"
+    label_name: str = "labels"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        out = {"input_ids": [], "attention_mask": [], "labels": []}
+        for s in samples:
+            chars = list(s[self.text_name])[: self.max_length - 2]
+            ids = self.tokenizer.convert_tokens_to_ids(chars)
+            ids = [self.tokenizer.cls_token_id] + ids + \
+                [self.tokenizer.sep_token_id]
+            labels = [str(x) for x in s.get(self.label_name, [])]
+            lab = [self.label2id.get(l, 0)
+                   for l in labels][: self.max_length - 2]
+            lab = [-100] + lab + [-100]
+            pad = self.max_length - len(ids)
+            out["input_ids"].append(ids + [self.tokenizer.pad_token_id or 0]
+                                    * pad)
+            out["attention_mask"].append([1] * len(ids) + [0] * pad)
+            out["labels"].append(lab + [-100] * pad)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+class _TaggingModule(TrainModule):
+    def __init__(self, args, model, config):
+        super().__init__(args)
+        self.model = model
+        self.config = config
+
+    def init_params(self, rng):
+        return self.model.init(rng, jnp.zeros((1, 16), jnp.int32))["params"]
+
+    def training_loss(self, params, batch, rng):
+        loss, logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            labels=batch["labels"], deterministic=False,
+            rngs={"dropout": rng})
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+class SequenceTaggingPipeline:
+    @staticmethod
+    def add_pipeline_specific_args(parent_parser: argparse.ArgumentParser):
+        parser = parent_parser.add_argument_group("sequence tagging")
+        parser.add_argument("--max_length", default=256, type=int)
+        parser.add_argument("--decode_type", default="linear", type=str,
+                            choices=["linear", "crf"])
+        parser.add_argument("--markup", default="bios", type=str)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
+        return parent_parser
+
+    def __init__(self, args=None, model: Optional[str] = None,
+                 tokenizer=None, labels: Optional[list[str]] = None,
+                 config=None, params=None, **kwargs):
+        self.args = args
+        self.labels = labels or ["O"]
+        self.label2id = {l: i for i, l in enumerate(self.labels)}
+        self.id2label = {i: l for i, l in enumerate(self.labels)}
+        decode_type = getattr(args, "decode_type", "linear") if args \
+            else "linear"
+        if config is None and model is not None:
+            config = MegatronBertConfig.from_pretrained(model)
+        if config is None:
+            config = MegatronBertConfig.small_test_config()
+        self.config = config
+        model_cls = _model_dict[
+            "bert-crf" if decode_type == "crf" else "bert-linear"]
+        self.model = model_cls(config, num_labels=len(self.labels))
+        self.decode_type = decode_type
+        if tokenizer is None and model is not None:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model)
+        self.tokenizer = tokenizer
+        self.params = params
+
+    def train(self, datasets: Any) -> None:
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.trainer import Trainer
+        from fengshen_tpu.utils import UniversalCheckpoint
+        collator = _TaggingCollator(
+            self.tokenizer, self.label2id,
+            max_length=getattr(self.args, "max_length", 256))
+        if isinstance(datasets, str):
+            from fengshen_tpu.data.fs_datasets import load_dataset
+            datasets = load_dataset(datasets)
+        datamodule = UniversalDataModule(tokenizer=self.tokenizer,
+                                         collate_fn=collator,
+                                         args=self.args, datasets=datasets)
+        module = _TaggingModule(self.args, self.model, self.config)
+        if self.params is not None:
+            module.init_params = lambda rng: self.params
+        trainer = Trainer(self.args)
+        trainer.callbacks.append(UniversalCheckpoint(self.args))
+        state = trainer.fit(module, datamodule)
+        self.params = state.params
+
+    def __call__(self, text: str):
+        if self.params is None:
+            self.params = self.model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+        chars = list(text)
+        ids = [self.tokenizer.cls_token_id] + \
+            self.tokenizer.convert_tokens_to_ids(chars) + \
+            [self.tokenizer.sep_token_id]
+        arr = jnp.asarray([ids], jnp.int32)
+        mask = jnp.ones_like(arr)
+        if self.decode_type == "crf":
+            tags = self.model.apply({"params": self.params}, arr,
+                                    attention_mask=mask, decode=True)
+            pred = np.asarray(tags)[0][1:-1]
+        else:
+            logits = self.model.apply({"params": self.params}, arr,
+                                      attention_mask=mask)
+            pred = np.asarray(logits.argmax(-1))[0][1:-1]
+        markup = getattr(self.args, "markup", "bios") if self.args \
+            else "bios"
+        entities = get_entities([self.id2label[int(p)] for p in pred],
+                                markup=markup)
+        return [{"entity": "".join(chars[s:e + 1]), "type": t,
+                 "start": s, "end": e} for t, s, e in entities]
+
+
+Pipeline = SequenceTaggingPipeline

@@ -1,0 +1,201 @@
+"""Text-classification pipeline.
+
+Port of reference: fengshen/pipelines/text_classification.py:134-234 — a
+pipeline object with `train()` (builds datamodule + task module + trainer)
+and `__call__()` (tokenize → forward → softmax labels), model dispatch via
+the config's `fengshen_model_type`
+(reference: :25-31,158-164 `_model_dict`).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.megatron_bert import (
+    MegatronBertConfig, MegatronBertForSequenceClassification)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+#: fengshen_model_type → (config cls, model cls); grows as families land
+_model_dict = {
+    "huggingface-auto": (MegatronBertConfig,
+                         MegatronBertForSequenceClassification),
+    "megatron-bert": (MegatronBertConfig,
+                      MegatronBertForSequenceClassification),
+}
+
+
+@dataclass
+class _Collator:
+    """Reference: pipelines/text_classification.py:38-91 _Collator."""
+
+    tokenizer: Any
+    max_length: int = 512
+    texta_name: str = "sentence"
+    textb_name: str = "sentence2"
+    label_name: str = "label"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        texta = [s[self.texta_name] for s in samples]
+        textb = [s.get(self.textb_name) for s in samples]
+        if any(b is None for b in textb):
+            textb = None
+        enc = self.tokenizer(texta, textb, padding="max_length",
+                             truncation=True, max_length=self.max_length,
+                             return_tensors="np")
+        out = {"input_ids": enc["input_ids"].astype(np.int32),
+               "attention_mask": enc["attention_mask"].astype(np.int32)}
+        if "token_type_ids" in enc:
+            out["token_type_ids"] = enc["token_type_ids"].astype(np.int32)
+        if samples and self.label_name in samples[0]:
+            out["labels"] = np.asarray(
+                [int(s[self.label_name]) for s in samples], np.int32)
+        return out
+
+
+class _TaskModule(TrainModule):
+    """Reference: pipelines/text_classification.py:38-91 _taskModel."""
+
+    def __init__(self, args, model, config):
+        super().__init__(args)
+        self.model = model
+        self.config = config
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 16), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            token_type_ids=batch.get("token_type_ids"),
+            deterministic=False, rngs={"dropout": rng})
+        loss, _ = stable_cross_entropy(logits[:, None, :],
+                                       batch["labels"][:, None])
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        return loss, {"acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+class TextClassificationPipeline:
+    @staticmethod
+    def add_pipeline_specific_args(parent_parser: argparse.ArgumentParser):
+        parser = parent_parser.add_argument_group("text classification")
+        parser.add_argument("--texta_name", default="sentence", type=str)
+        parser.add_argument("--textb_name", default="sentence2", type=str)
+        parser.add_argument("--label_name", default="label", type=str)
+        parser.add_argument("--id_name", default="id", type=str)
+        parser.add_argument("--max_length", default=512, type=int)
+        parser.add_argument("--return_all_scores", action="store_true",
+                            default=False)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
+        return parent_parser
+
+    def __init__(self, args=None, model: Optional[str] = None,
+                 tokenizer=None, params=None, config=None,
+                 num_labels: int = 2, **kwargs):
+        self.args = args
+        self.model_path = model
+        model_type = "huggingface-auto"
+        if config is None and model is not None:
+            import json
+            import os
+            cfg_file = os.path.join(model, "config.json")
+            if os.path.exists(cfg_file):
+                with open(cfg_file) as f:
+                    raw = json.load(f)
+                model_type = raw.get("fengshen_model_type",
+                                     raw.get("model_type",
+                                             "huggingface-auto"))
+                if model_type not in _model_dict:
+                    model_type = "huggingface-auto"
+                config = _model_dict[model_type][0].from_pretrained(model)
+        if config is None:
+            config = MegatronBertConfig.small_test_config()
+        if getattr(config, "num_labels", None) != num_labels and \
+                num_labels is not None:
+            config.num_labels = num_labels
+        self.config = config
+        self.model = _model_dict[model_type][1](config)
+
+        if tokenizer is None and model is not None:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model)
+        self.tokenizer = tokenizer
+        self.params = params
+        self._predict_fn = None
+
+    # -- training --------------------------------------------------------
+    def train(self, datasets: Any) -> None:
+        """Reference: pipelines/text_classification.py:194-218."""
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.trainer import Trainer
+        from fengshen_tpu.utils import UniversalCheckpoint
+
+        collator = _Collator(
+            self.tokenizer,
+            max_length=getattr(self.args, "max_length", 512),
+            texta_name=getattr(self.args, "texta_name", "sentence"),
+            textb_name=getattr(self.args, "textb_name", "sentence2"),
+            label_name=getattr(self.args, "label_name", "label"))
+        if isinstance(datasets, str):
+            from fengshen_tpu.data.fs_datasets import load_dataset
+            datasets = load_dataset(datasets)
+        datamodule = UniversalDataModule(tokenizer=self.tokenizer,
+                                         collate_fn=collator,
+                                         args=self.args, datasets=datasets)
+        module = _TaskModule(self.args, self.model, self.config)
+        if self.params is not None:
+            module.init_params = lambda rng: self.params
+        trainer = Trainer(self.args)
+        trainer.callbacks.append(UniversalCheckpoint(self.args))
+        state = trainer.fit(module, datamodule)
+        self.params = state.params
+
+    # -- inference -------------------------------------------------------
+    def __call__(self, text, text_pair=None):
+        if self.params is None:
+            rng = jax.random.PRNGKey(0)
+            self.params = self.model.init(
+                rng, jnp.zeros((1, 8), jnp.int32))["params"]
+        single = isinstance(text, str)
+        texts = [text] if single else list(text)
+        pairs = None
+        if text_pair is not None:
+            pairs = [text_pair] if single else list(text_pair)
+        enc = self.tokenizer(texts, pairs, padding=True, truncation=True,
+                             max_length=getattr(self.args, "max_length",
+                                                512),
+                             return_tensors="np")
+        kwargs = {"attention_mask":
+                  jnp.asarray(enc["attention_mask"], jnp.int32)}
+        if "token_type_ids" in enc:
+            kwargs["token_type_ids"] = jnp.asarray(enc["token_type_ids"],
+                                                   jnp.int32)
+        logits = self.model.apply({"params": self.params},
+                                  jnp.asarray(enc["input_ids"], jnp.int32),
+                                  **kwargs)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        results = [{"label": int(p.argmax()), "score": float(p.max())}
+                   for p in probs]
+        return results[0] if single else results
+
+
+Pipeline = TextClassificationPipeline
