@@ -1,0 +1,8 @@
+"""ALBERT family (reference: fengshen/models/albert/, 1,363 LoC)."""
+
+from fengshen_tpu.models.albert.modeling_albert import (
+    AlbertConfig, AlbertModel, AlbertForMaskedLM,
+    AlbertForSequenceClassification)
+
+__all__ = ["AlbertConfig", "AlbertModel", "AlbertForMaskedLM",
+           "AlbertForSequenceClassification"]

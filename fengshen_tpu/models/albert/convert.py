@@ -1,0 +1,53 @@
+"""torch(HF) → jax weights for ALBERT."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.albert.modeling_albert import AlbertConfig
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: AlbertConfig) -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    g = "albert.encoder.albert_layer_groups.0.albert_layers.0"
+    params: dict = {
+        "word_embeddings": {
+            "embedding": t("albert.embeddings.word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": t("albert.embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding":
+                t("albert.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("albert.embeddings.LayerNorm"),
+        "embedding_hidden_mapping_in": lin(
+            "albert.encoder.embedding_hidden_mapping_in"),
+        "albert_layer": {
+            "query": lin(f"{g}.attention.query"),
+            "key": lin(f"{g}.attention.key"),
+            "value": lin(f"{g}.attention.value"),
+            "attention_dense": lin(f"{g}.attention.dense"),
+            "attention_ln": ln(f"{g}.attention.LayerNorm"),
+            "ffn": lin(f"{g}.ffn"),
+            "ffn_output": lin(f"{g}.ffn_output"),
+            "full_layer_ln": ln(f"{g}.full_layer_layer_norm"),
+        },
+    }
+    if "albert.pooler.weight" in state_dict:
+        params["pooler"] = {"kernel": t("albert.pooler.weight").T,
+                            "bias": t("albert.pooler.bias")}
+    return params

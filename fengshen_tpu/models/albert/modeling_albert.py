@@ -1,0 +1,220 @@
+"""ALBERT in flax, HF-weight-compatible.
+
+Reference: fengshen/models/albert/. ALBERT = BERT with (1) factorized
+embeddings (embedding_size < hidden_size, projected up), (2) ONE shared
+transformer layer applied num_hidden_layers times — which on TPU means the
+natural implementation is `lax.scan` over a zero-parameter-growth body:
+cross-layer sharing is just a scan whose params are broadcast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", None)),
+    (r"(query|key|value|ffn)/kernel", P("fsdp", "tensor")),
+    (r"(attention_dense|ffn_output)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class AlbertConfig:
+    vocab_size: int = 30000
+    embedding_size: int = 128
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_hidden_groups: int = 1
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    inner_group_num: int = 1
+    hidden_act: str = "gelu_new"
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    num_labels: int = 2
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "AlbertConfig":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "AlbertConfig":
+        base = dict(vocab_size=128, embedding_size=16, hidden_size=32,
+                    num_hidden_layers=3, num_attention_heads=4,
+                    intermediate_size=64, max_position_embeddings=64)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def _dense(cfg, feats, name):
+    return nn.Dense(feats, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+class AlbertLayer(nn.Module):
+    config: AlbertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(cfg, cfg.hidden_size, "query")(hidden)
+        k = _dense(cfg, cfg.hidden_size, "key")(hidden)
+        v = _dense(cfg, cfg.hidden_size, "value")(hidden)
+        q = q.reshape(batch, seq, n_head, head_dim)
+        k = k.reshape(batch, seq, n_head, head_dim)
+        v = v.reshape(batch, seq, n_head, head_dim)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        drop_rng = None
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            drop_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, mask=mask, dropout_rng=drop_rng,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            deterministic=deterministic)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = out.reshape(batch, seq, cfg.hidden_size)
+        out = _dense(cfg, cfg.hidden_size, "attention_dense")(out)
+        out = nn.Dropout(cfg.hidden_dropout_prob)(
+            out, deterministic=deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="attention_ln")(hidden + out)
+
+        h = _dense(cfg, cfg.intermediate_size, "ffn")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.hidden_size, "ffn_output")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="full_layer_ln")(hidden + h)
+
+
+class AlbertModel(nn.Module):
+    config: AlbertConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        embed = lambda n, name: nn.Embed(  # noqa: E731
+            n, cfg.embedding_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+            embed(cfg.max_position_embeddings,
+                  "position_embeddings")(position_ids) + \
+            embed(cfg.type_vocab_size,
+                  "token_type_embeddings")(token_type_ids)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+        hidden = _dense(cfg, cfg.hidden_size,
+                        "embedding_hidden_mapping_in")(hidden)
+
+        # ONE layer's params, applied num_hidden_layers times (cross-layer
+        # sharing); groups>1 would add more layer instances
+        layer = AlbertLayer(cfg, name="albert_layer")
+        for _ in range(cfg.num_hidden_layers):
+            hidden = layer(hidden, attention_mask, deterministic)
+
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class AlbertForMaskedLM(nn.Module):
+    config: AlbertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        hidden, _ = AlbertModel(cfg, add_pooling_layer=False,
+                                name="albert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        h = _dense(cfg, cfg.embedding_size, "predictions_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="predictions_ln")(h)
+        wte = self.variables["params"]["albert"]["word_embeddings"][
+            "embedding"]
+        logits = h @ wte.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class AlbertForSequenceClassification(nn.Module):
+    config: AlbertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        _, pooled = AlbertModel(cfg, name="albert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(pooled)
+
+    def partition_rules(self):
+        return PARTITION_RULES

@@ -1,0 +1,12 @@
+"""Auto classes — lazy model/config/tokenizer registry.
+
+Port of the reference's forked HF auto classes
+(reference: fengshen/models/auto/ — `CONFIG_MAPPING_NAMES` at
+configuration_auto.py:30-35, `_LazyAutoMapping` at auto_factory.py:553).
+Resolution order: model_type from config.json → registry entry → class.
+"""
+
+from fengshen_tpu.models.auto.auto_factory import (AutoConfig, AutoModel,
+                                                   register_model)
+
+__all__ = ["AutoConfig", "AutoModel", "register_model"]

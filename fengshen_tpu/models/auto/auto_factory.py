@@ -1,0 +1,115 @@
+"""Lazy auto registry for fengshen-tpu model families."""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Optional
+
+#: model_type → (module, config class, {head: model class}) — names only,
+#: imported lazily like the reference's _LazyAutoMapping
+#: (reference: fengshen/models/auto/auto_factory.py:553)
+MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
+    "llama": ("fengshen_tpu.models.llama", "LlamaConfig",
+              {"causal_lm": "LlamaForCausalLM", "base": "LlamaModel"}),
+    "ziya_llama": ("fengshen_tpu.models.llama", "LlamaConfig",
+                   {"causal_lm": "LlamaForCausalLM"}),
+    "gpt2": ("fengshen_tpu.models.gpt2", "GPT2Config",
+             {"causal_lm": "GPT2LMHeadModel", "base": "GPT2Model"}),
+    "megatron-bert": ("fengshen_tpu.models.megatron_bert",
+                      "MegatronBertConfig",
+                      {"base": "MegatronBertModel",
+                       "pretraining": "MegatronBertForPreTraining",
+                       "masked_lm": "MegatronBertForMaskedLM",
+                       "sequence_classification":
+                           "MegatronBertForSequenceClassification",
+                       "token_classification":
+                           "MegatronBertForTokenClassification"}),
+    "t5": ("fengshen_tpu.models.t5", "T5Config",
+           {"base": "T5Model",
+            "conditional_generation": "T5ForConditionalGeneration",
+            "encoder": "T5EncoderModel"}),
+    "bart": ("fengshen_tpu.models.bart", "BartConfig",
+             {"base": "BartModel",
+              "conditional_generation": "BartForConditionalGeneration"}),
+    "roformer": ("fengshen_tpu.models.roformer", "RoFormerConfig",
+                 {"base": "RoFormerModel",
+                  "masked_lm": "RoFormerForMaskedLM",
+                  "sequence_classification":
+                      "RoFormerForSequenceClassification"}),
+    "albert": ("fengshen_tpu.models.albert", "AlbertConfig",
+               {"base": "AlbertModel", "masked_lm": "AlbertForMaskedLM",
+                "sequence_classification":
+                    "AlbertForSequenceClassification"}),
+}
+
+
+def register_model(model_type: str, module: str, config_cls: str,
+                   heads: dict[str, str]) -> None:
+    """Extend the registry (the reference's trust-remote-code loader role,
+    reference: fengshen/models/auto/dynamic.py:107)."""
+    MODEL_REGISTRY[model_type] = (module, config_cls, heads)
+
+
+def _resolve(model_type: str):
+    if model_type not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model_type {model_type!r}; known: "
+            f"{sorted(MODEL_REGISTRY)}")
+    module_name, config_name, heads = MODEL_REGISTRY[model_type]
+    module = importlib.import_module(module_name)
+    return module, config_name, heads
+
+
+def _model_type_from_path(path: str) -> str:
+    cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+        else path
+    with open(cfg_file) as f:
+        raw = json.load(f)
+    return raw.get("fengshen_model_type", raw.get("model_type", ""))
+
+
+class AutoConfig:
+    @staticmethod
+    def from_pretrained(path: str, **kwargs) -> Any:
+        model_type = _model_type_from_path(path)
+        module, config_name, _ = _resolve(model_type)
+        return getattr(module, config_name).from_pretrained(path)
+
+    @staticmethod
+    def for_model(model_type: str, **kwargs) -> Any:
+        module, config_name, _ = _resolve(model_type)
+        return getattr(module, config_name)(**kwargs)
+
+
+class AutoModel:
+    @staticmethod
+    def from_config(config: Any, head: str = "base") -> Any:
+        for model_type, (module_name, config_name, heads) in \
+                MODEL_REGISTRY.items():
+            if type(config).__name__ == config_name and head in heads:
+                module = importlib.import_module(module_name)
+                return getattr(module, heads[head])(config)
+        raise KeyError(
+            f"no registered model for config {type(config).__name__} "
+            f"with head {head!r}")
+
+    @staticmethod
+    def from_pretrained(path: str, head: str = "base") -> tuple[Any, Any]:
+        """Returns (model, params) for checkpoints with a converter."""
+        model_type = _model_type_from_path(path)
+        module, config_name, heads = _resolve(model_type)
+        config = getattr(module, config_name).from_pretrained(path)
+        if head not in heads:
+            raise KeyError(f"model_type {model_type!r} has no head "
+                           f"{head!r}; known: {sorted(heads)}")
+        model = getattr(module, heads[head])(config)
+        params = None
+        try:
+            convert = importlib.import_module(module.__name__ + ".convert")
+            if hasattr(convert, "load_hf_pretrained"):
+                _, params = convert.load_hf_pretrained(path, config)
+        except (ModuleNotFoundError, FileNotFoundError):
+            pass
+        return model, params

@@ -1,0 +1,7 @@
+"""BART family (reference: fengshen/models/bart/ — `BartForTextInfill` +
+Randeng-BART seq2seq examples)."""
+
+from fengshen_tpu.models.bart.modeling_bart import (
+    BartConfig, BartModel, BartForConditionalGeneration)
+
+__all__ = ["BartConfig", "BartModel", "BartForConditionalGeneration"]

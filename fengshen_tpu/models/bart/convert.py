@@ -1,0 +1,65 @@
+"""torch(HF) → jax weights for BART."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.bart.modeling_bart import BartConfig
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: BartConfig) -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    def attn(prefix):
+        return {p: lin(f"{prefix}.{p}")
+                for p in ("q_proj", "k_proj", "v_proj", "out_proj")}
+
+    model: dict = {
+        "shared": {"embedding": t("model.shared.weight")},
+        "encoder_embed_positions": {
+            "embedding": t("model.encoder.embed_positions.weight")},
+        "decoder_embed_positions": {
+            "embedding": t("model.decoder.embed_positions.weight")},
+        "encoder_layernorm_embedding": ln(
+            "model.encoder.layernorm_embedding"),
+        "decoder_layernorm_embedding": ln(
+            "model.decoder.layernorm_embedding"),
+    }
+    for i in range(config.encoder_layers):
+        pre = f"model.encoder.layers.{i}"
+        model[f"encoder_layer_{i}"] = {
+            "self_attn": attn(f"{pre}.self_attn"),
+            "self_attn_layer_norm": ln(f"{pre}.self_attn_layer_norm"),
+            "fc1": lin(f"{pre}.fc1"),
+            "fc2": lin(f"{pre}.fc2"),
+            "final_layer_norm": ln(f"{pre}.final_layer_norm"),
+        }
+    for i in range(config.decoder_layers):
+        pre = f"model.decoder.layers.{i}"
+        model[f"decoder_layer_{i}"] = {
+            "self_attn": attn(f"{pre}.self_attn"),
+            "self_attn_layer_norm": ln(f"{pre}.self_attn_layer_norm"),
+            "encoder_attn": attn(f"{pre}.encoder_attn"),
+            "encoder_attn_layer_norm": ln(f"{pre}.encoder_attn_layer_norm"),
+            "fc1": lin(f"{pre}.fc1"),
+            "fc2": lin(f"{pre}.fc2"),
+            "final_layer_norm": ln(f"{pre}.final_layer_norm"),
+        }
+    params: dict = {"model": model}
+    if "final_logits_bias" in state_dict:
+        params["final_logits_bias"] = t("final_logits_bias").reshape(-1)
+    return params

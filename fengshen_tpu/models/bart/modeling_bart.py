@@ -1,0 +1,273 @@
+"""BART in flax, HF-weight-compatible.
+
+Reference: fengshen/models/bart/ (lexically-constrained `BartForTextInfill`,
+Randeng-BART pretrain/QG examples). Post-LN encoder-decoder with learned
+positional embeddings offset by 2 (the HF quirk), scaled q attention, tied
+LM head with final_logits_bias.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.masks import causal_mask
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("shared/embedding", P("tensor", "fsdp")),
+    ("embed_positions/embedding", P(None, None)),
+    (r"(q_proj|k_proj|v_proj|fc1)/kernel", P("fsdp", "tensor")),
+    (r"(out_proj|fc2)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+_POS_OFFSET = 2  # HF BartLearnedPositionalEmbedding offset
+
+
+@dataclasses.dataclass
+class BartConfig:
+    vocab_size: int = 50265
+    d_model: int = 768
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 12
+    decoder_attention_heads: int = 12
+    encoder_ffn_dim: int = 3072
+    decoder_ffn_dim: int = 3072
+    activation_function: str = "gelu"
+    dropout: float = 0.1
+    attention_dropout: float = 0.0
+    max_position_embeddings: int = 1024
+    init_std: float = 0.02
+    scale_embedding: bool = False
+    pad_token_id: int = 1
+    bos_token_id: int = 0
+    eos_token_id: int = 2
+    decoder_start_token_id: int = 2
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def hidden_size(self) -> int:
+        return self.d_model
+
+    @property
+    def num_hidden_layers(self) -> int:
+        return self.encoder_layers + self.decoder_layers
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.encoder_ffn_dim
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "BartConfig":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "BartConfig":
+        base = dict(vocab_size=128, d_model=32, encoder_layers=2,
+                    decoder_layers=2, encoder_attention_heads=4,
+                    decoder_attention_heads=4, encoder_ffn_dim=64,
+                    decoder_ffn_dim=64, max_position_embeddings=64)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def _dense(cfg, feats, name, bias=True):
+    return nn.Dense(feats, use_bias=bias, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(cfg.init_std),
+                    name=name)
+
+
+class BartAttention(nn.Module):
+    config: BartConfig
+    num_heads: int
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, kv=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.config
+        batch, q_len, _ = hidden.shape
+        head_dim = cfg.d_model // self.num_heads
+        kv_in = hidden if kv is None else kv
+        q = _dense(cfg, cfg.d_model, "q_proj")(hidden)
+        k = _dense(cfg, cfg.d_model, "k_proj")(kv_in)
+        v = _dense(cfg, cfg.d_model, "v_proj")(kv_in)
+        q = q.reshape(batch, q_len, self.num_heads, head_dim)
+        k = k.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
+        v = v.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
+
+        mask = None
+        if self.causal:
+            mask = causal_mask(q_len, k.shape[1])[None, None]
+            if attention_mask is not None:
+                mask = mask & attention_mask[:, None, None, :].astype(bool)
+        elif attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        out = dot_product_attention(q, k, v, mask=mask,
+                                    deterministic=deterministic)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = out.reshape(batch, q_len, cfg.d_model)
+        return _dense(cfg, cfg.d_model, "out_proj")(out)
+
+
+class BartEncoderLayer(nn.Module):
+    config: BartConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = BartAttention(cfg, cfg.encoder_attention_heads,
+                          name="self_attn")(
+            hidden, attention_mask=attention_mask,
+            deterministic=deterministic)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        hidden = LayerNorm(name="self_attn_layer_norm")(hidden + h)
+        h = get_activation(cfg.activation_function)(
+            _dense(cfg, cfg.encoder_ffn_dim, "fc1")(hidden))
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.d_model, "fc2")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return LayerNorm(name="final_layer_norm")(hidden + h)
+
+
+class BartDecoderLayer(nn.Module):
+    config: BartConfig
+
+    @nn.compact
+    def __call__(self, hidden, encoder_hidden, attention_mask=None,
+                 encoder_attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = BartAttention(cfg, cfg.decoder_attention_heads, causal=True,
+                          name="self_attn")(
+            hidden, attention_mask=attention_mask,
+            deterministic=deterministic)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        hidden = LayerNorm(name="self_attn_layer_norm")(hidden + h)
+        h = BartAttention(cfg, cfg.decoder_attention_heads,
+                          name="encoder_attn")(
+            hidden, kv=encoder_hidden,
+            attention_mask=encoder_attention_mask,
+            deterministic=deterministic)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        hidden = LayerNorm(name="encoder_attn_layer_norm")(hidden + h)
+        h = get_activation(cfg.activation_function)(
+            _dense(cfg, cfg.decoder_ffn_dim, "fc1")(hidden))
+        h = _dense(cfg, cfg.d_model, "fc2")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return LayerNorm(name="final_layer_norm")(hidden + h)
+
+
+class BartModel(nn.Module):
+    config: BartConfig
+
+    def setup(self):
+        cfg = self.config
+        self.shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            name="shared")
+        self.encoder_embed_positions = nn.Embed(
+            cfg.max_position_embeddings + _POS_OFFSET, cfg.d_model,
+            dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            name="encoder_embed_positions")
+        self.decoder_embed_positions = nn.Embed(
+            cfg.max_position_embeddings + _POS_OFFSET, cfg.d_model,
+            dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            name="decoder_embed_positions")
+        self.encoder_layernorm_embedding = LayerNorm(
+            name="encoder_layernorm_embedding")
+        self.decoder_layernorm_embedding = LayerNorm(
+            name="decoder_layernorm_embedding")
+        self.encoder_layers = [
+            BartEncoderLayer(cfg, name=f"encoder_layer_{i}")
+            for i in range(cfg.encoder_layers)]
+        self.decoder_layers = [
+            BartDecoderLayer(cfg, name=f"decoder_layer_{i}")
+            for i in range(cfg.decoder_layers)]
+        self.embed_scale = (cfg.d_model ** 0.5) if cfg.scale_embedding \
+            else 1.0
+        self.dropout_layer = nn.Dropout(cfg.dropout)
+
+    def encode(self, input_ids, attention_mask=None, deterministic=True):
+        cfg = self.config
+        seq = input_ids.shape[1]
+        pos = jnp.arange(seq) + _POS_OFFSET
+        hidden = self.shared(input_ids) * self.embed_scale + \
+            self.encoder_embed_positions(pos)[None]
+        hidden = self.encoder_layernorm_embedding(hidden)
+        hidden = self.dropout_layer(hidden, deterministic=deterministic)
+        for layer in self.encoder_layers:
+            hidden = layer(hidden, attention_mask, deterministic)
+        return hidden
+
+    def decode(self, decoder_input_ids, encoder_hidden,
+               attention_mask=None, decoder_attention_mask=None,
+               deterministic=True):
+        cfg = self.config
+        seq = decoder_input_ids.shape[1]
+        pos = jnp.arange(seq) + _POS_OFFSET
+        hidden = self.shared(decoder_input_ids) * self.embed_scale + \
+            self.decoder_embed_positions(pos)[None]
+        hidden = self.decoder_layernorm_embedding(hidden)
+        hidden = self.dropout_layer(hidden, deterministic=deterministic)
+        for layer in self.decoder_layers:
+            hidden = layer(hidden, encoder_hidden, decoder_attention_mask,
+                           attention_mask, deterministic)
+        return hidden
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        dec = self.decode(decoder_input_ids, enc, attention_mask,
+                          decoder_attention_mask, deterministic)
+        return enc, dec
+
+
+class BartForConditionalGeneration(nn.Module):
+    config: BartConfig
+
+    def setup(self):
+        self.model = BartModel(self.config, name="model")
+        self.final_logits_bias = self.param(
+            "final_logits_bias", nn.initializers.zeros,
+            (self.config.vocab_size,), jnp.float32)
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        _, dec = self.model(input_ids, decoder_input_ids, attention_mask,
+                            decoder_attention_mask, deterministic)
+        emb = self.model.shared.embedding
+        logits = dec @ emb.T.astype(dec.dtype)
+        return logits + self.final_logits_bias.astype(logits.dtype)
+
+    def partition_rules(self):
+        return PARTITION_RULES

@@ -1,0 +1,9 @@
+"""RoFormer family (reference: fengshen/models/roformer/ — rotary BERT with
+the full head set, 2,160 LoC)."""
+
+from fengshen_tpu.models.roformer.modeling_roformer import (
+    RoFormerConfig, RoFormerModel, RoFormerForMaskedLM,
+    RoFormerForSequenceClassification)
+
+__all__ = ["RoFormerConfig", "RoFormerModel", "RoFormerForMaskedLM",
+           "RoFormerForSequenceClassification"]

@@ -1,0 +1,52 @@
+"""torch(HF) → jax weights for RoFormer."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.roformer.modeling_roformer import RoFormerConfig
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config: RoFormerConfig,
+                    head: str = "none") -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    ro: dict = {
+        "word_embeddings": {
+            "embedding": t("roformer.embeddings.word_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding":
+                t("roformer.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("roformer.embeddings.LayerNorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        pre = f"roformer.encoder.layer.{i}"
+        ro[f"layer_{i}"] = {
+            "query": lin(f"{pre}.attention.self.query"),
+            "key": lin(f"{pre}.attention.self.key"),
+            "value": lin(f"{pre}.attention.self.value"),
+            "attention_output_dense": lin(f"{pre}.attention.output.dense"),
+            "attention_ln": ln(f"{pre}.attention.output.LayerNorm"),
+            "intermediate_dense": lin(f"{pre}.intermediate.dense"),
+            "output_dense": lin(f"{pre}.output.dense"),
+            "output_ln": ln(f"{pre}.output.LayerNorm"),
+        }
+    params: dict = {"roformer": ro}
+    if head == "masked_lm":
+        params["transform_dense"] = lin("cls.predictions.transform.dense")
+        params["transform_ln"] = ln("cls.predictions.transform.LayerNorm")
+        params["bias"] = t("cls.predictions.bias")
+    return params

@@ -1,0 +1,7 @@
+"""TCBert — prompt-based topic classification (reference:
+fengshen/models/tcbert/, 366 LoC)."""
+
+from fengshen_tpu.models.tcbert.modeling_tcbert import (TCBertModel,
+                                                        TCBertPipelines)
+
+__all__ = ["TCBertModel", "TCBertPipelines"]

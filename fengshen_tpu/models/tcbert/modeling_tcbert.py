@@ -1,0 +1,109 @@
+"""TCBert: topic classification as prompt MLM.
+
+Behavioural port of reference: fengshen/models/tcbert/ — the template
+"这是一则[MASK][MASK]新闻：{text}"; the MLM head scores each label's words at
+the mask positions and the label with the highest joint score wins.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
+                                               MegatronBertForMaskedLM)
+
+
+class TCBertModel(nn.Module):
+    """MLM backbone scoring label words at mask positions."""
+
+    config: MegatronBertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        return MegatronBertForMaskedLM(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+
+    def partition_rules(self):
+        from fengshen_tpu.models.megatron_bert.modeling_megatron_bert \
+            import PARTITION_RULES
+        return PARTITION_RULES
+
+
+class TCBertPipelines:
+    @staticmethod
+    def pipelines_args(parent_parser: argparse.ArgumentParser):
+        parser = parent_parser.add_argument_group("tcbert")
+        parser.add_argument("--max_length", default=512, type=int)
+        parser.add_argument("--prompt", default="这是一则{}新闻：", type=str)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
+        return parent_parser
+
+    def __init__(self, args=None, model: Optional[str] = None,
+                 tokenizer=None, config=None, params=None,
+                 label_words: Optional[list[str]] = None):
+        self.args = args
+        if config is None and model is not None:
+            config = MegatronBertConfig.from_pretrained(model)
+        if config is None:
+            config = MegatronBertConfig.small_test_config()
+        self.config = config
+        if tokenizer is None and model is not None:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model)
+        self.tokenizer = tokenizer
+        self.model = TCBertModel(config)
+        self.params = params
+        self.label_words = label_words or []
+
+    def _encode(self, text: str, mask_len: int) -> tuple[list[int], int]:
+        tok = self.tokenizer
+        prompt_prefix = [tok.cls_token_id] + \
+            [tok.mask_token_id] * mask_len
+        body = tok.encode(text, add_special_tokens=False)
+        max_len = getattr(self.args, "max_length", 512) if self.args else 512
+        ids = (prompt_prefix + body + [tok.sep_token_id])[:max_len]
+        return ids, 1  # mask positions start after [CLS]
+
+    def predict(self, texts: list[str],
+                label_words: Optional[list[str]] = None) -> list[int]:
+        label_words = label_words or self.label_words
+        assert label_words, "label_words required"
+        if self.params is None:
+            self.params = self.model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+            )["params"]
+        tok = self.tokenizer
+        label_ids = [tok.encode(w, add_special_tokens=False)
+                     for w in label_words]
+        mask_len = max(len(l) for l in label_ids)
+        results = []
+        for text in texts:
+            ids, mask_start = self._encode(text, mask_len)
+            arr = jnp.asarray([ids], jnp.int32)
+            logits = self.model.apply({"params": self.params}, arr,
+                                      attention_mask=jnp.ones_like(arr))
+            logp = jax.nn.log_softmax(
+                np.asarray(logits)[0, mask_start:mask_start + mask_len],
+                axis=-1)
+            scores = []
+            for lab in label_ids:
+                s = sum(float(logp[i, t]) for i, t in enumerate(lab))
+                scores.append(s / len(lab))
+            results.append(int(np.argmax(scores)))
+        return results

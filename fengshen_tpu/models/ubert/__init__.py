@@ -1,0 +1,7 @@
+"""UBERT — unified extraction via biaffine spans (reference:
+fengshen/models/ubert/, 776 LoC self-contained model+pipeline)."""
+
+from fengshen_tpu.models.ubert.modeling_ubert import (UbertModel,
+                                                      UbertPipelines)
+
+__all__ = ["UbertModel", "UbertPipelines"]

@@ -1,0 +1,149 @@
+"""UBERT: unified information extraction with a biaffine span scorer.
+
+Behavioural port of reference: fengshen/models/ubert/ — task instruction +
+entity-type prompt + text in one sequence; a biaffine head scores every
+(start, end) span as belonging to the queried type; multi-label BCE loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
+                                               MegatronBertModel)
+from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
+    PARTITION_RULES, _dense)
+
+
+class UbertModel(nn.Module):
+    """Encoder + span biaffine with sigmoid scores."""
+
+    config: MegatronBertConfig
+    biaffine_size: int = 128
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 span_labels=None, span_mask=None, deterministic=True):
+        cfg = self.config
+        hidden, _ = MegatronBertModel(cfg, add_pooling_layer=False,
+                                      name="bert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        start = jax.nn.gelu(_dense(cfg, self.biaffine_size,
+                                   "start_mlp")(hidden))
+        end = jax.nn.gelu(_dense(cfg, self.biaffine_size,
+                                 "end_mlp")(hidden))
+        U = self.param("biaffine_u", nn.initializers.normal(0.02),
+                       (self.biaffine_size + 1, self.biaffine_size + 1),
+                       jnp.float32)
+        ones = jnp.ones(start.shape[:-1] + (1,), start.dtype)
+        start = jnp.concatenate([start, ones], axis=-1)
+        end = jnp.concatenate([end, ones], axis=-1)
+        span_logits = jnp.einsum("bid,de,bje->bij", start,
+                                 U.astype(start.dtype), end)
+        if span_labels is None:
+            return jax.nn.sigmoid(span_logits)
+        # multi-label BCE over valid spans
+        logp = jax.nn.log_sigmoid(span_logits)
+        lognp = jax.nn.log_sigmoid(-span_logits)
+        loss = -(span_labels * logp + (1 - span_labels) * lognp)
+        if span_mask is not None:
+            loss = loss * span_mask
+            denom = jnp.maximum(span_mask.sum(), 1)
+        else:
+            denom = loss.size
+        return loss.sum() / denom, jax.nn.sigmoid(span_logits)
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class UbertPipelines:
+    """Reference contract: fengshen/models/ubert `UbertPipelines` —
+    fit(train_data, dev_data) / predict(test_data) over instruction-style
+    samples {task_type, subtask_type, text, choices:[{entity_type, ...}]}."""
+
+    @staticmethod
+    def pipelines_args(parent_parser: argparse.ArgumentParser):
+        parser = parent_parser.add_argument_group("ubert")
+        parser.add_argument("--max_length", default=512, type=int)
+        parser.add_argument("--threshold", default=0.5, type=float)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
+        return parent_parser
+
+    def __init__(self, args=None, model: Optional[str] = None,
+                 tokenizer=None, config=None, params=None):
+        self.args = args
+        if config is None and model is not None:
+            config = MegatronBertConfig.from_pretrained(model)
+        if config is None:
+            config = MegatronBertConfig.small_test_config()
+        self.config = config
+        if tokenizer is None and model is not None:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model)
+        self.tokenizer = tokenizer
+        self.model = UbertModel(config)
+        self.params = params
+
+    def _encode(self, sample: dict, entity_type: str) -> dict:
+        tok = self.tokenizer
+        prompt = f"{sample.get('task_type', '抽取任务')}[SEP]{entity_type}"
+        p_ids = tok.encode(prompt, add_special_tokens=False)
+        t_ids = tok.encode(sample["text"], add_special_tokens=False)
+        ids = [tok.cls_token_id] + p_ids + [tok.sep_token_id] + t_ids + \
+            [tok.sep_token_id]
+        text_offset = 2 + len(p_ids)
+        max_len = getattr(self.args, "max_length", 512) if self.args else 512
+        return {"input_ids": ids[:max_len], "text_offset": text_offset}
+
+    def predict(self, data: list[dict]) -> list[dict]:
+        if self.params is None:
+            self.params = self.model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+            )["params"]
+        threshold = getattr(self.args, "threshold", 0.5) if self.args \
+            else 0.5
+        results = []
+        for sample in data:
+            out = {"text": sample["text"], "choices": []}
+            for choice in sample.get("choices", []):
+                etype = choice["entity_type"] if isinstance(choice, dict) \
+                    else str(choice)
+                enc = self._encode(sample, etype)
+                ids = jnp.asarray([enc["input_ids"]], jnp.int32)
+                scores = self.model.apply(
+                    {"params": self.params}, ids,
+                    attention_mask=jnp.ones_like(ids))
+                s = np.asarray(scores)[0]
+                off = enc["text_offset"]
+                entities = []
+                n = len(enc["input_ids"]) - 1  # drop final [SEP]
+                for i in range(off, n):
+                    for j in range(i, min(i + 32, n)):
+                        if s[i, j] > threshold:
+                            span_text = self.tokenizer.decode(
+                                enc["input_ids"][i:j + 1]).replace(" ", "")
+                            entities.append({
+                                "entity_type": etype,
+                                "entity_name": span_text,
+                                "score": float(s[i, j]),
+                                "start": i - off, "end": j - off})
+                out["choices"].append({"entity_type": etype,
+                                       "entity_list": entities})
+            results.append(out)
+        return results

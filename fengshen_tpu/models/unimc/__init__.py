@@ -1,0 +1,7 @@
+"""UniMC — label-as-option MRC classification (reference:
+fengshen/models/unimc/, FewCLUE/ZeroCLUE SOTA per SURVEY.md §6)."""
+
+from fengshen_tpu.models.unimc.modeling_unimc import (UniMCModel,
+                                                      UniMCPipelines)
+
+__all__ = ["UniMCModel", "UniMCPipelines"]

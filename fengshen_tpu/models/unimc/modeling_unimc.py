@@ -1,0 +1,200 @@
+"""UniMC: unified multiple-choice classification via option masks.
+
+Behavioural port of reference: fengshen/models/unimc/ (`UniMCModel` +
+`UniMCPipelines`, 660 LoC) — zero/few-shot classification reformulated as
+MRC: every label becomes an option prefixed with a special option-mask
+token; the MLM head scores a "yes" token at each option's mask position and
+the option with the highest score wins. Training minimises CE over option
+positions.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
+                                               MegatronBertForMaskedLM)
+
+
+class UniMCModel(nn.Module):
+    """MLM backbone + option-position scoring."""
+
+    config: MegatronBertConfig
+    yes_token_id: int = 1
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 option_positions=None, deterministic=True):
+        """option_positions: [B, n_options] indices of each option's mask
+        token. Returns per-option scores [B, n_options]."""
+        logits = MegatronBertForMaskedLM(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        if option_positions is None:
+            return logits
+        # score of the yes-token at each option mask position
+        pos_logits = jnp.take_along_axis(
+            logits,
+            jnp.broadcast_to(option_positions[..., None],
+                             option_positions.shape +
+                             (logits.shape[-1],)), axis=1)
+        return pos_logits[..., self.yes_token_id]
+
+    def partition_rules(self):
+        from fengshen_tpu.models.megatron_bert.modeling_megatron_bert \
+            import PARTITION_RULES
+        return PARTITION_RULES
+
+
+class UniMCPipelines:
+    """Reference: fengshen/pipelines/multiplechoice.py:41 wraps the
+    self-contained model; contract: train(data) / predict(data)."""
+
+    @staticmethod
+    def add_pipeline_specific_args(parent_parser: argparse.ArgumentParser):
+        parser = parent_parser.add_argument_group("unimc")
+        parser.add_argument("--max_length", default=512, type=int)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
+        return parent_parser
+
+    def __init__(self, args=None, model: Optional[str] = None,
+                 tokenizer=None, config=None, params=None):
+        self.args = args
+        if config is None and model is not None:
+            config = MegatronBertConfig.from_pretrained(model)
+        if config is None:
+            config = MegatronBertConfig.small_test_config()
+        self.config = config
+        if tokenizer is None and model is not None:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model)
+        self.tokenizer = tokenizer
+        yes_id = 1
+        if tokenizer is not None:
+            ids = tokenizer.convert_tokens_to_ids(["是"])
+            if ids and ids[0] != tokenizer.unk_token_id:
+                yes_id = ids[0]
+        self.model = UniMCModel(config, yes_token_id=yes_id)
+        self.params = params
+
+    def _encode(self, sample: dict) -> dict:
+        """sample: {texta, choices: [...], label?}. Layout:
+        [CLS] [MASK] opt1 [SEP] [MASK] opt2 [SEP] ... text [SEP]"""
+        tok = self.tokenizer
+        ids = [tok.cls_token_id]
+        option_positions = []
+        for choice in sample["choices"]:
+            option_positions.append(len(ids))
+            ids.append(tok.mask_token_id)
+            ids.extend(tok.encode(choice, add_special_tokens=False))
+            ids.append(tok.sep_token_id)
+        ids.extend(tok.encode(sample.get("texta", ""),
+                              add_special_tokens=False))
+        ids.append(tok.sep_token_id)
+        max_len = getattr(self.args, "max_length", 512) if self.args else 512
+        ids = ids[:max_len]
+        return {"input_ids": ids, "option_positions": option_positions,
+                "label": sample.get("label")}
+
+    def _collate(self, samples: list[dict]) -> dict:
+        encoded = [self._encode(s) for s in samples]
+        max_len = max(len(e["input_ids"]) for e in encoded)
+        n_opt = max(len(e["option_positions"]) for e in encoded)
+        pad = self.tokenizer.pad_token_id or 0
+        batch = {"input_ids": [], "attention_mask": [],
+                 "option_positions": [], "labels": []}
+        for e in encoded:
+            p = max_len - len(e["input_ids"])
+            batch["input_ids"].append(e["input_ids"] + [pad] * p)
+            batch["attention_mask"].append([1] * len(e["input_ids"]) +
+                                           [0] * p)
+            opts = e["option_positions"] + [0] * (
+                n_opt - len(e["option_positions"]))
+            batch["option_positions"].append(opts)
+            batch["labels"].append(e["label"] if e["label"] is not None
+                                   else -100)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+    def train(self, train_data: list[dict],
+              dev_data: Optional[list[dict]] = None) -> None:
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+        from fengshen_tpu.trainer import Trainer
+        from fengshen_tpu.trainer.module import TrainModule
+
+        pipe = self
+
+        class _Module(TrainModule):
+            def __init__(self, args):
+                super().__init__(args)
+                self.model = pipe.model
+                self.config = pipe.config
+
+            def init_params(self, rng):
+                return self.model.init(
+                    rng, jnp.zeros((1, 16), jnp.int32))["params"]
+
+            def training_loss(self, params, batch, rng):
+                scores = self.model.apply(
+                    {"params": params}, batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    option_positions=batch["option_positions"],
+                    deterministic=False, rngs={"dropout": rng})
+                loss, _ = stable_cross_entropy(scores[:, None, :],
+                                               batch["labels"][:, None])
+                acc = (scores.argmax(-1) == batch["labels"]).mean()
+                return loss, {"acc": acc}
+
+            def partition_rules(self):
+                return self.model.partition_rules()
+
+        class ListDS:
+            def __init__(self, rows):
+                self.rows = rows
+
+            def __len__(self):
+                return len(self.rows)
+
+            def __getitem__(self, i):
+                return self.rows[i]
+
+        datasets = {"train": ListDS(train_data)}
+        if dev_data:
+            datasets["validation"] = ListDS(dev_data)
+        dm = UniversalDataModule(tokenizer=self.tokenizer,
+                                 collate_fn=self._collate, args=self.args,
+                                 datasets=datasets)
+        module = _Module(self.args)
+        if self.params is not None:
+            module.init_params = lambda rng: self.params
+        trainer = Trainer(self.args)
+        state = trainer.fit(module, dm)
+        self.params = state.params
+
+    def predict(self, data: list[dict]) -> list[int]:
+        if self.params is None:
+            self.params = self.model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+            )["params"]
+        batch = self._collate(data)
+        scores = self.model.apply(
+            {"params": self.params},
+            jnp.asarray(batch["input_ids"], jnp.int32),
+            attention_mask=jnp.asarray(batch["attention_mask"], jnp.int32),
+            option_positions=jnp.asarray(batch["option_positions"],
+                                         jnp.int32))
+        return [int(x) for x in np.asarray(scores.argmax(-1))]

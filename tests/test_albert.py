@@ -1,0 +1,38 @@
+"""ALBERT golden-value parity vs HF torch."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.models.albert import AlbertConfig, AlbertModel
+from fengshen_tpu.models.albert.convert import torch_to_params
+
+
+def test_albert_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+    hf_cfg = transformers.AlbertConfig(
+        vocab_size=128, embedding_size=16, hidden_size=32,
+        num_hidden_layers=3, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.AlbertModel(hf_cfg).eval()
+    cfg = AlbertConfig(vocab_size=128, embedding_size=16, hidden_size=32,
+                       num_hidden_layers=3, num_attention_heads=4,
+                       intermediate_size=64, max_position_embeddings=64,
+                       dtype="float32")
+    sd = {f"albert.{k}" if not k.startswith("albert.") else k: v
+          for k, v in tm.state_dict().items()}
+    params = torch_to_params(sd, cfg)
+    ids = np.array([[3, 17, 9, 42, 7, 99, 1, 5]], dtype=np.int32)
+    mask = np.array([[1, 1, 1, 1, 1, 1, 1, 0]], dtype=np.int32)
+    hidden, pooled = AlbertModel(cfg).apply(
+        {"params": params}, jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask))
+    with torch.no_grad():
+        out = tm(torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(mask, dtype=torch.long))
+    np.testing.assert_allclose(np.asarray(hidden),
+                               out.last_hidden_state.numpy(), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(), atol=2e-3)

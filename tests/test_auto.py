@@ -1,0 +1,38 @@
+"""Auto-class registry tests."""
+
+import json
+
+import pytest
+
+from fengshen_tpu.models.auto import AutoConfig, AutoModel, register_model
+
+
+def test_auto_config_from_path(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "llama", "vocab_size": 64, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 1,
+        "num_attention_heads": 4}))
+    cfg = AutoConfig.from_pretrained(str(tmp_path))
+    assert type(cfg).__name__ == "LlamaConfig"
+    assert cfg.vocab_size == 64
+
+
+def test_auto_model_from_config():
+    cfg = AutoConfig.for_model("gpt2", vocab_size=64, n_embd=32, n_layer=1,
+                               n_head=4)
+    model = AutoModel.from_config(cfg, head="causal_lm")
+    assert type(model).__name__ == "GPT2LMHeadModel"
+
+
+def test_auto_unknown_type():
+    with pytest.raises(KeyError, match="unknown model_type"):
+        AutoConfig.for_model("nope")
+
+
+def test_register_model():
+    register_model("test-fake", "fengshen_tpu.models.llama", "LlamaConfig",
+                   {"base": "LlamaModel"})
+    cfg = AutoConfig.for_model("test-fake", vocab_size=8, hidden_size=16,
+                               intermediate_size=32, num_hidden_layers=1,
+                               num_attention_heads=2)
+    assert cfg.vocab_size == 8

@@ -1,0 +1,37 @@
+"""RoFormer golden-value parity vs HF torch."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.models.roformer import RoFormerConfig, RoFormerModel
+from fengshen_tpu.models.roformer.convert import torch_to_params
+
+
+def test_roformer_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+    hf_cfg = transformers.RoFormerConfig(
+        vocab_size=128, embedding_size=32, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, rotary_value=False,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.RoFormerModel(hf_cfg).eval()
+    cfg = RoFormerConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=64, max_position_embeddings=64,
+                         dtype="float32")
+    sd = {f"roformer.{k}": v for k, v in tm.state_dict().items()}
+    params = torch_to_params(sd, cfg)["roformer"]  # top-level apply: unnest
+    model = RoFormerModel(cfg, add_pooling_layer=False)
+    ids = np.array([[3, 17, 9, 42, 7, 99, 1, 5]], dtype=np.int32)
+    mask = np.array([[1, 1, 1, 1, 1, 1, 1, 0]], dtype=np.int32)
+    hidden, _ = model.apply({"params": params},
+                            jnp.asarray(ids),
+                            attention_mask=jnp.asarray(mask))
+    with torch.no_grad():
+        ref = tm(torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(mask, dtype=torch.long)
+                 ).last_hidden_state.numpy()
+    np.testing.assert_allclose(np.asarray(hidden), ref, atol=2e-3)

@@ -1,0 +1,65 @@
+"""UniMC / UBERT / TCBert smoke + behavioural tests."""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+
+
+def _bert_tokenizer(tmp_path):
+    from transformers import BertTokenizer
+    chars = list("是否这则一体育财经新闻运动员比赛股市经济测试文本北京大学")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + \
+        sorted(set(chars))
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab))
+    return BertTokenizer(str(vf))
+
+
+def _small_cfg(tok):
+    return MegatronBertConfig(
+        vocab_size=len(tok), hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, dtype="float32")
+
+
+def test_unimc_train_and_predict(tmp_path, mesh8):
+    from fengshen_tpu.models.unimc import UniMCPipelines
+    tok = _bert_tokenizer(tmp_path)
+    parser = argparse.ArgumentParser()
+    parser = UniMCPipelines.add_pipeline_specific_args(parser)
+    args = parser.parse_args([
+        "--max_steps", "2", "--train_batchsize", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs")])
+    pipe = UniMCPipelines(args=args, tokenizer=tok, config=_small_cfg(tok))
+    data = [{"texta": "运动员比赛", "choices": ["体育", "财经"], "label": 0},
+            {"texta": "股市经济", "choices": ["体育", "财经"], "label": 1}] * 4
+    pipe.train(data)
+    preds = pipe.predict(data[:2])
+    assert len(preds) == 2 and all(p in (0, 1) for p in preds)
+
+
+def test_ubert_predict_shapes(tmp_path):
+    from fengshen_tpu.models.ubert import UbertPipelines
+    tok = _bert_tokenizer(tmp_path)
+    pipe = UbertPipelines(args=None, tokenizer=tok, config=_small_cfg(tok))
+    out = pipe.predict([{"task_type": "抽取任务", "text": "北京大学",
+                         "choices": [{"entity_type": "机构"}]}])
+    assert len(out) == 1
+    assert out[0]["choices"][0]["entity_type"] == "机构"
+    for ent in out[0]["choices"][0]["entity_list"]:
+        assert set(ent) >= {"entity_name", "score", "start", "end"}
+
+
+def test_tcbert_predict(tmp_path):
+    from fengshen_tpu.models.tcbert import TCBertPipelines
+    tok = _bert_tokenizer(tmp_path)
+    pipe = TCBertPipelines(args=None, tokenizer=tok, config=_small_cfg(tok),
+                           label_words=["体育", "财经"])
+    preds = pipe.predict(["运动员比赛", "股市经济"])
+    assert len(preds) == 2 and all(p in (0, 1) for p in preds)
