@@ -42,6 +42,16 @@ MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
                {"base": "AlbertModel", "masked_lm": "AlbertForMaskedLM",
                 "sequence_classification":
                     "AlbertForSequenceClassification"}),
+    "deberta-v2": ("fengshen_tpu.models.deberta_v2", "DebertaV2Config",
+                   {"base": "DebertaV2Model",
+                    "masked_lm": "DebertaV2ForMaskedLM",
+                    "sequence_classification":
+                        "DebertaV2ForSequenceClassification"}),
+    "longformer": ("fengshen_tpu.models.longformer", "LongformerConfig",
+                   {"base": "LongformerModel",
+                    "masked_lm": "LongformerForMaskedLM",
+                    "sequence_classification":
+                        "LongformerForSequenceClassification"}),
 }
 
 

@@ -1,0 +1,9 @@
+"""DeBERTa-v2 family (reference: fengshen/models/deberta_v2/ — the
+Erlangshen-DeBERTa-v2 Chinese NLU fork, 1,617 LoC)."""
+
+from fengshen_tpu.models.deberta_v2.modeling_deberta_v2 import (
+    DebertaV2Config, DebertaV2Model, DebertaV2ForMaskedLM,
+    DebertaV2ForSequenceClassification)
+
+__all__ = ["DebertaV2Config", "DebertaV2Model", "DebertaV2ForMaskedLM",
+           "DebertaV2ForSequenceClassification"]

@@ -1,0 +1,66 @@
+"""torch(HF) → jax weights for DeBERTa-v2."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.deberta_v2.modeling_deberta_v2 import (
+    DebertaV2Config)
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: DebertaV2Config) -> dict:
+    def t(name):
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().float().numpy()
+        return np.asarray(x)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    d: dict = {
+        "word_embeddings": {
+            "embedding": t("deberta.embeddings.word_embeddings.weight")},
+        "embeddings_ln": ln("deberta.embeddings.LayerNorm"),
+    }
+    if config.position_biased_input:
+        d["position_embeddings"] = {"embedding": t(
+            "deberta.embeddings.position_embeddings.weight")}
+    if config.relative_attention:
+        d["rel_embeddings"] = t("deberta.encoder.rel_embeddings.weight")
+        if "layer_norm" in config.norm_rel_ebd:
+            d["rel_embeddings_ln"] = ln("deberta.encoder.LayerNorm")
+    if config.conv_kernel_size > 0:
+        # torch Conv1d weight [out, in, k] → flax Conv kernel [k, in, out]
+        d["conv"] = {"kernel": t("deberta.encoder.conv.conv.weight"
+                                 ).transpose(2, 1, 0),
+                     "bias": t("deberta.encoder.conv.conv.bias")}
+        d["conv_ln"] = ln("deberta.encoder.conv.LayerNorm")
+    for i in range(config.num_hidden_layers):
+        pre = f"deberta.encoder.layer.{i}"
+        layer = {
+            "self": {
+                "query_proj": lin(f"{pre}.attention.self.query_proj"),
+                "key_proj": lin(f"{pre}.attention.self.key_proj"),
+                "value_proj": lin(f"{pre}.attention.self.value_proj"),
+            },
+            "attention_output_dense": lin(f"{pre}.attention.output.dense"),
+            "attention_ln": ln(f"{pre}.attention.output.LayerNorm"),
+            "intermediate_dense": lin(f"{pre}.intermediate.dense"),
+            "output_dense": lin(f"{pre}.output.dense"),
+            "output_ln": ln(f"{pre}.output.LayerNorm"),
+        }
+        if not config.share_att_key:
+            layer["self"]["pos_query_proj"] = lin(
+                f"{pre}.attention.self.pos_query_proj")
+            layer["self"]["pos_key_proj"] = lin(
+                f"{pre}.attention.self.pos_key_proj")
+        d[f"layer_{i}"] = layer
+    return {"deberta": d}

@@ -1,0 +1,347 @@
+"""DeBERTa-v2 in flax, HF-weight-compatible.
+
+Reference: fengshen/models/deberta_v2/ (HF fork for Erlangshen-DeBERTa).
+Disentangled attention: content↔content plus content→position (c2p) and
+position→content (p2c) terms over log-bucketed relative positions, with the
+relative-position embedding table shared across layers and projected by the
+(shared) key/query projections. Optional depthwise conv branch on layer 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", None)),
+    (r"(query_proj|key_proj|value_proj|intermediate_dense)/kernel",
+     P("fsdp", "tensor")),
+    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class DebertaV2Config:
+    vocab_size: int = 128100
+    hidden_size: int = 1536
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 24
+    intermediate_size: int = 6144
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 0
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-7
+    relative_attention: bool = True
+    max_relative_positions: int = -1
+    position_buckets: int = 256
+    norm_rel_ebd: str = "layer_norm"
+    share_att_key: bool = True
+    pos_att_type: tuple = ("p2c", "c2p")
+    position_biased_input: bool = False
+    conv_kernel_size: int = 0
+    conv_act: str = "tanh"  # HF DebertaV2 default
+    pad_token_id: int = 0
+    num_labels: int = 2
+    pooler_hidden_size: Optional[int] = None
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.max_relative_positions < 1:
+            self.max_relative_positions = self.max_position_embeddings
+        if self.pooler_hidden_size is None:
+            self.pooler_hidden_size = self.hidden_size
+        if isinstance(self.pos_att_type, str):
+            self.pos_att_type = tuple(
+                x.strip() for x in self.pos_att_type.split("|") if x)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def pos_ebd_size(self) -> int:
+        return self.position_buckets if self.position_buckets > 0 \
+            else self.max_relative_positions
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "DebertaV2Config":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "DebertaV2Config":
+        base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, position_buckets=8)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def _dense(cfg, feats, name):
+    return nn.Dense(feats, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+def make_log_bucket_position(relative_pos, bucket_size: int,
+                             max_position: int):
+    """Exact port of HF's torchscript make_log_bucket_position."""
+    sign = jnp.sign(relative_pos)
+    mid = bucket_size // 2
+    inside = (relative_pos < mid) & (relative_pos > -mid)
+    abs_pos = jnp.where(inside, mid - 1, jnp.abs(relative_pos)
+                        ).astype(jnp.float32)
+    log_pos = jnp.ceil(
+        jnp.log(abs_pos / mid) /
+        np.log((max_position - 1) / mid) * (mid - 1)) + mid
+    bucket_pos = jnp.where(abs_pos <= mid,
+                           relative_pos.astype(jnp.float32),
+                           log_pos * sign)
+    return bucket_pos.astype(jnp.int32)
+
+
+def build_relative_position(q_len: int, k_len: int, bucket_size: int,
+                            max_position: int):
+    rel = jnp.arange(q_len)[:, None] - jnp.arange(k_len)[None, :]
+    if bucket_size > 0 and max_position > 0:
+        rel = make_log_bucket_position(rel, bucket_size, max_position)
+    return rel.astype(jnp.int32)  # [q, k]
+
+
+class DisentangledSelfAttention(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, rel_embeddings,
+                 relative_pos, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+
+        q_proj = _dense(cfg, cfg.hidden_size, "query_proj")
+        k_proj = _dense(cfg, cfg.hidden_size, "key_proj")
+        v_proj = _dense(cfg, cfg.hidden_size, "value_proj")
+        q = q_proj(hidden).reshape(batch, seq, n_head, head_dim)
+        k = k_proj(hidden).reshape(batch, seq, n_head, head_dim)
+        v = v_proj(hidden).reshape(batch, seq, n_head, head_dim)
+
+        scale_factor = 1 + len(cfg.pos_att_type)
+        scale = jnp.sqrt(jnp.asarray(head_dim * scale_factor, jnp.float32))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / scale
+
+        if cfg.relative_attention:
+            att_span = cfg.pos_ebd_size
+            rel_emb = rel_embeddings[: att_span * 2]  # [2*span, H]
+            if cfg.share_att_key:
+                pos_q = q_proj(rel_emb).reshape(-1, n_head, head_dim)
+                pos_k = k_proj(rel_emb).reshape(-1, n_head, head_dim)
+            else:
+                pos_q = _dense(cfg, cfg.hidden_size, "pos_query_proj")(
+                    rel_emb).reshape(-1, n_head, head_dim)
+                pos_k = _dense(cfg, cfg.hidden_size, "pos_key_proj")(
+                    rel_emb).reshape(-1, n_head, head_dim)
+
+            if "c2p" in cfg.pos_att_type:
+                c2p = jnp.einsum("bqhd,phd->bhqp", q, pos_k,
+                                 preferred_element_type=jnp.float32)
+                c2p_pos = jnp.clip(relative_pos + att_span, 0,
+                                   att_span * 2 - 1)  # [q, k]
+                gathered = jnp.take_along_axis(
+                    c2p, jnp.broadcast_to(
+                        c2p_pos[None, None], (batch, n_head) +
+                        c2p_pos.shape), axis=-1)
+                scores = scores + gathered / scale
+            if "p2c" in cfg.pos_att_type:
+                p2c = jnp.einsum("bkhd,phd->bhkp", k, pos_q,
+                                 preferred_element_type=jnp.float32)
+                p2c_pos = jnp.clip(-relative_pos + att_span, 0,
+                                   att_span * 2 - 1)  # [q, k] (k as rows
+                # after transpose below)
+                gathered = jnp.take_along_axis(
+                    p2c, jnp.broadcast_to(
+                        p2c_pos[None, None], (batch, n_head) +
+                        p2c_pos.shape), axis=-1)
+                scores = scores + gathered.transpose(0, 1, 3, 2) / scale
+
+        if attention_mask is not None:
+            scores = jnp.where(
+                attention_mask[:, None, None, :].astype(bool), scores,
+                jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            keep = jax.random.bernoulli(
+                self.make_rng("dropout"),
+                1.0 - cfg.attention_probs_dropout_prob, probs.shape)
+            probs = jnp.where(
+                keep, probs / (1.0 - cfg.attention_probs_dropout_prob), 0.0)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        return out.reshape(batch, seq, cfg.hidden_size)
+
+
+class DebertaV2Layer(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask, rel_embeddings, relative_pos,
+                 deterministic=True):
+        cfg = self.config
+        h = DisentangledSelfAttention(cfg, name="self")(
+            hidden, attention_mask, rel_embeddings, relative_pos,
+            deterministic)
+        h = _dense(cfg, cfg.hidden_size, "attention_output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="attention_ln")(hidden + h)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="output_ln")(hidden + h)
+
+
+class DebertaV2Model(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((batch, seq), jnp.int32)
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
+                          param_dtype=jnp.dtype(cfg.param_dtype),
+                          embedding_init=nn.initializers.normal(
+                              cfg.initializer_range),
+                          name="word_embeddings")(input_ids)
+        if cfg.position_biased_input:
+            pos = jnp.arange(seq)[None]
+            hidden = hidden + nn.Embed(
+                cfg.max_position_embeddings, cfg.hidden_size,
+                dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+                embedding_init=nn.initializers.normal(
+                    cfg.initializer_range),
+                name="position_embeddings")(pos)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        # HF masks embeddings by the input mask
+        hidden = hidden * attention_mask[..., None].astype(hidden.dtype)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+
+        rel_embeddings = None
+        relative_pos = None
+        if cfg.relative_attention:
+            rel_embeddings = self.param(
+                "rel_embeddings", nn.initializers.normal(
+                    cfg.initializer_range),
+                (cfg.pos_ebd_size * 2, cfg.hidden_size),
+                jnp.dtype(cfg.param_dtype))
+            if "layer_norm" in cfg.norm_rel_ebd:
+                rel_embeddings = LayerNorm(
+                    epsilon=cfg.layer_norm_eps, name="rel_embeddings_ln")(
+                    rel_embeddings)
+            relative_pos = build_relative_position(
+                seq, seq, cfg.position_buckets, cfg.max_relative_positions)
+
+        conv_out = None
+        for i in range(cfg.num_hidden_layers):
+            prev = hidden
+            hidden = DebertaV2Layer(cfg, name=f"layer_{i}")(
+                hidden, attention_mask, rel_embeddings, relative_pos,
+                deterministic)
+            if i == 0 and cfg.conv_kernel_size > 0:
+                conv = nn.Conv(
+                    cfg.hidden_size, (cfg.conv_kernel_size,),
+                    padding="SAME", feature_group_count=1,
+                    dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+                    name="conv")(prev)
+                conv = conv * attention_mask[..., None].astype(conv.dtype)
+                conv = get_activation(cfg.conv_act)(
+                    nn.Dropout(cfg.hidden_dropout_prob)(
+                        conv, deterministic=deterministic))
+                hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                                   name="conv_ln")(hidden + conv)
+                hidden = hidden * attention_mask[..., None].astype(
+                    hidden.dtype)
+        return hidden
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class DebertaV2ForMaskedLM(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        hidden = DebertaV2Model(cfg, name="deberta")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        wte = self.variables["params"]["deberta"]["word_embeddings"][
+            "embedding"]
+        logits = h @ wte.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class DebertaV2ForSequenceClassification(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        hidden = DebertaV2Model(cfg, name="deberta")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        # ContextPooler: dense+tanh over [CLS] with dropout
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden[:, 0], deterministic=deterministic)
+        pooled = jnp.tanh(_dense(cfg, cfg.pooler_hidden_size,
+                                 "pooler_dense")(pooled))
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(pooled)
+
+    def partition_rules(self):
+        return PARTITION_RULES

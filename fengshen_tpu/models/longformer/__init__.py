@@ -1,0 +1,9 @@
+"""Longformer family (reference: fengshen/models/longformer/ — sliding
+window + global attention with RoPE for long-doc Chinese NLU, 2,572 LoC)."""
+
+from fengshen_tpu.models.longformer.modeling_longformer import (
+    LongformerConfig, LongformerModel, LongformerForMaskedLM,
+    LongformerForSequenceClassification)
+
+__all__ = ["LongformerConfig", "LongformerModel", "LongformerForMaskedLM",
+           "LongformerForSequenceClassification"]

@@ -1,0 +1,275 @@
+"""Longformer in flax.
+
+Reference: fengshen/models/longformer/modeling_longformer.py — BERT encoder
+whose attention is sliding-window local + designated global tokens, the
+reference's long-document NLU answer (SURVEY.md §5.7). Semantics:
+
+- local: token i attends j iff |i-j| ≤ window//2;
+- global tokens (from `global_attention_mask`) attend everywhere and are
+  attended by everyone, through SEPARATE global q/k/v projections for the
+  global-query rows (HF convention).
+
+This implementation expresses the pattern as a mask over dense attention —
+on TPU the MXU makes dense-with-mask the right baseline; the block-sparse
+layouts in ops.masks + Pallas flash cover the truly long regime. The
+reference fork also adds RoPE (`RoPEmbedding`); enabled via
+`use_rotary=True` (the Erlangshen-Longformer variant).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.masks import sliding_window_mask
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", None)),
+    (r"(query|key|value|query_global|key_global|value_global|"
+     r"intermediate_dense)/kernel", P("fsdp", "tensor")),
+    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class LongformerConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 4096
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    attention_window: int = 512
+    use_rotary: bool = False  # Erlangshen fork adds RoPE
+    pad_token_id: int = 0
+    num_labels: int = 2
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "LongformerConfig":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        if isinstance(raw.get("attention_window"), list):
+            raw["attention_window"] = raw["attention_window"][0]
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "LongformerConfig":
+        base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, attention_window=8)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def _dense(cfg, feats, name):
+    return nn.Dense(feats, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+class LongformerSelfAttention(nn.Module):
+    config: LongformerConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None,
+                 global_attention_mask=None, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+
+        def qkv(prefix):
+            q = _dense(cfg, cfg.hidden_size, f"query{prefix}")(hidden)
+            k = _dense(cfg, cfg.hidden_size, f"key{prefix}")(hidden)
+            v = _dense(cfg, cfg.hidden_size, f"value{prefix}")(hidden)
+            shape = (batch, seq, n_head, head_dim)
+            q, k, v = (x.reshape(shape) for x in (q, k, v))
+            if cfg.use_rotary:
+                pos = jnp.arange(seq)[None]
+                q, k = apply_rotary_pos_emb(q, k, pos)
+            return q, k, v
+
+        q, k, v = qkv("")
+        qg, kg, vg = qkv("_global")
+
+        half = cfg.attention_window // 2
+        local = sliding_window_mask(seq, half + 1, causal=False)  # |i-j|<=half
+        valid = jnp.ones((batch, seq), bool) if attention_mask is None \
+            else attention_mask.astype(bool)
+        if global_attention_mask is None:
+            is_global = jnp.zeros((batch, seq), bool)
+        else:
+            is_global = global_attention_mask.astype(bool) & valid
+
+        # pattern: local OR column-global (everyone sees global keys);
+        # global-query rows handled separately below
+        mask = local[None] | is_global[:, None, :]
+        mask = mask & valid[:, None, :] & valid[:, :, None]
+        bias = jnp.where(mask[:, None], 0.0, -1e9)
+
+        scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        probs = jax.nn.softmax(scores + bias, axis=-1)
+        out_local = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+        # global queries: full attention with the global projections
+        g_scores = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
+                              preferred_element_type=jnp.float32) * scale
+        g_bias = jnp.where(valid[:, None, None, :], 0.0, -1e9)
+        g_probs = jax.nn.softmax(g_scores + g_bias, axis=-1)
+        out_global = jnp.einsum("bhqk,bkhd->bqhd",
+                                g_probs.astype(vg.dtype), vg)
+
+        out = jnp.where(is_global[:, :, None, None], out_global, out_local)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        return out.reshape(batch, seq, cfg.hidden_size)
+
+
+class LongformerLayer(nn.Module):
+    config: LongformerConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None,
+                 global_attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = LongformerSelfAttention(cfg, name="self")(
+            hidden, attention_mask, global_attention_mask, deterministic)
+        h = _dense(cfg, cfg.hidden_size, "attention_output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="attention_ln")(hidden + h)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="output_ln")(hidden + h)
+
+
+class LongformerModel(nn.Module):
+    config: LongformerConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 global_attention_mask=None, position_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
+                          param_dtype=jnp.dtype(cfg.param_dtype),
+                          embedding_init=nn.initializers.normal(
+                              cfg.initializer_range),
+                          name="word_embeddings")(input_ids)
+        if not cfg.use_rotary:
+            if position_ids is None:
+                position_ids = jnp.arange(seq)[None]
+            hidden = hidden + nn.Embed(
+                cfg.max_position_embeddings, cfg.hidden_size,
+                dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+                embedding_init=nn.initializers.normal(
+                    cfg.initializer_range),
+                name="position_embeddings")(position_ids)
+        hidden = hidden + nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="token_type_embeddings")(token_type_ids)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+        for i in range(cfg.num_hidden_layers):
+            hidden = LongformerLayer(cfg, name=f"layer_{i}")(
+                hidden, attention_mask, global_attention_mask,
+                deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class LongformerForMaskedLM(nn.Module):
+    config: LongformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 global_attention_mask=None, deterministic=True):
+        cfg = self.config
+        hidden, _ = LongformerModel(cfg, add_pooling_layer=False,
+                                    name="longformer")(
+            input_ids, attention_mask, token_type_ids,
+            global_attention_mask, deterministic=deterministic)
+        h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        wte = self.variables["params"]["longformer"]["word_embeddings"][
+            "embedding"]
+        logits = h @ wte.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class LongformerForSequenceClassification(nn.Module):
+    config: LongformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 global_attention_mask=None, deterministic=True):
+        cfg = self.config
+        _, pooled = LongformerModel(cfg, name="longformer")(
+            input_ids, attention_mask, token_type_ids,
+            global_attention_mask, deterministic=deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(pooled)
+
+    def partition_rules(self):
+        return PARTITION_RULES

@@ -1,0 +1,55 @@
+"""DeBERTa-v2 golden-value parity vs HF torch."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.models.deberta_v2 import DebertaV2Config, DebertaV2Model
+from fengshen_tpu.models.deberta_v2.convert import torch_to_params
+
+
+def _pair(conv=0):
+    torch = pytest.importorskip("torch")
+    import transformers
+    hf_cfg = transformers.DebertaV2Config(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, relative_attention=True,
+        position_buckets=8, norm_rel_ebd="layer_norm", share_att_key=True,
+        pos_att_type=["p2c", "c2p"], position_biased_input=False,
+        conv_kernel_size=conv, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.DebertaV2Model(hf_cfg).eval()
+    cfg = DebertaV2Config(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, position_buckets=8,
+        conv_kernel_size=conv, dtype="float32")
+    sd = {f"deberta.{k}": v for k, v in tm.state_dict().items()}
+    return torch_to_params(sd, cfg)["deberta"], tm, cfg
+
+
+def _compare(params, tm, cfg, atol=3e-3):
+    import torch
+    ids = np.array([[3, 17, 9, 42, 7, 99, 1, 5]], dtype=np.int32)
+    mask = np.array([[1, 1, 1, 1, 1, 1, 1, 0]], dtype=np.int32)
+    hidden = DebertaV2Model(cfg).apply(
+        {"params": params}, jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask))
+    with torch.no_grad():
+        ref = tm(torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(mask, dtype=torch.long)
+                 ).last_hidden_state.numpy()
+    # padded positions carry no meaning; compare valid tokens only
+    np.testing.assert_allclose(np.asarray(hidden)[:, :7], ref[:, :7],
+                               atol=atol)
+
+
+def test_deberta_forward_parity():
+    params, tm, cfg = _pair(conv=0)
+    _compare(params, tm, cfg)
+
+
+def test_deberta_forward_parity_with_conv():
+    params, tm, cfg = _pair(conv=3)
+    _compare(params, tm, cfg)
