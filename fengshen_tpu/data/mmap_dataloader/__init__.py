@@ -1,0 +1,7 @@
+"""Generic memmap datamodule (reference: fengshen/data/mmap_dataloader/)."""
+
+from fengshen_tpu.data.mmap_dataloader.mmap_index_dataset import (
+    MMapIndexDataset)
+from fengshen_tpu.data.mmap_dataloader.mmap_datamodule import MMapDataModule
+
+__all__ = ["MMapIndexDataset", "MMapDataModule"]

@@ -1,0 +1,35 @@
+"""Datamodule over MMapIndexDataset
+(reference: fengshen/data/mmap_dataloader/mmap_datamodule.py:7-68)."""
+
+from __future__ import annotations
+
+import argparse
+
+from fengshen_tpu.data.mmap_dataloader.mmap_index_dataset import (
+    MMapIndexDataset)
+from fengshen_tpu.data.universal_datamodule import UniversalDataModule
+
+
+class MMapDataModule(UniversalDataModule):
+    @staticmethod
+    def add_data_specific_args(parent_args: argparse.ArgumentParser):
+        parent_args = UniversalDataModule.add_data_specific_args(parent_args)
+        parser = parent_args.add_argument_group("MMap DataModule")
+        parser.add_argument("--train_datas_dir", type=str, default=None)
+        parser.add_argument("--val_datas_dir", type=str, default=None)
+        parser.add_argument("--test_datas_dir", type=str, default=None)
+        parser.add_argument("--input_tensor_name", type=str, nargs="+",
+                            default=["input_ids"])
+        return parent_args
+
+    def __init__(self, collate_fn=None, args=None, **kwargs):
+        datasets = {}
+        names = getattr(args, "input_tensor_name", ["input_ids"])
+        for split, attr in (("train", "train_datas_dir"),
+                            ("validation", "val_datas_dir"),
+                            ("test", "test_datas_dir")):
+            path = getattr(args, attr, None)
+            if path:
+                datasets[split] = MMapIndexDataset(path, names)
+        super().__init__(collate_fn=collate_fn, args=args,
+                         datasets=datasets, **kwargs)

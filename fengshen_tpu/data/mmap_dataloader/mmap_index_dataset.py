@@ -1,0 +1,49 @@
+"""N-tensor memmap dataset keyed by tensor names.
+
+Port of reference: fengshen/data/mmap_dataloader/mmap_index_dataset.py:7-53
+— each named tensor is a pair of files `{name}.npy` (flat data memmap) and
+`{name}_idx.npy` (row offsets); `__getitem__` returns a dict of rows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+
+class MMapIndexDataset:
+    def __init__(self, data_dir: str, input_tensor_name: Sequence[str]):
+        self.names = list(input_tensor_name)
+        self._data = {}
+        self._idx = {}
+        for name in self.names:
+            self._data[name] = np.load(
+                os.path.join(data_dir, f"{name}.npy"), mmap_mode="r")
+            self._idx[name] = np.load(
+                os.path.join(data_dir, f"{name}_idx.npy"))
+
+    def __len__(self) -> int:
+        first = self.names[0]
+        return len(self._idx[first]) - 1
+
+    def __getitem__(self, i: int) -> dict:
+        out = {}
+        for name in self.names:
+            lo, hi = int(self._idx[name][i]), int(self._idx[name][i + 1])
+            out[name] = np.asarray(self._data[name][lo:hi])
+        return out
+
+
+def convert_py_to_npy(rows: Sequence[Sequence[int]], data_dir: str,
+                      name: str, dtype=np.int32) -> None:
+    """Build the `{name}.npy`/`{name}_idx.npy` pair from python lists
+    (reference: fengshen/utils/convert_py_to_npy.py)."""
+    os.makedirs(data_dir, exist_ok=True)
+    flat = np.concatenate([np.asarray(r, dtype) for r in rows]) if rows \
+        else np.zeros((0,), dtype)
+    idx = np.zeros((len(rows) + 1,), np.int64)
+    np.cumsum([len(r) for r in rows], out=idx[1:])
+    np.save(os.path.join(data_dir, f"{name}.npy"), flat)
+    np.save(os.path.join(data_dir, f"{name}_idx.npy"), idx)

@@ -1,0 +1,7 @@
+"""Sequence-tagging data (reference:
+fengshen/data/sequence_tagging_dataloader/)."""
+
+from fengshen_tpu.data.sequence_tagging_dataloader.conll import (
+    load_conll, ConllDataset)
+
+__all__ = ["load_conll", "ConllDataset"]

@@ -1,0 +1,42 @@
+"""CoNLL-format NER loading
+(reference: fengshen/data/sequence_tagging_dataloader/ — span/bio collators
+and conll loaders)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def load_conll(path: str, sep: Optional[str] = None
+               ) -> list[dict]:
+    """Read `char TAG` lines separated by blank lines →
+    [{"text": str, "labels": [tags]}]."""
+    samples: list[dict] = []
+    chars: list[str] = []
+    tags: list[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip():
+                if chars:
+                    samples.append({"text": "".join(chars),
+                                    "labels": list(tags)})
+                    chars, tags = [], []
+                continue
+            parts = line.split(sep)
+            chars.append(parts[0])
+            tags.append(parts[-1] if len(parts) > 1 else "O")
+    if chars:
+        samples.append({"text": "".join(chars), "labels": list(tags)})
+    return samples
+
+
+class ConllDataset:
+    def __init__(self, path: str):
+        self.samples = load_conll(path)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, i: int) -> dict:
+        return self.samples[i]

@@ -1,0 +1,6 @@
+"""Task datasets (reference: fengshen/data/task_dataloader/)."""
+
+from fengshen_tpu.data.task_dataloader.task_datasets import (
+    LCSTSDataset, MedicalQADataset)
+
+__all__ = ["LCSTSDataset", "MedicalQADataset"]

@@ -1,0 +1,55 @@
+"""Summarisation / medical-QA json datasets.
+
+Port of reference: fengshen/data/task_dataloader/task_datasets.py:1-206
+(LCSTS summary) and medicalQADataset.py (YuyuanQA) — jsonl loaders
+producing encoder-decoder / causal-QA samples.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+
+class _JsonlDataset:
+    def __init__(self, data_path: str):
+        self.rows: list[dict] = []
+        with open(data_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    self.rows.append(json.loads(line))
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
+class LCSTSDataset(_JsonlDataset):
+    """{"text": ..., "summary": ...} rows
+    (reference: task_datasets.py LCSTSDataset)."""
+
+    def __init__(self, data_path: str, text_key: str = "text",
+                 summary_key: str = "summary"):
+        super().__init__(data_path)
+        self.text_key, self.summary_key = text_key, summary_key
+
+    def __getitem__(self, i: int) -> dict:
+        row = self.rows[i]
+        return {"text": row[self.text_key],
+                "summary": row.get(self.summary_key, "")}
+
+
+class MedicalQADataset(_JsonlDataset):
+    """{"question"/"query": ..., "answer": ...} rows
+    (reference: medicalQADataset.py)."""
+
+    def __init__(self, data_path: str, question_key: str = "question",
+                 answer_key: str = "answer"):
+        super().__init__(data_path)
+        self.question_key, self.answer_key = question_key, answer_key
+
+    def __getitem__(self, i: int) -> dict:
+        row = self.rows[i]
+        q = row.get(self.question_key) or row.get("query", "")
+        return {"question": q, "answer": row.get(self.answer_key, "")}

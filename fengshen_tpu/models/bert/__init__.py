@@ -1,0 +1,8 @@
+"""Standard BERT (post-LN) — used directly by pretrain_bert-style workloads
+and as the Taiyi-CLIP text tower (reference:
+fengshen/models/clip/modeling_taiyi_clip.py:27-29 uses HF BertModel)."""
+
+from fengshen_tpu.models.bert.modeling_bert import (BertConfig, BertModel,
+                                                    BertForMaskedLM)
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM"]

@@ -1,0 +1,184 @@
+"""Vanilla post-LN BERT in flax, HF-weight-compatible."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", None)),
+    (r"(query|key|value|intermediate_dense)/kernel", P("fsdp", "tensor")),
+    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 21128
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    num_labels: int = 2
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "BertConfig":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "BertConfig":
+        base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def _dense(cfg, feats, name):
+    return nn.Dense(feats, dtype=_dt(cfg),
+                    param_dtype=jnp.dtype(cfg.param_dtype),
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range), name=name)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(cfg, cfg.hidden_size, "query")(hidden)
+        k = _dense(cfg, cfg.hidden_size, "key")(hidden)
+        v = _dense(cfg, cfg.hidden_size, "value")(hidden)
+        q = q.reshape(batch, seq, n_head, head_dim)
+        k = k.reshape(batch, seq, n_head, head_dim)
+        v = v.reshape(batch, seq, n_head, head_dim)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        drop_rng = None
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            drop_rng = self.make_rng("dropout")
+        out = dot_product_attention(
+            q, k, v, mask=mask, dropout_rng=drop_rng,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            deterministic=deterministic)
+        out = with_sharding_constraint(
+            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = out.reshape(batch, seq, cfg.hidden_size)
+        out = _dense(cfg, cfg.hidden_size, "attention_output_dense")(out)
+        out = nn.Dropout(cfg.hidden_dropout_prob)(
+            out, deterministic=deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="attention_ln")(hidden + out)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="output_ln")(hidden + h)
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True):
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        embed = lambda n, name: nn.Embed(  # noqa: E731
+            n, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+            embed(cfg.max_position_embeddings,
+                  "position_embeddings")(position_ids) + \
+            embed(cfg.type_vocab_size,
+                  "token_type_embeddings")(token_type_ids)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+        for i in range(cfg.num_hidden_layers):
+            hidden = BertLayer(cfg, name=f"layer_{i}")(
+                hidden, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class BertForMaskedLM(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        hidden, _ = BertModel(cfg, add_pooling_layer=False, name="bert")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        wte = self.variables["params"]["bert"]["word_embeddings"][
+            "embedding"]
+        logits = h @ wte.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+    def partition_rules(self):
+        return PARTITION_RULES

@@ -1,0 +1,10 @@
+"""Taiyi-CLIP family (reference: fengshen/models/clip/ — Chinese CLIP:
+BertModel text tower + CLIPVisionTransformer,
+modeling_taiyi_clip.py:27-29)."""
+
+from fengshen_tpu.models.clip.modeling_taiyi_clip import (
+    CLIPVisionConfig, CLIPVisionTransformer, TaiyiCLIPModel,
+    clip_contrastive_loss)
+
+__all__ = ["CLIPVisionConfig", "CLIPVisionTransformer", "TaiyiCLIPModel",
+           "clip_contrastive_loss"]

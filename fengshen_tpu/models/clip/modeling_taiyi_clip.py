@@ -1,0 +1,199 @@
+"""Taiyi-CLIP: Chinese text tower + CLIP ViT, contrastive objective.
+
+Reference: fengshen/models/clip/modeling_taiyi_clip.py — `TaiyiCLIPModel`
+pairs an HF BertModel (Chinese text) with a CLIPVisionTransformer; training
+is the standard symmetric InfoNCE with a learnable logit scale
+(reference workload: fengshen/examples/pretrain_taiyi_clip/pretrain.py with
+frozen-tower options).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.bert import BertConfig, BertModel
+from fengshen_tpu.ops.norms import LayerNorm
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("word_embeddings/embedding", P("tensor", None)),
+    (r"(query|key|value|q_proj|k_proj|v_proj|fc1|intermediate_dense)"
+     r"/kernel", P("fsdp", "tensor")),
+    (r"(attention_output_dense|output_dense|out_proj|fc2)/kernel",
+     P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class CLIPVisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    projection_dim: int = 512
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    hidden_act: str = "gelu_new"   # CLIP uses quick_gelu; tanh approx close
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "CLIPVisionConfig":
+        base = dict(hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    image_size=32, patch_size=8, projection_dim=16)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class CLIPVisionLayer(nn.Module):
+    """Pre-LN transformer block (CLIP ViT convention)."""
+
+    config: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm1")(hidden)
+        q = dense(cfg.hidden_size, "q_proj")(h).reshape(
+            batch, seq, n_head, head_dim)
+        k = dense(cfg.hidden_size, "k_proj")(h).reshape(
+            batch, seq, n_head, head_dim)
+        v = dense(cfg.hidden_size, "v_proj")(h).reshape(
+            batch, seq, n_head, head_dim)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        out = dense(cfg.hidden_size, "out_proj")(
+            out.reshape(batch, seq, cfg.hidden_size))
+        hidden = hidden + out
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm2")(hidden)
+        h = quick_gelu(dense(cfg.intermediate_size, "fc1")(h))
+        h = dense(cfg.hidden_size, "fc2")(h)
+        return hidden + h
+
+
+class CLIPVisionTransformer(nn.Module):
+    config: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        """pixel_values [B, H, W, 3] → (last_hidden [B, 1+P, D],
+        pooled [B, D])."""
+        cfg = self.config
+        batch = pixel_values.shape[0]
+        patches = nn.Conv(cfg.hidden_size,
+                          (cfg.patch_size, cfg.patch_size),
+                          strides=(cfg.patch_size, cfg.patch_size),
+                          use_bias=False, dtype=_dt(cfg),
+                          param_dtype=jnp.dtype(cfg.param_dtype),
+                          name="patch_embedding")(pixel_values)
+        patches = patches.reshape(batch, -1, cfg.hidden_size)
+        cls = self.param("class_embedding",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.hidden_size,), jnp.dtype(cfg.param_dtype))
+        hidden = jnp.concatenate(
+            [jnp.broadcast_to(cls[None, None],
+                              (batch, 1, cfg.hidden_size)).astype(
+                patches.dtype), patches], axis=1)
+        n_pos = hidden.shape[1]
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (n_pos, cfg.hidden_size),
+                         jnp.dtype(cfg.param_dtype))
+        hidden = hidden + pos[None].astype(hidden.dtype)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="pre_layrnorm")(hidden)
+        for i in range(cfg.num_hidden_layers):
+            hidden = CLIPVisionLayer(cfg, name=f"layer_{i}")(hidden)
+        pooled = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="post_layernorm")(hidden[:, 0])
+        return hidden, pooled
+
+
+class TaiyiCLIPModel(nn.Module):
+    """Chinese-BERT text tower + CLIP ViT, joint embedding space."""
+
+    text_config: BertConfig
+    vision_config: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, input_ids=None, pixel_values=None,
+                 attention_mask=None, deterministic=True):
+        text_emb = image_emb = None
+        if input_ids is not None:
+            text_emb = self.get_text_features(input_ids, attention_mask,
+                                              deterministic)
+        if pixel_values is not None:
+            image_emb = self.get_image_features(pixel_values)
+        scale = self.param("logit_scale",
+                           lambda rng, shape: jnp.full(shape,
+                                                       np.log(1 / 0.07)),
+                           ())
+        return text_emb, image_emb, jnp.exp(scale)
+
+    def get_text_features(self, input_ids, attention_mask=None,
+                          deterministic=True):
+        hidden, _ = BertModel(self.text_config, add_pooling_layer=False,
+                              name="text_model")(
+            input_ids, attention_mask, deterministic=deterministic)
+        # Taiyi uses the [CLS] hidden projected to the shared space
+        proj = nn.Dense(self.vision_config.projection_dim, use_bias=False,
+                        dtype=_dt(self.vision_config),
+                        param_dtype=jnp.dtype(
+                            self.vision_config.param_dtype),
+                        name="text_projection")(hidden[:, 0])
+        return proj / jnp.linalg.norm(proj, axis=-1, keepdims=True)
+
+    def get_image_features(self, pixel_values):
+        _, pooled = CLIPVisionTransformer(self.vision_config,
+                                          name="vision_model")(pixel_values)
+        proj = nn.Dense(self.vision_config.projection_dim, use_bias=False,
+                        dtype=_dt(self.vision_config),
+                        param_dtype=jnp.dtype(
+                            self.vision_config.param_dtype),
+                        name="visual_projection")(pooled)
+        return proj / jnp.linalg.norm(proj, axis=-1, keepdims=True)
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+def clip_contrastive_loss(text_emb, image_emb, logit_scale):
+    """Symmetric InfoNCE (reference:
+    fengshen/examples/pretrain_taiyi_clip/pretrain.py training_step)."""
+    logits = text_emb @ image_emb.T * logit_scale
+    n = logits.shape[0]
+    labels = jnp.arange(n)
+    loss_t = -jax.nn.log_softmax(logits, axis=1)[labels, labels].mean()
+    loss_i = -jax.nn.log_softmax(logits, axis=0)[labels, labels].mean()
+    return (loss_t + loss_i) / 2, logits

@@ -1,0 +1,7 @@
+"""Pegasus family (reference: fengshen/examples/pegasus/ — Randeng-Pegasus
+LCSTS summarization, pretrain_pegasus.py gap-sentence objective)."""
+
+from fengshen_tpu.models.pegasus.modeling_pegasus import (
+    PegasusConfig, PegasusForConditionalGeneration)
+
+__all__ = ["PegasusConfig", "PegasusForConditionalGeneration"]

@@ -1,0 +1,190 @@
+"""Pegasus in flax, HF-weight-compatible.
+
+Reference: fengshen/examples/pegasus/ (Randeng-Pegasus summarization; the
+reference uses HF PegasusForConditionalGeneration). Pre-LN encoder-decoder
+with STATIC sinusoidal positions and final stack LayerNorms — the
+architectural deltas from BART.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.bart.modeling_bart import BartAttention
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.norms import LayerNorm
+from fengshen_tpu.parallel.mesh import BATCH_AXES
+from fengshen_tpu.parallel.partition import with_sharding_constraint
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("shared/embedding", P("tensor", "fsdp")),
+    (r"(q_proj|k_proj|v_proj|fc1)/kernel", P("fsdp", "tensor")),
+    (r"(out_proj|fc2)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class PegasusConfig:
+    vocab_size: int = 96103
+    d_model: int = 1024
+    encoder_layers: int = 16
+    decoder_layers: int = 16
+    encoder_attention_heads: int = 16
+    decoder_attention_heads: int = 16
+    encoder_ffn_dim: int = 4096
+    decoder_ffn_dim: int = 4096
+    activation_function: str = "gelu"
+    dropout: float = 0.1
+    max_position_embeddings: int = 1024
+    init_std: float = 0.02
+    scale_embedding: bool = True
+    pad_token_id: int = 0
+    eos_token_id: int = 1
+    decoder_start_token_id: int = 0
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @property
+    def hidden_size(self) -> int:
+        return self.d_model
+
+    @property
+    def num_hidden_layers(self) -> int:
+        return self.encoder_layers + self.decoder_layers
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.encoder_ffn_dim
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "PegasusConfig":
+        cfg_file = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else path
+        with open(cfg_file) as f:
+            raw = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "PegasusConfig":
+        base = dict(vocab_size=128, d_model=32, encoder_layers=2,
+                    decoder_layers=2, encoder_attention_heads=4,
+                    decoder_attention_heads=4, encoder_ffn_dim=64,
+                    decoder_ffn_dim=64, max_position_embeddings=64)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def sinusoidal_positions(n_pos: int, dim: int) -> jnp.ndarray:
+    """HF Pegasus sinusoidal table: sin in first half, cos in second."""
+    position_enc = np.array(
+        [[pos / np.power(10000, 2 * (j // 2) / dim) for j in range(dim)]
+         for pos in range(n_pos)])
+    table = np.zeros((n_pos, dim), np.float32)
+    sentinel = dim // 2 + dim % 2
+    table[:, :sentinel] = np.sin(position_enc[:, 0::2])
+    table[:, sentinel:] = np.cos(position_enc[:, 1::2])
+    return jnp.asarray(table)
+
+
+class _PegasusEncoderLayer(nn.Module):
+    config: PegasusConfig
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = LayerNorm(name="self_attn_layer_norm")(hidden)
+        h = BartAttention(cfg, cfg.encoder_attention_heads,
+                          name="self_attn")(
+            h, attention_mask=attention_mask, deterministic=deterministic)
+        hidden = hidden + h
+        h = LayerNorm(name="final_layer_norm")(hidden)
+        h = get_activation(cfg.activation_function)(
+            nn.Dense(cfg.encoder_ffn_dim, dtype=_dt(cfg),
+                     param_dtype=jnp.dtype(cfg.param_dtype),
+                     name="fc1")(h))
+        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = nn.Dense(cfg.d_model, dtype=_dt(cfg),
+                     param_dtype=jnp.dtype(cfg.param_dtype), name="fc2")(h)
+        return hidden + h
+
+
+class _PegasusDecoderLayer(nn.Module):
+    config: PegasusConfig
+
+    @nn.compact
+    def __call__(self, hidden, encoder_hidden, attention_mask=None,
+                 encoder_attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = LayerNorm(name="self_attn_layer_norm")(hidden)
+        h = BartAttention(cfg, cfg.decoder_attention_heads, causal=True,
+                          name="self_attn")(
+            h, attention_mask=attention_mask, deterministic=deterministic)
+        hidden = hidden + h
+        h = LayerNorm(name="encoder_attn_layer_norm")(hidden)
+        h = BartAttention(cfg, cfg.decoder_attention_heads,
+                          name="encoder_attn")(
+            h, kv=encoder_hidden, attention_mask=encoder_attention_mask,
+            deterministic=deterministic)
+        hidden = hidden + h
+        h = LayerNorm(name="final_layer_norm")(hidden)
+        h = get_activation(cfg.activation_function)(
+            nn.Dense(cfg.decoder_ffn_dim, dtype=_dt(cfg),
+                     param_dtype=jnp.dtype(cfg.param_dtype),
+                     name="fc1")(h))
+        h = nn.Dense(cfg.d_model, dtype=_dt(cfg),
+                     param_dtype=jnp.dtype(cfg.param_dtype), name="fc2")(h)
+        return hidden + h
+
+
+class PegasusForConditionalGeneration(nn.Module):
+    config: PegasusConfig
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        cfg = self.config
+        shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
+                          param_dtype=jnp.dtype(cfg.param_dtype),
+                          embedding_init=nn.initializers.normal(
+                              cfg.init_std), name="shared")
+        scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
+        pos_table = sinusoidal_positions(cfg.max_position_embeddings,
+                                         cfg.d_model)
+
+        enc = shared(input_ids) * scale + \
+            pos_table[None, :input_ids.shape[1]].astype(_dt(cfg))
+        for i in range(cfg.encoder_layers):
+            enc = _PegasusEncoderLayer(cfg, name=f"encoder_layer_{i}")(
+                enc, attention_mask, deterministic)
+        enc = LayerNorm(name="encoder_layer_norm")(enc)
+
+        dec = shared(decoder_input_ids) * scale + \
+            pos_table[None, :decoder_input_ids.shape[1]].astype(_dt(cfg))
+        for i in range(cfg.decoder_layers):
+            dec = _PegasusDecoderLayer(cfg, name=f"decoder_layer_{i}")(
+                dec, enc, decoder_attention_mask, attention_mask,
+                deterministic)
+        dec = LayerNorm(name="decoder_layer_norm")(dec)
+
+        logits = dec @ shared.embedding.T.astype(dec.dtype)
+        bias = self.param("final_logits_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits + bias.astype(logits.dtype)
+
+    def partition_rules(self):
+        return PARTITION_RULES

@@ -1,0 +1,6 @@
+"""Information-extraction (UBERT) pipeline
+(reference: fengshen/pipelines/information_extraction.py:27)."""
+
+from fengshen_tpu.models.ubert import UbertPipelines as Pipeline
+
+__all__ = ["Pipeline"]

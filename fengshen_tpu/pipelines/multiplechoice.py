@@ -1,0 +1,7 @@
+"""Multiple-choice (UniMC) pipeline
+(reference: fengshen/pipelines/multiplechoice.py:41 — wraps the
+self-contained UniMC package)."""
+
+from fengshen_tpu.models.unimc import UniMCPipelines as Pipeline
+
+__all__ = ["Pipeline"]

@@ -1,0 +1,6 @@
+"""Topic-classification (TCBert) pipeline
+(reference: fengshen/pipelines/tcbert.py:40)."""
+
+from fengshen_tpu.models.tcbert import TCBertPipelines as Pipeline
+
+__all__ = ["Pipeline"]

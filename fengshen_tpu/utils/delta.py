@@ -1,0 +1,27 @@
+"""Delta-weight release tooling.
+
+Port of reference: fengshen/utils/apply_delta.py + make_delta.py — the
+Ziya-LLaMA license workaround: published weights are deltas against the
+original base model; users apply them locally. Works on flax param pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def make_delta(base_params: Any, target_params: Any) -> Any:
+    """delta = target - base (reference: make_delta.py)."""
+    return jax.tree_util.tree_map(
+        lambda t, b: np.asarray(t, np.float32) - np.asarray(b, np.float32),
+        target_params, base_params)
+
+
+def apply_delta(base_params: Any, delta_params: Any) -> Any:
+    """target = base + delta (reference: apply_delta.py)."""
+    return jax.tree_util.tree_map(
+        lambda b, d: np.asarray(b, np.float32) + np.asarray(d, np.float32),
+        base_params, delta_params)

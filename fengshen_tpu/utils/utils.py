@@ -1,0 +1,43 @@
+"""Misc runtime utilities.
+
+Reference: fengshen/utils/utils.py — `report_memory` (cuda
+allocated/reserved printout, :62-74) becomes a jax live-buffer/HBM report;
+jieba helpers live in fengshen_tpu.utils.chinese.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def report_memory(name: str = "") -> dict:
+    """Device-memory snapshot (reference: utils.py:62-74). Returns and
+    prints per-device bytes-in-use when the backend exposes memory stats
+    (TPU does; CPU returns zeros)."""
+    stats = {}
+    for dev in jax.local_devices():
+        mem = getattr(dev, "memory_stats", lambda: None)()
+        if mem:
+            stats[str(dev)] = {
+                "bytes_in_use": mem.get("bytes_in_use", 0),
+                "peak_bytes_in_use": mem.get("peak_bytes_in_use", 0),
+                "bytes_limit": mem.get("bytes_limit", 0),
+            }
+        else:
+            stats[str(dev)] = {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                               "bytes_limit": 0}
+    total = sum(s["bytes_in_use"] for s in stats.values())
+    print(f"[report_memory]{' ' + name if name else ''} "
+          f"total={total / 2**30:.2f}GiB over {len(stats)} device(s)",
+          flush=True)
+    return stats
+
+
+def start_profiler_trace(logdir: str) -> None:
+    """jax.profiler trace start — the observability the reference lacked
+    (SURVEY.md §5.1: wandb only, no profiler)."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profiler_trace() -> None:
+    jax.profiler.stop_trace()

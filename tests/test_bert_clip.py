@@ -1,0 +1,90 @@
+"""BERT parity + Taiyi-CLIP behaviour tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.models.bert import BertConfig, BertModel
+from fengshen_tpu.models.clip import (CLIPVisionConfig, TaiyiCLIPModel,
+                                      clip_contrastive_loss)
+
+
+def test_bert_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.BertModel(hf_cfg).eval()
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, dtype="float32")
+    sd = tm.state_dict()
+
+    def t(n):
+        return sd[n].detach().numpy()
+
+    def lin(p):
+        return {"kernel": t(f"{p}.weight").T, "bias": t(f"{p}.bias")}
+
+    def ln(p):
+        return {"scale": t(f"{p}.weight"), "bias": t(f"{p}.bias")}
+
+    params = {
+        "word_embeddings": {
+            "embedding": t("embeddings.word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": t("embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding": t("embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("embeddings.LayerNorm"),
+        "pooler": lin("pooler.dense"),
+    }
+    for i in range(2):
+        pre = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "query": lin(f"{pre}.attention.self.query"),
+            "key": lin(f"{pre}.attention.self.key"),
+            "value": lin(f"{pre}.attention.self.value"),
+            "attention_output_dense": lin(f"{pre}.attention.output.dense"),
+            "attention_ln": ln(f"{pre}.attention.output.LayerNorm"),
+            "intermediate_dense": lin(f"{pre}.intermediate.dense"),
+            "output_dense": lin(f"{pre}.output.dense"),
+            "output_ln": ln(f"{pre}.output.LayerNorm"),
+        }
+    ids = np.array([[2, 17, 9, 42, 7, 99, 1, 5]], dtype=np.int32)
+    mask = np.array([[1, 1, 1, 1, 1, 1, 1, 1]], dtype=np.int32)
+    hidden, pooled = BertModel(cfg).apply(
+        {"params": params}, jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask))
+    with torch.no_grad():
+        out = tm(torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(mask, dtype=torch.long))
+    np.testing.assert_allclose(np.asarray(hidden),
+                               out.last_hidden_state.numpy(), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(), atol=2e-3)
+
+
+def test_taiyi_clip_shapes_and_loss():
+    text_cfg = BertConfig.small_test_config(dtype="float32")
+    vis_cfg = CLIPVisionConfig.small_test_config()
+    model = TaiyiCLIPModel(text_cfg, vis_cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 127, (4, 10)),
+                      jnp.int32)
+    pix = jnp.asarray(np.random.RandomState(1).rand(4, 32, 32, 3),
+                      jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), ids, pix)["params"]
+    text_emb, image_emb, scale = model.apply({"params": params}, ids, pix)
+    assert text_emb.shape == (4, 16) and image_emb.shape == (4, 16)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(text_emb), axis=-1),
+                               1.0, atol=1e-5)
+    loss, logits = clip_contrastive_loss(text_emb, image_emb, scale)
+    assert logits.shape == (4, 4)
+    assert np.isfinite(float(loss))
+    # identical towers on matched pairs should beat shuffled pairs
+    loss_shuf, _ = clip_contrastive_loss(text_emb, image_emb[::-1], scale)
+    assert np.isfinite(float(loss_shuf))

@@ -199,3 +199,54 @@ def test_chinese_char_tokenize():
     assert is_chinese_char(ord("中"))
     assert not is_chinese_char(ord("a"))
     assert chinese_char_tokenize("ab中c").split() == ["ab", "中", "c"]
+
+
+def test_delta_roundtrip():
+    from fengshen_tpu.utils.delta import make_delta, apply_delta
+    base = {"w": np.ones((4,)), "b": np.zeros((2,))}
+    target = {"w": np.full((4,), 3.0), "b": np.ones((2,))}
+    delta = make_delta(base, target)
+    back = apply_delta(base, delta)
+    np.testing.assert_allclose(back["w"], target["w"])
+    np.testing.assert_allclose(back["b"], target["b"])
+
+
+def test_report_memory_runs(capsys):
+    from fengshen_tpu.utils.utils import report_memory
+    stats = report_memory("test")
+    assert len(stats) >= 1
+    assert "report_memory" in capsys.readouterr().out
+
+
+def test_mmap_index_dataset(tmp_path):
+    from fengshen_tpu.data.mmap_dataloader.mmap_index_dataset import (
+        MMapIndexDataset, convert_py_to_npy)
+    rows = [[1, 2, 3], [4, 5], [6]]
+    convert_py_to_npy(rows, str(tmp_path), "input_ids")
+    ds = MMapIndexDataset(str(tmp_path), ["input_ids"])
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[0]["input_ids"], [1, 2, 3])
+    np.testing.assert_array_equal(ds[2]["input_ids"], [6])
+
+
+def test_conll_loader(tmp_path):
+    from fengshen_tpu.data.sequence_tagging_dataloader import load_conll
+    p = tmp_path / "ner.txt"
+    p.write_text("北 B-LOC\n京 I-LOC\n好 O\n\n天 O\n")
+    samples = load_conll(str(p))
+    assert samples[0]["text"] == "北京好"
+    assert samples[0]["labels"] == ["B-LOC", "I-LOC", "O"]
+    assert samples[1]["text"] == "天"
+
+
+def test_task_datasets(tmp_path):
+    from fengshen_tpu.data.task_dataloader import (LCSTSDataset,
+                                                   MedicalQADataset)
+    p = tmp_path / "lcsts.jsonl"
+    p.write_text('{"text": "正文", "summary": "摘要"}\n')
+    ds = LCSTSDataset(str(p))
+    assert ds[0] == {"text": "正文", "summary": "摘要"}
+    q = tmp_path / "qa.jsonl"
+    q.write_text('{"question": "问", "answer": "答"}\n')
+    qa = MedicalQADataset(str(q))
+    assert qa[0] == {"question": "问", "answer": "答"}
