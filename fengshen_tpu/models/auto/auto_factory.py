@@ -52,6 +52,14 @@ MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
                     "masked_lm": "LongformerForMaskedLM",
                     "sequence_classification":
                         "LongformerForSequenceClassification"}),
+    "bert": ("fengshen_tpu.models.bert", "BertConfig",
+             {"base": "BertModel", "masked_lm": "BertForMaskedLM"}),
+    "pegasus": ("fengshen_tpu.models.pegasus", "PegasusConfig",
+                {"conditional_generation":
+                     "PegasusForConditionalGeneration"}),
+    "zen": ("fengshen_tpu.models.zen", "ZenConfig",
+            {"base": "ZenModel",
+             "sequence_classification": "ZenForSequenceClassification"}),
 }
 
 

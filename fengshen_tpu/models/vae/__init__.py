@@ -1,0 +1,9 @@
+"""Latent-text VAE family (reference: fengshen/models/DAVAE 1,329 LoC,
+GAVAE 551, PPVAE 232, deepVAE 947 — GPT2-based latent connectors for
+controlled text generation)."""
+
+from fengshen_tpu.models.vae.modeling_vae import (TextVAEConfig,
+                                                  LatentConnector,
+                                                  TextVAEModel, vae_loss)
+
+__all__ = ["TextVAEConfig", "LatentConnector", "TextVAEModel", "vae_loss"]

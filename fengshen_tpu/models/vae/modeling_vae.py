@@ -1,0 +1,113 @@
+"""Latent-text VAE: GPT-2 encoder → gaussian latent → GPT-2 decoder.
+
+Behavioural port of the reference's VAE family core (reference:
+fengshen/models/DAVAE/DAVAEModel — GPT2-based latent connectors where the
+posterior comes from the encoder's final hidden state and the decoder is
+conditioned on the latent via an injected embedding; GAVAE/PPVAE add
+GAN/plug-in objectives on the same skeleton; deepVAE's Della stacks
+per-layer latents).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fengshen_tpu.models.gpt2 import GPT2Config, GPT2Model
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+
+@dataclasses.dataclass
+class TextVAEConfig:
+    latent_size: int = 128
+    beta: float = 1.0          # KL weight
+    encoder: GPT2Config = None
+    decoder: GPT2Config = None
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "TextVAEConfig":
+        enc = GPT2Config.small_test_config(dtype="float32")
+        dec = GPT2Config.small_test_config(dtype="float32")
+        base = dict(latent_size=8, encoder=enc, decoder=dec)
+        base.update(overrides)
+        return cls(**base)
+
+
+class LatentConnector(nn.Module):
+    """hidden → (mean, logvar); latent → decoder conditioning vector."""
+
+    latent_size: int
+    hidden_size: int
+
+    def setup(self):
+        self.posterior = nn.Dense(2 * self.latent_size, name="posterior")
+        self.latent_proj = nn.Dense(self.hidden_size, name="latent_proj")
+
+    def encode(self, pooled):
+        stats = self.posterior(pooled)
+        mean, logvar = jnp.split(stats, 2, axis=-1)
+        return mean, logvar
+
+    def to_conditioning(self, latent):
+        return self.latent_proj(latent)
+
+    def __call__(self, pooled):  # init path
+        mean, logvar = self.encode(pooled)
+        return self.to_conditioning(mean), mean, logvar
+
+
+class TextVAEModel(nn.Module):
+    config: TextVAEConfig
+
+    def setup(self):
+        self.encoder = GPT2Model(self.config.encoder, name="encoder")
+        self.decoder = GPT2Model(self.config.decoder, name="decoder")
+        self.connector = LatentConnector(
+            self.config.latent_size, self.config.decoder.n_embd,
+            name="connector")
+        self.lm_head = nn.Dense(self.config.decoder.vocab_size,
+                                use_bias=False, name="lm_head")
+
+    def encode(self, input_ids, attention_mask=None, deterministic=True):
+        hidden = self.encoder(input_ids, attention_mask=attention_mask,
+                              deterministic=deterministic)
+        # posterior from the last real token's hidden state
+        if attention_mask is not None:
+            last = attention_mask.sum(-1) - 1
+        else:
+            last = jnp.full((input_ids.shape[0],), input_ids.shape[1] - 1)
+        pooled = jnp.take_along_axis(hidden, last[:, None, None],
+                                     axis=1)[:, 0]
+        return self.connector.encode(pooled)
+
+    def decode_logits(self, latent, input_ids, deterministic=True):
+        """Teacher-forced reconstruction, latent added to every position
+        (the reference's embedding-injection conditioning)."""
+        cond = self.connector.to_conditioning(latent)[:, None, :]
+        hidden = self.decoder(input_ids, deterministic=deterministic)
+        hidden = hidden + cond.astype(hidden.dtype)
+        return self.lm_head(hidden)
+
+    def __call__(self, input_ids, attention_mask=None, rng=None,
+                 deterministic=True):
+        mean, logvar = self.encode(input_ids, attention_mask, deterministic)
+        if rng is not None:
+            eps = jax.random.normal(rng, mean.shape)
+            latent = mean + jnp.exp(0.5 * logvar) * eps
+        else:
+            latent = mean
+        logits = self.decode_logits(latent, input_ids, deterministic)
+        return logits, mean, logvar
+
+
+def vae_loss(logits, input_ids, mean, logvar, beta: float = 1.0,
+             ignore_index: int = -100):
+    """Reconstruction CE + beta·KL(q(z|x) ‖ N(0,I))."""
+    recon, _ = stable_cross_entropy(logits[:, :-1], input_ids[:, 1:],
+                                    ignore_index)
+    kl = 0.5 * (jnp.exp(logvar) + mean ** 2 - 1.0 - logvar).sum(-1).mean()
+    return recon + beta * kl, {"recon": recon, "kl": kl}

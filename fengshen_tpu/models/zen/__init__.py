@@ -1,0 +1,11 @@
+"""ZEN1/ZEN2 — n-gram-enhanced Chinese BERT (reference:
+fengshen/models/zen1/ 1,715 LoC + fengshen/models/zen2/ 2,129 LoC:
+`ZenModel` = BERT + n-gram side encoder fused via a char↔ngram matching
+matrix, `ZenNgramDict`)."""
+
+from fengshen_tpu.models.zen.modeling_zen import (ZenConfig, ZenModel,
+                                                  ZenForSequenceClassification)
+from fengshen_tpu.models.zen.ngram_utils import ZenNgramDict
+
+__all__ = ["ZenConfig", "ZenModel", "ZenForSequenceClassification",
+           "ZenNgramDict"]

@@ -1,0 +1,123 @@
+"""ZEN in flax: BERT char encoder + n-gram side encoder.
+
+Reference: fengshen/models/zen1/modeling.py — `ZenModel`: a BERT backbone
+whose layer outputs are enhanced by a parallel transformer over matched
+n-gram embeddings; at each fused layer, char hidden states receive the sum
+of the hidden states of the n-grams covering them (char↔ngram position
+matrix), normalised by the cover count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from fengshen_tpu.models.bert.modeling_bert import (BertConfig, BertLayer,
+                                                    LayerNorm, _dense, _dt)
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    ("(word|ngram)_embeddings/embedding", P("tensor", None)),
+    (r"(query|key|value|intermediate_dense)/kernel", P("fsdp", "tensor")),
+    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
+    (".*", P(None)),
+]
+
+
+@dataclasses.dataclass
+class ZenConfig(BertConfig):
+    ngram_vocab_size: int = 104089
+    num_ngram_layers: int = 6  # side-encoder depth; fusion on these layers
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "ZenConfig":
+        base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, ngram_vocab_size=64,
+                    num_ngram_layers=2)
+        base.update(overrides)
+        return cls(**base)
+
+
+class ZenModel(nn.Module):
+    config: ZenConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, ngram_ids=None, ngram_positions=None,
+                 attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        """ngram_ids [B, M]; ngram_positions [B, S, M] (1 = char in gram)."""
+        cfg = self.config
+        batch, seq = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = lambda n, name: nn.Embed(  # noqa: E731
+            n, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+            embed(cfg.max_position_embeddings, "position_embeddings")(
+                jnp.arange(seq)[None]) + \
+            embed(cfg.type_vocab_size,
+                  "token_type_embeddings")(token_type_ids)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+
+        ngram_hidden = None
+        ngram_mask = None
+        if ngram_ids is not None:
+            ngram_hidden = embed(cfg.ngram_vocab_size,
+                                 "ngram_embeddings")(ngram_ids)
+            ngram_hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     name="ngram_ln")(ngram_hidden)
+            ngram_mask = (ngram_ids != 0).astype(jnp.int32)
+
+        for i in range(cfg.num_hidden_layers):
+            hidden = BertLayer(cfg, name=f"layer_{i}")(
+                hidden, attention_mask, deterministic)
+            if ngram_hidden is not None and i < cfg.num_ngram_layers:
+                ngram_hidden = BertLayer(cfg, name=f"ngram_layer_{i}")(
+                    ngram_hidden, ngram_mask, deterministic)
+                # fuse: each char receives mean of covering grams' hiddens
+                pos = ngram_positions.astype(jnp.float32) * \
+                    ngram_mask[:, None, :].astype(jnp.float32)
+                cover = jnp.maximum(pos.sum(-1, keepdims=True), 1.0)
+                fused = jnp.einsum("bsm,bmh->bsh", pos / cover,
+                                   ngram_hidden.astype(jnp.float32))
+                hidden = hidden + fused.astype(hidden.dtype)
+
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class ZenForSequenceClassification(nn.Module):
+    config: ZenConfig
+
+    @nn.compact
+    def __call__(self, input_ids, ngram_ids=None, ngram_positions=None,
+                 attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        _, pooled = ZenModel(cfg, name="zen")(
+            input_ids, ngram_ids, ngram_positions, attention_mask,
+            token_type_ids, deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _dense(cfg, cfg.num_labels, "classifier")(pooled)
+
+    def partition_rules(self):
+        return PARTITION_RULES

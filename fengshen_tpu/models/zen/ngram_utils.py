@@ -1,0 +1,57 @@
+"""N-gram dictionary + matching (reference: fengshen/models/zen1/
+ngram_utils.py `ZenNgramDict`)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class ZenNgramDict:
+    """ngram → id vocabulary with text matching.
+
+    File format: one ngram per line (optionally `ngram\\tfreq`), as in the
+    reference's ngram.txt shipped with ZEN checkpoints.
+    """
+
+    def __init__(self, ngram_freq_path: Optional[str] = None,
+                 ngrams: Optional[list[str]] = None,
+                 max_ngram_in_seq: int = 128,
+                 max_ngram_len: int = 8):
+        self.max_ngram_in_seq = max_ngram_in_seq
+        self.max_ngram_len = max_ngram_len
+        vocab: list[str] = ["[pad]"]
+        if ngram_freq_path and os.path.exists(ngram_freq_path):
+            with open(ngram_freq_path) as f:
+                for line in f:
+                    token = line.strip().split("\t")[0].split(",")[0]
+                    if token:
+                        vocab.append(token)
+        if ngrams:
+            vocab.extend(ngrams)
+        self.id_to_ngram_list = vocab
+        self.ngram_to_id_dict = {g: i for i, g in enumerate(vocab)}
+
+    def __len__(self) -> int:
+        return len(self.id_to_ngram_list)
+
+    def match(self, chars: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Return (ngram_ids [M], positions [S, M]) for a char sequence:
+        positions[i, j] = 1 iff char i is inside matched ngram j."""
+        seq_len = len(chars)
+        matches: list[tuple[int, int, int]] = []  # (ngram_id, start, length)
+        for start in range(seq_len):
+            for ln in range(2, min(self.max_ngram_len, seq_len - start) + 1):
+                gram = "".join(chars[start:start + ln])
+                gid = self.ngram_to_id_dict.get(gram)
+                if gid is not None:
+                    matches.append((gid, start, ln))
+        matches = matches[: self.max_ngram_in_seq]
+        ngram_ids = np.zeros((self.max_ngram_in_seq,), np.int32)
+        positions = np.zeros((seq_len, self.max_ngram_in_seq), np.int32)
+        for j, (gid, start, ln) in enumerate(matches):
+            ngram_ids[j] = gid
+            positions[start:start + ln, j] = 1
+        return ngram_ids, positions
