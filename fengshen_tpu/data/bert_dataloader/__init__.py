@@ -1,0 +1,7 @@
+"""BERT corpus pipeline (reference: fengshen/data/bert_dataloader/ —
+corpus sharding + sentence-level preprocessing + BertDataModule)."""
+
+from fengshen_tpu.data.bert_dataloader.load import (shard_corpus,
+                                                    preprocess_corpus)
+
+__all__ = ["shard_corpus", "preprocess_corpus"]

@@ -1,0 +1,61 @@
+"""Corpus sharding + preprocessing.
+
+Port of reference: fengshen/data/bert_dataloader/load.py:27-200 +
+preprocessing.py + auto_split.sh — split a large jsonl corpus into
+~size-bounded shards and normalise documents to sentence lists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator
+
+from fengshen_tpu.data.data_utils.sentence_split import (
+    ChineseSentenceSplitter)
+
+
+def shard_corpus(input_path: str, output_dir: str,
+                 shard_mb: int = 100) -> list[str]:
+    """Split a jsonl corpus into ≤shard_mb files
+    (reference: auto_split.sh's 100MB sharding)."""
+    os.makedirs(output_dir, exist_ok=True)
+    limit = shard_mb * 1024 * 1024
+    shards: list[str] = []
+    out = None
+    written = 0
+    with open(input_path) as f:
+        for line in f:
+            if out is None or written >= limit:
+                if out is not None:
+                    out.close()
+                path = os.path.join(output_dir,
+                                    f"shard_{len(shards):05d}.jsonl")
+                shards.append(path)
+                out = open(path, "w")
+                written = 0
+            out.write(line)
+            written += len(line.encode())
+    if out is not None:
+        out.close()
+    return shards
+
+
+def preprocess_corpus(input_path: str, output_path: str,
+                      content_key: str = "text") -> int:
+    """Document → sentence-list rows
+    (reference: preprocessing.py sentence-level normalisation)."""
+    splitter = ChineseSentenceSplitter()
+    n = 0
+    with open(input_path) as fin, open(output_path, "w") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            sentences = splitter.tokenize(row.get(content_key, ""))
+            if sentences:
+                fout.write(json.dumps({"sentences": sentences},
+                                      ensure_ascii=False) + "\n")
+                n += 1
+    return n

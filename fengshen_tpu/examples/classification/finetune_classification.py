@@ -1,0 +1,41 @@
+"""Text-classification finetune (AFQMC-style).
+
+Port of reference: fengshen/examples/classification/
+finetune_classification.py — the demo workload of the reference's README
+("7 GB finetune of Erlangshen-1.3B", demo_classification_afqmc_*.sh).
+Thin wrapper over the TextClassificationPipeline train path so the CLI
+surface matches the reference scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    from fengshen_tpu.pipelines.text_classification import (
+        TextClassificationPipeline)
+
+    parser = argparse.ArgumentParser()
+    parser = TextClassificationPipeline.add_pipeline_specific_args(parser)
+    parser.add_argument("--num_labels", type=int, default=2)
+    args = parser.parse_args(argv)
+
+    pipeline = TextClassificationPipeline(
+        args=args, model=getattr(args, "model_path", None),
+        num_labels=args.num_labels)
+    if args.datasets_name:
+        pipeline.train(args.datasets_name)
+    else:
+        import datasets as hf_datasets
+        data_files = {}
+        if args.train_file:
+            data_files["train"] = args.train_file
+        if args.val_file:
+            data_files["validation"] = args.val_file
+        pipeline.train(hf_datasets.load_dataset(
+            args.raw_file_type, data_files=data_files))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Seq2seq summarization finetune (LCSTS-style).
+
+Port of reference: fengshen/examples/summary/seq2seq_summary.py (and the
+pegasus/mt5_summary variants) — encoder-decoder finetune over
+{text, summary} pairs with teacher forcing; works with T5, BART, or
+Pegasus via --model_type.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class Seq2SeqCollator:
+    tokenizer: Any
+    max_src_length: int = 512
+    max_tgt_length: int = 128
+    decoder_start_token_id: int = 0
+    text_key: str = "text"
+    summary_key: str = "summary"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        pad = self.tokenizer.pad_token_id or 0
+        eos = self.tokenizer.eos_token_id
+        batch = {"input_ids": [], "attention_mask": [],
+                 "decoder_input_ids": [], "labels": []}
+        for s in samples:
+            src = self.tokenizer.encode(s[self.text_key],
+                                        add_special_tokens=False
+                                        )[: self.max_src_length - 1]
+            if eos is not None:
+                src = src + [eos]
+            tgt = self.tokenizer.encode(s[self.summary_key],
+                                        add_special_tokens=False
+                                        )[: self.max_tgt_length - 1]
+            if eos is not None:
+                tgt = tgt + [eos]
+            dec_in = [self.decoder_start_token_id] + tgt[:-1]
+            ps = self.max_src_length - len(src)
+            pt = self.max_tgt_length - len(tgt)
+            batch["input_ids"].append(src + [pad] * ps)
+            batch["attention_mask"].append([1] * len(src) + [0] * ps)
+            batch["decoder_input_ids"].append(dec_in + [pad] * pt)
+            batch["labels"].append(tgt + [-100] * pt)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class Seq2SeqModule(TrainModule):
+    def __init__(self, args, model, config):
+        super().__init__(args)
+        self.model = model
+        self.config = config
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n = vocab_parallel_cross_entropy(logits, batch["labels"])
+        return loss, {"n_tokens": n}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def build_model(model_type: str, model_path=None, config=None):
+    if model_type == "t5":
+        from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+        config = config or (T5Config.from_pretrained(model_path)
+                            if model_path else T5Config.small_test_config())
+        return T5ForConditionalGeneration(config), config
+    if model_type == "bart":
+        from fengshen_tpu.models.bart import (BartConfig,
+                                              BartForConditionalGeneration)
+        config = config or (BartConfig.from_pretrained(model_path)
+                            if model_path else
+                            BartConfig.small_test_config())
+        return BartForConditionalGeneration(config), config
+    if model_type == "pegasus":
+        from fengshen_tpu.models.pegasus import (
+            PegasusConfig, PegasusForConditionalGeneration)
+        config = config or (PegasusConfig.from_pretrained(model_path)
+                            if model_path else
+                            PegasusConfig.small_test_config())
+        return PegasusForConditionalGeneration(config), config
+    raise ValueError(f"unknown model_type {model_type!r}")
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    group = parser.add_argument_group("summary")
+    group.add_argument("--model_type", default="t5", type=str,
+                       choices=["t5", "bart", "pegasus"])
+    group.add_argument("--max_src_length", default=512, type=int)
+    group.add_argument("--max_tgt_length", default=128, type=int)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    model, config = build_model(args.model_type, args.model_path)
+    collator = Seq2SeqCollator(
+        tokenizer, max_src_length=args.max_src_length,
+        max_tgt_length=args.max_tgt_length,
+        decoder_start_token_id=getattr(config, "decoder_start_token_id", 0))
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = Seq2SeqModule(args, model, config)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

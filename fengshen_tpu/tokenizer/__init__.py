@@ -1,0 +1,6 @@
+"""Tokenizer training utilities (reference: fengshen/tokenizer/)."""
+
+from fengshen_tpu.tokenizer.sentencepiece_train import (train_sentencepiece,
+                                                        shuffle_corpus)
+
+__all__ = ["train_sentencepiece", "shuffle_corpus"]

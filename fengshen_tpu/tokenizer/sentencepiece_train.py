@@ -1,0 +1,45 @@
+"""SentencePiece training pipeline.
+
+Port of reference: fengshen/tokenizer/sentencepiece/pretrain_google_sp.sh
+(spm_train with vocab 40k, character coverage .9995) + shuffle_corpus.py.
+The sentencepiece package is optional in this environment — gated at call
+time with the same defaults as the reference's shell script.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+def shuffle_corpus(input_path: str, output_path: str,
+                   seed: int = 42) -> None:
+    """Reference: fengshen/tokenizer/sentencepiece/shuffle_corpus.py."""
+    with open(input_path) as f:
+        lines = f.readlines()
+    random.Random(seed).shuffle(lines)
+    with open(output_path, "w") as f:
+        f.writelines(lines)
+
+
+def train_sentencepiece(input_path: str, model_prefix: str,
+                        vocab_size: int = 40000,
+                        character_coverage: float = 0.9995,
+                        model_type: str = "unigram",
+                        user_defined_symbols: Optional[list[str]] = None,
+                        ) -> str:
+    """spm_train with the reference's defaults
+    (reference: pretrain_google_sp.sh)."""
+    try:
+        import sentencepiece as spm
+    except ImportError as e:
+        raise ImportError(
+            "sentencepiece is not installed in this environment; install it "
+            "or run the reference's spm_train CLI with the same flags"
+        ) from e
+    spm.SentencePieceTrainer.train(
+        input=input_path, model_prefix=model_prefix,
+        vocab_size=vocab_size, character_coverage=character_coverage,
+        model_type=model_type,
+        user_defined_symbols=user_defined_symbols or [])
+    return f"{model_prefix}.model"

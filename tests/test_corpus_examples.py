@@ -1,0 +1,69 @@
+"""Corpus pipeline + seq2seq example tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+def test_shard_and_preprocess(tmp_path):
+    from fengshen_tpu.data.bert_dataloader import (shard_corpus,
+                                                   preprocess_corpus)
+    src = tmp_path / "corpus.jsonl"
+    with open(src, "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"text": "今天天气很好。我们去公园吧！"},
+                               ensure_ascii=False) + "\n")
+    shards = shard_corpus(str(src), str(tmp_path / "shards"), shard_mb=1)
+    assert len(shards) >= 1
+    n = preprocess_corpus(shards[0], str(tmp_path / "pre.jsonl"))
+    assert n == 100
+    row = json.loads(open(tmp_path / "pre.jsonl").readline())
+    assert row["sentences"] == ["今天天气很好。", "我们去公园吧！"]
+
+
+def test_seq2seq_collator_and_fit(tmp_path, mesh8):
+    import argparse
+    from fengshen_tpu.examples.summary.seq2seq_summary import (
+        Seq2SeqCollator, Seq2SeqModule, build_model)
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.models.model_utils import add_module_args
+
+    class FakeTok:
+        pad_token_id = 0
+        eos_token_id = 1
+
+        def encode(self, text, add_special_tokens=True):
+            return [3 + (ord(c) % 90) for c in text]
+
+    model, config = build_model("t5")
+    coll = Seq2SeqCollator(FakeTok(), max_src_length=16, max_tgt_length=8)
+    batch = coll([{"text": "今天天气很好", "summary": "好天"}])
+    assert batch["input_ids"].shape == (1, 16)
+    assert batch["decoder_input_ids"].shape == (1, 8)
+    assert batch["labels"][0][batch["labels"][0] != -100][-1] == 1  # eos
+
+    parser = argparse.ArgumentParser()
+    add_module_args(parser)
+    add_trainer_args(parser)
+    UniversalDataModule.add_data_specific_args(parser)
+    args = parser.parse_args([
+        "--max_steps", "2", "--train_batchsize", "4",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path)])
+    rows = [{"text": "今天天气很好", "summary": "好天"}] * 16
+
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    dm = UniversalDataModule(args=args, collate_fn=coll,
+                             datasets={"train": DS()})
+    module = Seq2SeqModule(args, model, config)
+    trainer = Trainer(args)
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 2
