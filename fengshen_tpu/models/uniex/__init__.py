@@ -1,0 +1,7 @@
+"""UniEX — unified information extraction with a triaffine scorer
+(reference: fengshen/models/uniex/, 2,002 LoC)."""
+
+from fengshen_tpu.models.uniex.modeling_uniex import (UniEXBertModel,
+                                                      UniEXPipelines)
+
+__all__ = ["UniEXBertModel", "UniEXPipelines"]

@@ -63,3 +63,13 @@ def test_tcbert_predict(tmp_path):
                            label_words=["体育", "财经"])
     preds = pipe.predict(["运动员比赛", "股市经济"])
     assert len(preds) == 2 and all(p in (0, 1) for p in preds)
+
+
+def test_uniex_predict(tmp_path):
+    from fengshen_tpu.models.uniex import UniEXPipelines
+    tok = _bert_tokenizer(tmp_path)
+    pipe = UniEXPipelines(args=None, tokenizer=tok, config=_small_cfg(tok))
+    out = pipe.predict([{"text": "北京大学", "choices": ["机构"]}])
+    assert len(out) == 1 and out[0]["text"] == "北京大学"
+    for ent in out[0]["entity_list"]:
+        assert set(ent) == {"entity_type", "entity_name", "score"}
