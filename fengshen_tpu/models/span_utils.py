@@ -1,0 +1,30 @@
+"""Shared typed-span decoding for the extraction pipelines (UBERT/UniEX)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def decode_spans(scores: np.ndarray, ids: list[int], tokenizer: Any,
+                 text_offset: int, threshold: float,
+                 max_span_len: int = 32) -> list[dict]:
+    """scores [S, S] (start × end) → entity dicts above threshold.
+
+    Spans start within the text region (after `text_offset`), skip the final
+    [SEP], and are capped at `max_span_len` tokens.
+    """
+    entities: list[dict] = []
+    n = len(ids) - 1  # drop final [SEP]
+    for i in range(text_offset, n):
+        for j in range(i, min(i + max_span_len, n)):
+            if scores[i, j] > threshold:
+                entities.append({
+                    "entity_name": tokenizer.decode(
+                        ids[i:j + 1]).replace(" ", ""),
+                    "score": float(scores[i, j]),
+                    "start": i - text_offset,
+                    "end": j - text_offset,
+                })
+    return entities

@@ -129,20 +129,12 @@ class UbertPipelines:
                 scores = self.model.apply(
                     {"params": self.params}, ids,
                     attention_mask=jnp.ones_like(ids))
-                s = np.asarray(scores)[0]
-                off = enc["text_offset"]
-                entities = []
-                n = len(enc["input_ids"]) - 1  # drop final [SEP]
-                for i in range(off, n):
-                    for j in range(i, min(i + 32, n)):
-                        if s[i, j] > threshold:
-                            span_text = self.tokenizer.decode(
-                                enc["input_ids"][i:j + 1]).replace(" ", "")
-                            entities.append({
-                                "entity_type": etype,
-                                "entity_name": span_text,
-                                "score": float(s[i, j]),
-                                "start": i - off, "end": j - off})
+                from fengshen_tpu.models.span_utils import decode_spans
+                entities = [
+                    {"entity_type": etype, **ent}
+                    for ent in decode_spans(
+                        np.asarray(scores)[0], enc["input_ids"],
+                        self.tokenizer, enc["text_offset"], threshold)]
                 out["choices"].append({"entity_type": etype,
                                        "entity_list": entities})
             results.append(out)

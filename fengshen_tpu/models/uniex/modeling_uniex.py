@@ -55,10 +55,10 @@ class UniEXBertModel(nn.Module):
         ones_s = jnp.ones(start.shape[:-1] + (1,), start.dtype)
         start_1 = jnp.concatenate([start, ones_s], axis=-1)
         end_1 = jnp.concatenate([end, ones_s], axis=-1)
-        # [B, Si, d, Sj] then contracted with type reps → [B, T, Si, Sj]
-        inter = jnp.einsum("bid,dke,bje->bikj", start_1,
-                           U.astype(start.dtype), end_1)
-        logits = jnp.einsum("btk,bikj->btij", typ, inter)
+        # contract the small type dim FIRST: [B,T,d+1,d+1] per-type bilinear
+        # forms, never a [B,S,d,S]-sized intermediate
+        per_type = jnp.einsum("btk,dke->btde", typ, U.astype(typ.dtype))
+        logits = jnp.einsum("bid,btde,bje->btij", start_1, per_type, end_1)
         if span_labels is None:
             return jax.nn.sigmoid(logits)
         logp = jax.nn.log_sigmoid(logits)
@@ -107,9 +107,12 @@ class UniEXPipelines:
             self.params = self.model.init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32),
                 jnp.zeros((1, 1), jnp.int32))["params"]
+        from fengshen_tpu.models.span_utils import decode_spans
         tok = self.tokenizer
         threshold = getattr(self.args, "threshold", 0.5) if self.args \
             else 0.5
+        max_len = min(getattr(self.args, "max_length", 512) if self.args
+                      else 512, self.config.max_position_embeddings)
         results = []
         for row in data:
             types = [c["entity_type"] if isinstance(c, dict) else str(c)
@@ -122,22 +125,16 @@ class UniEXPipelines:
                 ids.append(tok.sep_token_id)
             text_offset = len(ids)
             text_ids = tok.encode(row["text"], add_special_tokens=False)
-            ids = ids + text_ids + [tok.sep_token_id]
+            ids = (ids + text_ids)[: max_len - 1] + [tok.sep_token_id]
             arr = jnp.asarray([ids], jnp.int32)
             tpos = jnp.asarray([type_positions], jnp.int32)
             scores = np.asarray(self.model.apply(
                 {"params": self.params}, arr, tpos,
                 attention_mask=jnp.ones_like(arr)))[0]
             out = {"text": row["text"], "entity_list": []}
-            n = len(ids) - 1
             for ti, tname in enumerate(types):
-                for i in range(text_offset, n):
-                    for j in range(i, min(i + 32, n)):
-                        if scores[ti, i, j] > threshold:
-                            out["entity_list"].append({
-                                "entity_type": tname,
-                                "entity_name": tok.decode(
-                                    ids[i:j + 1]).replace(" ", ""),
-                                "score": float(scores[ti, i, j])})
+                for ent in decode_spans(scores[ti], ids, tok, text_offset,
+                                        threshold):
+                    out["entity_list"].append({"entity_type": tname, **ent})
             results.append(out)
         return results

@@ -72,4 +72,5 @@ def test_uniex_predict(tmp_path):
     out = pipe.predict([{"text": "北京大学", "choices": ["机构"]}])
     assert len(out) == 1 and out[0]["text"] == "北京大学"
     for ent in out[0]["entity_list"]:
-        assert set(ent) == {"entity_type", "entity_name", "score"}
+        assert set(ent) == {"entity_type", "entity_name", "score",
+                            "start", "end"}
