@@ -1,0 +1,10 @@
+"""Transfo-XL denoise / Bigan family (reference:
+fengshen/models/transfo_xl_denoise/ — denoising AE over a GPT2-XL-scale
+backbone with segment-level recurrence for long text)."""
+
+from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl_denoise \
+    import (TransfoXLDenoiseConfig, TransfoXLDenoiseModel,
+            DenoiseCollator)
+
+__all__ = ["TransfoXLDenoiseConfig", "TransfoXLDenoiseModel",
+           "DenoiseCollator"]
