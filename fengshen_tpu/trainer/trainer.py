@@ -43,6 +43,21 @@ PEAK_FLOPS = {
 }
 
 
+def _prefetch(loader, shardings, depth: int = 2):
+    """Double-buffered host→device transfer: the next batch's device_put is
+    issued while the current step computes (the device-prefetch contract of
+    SURVEY.md §7 step 1; jax transfers are async, so holding `depth`
+    in-flight batches overlaps H2D with compute)."""
+    import collections
+    queue = collections.deque()
+    for batch in loader:
+        queue.append((batch, jax.device_put(batch, shardings)))
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+
+
 def add_trainer_args(parent_parser: argparse.ArgumentParser):
     """Lightning-Trainer-compatible flag subset actually used by the
     reference examples (SURVEY.md §2.9 pattern)."""
@@ -77,6 +92,25 @@ class Trainer:
         self.callbacks: list = []
         self._log_path = os.path.join(
             getattr(args, "default_root_dir", "./runs"), "metrics.jsonl")
+        self._preempted = False
+        self._install_preemption_handler()
+
+    def _install_preemption_handler(self) -> None:
+        """SIGTERM (the preemption notice on TPU pods) sets a flag; the
+        train loop checkpoints and exits cleanly at the next step
+        boundary."""
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def handler(signum, frame):
+            self._preempted = True
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, handler)
+        except (ValueError, OSError):  # non-main thread / restricted env
+            pass
 
     # -- step compilation ------------------------------------------------
     def _build_train_step(self, module: TrainModule, state_sh, batch_spec,
@@ -212,8 +246,7 @@ class Trainer:
         while not done:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(epoch)
-            for batch in train_loader:
-                device_batch = jax.device_put(batch, batch_sh)
+            for batch, device_batch in _prefetch(train_loader, batch_sh):
                 state, metrics = step_fn(state, device_batch, rng)
                 self.global_step = int(self.global_step) + 1
                 self.consumed_samples += world_batch
@@ -240,6 +273,14 @@ class Trainer:
                 for cb in self.callbacks:
                     if hasattr(cb, "on_train_step_end"):
                         cb.on_train_step_end(self, state)
+                if self._preempted:
+                    # preemption-aware autosave (SURVEY.md §5.3: TPU pods
+                    # preempt; the reference only had SLURM re-queue)
+                    if ckpt_cb is not None:
+                        ckpt_cb.save(state, self)
+                    self._log({"event": "preempted_saved",
+                               "step": self.global_step})
+                    return state
                 if self.global_step >= max_steps:
                     done = True
                     break

@@ -17,10 +17,12 @@ def _parse(argv, extra=None):
     from fengshen_tpu.trainer import add_trainer_args
     from fengshen_tpu.models.model_utils import add_module_args
     from fengshen_tpu.data.universal_datamodule import UniversalDataModule
+    from fengshen_tpu.utils import UniversalCheckpoint
     parser = argparse.ArgumentParser()
     add_module_args(parser)
     add_trainer_args(parser)
     UniversalDataModule.add_data_specific_args(parser)
+    UniversalCheckpoint.add_argparse_args(parser)
     return parser.parse_args(argv)
 
 
@@ -188,3 +190,49 @@ def test_scan_export_roundtrip():
     k0 = params["model"]["layers"]["layer"]["self_attn"]["q_proj"]["kernel"]
     k1 = back["model"]["layers"]["layer"]["self_attn"]["q_proj"]["kernel"]
     np.testing.assert_allclose(np.asarray(k0), np.asarray(k1), atol=1e-6)
+
+
+def test_preemption_autosave(mesh8, tmp_path):
+    """SIGTERM-style preemption flag triggers a checkpoint and clean exit."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    cfg = LlamaConfig.small_test_config(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    data = [{"input_ids": rng.randint(0, 255, 16).tolist()}
+            for _ in range(64)]
+
+    class DS:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return data[i]
+
+    args = _parse(["--max_steps", "50", "--train_batchsize", "8",
+                   "--log_every_n_steps", "1", "--warmup_steps", "1",
+                   "--default_root_dir", str(tmp_path),
+                   "--save_ckpt_path", str(tmp_path / "ck"),
+                   "--load_ckpt_path", str(tmp_path / "none")])
+    from fengshen_tpu.data import UniversalDataModule
+    module = CausalLMModule(args, model, cfg)
+    dm = UniversalDataModule(args=args, datasets={"train": DS()})
+    trainer = Trainer(args)
+    cb = UniversalCheckpoint(args)
+    trainer.callbacks.append(cb)
+
+    # preempt after step 2 via the step-end hook
+    class Preemptor:
+        def on_train_step_end(self, tr, state):
+            if tr.global_step == 2:
+                tr._preempted = True
+
+    trainer.callbacks.append(Preemptor())
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 2  # stopped early
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() == 2  # autosaved at preemption
