@@ -1,0 +1,96 @@
+"""CLUE-style classification evaluation harness.
+
+Reference: fengshen/examples/clue1.1/ — the leaderboard recipe (the
+reference's quality-parity bar in BASELINE.md). Evaluates a classification
+pipeline (or a UniMC zero/few-shot pipeline) over CLUE-format jsonl and
+reports accuracy per task.
+
+    python -m fengshen_tpu.examples.clue1_1.evaluate_clue \
+        --task tnews --data dev.json --model <dir> [--zero_shot]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+#: task → (text field(s), label list or None for dataset-provided)
+CLUE_TASKS = {
+    "tnews": (("sentence",), None),
+    "afqmc": (("sentence1", "sentence2"), ["不同", "相同"]),
+    "iflytek": (("sentence",), None),
+    "ocnli": (("sentence1", "sentence2"), ["矛盾", "中立", "蕴含"]),
+    "cmnli": (("sentence1", "sentence2"), ["矛盾", "中立", "蕴含"]),
+    "wsc": (("text",), ["否", "是"]),
+    "csl": (("abst",), ["否", "是"]),
+}
+
+
+def load_clue_jsonl(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def evaluate_classification(pipeline, rows: list[dict], text_fields,
+                            label_key: str = "label") -> float:
+    """Accuracy of a TextClassificationPipeline over CLUE rows."""
+    correct = total = 0
+    for row in rows:
+        texts = [row[f] for f in text_fields if f in row]
+        pred = pipeline(texts[0], texts[1] if len(texts) > 1 else None)
+        gold = row.get(label_key)
+        if gold is None:
+            continue
+        total += 1
+        correct += int(pred["label"] == int(gold))
+    return correct / max(total, 1)
+
+
+def evaluate_unimc(pipeline, rows: list[dict], choices: list[str],
+                   text_fields, label_key: str = "label") -> float:
+    """Zero/few-shot accuracy via the UniMC label-as-option pipeline."""
+    data = []
+    golds = []
+    for row in rows:
+        text = " ".join(str(row[f]) for f in text_fields if f in row)
+        data.append({"texta": text, "choices": choices})
+        golds.append(int(row.get(label_key, -1)))
+    preds = pipeline.predict(data)
+    pairs = [(p, g) for p, g in zip(preds, golds) if g >= 0]
+    if not pairs:
+        return 0.0
+    return sum(int(p == g) for p, g in pairs) / len(pairs)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--task", required=True, choices=sorted(CLUE_TASKS))
+    parser.add_argument("--data", required=True, type=str)
+    parser.add_argument("--model", type=str, default=None)
+    parser.add_argument("--zero_shot", action="store_true", default=False)
+    args, rest = parser.parse_known_args(argv)
+
+    text_fields, choices = CLUE_TASKS[args.task]
+    rows = load_clue_jsonl(args.data)
+    if args.zero_shot:
+        from fengshen_tpu.models.unimc import UniMCPipelines
+        pipe = UniMCPipelines(args=None, model=args.model)
+        acc = evaluate_unimc(pipe, rows, choices or [], text_fields)
+    else:
+        from fengshen_tpu.pipelines.text_classification import (
+            TextClassificationPipeline)
+        pipe = TextClassificationPipeline(args=None, model=args.model)
+        acc = evaluate_classification(pipe, rows, text_fields)
+    print(json.dumps({"task": args.task, "accuracy": round(acc, 4),
+                      "n": len(rows)}))
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,9 @@ MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
     "zen": ("fengshen_tpu.models.zen", "ZenConfig",
             {"base": "ZenModel",
              "sequence_classification": "ZenForSequenceClassification"}),
+    "deltalm": ("fengshen_tpu.models.deltalm", "DeltaLMConfig",
+                {"conditional_generation":
+                     "DeltaLMForConditionalGeneration"}),
 }
 
 

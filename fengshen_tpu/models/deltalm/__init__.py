@@ -1,0 +1,8 @@
+"""DeltaLM family (reference: fengshen/models/deltalm/, 1,978 LoC —
+encoder-decoder for translation with an interleaved decoder initialised
+from the encoder)."""
+
+from fengshen_tpu.models.deltalm.modeling_deltalm import (
+    DeltaLMConfig, DeltaLMForConditionalGeneration)
+
+__all__ = ["DeltaLMConfig", "DeltaLMForConditionalGeneration"]
