@@ -16,7 +16,27 @@ import numpy as np
 import optax
 
 
+def _watchdog(seconds: int = 540) -> None:
+    """Fail fast (exit 1) instead of hanging forever if the accelerator or
+    its compile service is wedged."""
+    import os
+    import signal
+
+    def on_alarm(signum, frame):
+        import sys
+        print("bench watchdog: accelerator unresponsive, aborting",
+              file=sys.stderr, flush=True)
+        os._exit(1)
+
+    try:
+        signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(seconds)
+    except (ValueError, OSError):
+        pass
+
+
 def main() -> None:
+    _watchdog()
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
     from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Erlangshen-MegatronBert pretrain launcher — TPU counterpart of the
+# reference's pretrain_erlangshen_base.sh (reference: fengshen/examples/
+# pretrain_erlangshen_bert/pretrain_erlangshen_base.sh:25-41 heredoc
+# ZeRO-1 JSON → PL_DEEPSPEED_CONFIG_PATH). ZeRO ≈ --fsdp_parallel_size.
+
+MODEL_PATH=${MODEL_PATH:-"./erlangshen-bert-base"}
+TRAIN_FILE=${TRAIN_FILE:-"./corpus.jsonl"}
+OUTPUT=${OUTPUT:-"./runs/erlangshen_base"}
+
+python -m fengshen_tpu.examples.pretrain_erlangshen_bert.pretrain_erlangshen \
+    --model_path "$MODEL_PATH" \
+    --train_file "$TRAIN_FILE" \
+    --max_seq_length 512 \
+    --masked_lm_prob 0.15 \
+    --train_batchsize 32 \
+    --fsdp_parallel_size 8 \
+    --learning_rate 1e-4 \
+    --warmup_ratio 0.01 \
+    --scheduler_type polynomial \
+    --max_steps 100000 \
+    --every_n_train_steps 1000 \
+    --save_ckpt_path "$OUTPUT/ckpt" \
+    --load_ckpt_path "$OUTPUT/ckpt" \
+    --default_root_dir "$OUTPUT"

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Ziya-LLaMA SFT launcher — the TPU counterpart of the reference's
+# finetune_with_tp.sh (reference: fengshen/examples/ziya_llama/
+# finetune_with_tp.sh: SLURM srun + heredoc DeepSpeed JSON + TP=8).
+# Here the whole DeepSpeed/NCCL surface is four mesh flags; run one process
+# per HOST (not per chip) — jax.distributed handles the rest.
+
+MODEL_PATH=${MODEL_PATH:-"./ziya-llama-13b"}
+TRAIN_FILE=${TRAIN_FILE:-"./sft_train.jsonl"}
+OUTPUT=${OUTPUT:-"./runs/ziya_sft"}
+
+python -m fengshen_tpu.examples.ziya_llama.finetune_ziya_llama \
+    --model_path "$MODEL_PATH" \
+    --train_file "$TRAIN_FILE" \
+    --max_seq_length 1024 \
+    --train_batchsize 1 \
+    --accumulate_grad_batches 8 \
+    --tensor_model_parallel_size 8 \
+    --fsdp_parallel_size 1 \
+    --learning_rate 1e-5 \
+    --warmup_ratio 0.03 \
+    --scheduler_type cosine \
+    --max_epochs 2 \
+    --precision bf16 \
+    --gradient_clip_val 1.0 \
+    --every_n_train_steps 500 \
+    --save_ckpt_path "$OUTPUT/ckpt" \
+    --load_ckpt_path "$OUTPUT/ckpt" \
+    --default_root_dir "$OUTPUT"

@@ -297,6 +297,25 @@ class Trainer:
         self._log({"event": "fit_end", "step": self.global_step})
         return state
 
+    # -- predict ---------------------------------------------------------
+    def predict(self, module: TrainModule, dataloader, state=None,
+                params=None, **kwargs) -> list:
+        """Prediction loop over `module.predict_step`
+        (reference: the Lightning predict path used for TP generation,
+        fengshen/examples/ziya_llama/finetune_ziya_llama.py:155-176 +
+        strategies/megatron_deepspeed.py:371-399)."""
+        if params is None:
+            params = state.params if state is not None else None
+        if params is None:
+            raise ValueError("predict needs state or params")
+        if not hasattr(module, "predict_step"):
+            raise AttributeError(
+                f"{type(module).__name__} defines no predict_step")
+        outputs = []
+        for batch in dataloader:
+            outputs.append(module.predict_step(params, batch, **kwargs))
+        return outputs
+
     # -- validation ------------------------------------------------------
     def _run_validation(self, module, datamodule, state, rng):
         loader = getattr(datamodule, "val_dataloader", lambda: None)()
