@@ -1,0 +1,13 @@
+"""Taiyi Stable Diffusion family (reference:
+fengshen/examples/finetune_taiyi_stable_diffusion/finetune.py — latent
+diffusion finetune over diffusers' tokenizer/text_encoder/vae/unet/scheduler
+with the ε / v-prediction switch, SURVEY.md §3.4)."""
+
+from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
+from fengshen_tpu.models.stable_diffusion.autoencoder_kl import AutoencoderKL
+from fengshen_tpu.models.stable_diffusion.unet import UNet2DConditionModel
+from fengshen_tpu.models.stable_diffusion.modeling_taiyi_sd import (
+    TaiyiStableDiffusion, diffusion_loss)
+
+__all__ = ["DDPMScheduler", "AutoencoderKL", "UNet2DConditionModel",
+           "TaiyiStableDiffusion", "diffusion_loss"]

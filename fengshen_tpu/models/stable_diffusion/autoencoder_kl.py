@@ -1,0 +1,109 @@
+"""KL autoencoder (the SD VAE): image ↔ latent.
+
+Reference workload usage: vae.encode(pixels).latent_dist.sample() × 0.18215
+(reference: finetune_taiyi_stable_diffusion/finetune.py:112-120). Compact
+conv encoder/decoder with the same latent contract (4-channel latents at
+1/8 resolution, scaling factor 0.18215).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+SCALING_FACTOR = 0.18215
+
+
+@dataclasses.dataclass
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_mults: Sequence[int] = (1, 2, 4, 4)
+    dtype: str = "float32"
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "VAEConfig":
+        base = dict(base_channels=16, channel_mults=(1, 2))
+        base.update(overrides)
+        return cls(**base)
+
+
+class _ResBlock(nn.Module):
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.GroupNorm(num_groups=min(8, x.shape[-1]),
+                         name="norm1")(x)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv1")(jax.nn.silu(h))
+        h = nn.GroupNorm(num_groups=min(8, self.channels), name="norm2")(h)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv2")(jax.nn.silu(h))
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                        name="skip")(x)
+        return x + h
+
+
+class AutoencoderKL(nn.Module):
+    config: VAEConfig
+
+    @nn.compact
+    def __call__(self, pixels, rng=None):
+        mean, logvar = self.encode(pixels)
+        if rng is not None:
+            latent = mean + jnp.exp(0.5 * logvar) * \
+                jax.random.normal(rng, mean.shape)
+        else:
+            latent = mean
+        recon = self.decode(latent)
+        return recon, mean, logvar
+
+    @nn.compact
+    def encode(self, pixels):
+        """pixels [B, H, W, C] → (mean, logvar) latents at 1/2^n res."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME", dtype=dt,
+                    name="conv_in")(pixels)
+        for i, mult in enumerate(cfg.channel_mults):
+            ch = cfg.base_channels * mult
+            h = _ResBlock(ch, dt, name=f"down_{i}_res")(h)
+            if i < len(cfg.channel_mults) - 1:
+                h = nn.Conv(ch, (3, 3), strides=(2, 2), padding="SAME",
+                            dtype=dt, name=f"down_{i}_downsample")(h)
+        h = _ResBlock(h.shape[-1], dt, name="mid_res")(h)
+        h = nn.GroupNorm(num_groups=min(8, h.shape[-1]),
+                         name="norm_out")(h)
+        stats = nn.Conv(2 * cfg.latent_channels, (3, 3), padding="SAME",
+                        dtype=dt, name="conv_out")(jax.nn.silu(h))
+        mean, logvar = jnp.split(stats, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    @nn.compact
+    def decode(self, latent):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        mults = list(reversed(cfg.channel_mults))
+        h = nn.Conv(cfg.base_channels * mults[0], (3, 3), padding="SAME",
+                    dtype=dt, name="dec_conv_in")(latent)
+        h = _ResBlock(h.shape[-1], dt, name="dec_mid_res")(h)
+        for i, mult in enumerate(mults):
+            ch = cfg.base_channels * mult
+            h = _ResBlock(ch, dt, name=f"up_{i}_res")(h)
+            if i < len(mults) - 1:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(ch, (3, 3), padding="SAME", dtype=dt,
+                            name=f"up_{i}_conv")(h)
+        h = nn.GroupNorm(num_groups=min(8, h.shape[-1]),
+                         name="dec_norm_out")(h)
+        return nn.Conv(cfg.in_channels, (3, 3), padding="SAME", dtype=dt,
+                       name="dec_conv_out")(jax.nn.silu(h))

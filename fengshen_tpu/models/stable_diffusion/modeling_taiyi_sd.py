@@ -1,0 +1,79 @@
+"""Taiyi Stable Diffusion: text encoder + VAE + UNet + scheduler.
+
+Port of the reference training step (reference:
+fengshen/examples/finetune_taiyi_stable_diffusion/finetune.py:112-144):
+vae.encode → ×0.18215 → sample noise+timesteps → scheduler.add_noise →
+text_encoder(input_ids) → unet(noisy, t, text) → MSE against ε or v
+(:130-136), with frozen-tower options (:91-100).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fengshen_tpu.models.bert import BertConfig, BertModel
+from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
+    SCALING_FACTOR, AutoencoderKL, VAEConfig)
+from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
+from fengshen_tpu.models.stable_diffusion.unet import (UNetConfig,
+                                                       UNet2DConditionModel)
+
+
+class TaiyiStableDiffusion(nn.Module):
+    """The three-model latent-diffusion pipeline with a Chinese text tower."""
+
+    text_config: BertConfig
+    vae_config: VAEConfig
+    unet_config: UNetConfig
+
+    def setup(self):
+        self.text_encoder = BertModel(self.text_config,
+                                      add_pooling_layer=False,
+                                      name="text_encoder")
+        self.vae = AutoencoderKL(self.vae_config, name="vae")
+        self.unet = UNet2DConditionModel(self.unet_config, name="unet")
+
+    def encode_text(self, input_ids, attention_mask=None,
+                    deterministic=True):
+        hidden, _ = self.text_encoder(input_ids, attention_mask,
+                                      deterministic=deterministic)
+        return hidden
+
+    def encode_image(self, pixels, rng=None):
+        mean, logvar = self.vae.encode(pixels)
+        if rng is not None:
+            latent = mean + jnp.exp(0.5 * logvar) * \
+                jax.random.normal(rng, mean.shape)
+        else:
+            latent = mean
+        return latent * SCALING_FACTOR
+
+    def denoise(self, noisy_latents, timesteps, text_states):
+        return self.unet(noisy_latents, timesteps, text_states)
+
+    def __call__(self, input_ids, pixels, timesteps, noise,
+                 attention_mask=None, rng=None, deterministic=True):
+        latents = self.encode_image(pixels, rng)
+        scheduler = DDPMScheduler()
+        noisy = scheduler.add_noise(latents, noise, timesteps)
+        text = self.encode_text(input_ids, attention_mask, deterministic)
+        pred = self.denoise(noisy, timesteps, text)
+        return pred, latents
+
+
+def diffusion_loss(pred, latents, noise, timesteps,
+                   scheduler: Optional[DDPMScheduler] = None,
+                   prediction_type: str = "epsilon"):
+    """MSE against the ε or v target (reference: finetune.py:130-136)."""
+    scheduler = scheduler or DDPMScheduler(prediction_type=prediction_type)
+    if prediction_type == "v_prediction":
+        target = scheduler.get_velocity(latents, noise, timesteps)
+    else:
+        target = noise
+    return jnp.mean(jnp.square(pred.astype(jnp.float32) -
+                               target.astype(jnp.float32)))

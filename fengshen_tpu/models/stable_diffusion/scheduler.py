@@ -1,0 +1,65 @@
+"""DDPM noise scheduler (diffusers-parity math).
+
+Reference workload: fengshen/examples/finetune_taiyi_stable_diffusion/
+finetune.py:112-144 — `scheduler.add_noise` during training and the
+ε / v-prediction target switch (:130-136).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class DDPMScheduler:
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    beta_schedule: str = "scaled_linear"   # diffusers SD default
+    prediction_type: str = "epsilon"       # "epsilon" | "v_prediction"
+
+    def __post_init__(self):
+        if self.beta_schedule == "scaled_linear":
+            betas = np.linspace(self.beta_start ** 0.5,
+                                self.beta_end ** 0.5,
+                                self.num_train_timesteps) ** 2
+        elif self.beta_schedule == "linear":
+            betas = np.linspace(self.beta_start, self.beta_end,
+                                self.num_train_timesteps)
+        else:
+            raise ValueError(f"unknown beta schedule {self.beta_schedule!r}")
+        alphas = 1.0 - betas
+        self.alphas_cumprod = jnp.asarray(np.cumprod(alphas),
+                                          dtype=jnp.float32)
+
+    def _gather(self, t, shape):
+        a = self.alphas_cumprod[t]
+        return a.reshape(a.shape + (1,) * (len(shape) - a.ndim))
+
+    def add_noise(self, sample, noise, timesteps):
+        a = self._gather(timesteps, sample.shape)
+        return jnp.sqrt(a) * sample + jnp.sqrt(1 - a) * noise
+
+    def get_velocity(self, sample, noise, timesteps):
+        """v = sqrt(ᾱ)·ε − sqrt(1−ᾱ)·x (the v-prediction target)."""
+        a = self._gather(timesteps, sample.shape)
+        return jnp.sqrt(a) * noise - jnp.sqrt(1 - a) * sample
+
+    def step(self, model_output, timestep, sample):
+        """One ancestral DDPM denoise step (inference)."""
+        a_t = self.alphas_cumprod[timestep]
+        a_prev = jnp.where(timestep > 0,
+                           self.alphas_cumprod[jnp.maximum(timestep - 1, 0)],
+                           1.0)
+        if self.prediction_type == "v_prediction":
+            eps = jnp.sqrt(a_t) * model_output + \
+                jnp.sqrt(1 - a_t) * sample
+        else:
+            eps = model_output
+        x0 = (sample - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+        dir_xt = jnp.sqrt(1 - a_prev) * eps
+        return jnp.sqrt(a_prev) * x0 + dir_xt

@@ -1,0 +1,150 @@
+"""Conditional UNet for latent diffusion.
+
+The denoiser of the Taiyi-SD workload (reference: finetune.py:139-144
+`unet(noisy_latents, timesteps, encoder_hidden_states)`), compact but
+structurally faithful: sinusoidal time embedding → MLP; down path of
+resblocks (+ cross-attention on text states) with downsampling; middle
+block; up path with skip connections and upsampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    base_channels: int = 320
+    channel_mults: Sequence[int] = (1, 2, 4, 4)
+    num_heads: int = 8
+    cross_attention_dim: int = 768
+    dtype: str = "float32"
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "UNetConfig":
+        base = dict(base_channels=32, channel_mults=(1, 2), num_heads=2,
+                    cross_attention_dim=32)
+        base.update(overrides)
+        return cls(**base)
+
+
+def timestep_embedding(timesteps: jax.Array, dim: int) -> jax.Array:
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    args = timesteps.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class _TimeResBlock(nn.Module):
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, temb):
+        h = nn.GroupNorm(num_groups=min(8, x.shape[-1]), name="norm1")(x)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME",
+                    dtype=self.dtype, name="conv1")(jax.nn.silu(h))
+        h = h + nn.Dense(self.channels, dtype=self.dtype,
+                         name="time_proj")(jax.nn.silu(temb))[:, None, None]
+        h = nn.GroupNorm(num_groups=min(8, self.channels), name="norm2")(h)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME",
+                    dtype=self.dtype, name="conv2")(jax.nn.silu(h))
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                        name="skip")(x)
+        return x + h
+
+
+class _CrossAttnBlock(nn.Module):
+    channels: int
+    num_heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context):
+        """x [B,H,W,C]; context [B,T,D] (text states)."""
+        b, hh, ww, c = x.shape
+        head_dim = self.channels // self.num_heads
+        flat = x.reshape(b, hh * ww, c)
+        h = nn.LayerNorm(name="norm")(flat)
+        q = nn.Dense(self.channels, use_bias=False, dtype=self.dtype,
+                     name="to_q")(h)
+        k = nn.Dense(self.channels, use_bias=False, dtype=self.dtype,
+                     name="to_k")(context)
+        v = nn.Dense(self.channels, use_bias=False, dtype=self.dtype,
+                     name="to_v")(context)
+        q = q.reshape(b, -1, self.num_heads, head_dim)
+        k = k.reshape(b, -1, self.num_heads, head_dim)
+        v = v.reshape(b, -1, self.num_heads, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        out = nn.Dense(self.channels, dtype=self.dtype, name="to_out")(
+            out.reshape(b, -1, self.channels))
+        return x + out.reshape(b, hh, ww, c)
+
+
+class UNet2DConditionModel(nn.Module):
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, latents, timesteps, encoder_hidden_states):
+        """latents [B,H,W,C_in], timesteps [B], text states [B,T,D] →
+        predicted noise/velocity [B,H,W,C_out]."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        tdim = cfg.base_channels * 4
+        temb = timestep_embedding(timesteps, cfg.base_channels)
+        temb = nn.Dense(tdim, dtype=dt, name="time_mlp1")(temb)
+        temb = nn.Dense(tdim, dtype=dt, name="time_mlp2")(
+            jax.nn.silu(temb))
+
+        h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME", dtype=dt,
+                    name="conv_in")(latents)
+        skips = []
+        for i, mult in enumerate(cfg.channel_mults):
+            ch = cfg.base_channels * mult
+            h = _TimeResBlock(ch, dt, name=f"down_{i}_res")(h, temb)
+            h = _CrossAttnBlock(ch, cfg.num_heads,
+                                dt, name=f"down_{i}_attn")(
+                h, encoder_hidden_states)
+            skips.append(h)  # one skip per resolution level
+            if i < len(cfg.channel_mults) - 1:
+                h = nn.Conv(ch, (3, 3), strides=(2, 2), padding="SAME",
+                            dtype=dt, name=f"down_{i}_downsample")(h)
+
+        h = _TimeResBlock(h.shape[-1], dt, name="mid_res1")(h, temb)
+        h = _CrossAttnBlock(h.shape[-1], cfg.num_heads, dt,
+                            name="mid_attn")(h, encoder_hidden_states)
+        h = _TimeResBlock(h.shape[-1], dt, name="mid_res2")(h, temb)
+
+        for i, mult in enumerate(reversed(cfg.channel_mults)):
+            ch = cfg.base_channels * mult
+            skip = skips.pop()
+            if skip.shape[1] != h.shape[1]:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, skip.shape[1], skip.shape[2],
+                                         c), "nearest")
+                h = nn.Conv(c, (3, 3), padding="SAME", dtype=dt,
+                            name=f"up_{i}_upconv")(h)
+            h = jnp.concatenate([h, skip], axis=-1)
+            h = _TimeResBlock(ch, dt, name=f"up_{i}_res")(h, temb)
+            h = _CrossAttnBlock(ch, cfg.num_heads, dt,
+                                name=f"up_{i}_attn")(
+                h, encoder_hidden_states)
+
+        h = nn.GroupNorm(num_groups=min(8, h.shape[-1]),
+                         name="norm_out")(h)
+        return nn.Conv(cfg.out_channels, (3, 3), padding="SAME", dtype=dt,
+                       name="conv_out")(jax.nn.silu(h))
