@@ -1,0 +1,9 @@
+"""Hubert audio family (reference: fengshen/examples/hubert/
+pretrain_hubert.py wraps the fairseq HubertModel; here a native flax
+implementation of the masked-cluster-prediction pretraining)."""
+
+from fengshen_tpu.models.hubert.modeling_hubert import (
+    HubertConfig, HubertModel, hubert_pretrain_loss, compute_mask_indices)
+
+__all__ = ["HubertConfig", "HubertModel", "hubert_pretrain_loss",
+           "compute_mask_indices"]

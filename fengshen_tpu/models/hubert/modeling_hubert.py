@@ -1,0 +1,141 @@
+"""Hubert in flax: conv waveform encoder + transformer + masked
+cluster prediction.
+
+Behavioural port of the reference workload (reference:
+fengshen/examples/hubert/pretrain_hubert.py:19-55 over fairseq's
+HubertModel; data at fengshen/data/hubert/hubert_dataset.py): raw audio →
+strided conv feature encoder (~50Hz frames) → span-masked frames replaced
+by a learned mask embedding → transformer encoder → per-frame logits over
+k-means cluster codebooks; loss is CE at masked (and optionally unmasked)
+frames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fengshen_tpu.models.bert.modeling_bert import BertConfig, BertLayer
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+
+@dataclasses.dataclass
+class HubertConfig:
+    # conv feature encoder: (channels, kernel, stride) per layer
+    conv_layers: Sequence[Sequence[int]] = (
+        (512, 10, 5), (512, 3, 2), (512, 3, 2), (512, 3, 2), (512, 3, 2),
+        (512, 2, 2), (512, 2, 2))
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_clusters: int = 500
+    mask_prob: float = 0.65
+    mask_length: int = 10
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+    param_dtype: str = "float32"
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "HubertConfig":
+        base = dict(conv_layers=((16, 10, 5), (16, 3, 2)), hidden_size=32,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=64, num_clusters=16, mask_length=2)
+        base.update(overrides)
+        return cls(**base)
+
+    def _bert_config(self) -> BertConfig:
+        return BertConfig(
+            vocab_size=1, hidden_size=self.hidden_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            intermediate_size=self.intermediate_size,
+            layer_norm_eps=self.layer_norm_eps,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            dtype=self.dtype, param_dtype=self.param_dtype)
+
+
+def compute_mask_indices(shape: tuple[int, int], mask_prob: float,
+                         mask_length: int, rng: np.random.RandomState
+                         ) -> np.ndarray:
+    """Span mask over frames (fairseq-style): choose start indices so that
+    ~mask_prob of frames fall inside a span of mask_length."""
+    batch, frames = shape
+    mask = np.zeros(shape, bool)
+    n_spans = max(1, int(mask_prob * frames / mask_length + rng.random()))
+    for b in range(batch):
+        starts = rng.choice(max(frames - mask_length, 1),
+                            size=min(n_spans, max(frames - mask_length, 1)),
+                            replace=False)
+        for s in starts:
+            mask[b, s:s + mask_length] = True
+    return mask
+
+
+class HubertModel(nn.Module):
+    config: HubertConfig
+
+    @nn.compact
+    def __call__(self, waveform, mask_time_indices=None,
+                 deterministic=True):
+        """waveform [B, T] → (logits [B, F, num_clusters], features)."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        h = waveform[..., None]  # [B, T, 1]
+        for i, (ch, kernel, stride) in enumerate(cfg.conv_layers):
+            h = nn.Conv(ch, (kernel,), strides=(stride,), use_bias=False,
+                        dtype=dt, name=f"conv_{i}")(h)
+            h = nn.GroupNorm(num_groups=min(8, ch),
+                             name=f"conv_norm_{i}")(h) if i == 0 else h
+            h = jax.nn.gelu(h)
+        features = nn.Dense(cfg.hidden_size, dtype=dt,
+                            name="feature_projection")(h)
+        features = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                name="feature_norm")(features)
+
+        mask_emb = self.param("mask_embedding",
+                              nn.initializers.normal(0.02),
+                              (cfg.hidden_size,),
+                              jnp.dtype(cfg.param_dtype))
+        if mask_time_indices is not None:
+            features = jnp.where(mask_time_indices[..., None],
+                                 mask_emb[None, None].astype(features.dtype),
+                                 features)
+
+        bert_cfg = cfg._bert_config()
+        hidden = features
+        for i in range(cfg.num_hidden_layers):
+            hidden = BertLayer(bert_cfg, name=f"layer_{i}")(
+                hidden, None, deterministic)
+        logits = nn.Dense(cfg.num_clusters, dtype=dt,
+                          name="cluster_head")(hidden)
+        return logits, hidden
+
+    def partition_rules(self):
+        from jax.sharding import PartitionSpec as P
+        return [
+            (r"(query|key|value|intermediate_dense)/kernel",
+             P("fsdp", "tensor")),
+            (r"(attention_output_dense|output_dense)/kernel",
+             P("tensor", "fsdp")),
+            (".*", P(None)),
+        ]
+
+
+def hubert_pretrain_loss(logits, cluster_targets, mask_time_indices,
+                         unmasked_weight: float = 0.0):
+    """CE at masked frames (+ optional unmasked term, fairseq's
+    pred_nomask)."""
+    masked_targets = jnp.where(mask_time_indices, cluster_targets, -100)
+    loss_m, n_m = stable_cross_entropy(logits, masked_targets)
+    if unmasked_weight > 0.0:
+        unmasked_targets = jnp.where(mask_time_indices, -100,
+                                     cluster_targets)
+        loss_u, _ = stable_cross_entropy(logits, unmasked_targets)
+        return loss_m + unmasked_weight * loss_u, n_m
+    return loss_m, n_m

@@ -1,0 +1,41 @@
+"""Hubert audio pretraining tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_mask_indices():
+    from fengshen_tpu.models.hubert import compute_mask_indices
+    rng = np.random.RandomState(0)
+    mask = compute_mask_indices((2, 50), mask_prob=0.5, mask_length=5,
+                                rng=rng)
+    assert mask.shape == (2, 50)
+    frac = mask.mean()
+    assert 0.1 < frac < 0.9
+
+
+def test_hubert_forward_and_loss():
+    from fengshen_tpu.models.hubert import (HubertConfig, HubertModel,
+                                            hubert_pretrain_loss,
+                                            compute_mask_indices)
+    cfg = HubertConfig.small_test_config()
+    model = HubertModel(cfg)
+    wav = jnp.asarray(np.random.RandomState(0).randn(2, 400), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), wav)["params"]
+    logits, hidden = model.apply({"params": params}, wav)
+    n_frames = logits.shape[1]
+    assert n_frames < 400 and logits.shape[-1] == 16
+
+    rng = np.random.RandomState(1)
+    mask = jnp.asarray(compute_mask_indices((2, n_frames), 0.5, 2, rng))
+    targets = jnp.asarray(rng.randint(0, 16, (2, n_frames)))
+    logits_m, _ = model.apply({"params": params}, wav,
+                              mask_time_indices=mask)
+    # masked frames produce different logits than unmasked run
+    assert float(jnp.abs(logits_m - logits).max()) > 1e-6
+    loss, n = hubert_pretrain_loss(logits_m, targets, mask)
+    assert np.isfinite(float(loss)) and int(n) == int(mask.sum())
+    loss2, _ = hubert_pretrain_loss(logits_m, targets, mask,
+                                    unmasked_weight=0.5)
+    assert float(loss2) != float(loss)
