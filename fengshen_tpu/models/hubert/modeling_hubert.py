@@ -21,7 +21,6 @@ import numpy as np
 from flax import linen as nn
 
 from fengshen_tpu.models.bert.modeling_bert import BertConfig, BertLayer
-from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
 
 
 @dataclasses.dataclass
@@ -37,6 +36,11 @@ class HubertConfig:
     num_clusters: int = 500
     mask_prob: float = 0.65
     mask_length: int = 10
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    # fairseq-style conv positional embedding over frames
+    pos_conv_kernel: int = 128
+    pos_conv_groups: int = 16
     layer_norm_eps: float = 1e-5
     dtype: str = "float32"
     param_dtype: str = "float32"
@@ -45,7 +49,8 @@ class HubertConfig:
     def small_test_config(cls, **overrides: Any) -> "HubertConfig":
         base = dict(conv_layers=((16, 10, 5), (16, 3, 2)), hidden_size=32,
                     num_hidden_layers=2, num_attention_heads=4,
-                    intermediate_size=64, num_clusters=16, mask_length=2)
+                    intermediate_size=64, num_clusters=16, mask_length=2,
+                    pos_conv_kernel=7, pos_conv_groups=4)
         base.update(overrides)
         return cls(**base)
 
@@ -56,7 +61,8 @@ class HubertConfig:
             num_attention_heads=self.num_attention_heads,
             intermediate_size=self.intermediate_size,
             layer_norm_eps=self.layer_norm_eps,
-            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            hidden_dropout_prob=self.hidden_dropout_prob,
+            attention_probs_dropout_prob=self.attention_probs_dropout_prob,
             dtype=self.dtype, param_dtype=self.param_dtype)
 
 
@@ -88,8 +94,10 @@ class HubertModel(nn.Module):
         dt = jnp.dtype(cfg.dtype)
         h = waveform[..., None]  # [B, T, 1]
         for i, (ch, kernel, stride) in enumerate(cfg.conv_layers):
-            h = nn.Conv(ch, (kernel,), strides=(stride,), use_bias=False,
-                        dtype=dt, name=f"conv_{i}")(h)
+            # VALID padding: fairseq/HF HuBERT convs are unpadded, which
+            # fixes the frame count expected by the k-means label pipeline
+            h = nn.Conv(ch, (kernel,), strides=(stride,), padding="VALID",
+                        use_bias=False, dtype=dt, name=f"conv_{i}")(h)
             h = nn.GroupNorm(num_groups=min(8, ch),
                              name=f"conv_norm_{i}")(h) if i == 0 else h
             h = jax.nn.gelu(h)
@@ -107,6 +115,15 @@ class HubertModel(nn.Module):
                                  mask_emb[None, None].astype(features.dtype),
                                  features)
 
+        # conv positional embedding (fairseq pos_conv): grouped conv over
+        # frames, gelu, added to features — gives the stack its positional
+        # signal (BertLayer alone is position-agnostic)
+        pos = nn.Conv(cfg.hidden_size, (cfg.pos_conv_kernel,),
+                      padding="SAME",
+                      feature_group_count=cfg.pos_conv_groups,
+                      dtype=dt, name="pos_conv")(features)
+        features = features + jax.nn.gelu(pos)
+
         bert_cfg = cfg._bert_config()
         hidden = features
         for i in range(cfg.num_hidden_layers):
@@ -117,25 +134,25 @@ class HubertModel(nn.Module):
         return logits, hidden
 
     def partition_rules(self):
-        from jax.sharding import PartitionSpec as P
-        return [
-            (r"(query|key|value|intermediate_dense)/kernel",
-             P("fsdp", "tensor")),
-            (r"(attention_output_dense|output_dense)/kernel",
-             P("tensor", "fsdp")),
-            (".*", P(None)),
-        ]
+        # same layer param names as the BERT stack it reuses
+        from fengshen_tpu.models.bert.modeling_bert import PARTITION_RULES
+        return PARTITION_RULES
 
 
 def hubert_pretrain_loss(logits, cluster_targets, mask_time_indices,
                          unmasked_weight: float = 0.0):
     """CE at masked frames (+ optional unmasked term, fairseq's
-    pred_nomask)."""
-    masked_targets = jnp.where(mask_time_indices, cluster_targets, -100)
-    loss_m, n_m = stable_cross_entropy(logits, masked_targets)
+    pred_nomask). The per-frame CE is computed once and reduced under the
+    two masks."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ce = -jnp.take_along_axis(logp, cluster_targets[..., None],
+                                    axis=-1)[..., 0]
+    masked = mask_time_indices.astype(jnp.float32)
+    n_m = jnp.maximum(masked.sum(), 1)
+    loss_m = (token_ce * masked).sum() / n_m
     if unmasked_weight > 0.0:
-        unmasked_targets = jnp.where(mask_time_indices, -100,
-                                     cluster_targets)
-        loss_u, _ = stable_cross_entropy(logits, unmasked_targets)
-        return loss_m + unmasked_weight * loss_u, n_m
-    return loss_m, n_m
+        unmasked = 1.0 - masked
+        loss_u = (token_ce * unmasked).sum() / jnp.maximum(unmasked.sum(),
+                                                           1)
+        return loss_m + unmasked_weight * loss_u, masked.sum()
+    return loss_m, masked.sum()
