@@ -1,0 +1,7 @@
+"""Image-text data for CLIP/SD (reference: fengshen/data/clip_dataloader/
+flickr.py and fengshen/data/taiyi_stable_diffusion_datasets/)."""
+
+from fengshen_tpu.data.clip_dataloader.image_text import (
+    ImageTextCSVDataset, CLIPCollator, SDCollator)
+
+__all__ = ["ImageTextCSVDataset", "CLIPCollator", "SDCollator"]

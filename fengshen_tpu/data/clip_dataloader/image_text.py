@@ -1,0 +1,101 @@
+"""Image-text csv datasets + collators for CLIP / Stable Diffusion.
+
+Reference: fengshen/data/clip_dataloader/flickr.py (image-path/caption csv
+for Taiyi-CLIP) and fengshen/data/taiyi_stable_diffusion_datasets/
+taiyi_datasets.py (image+caption rows for SD finetune). Images are loaded
+with PIL, resized/center-cropped and normalised on host; tensors are NHWC
+float32 (TPU conv layout).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+
+class ImageTextCSVDataset:
+    """csv rows (image_path, caption) → dicts. Separator configurable
+    (the reference's flickr csv uses tab)."""
+
+    def __init__(self, csv_path: str, image_root: Optional[str] = None,
+                 image_key: str = "image", caption_key: str = "caption",
+                 delimiter: str = ","):
+        self.rows: list[dict] = []
+        self.image_root = image_root or os.path.dirname(csv_path)
+        with open(csv_path) as f:
+            reader = csv.DictReader(f, delimiter=delimiter)
+            for row in reader:
+                self.rows.append({"image": row[image_key],
+                                  "caption": row[caption_key]})
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int) -> dict:
+        row = self.rows[i]
+        path = row["image"]
+        if not os.path.isabs(path):
+            path = os.path.join(self.image_root, path)
+        return {"image_path": path, "caption": row["caption"]}
+
+
+def load_image(path: str, size: int) -> np.ndarray:
+    """PIL load → resize shorter side → center crop → [0,1] NHWC float."""
+    from PIL import Image
+    img = Image.open(path).convert("RGB")
+    w, h = img.size
+    scale = size / min(w, h)
+    img = img.resize((max(int(w * scale), size),
+                      max(int(h * scale), size)))
+    w, h = img.size
+    left, top = (w - size) // 2, (h - size) // 2
+    img = img.crop((left, top, left + size, top + size))
+    return np.asarray(img, np.float32) / 255.0
+
+
+@dataclass
+class CLIPCollator:
+    """captions+images → contrastive batch (Taiyi-CLIP pretrain)."""
+
+    tokenizer: Any
+    image_size: int = 224
+    max_length: int = 77
+    mean: tuple = (0.48145466, 0.4578275, 0.40821073)
+    std: tuple = (0.26862954, 0.26130258, 0.27577711)
+
+    def __call__(self, samples: list[dict]) -> dict:
+        enc = self.tokenizer([s["caption"] for s in samples],
+                             padding="max_length", truncation=True,
+                             max_length=self.max_length,
+                             return_tensors="np")
+        images = np.stack([load_image(s["image_path"], self.image_size)
+                           for s in samples])
+        images = (images - np.asarray(self.mean)) / np.asarray(self.std)
+        return {"input_ids": enc["input_ids"].astype(np.int32),
+                "attention_mask": enc["attention_mask"].astype(np.int32),
+                "pixel_values": images.astype(np.float32)}
+
+
+@dataclass
+class SDCollator:
+    """captions+images → latent-diffusion batch (pixels in [-1, 1],
+    reference: taiyi_datasets.py normalisation)."""
+
+    tokenizer: Any
+    image_size: int = 512
+    max_length: int = 77
+
+    def __call__(self, samples: list[dict]) -> dict:
+        enc = self.tokenizer([s["caption"] for s in samples],
+                             padding="max_length", truncation=True,
+                             max_length=self.max_length,
+                             return_tensors="np")
+        images = np.stack([load_image(s["image_path"], self.image_size)
+                           for s in samples])
+        return {"input_ids": enc["input_ids"].astype(np.int32),
+                "attention_mask": enc["attention_mask"].astype(np.int32),
+                "pixel_values": (images * 2.0 - 1.0).astype(np.float32)}

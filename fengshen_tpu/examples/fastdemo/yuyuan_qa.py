@@ -1,0 +1,54 @@
+"""YuyuanQA-style interactive demo.
+
+Port of reference: fengshen/examples/FastDemo/YuyuanQA.py — a minimal
+question-answering demo over a finetuned causal LM ("Question:...Answer:"
+format), reading questions from stdin and generating answers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def answer(model, params, tokenizer, question: str,
+           max_new_tokens: int = 64) -> str:
+    import jax.numpy as jnp
+
+    from fengshen_tpu.utils.generate import generate
+
+    prompt = f"Question:{question} Answer:"
+    ids = tokenizer.encode(prompt, add_special_tokens=False)
+    out = generate(model, params, jnp.asarray([ids], jnp.int32),
+                   max_new_tokens=max_new_tokens,
+                   eos_token_id=tokenizer.eos_token_id)
+    new_tokens = list(out[0][len(ids):])
+    return tokenizer.decode(new_tokens, skip_special_tokens=True).strip()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from fengshen_tpu.models.gpt2.convert import load_hf_pretrained
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", required=True, type=str)
+    parser.add_argument("--max_new_tokens", default=64, type=int)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    config, params = load_hf_pretrained(args.model_path)
+    model = GPT2LMHeadModel(config)
+
+    print("YuyuanQA demo — type a question, empty line to exit")
+    for line in sys.stdin:
+        q = line.strip()
+        if not q:
+            break
+        print(answer(model, params, tokenizer, q,
+                     max_new_tokens=args.max_new_tokens), flush=True)
+
+
+if __name__ == "__main__":
+    main()

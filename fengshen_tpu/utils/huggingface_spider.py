@@ -1,0 +1,9 @@
+"""List IDEA-CCNL models on the HF hub
+(reference: fengshen/utils/huggingface_spider.py, 12 LoC)."""
+
+from __future__ import annotations
+
+
+def list_fengshenbang_models(author: str = "IDEA-CCNL") -> list[str]:
+    from huggingface_hub import HfApi
+    return [m.modelId for m in HfApi().list_models(author=author)]

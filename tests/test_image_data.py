@@ -1,0 +1,59 @@
+"""Image-text dataset/collator tests."""
+
+import csv
+
+import numpy as np
+
+
+def _make_dataset(tmp_path, n=3, size=40):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    rows = []
+    for i in range(n):
+        img = Image.fromarray(rng.randint(0, 255, (size, size + 10, 3),
+                                          np.uint8))
+        path = tmp_path / f"img_{i}.png"
+        img.save(path)
+        rows.append({"image": f"img_{i}.png", "caption": f"图片{i}"})
+    csv_path = tmp_path / "data.csv"
+    with open(csv_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=["image", "caption"])
+        writer.writeheader()
+        writer.writerows(rows)
+    return str(csv_path)
+
+
+class FakeTok:
+    def __call__(self, texts, padding=None, truncation=None,
+                 max_length=None, return_tensors=None):
+        ids = np.zeros((len(texts), max_length), np.int64)
+        mask = np.zeros((len(texts), max_length), np.int64)
+        for i, t in enumerate(texts):
+            n = min(len(t), max_length)
+            ids[i, :n] = [3 + (ord(c) % 90) for c in t][:n]
+            mask[i, :n] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def test_clip_collator(tmp_path):
+    from fengshen_tpu.data.clip_dataloader import (ImageTextCSVDataset,
+                                                   CLIPCollator)
+    ds = ImageTextCSVDataset(_make_dataset(tmp_path))
+    assert len(ds) == 3
+    coll = CLIPCollator(FakeTok(), image_size=32, max_length=16)
+    batch = coll([ds[0], ds[1]])
+    assert batch["pixel_values"].shape == (2, 32, 32, 3)
+    assert batch["input_ids"].shape == (2, 16)
+    # normalised: roughly zero-centred
+    assert abs(batch["pixel_values"].mean()) < 3.0
+
+
+def test_sd_collator(tmp_path):
+    from fengshen_tpu.data.clip_dataloader import (ImageTextCSVDataset,
+                                                   SDCollator)
+    ds = ImageTextCSVDataset(_make_dataset(tmp_path))
+    coll = SDCollator(FakeTok(), image_size=16, max_length=8)
+    batch = coll([ds[0]])
+    assert batch["pixel_values"].shape == (1, 16, 16, 3)
+    assert batch["pixel_values"].min() >= -1.0
+    assert batch["pixel_values"].max() <= 1.0
