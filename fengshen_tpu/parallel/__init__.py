@@ -28,6 +28,7 @@ from fengshen_tpu.parallel.partition import (
     tree_paths,
 )
 from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = [
     "MeshConfig",
@@ -48,4 +49,5 @@ __all__ = [
     "shard_batch_spec",
     "tree_paths",
     "vocab_parallel_cross_entropy",
+    "pipeline_apply",
 ]

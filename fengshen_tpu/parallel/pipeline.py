@@ -1,0 +1,102 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over a mesh axis.
+
+The reference's pipeline parallelism is plumbing-only: topology/groups/seeds
+exist (reference: fengshen/models/megatron/mpu/initialize.py:111-134,
+fengshen/strategies/megatron_deepspeed.py:347-361) but no PipelineModule is
+ever wired into an example (SURVEY.md §2.4). This module provides a REAL
+schedule, TPU-native: stages live on shards of a named mesh axis, stacked
+per-stage parameters are sharded over that axis, and activations flow
+stage-to-stage with `jax.lax.ppermute` while microbatches fill the pipe
+(GPipe). Everything is a single SPMD program — no per-stage processes.
+
+Usage sketch::
+
+    mesh = Mesh(devices.reshape(4, 2), ("pipe", "data"))
+    out = pipeline_apply(stage_fn, stacked_params, microbatches,
+                         mesh=mesh, axis_name="pipe")
+
+where ``stage_fn(stage_params, x) -> x`` is one stage's computation and
+``stacked_params`` has a leading [n_stages] dim on every leaf.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_body(stage_params: Any, microbatches: jax.Array,
+                   stage_fn: Callable, axis_name: str,
+                   n_microbatches: int) -> jax.Array:
+    """shard_map body. stage_params: this stage's params (leading stage dim
+    already split away by sharding). microbatches: [M, mb, ...] replicated.
+    Returns [M, mb, ...] outputs valid on the LAST stage."""
+    n_stages = jax.lax.axis_size(axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    is_first = stage_idx == 0
+    is_last = stage_idx == n_stages - 1
+
+    # strip the stage dim the sharding left as size 1
+    local_params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+
+    mb_shape = microbatches.shape[1:]
+    state = jnp.zeros(mb_shape, microbatches.dtype)  # current activation
+    outputs = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+
+    total_ticks = n_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t while t < M; later stages use the
+        # activation that arrived from the previous stage
+        feed = jnp.take(microbatches, jnp.clip(t, 0, n_microbatches - 1),
+                        axis=0)
+        x = jnp.where(is_first, feed, state)
+        y = stage_fn(local_params, x)
+        # last stage emits microbatch (t - n_stages + 1) when it's valid
+        out_idx = t - (n_stages - 1)
+        emit = jnp.logical_and(is_last, out_idx >= 0)
+        outputs = jax.lax.cond(
+            emit,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), 0),
+            lambda o: o, outputs)
+        # rotate activations to the next stage (last→0 wraps; stage 0
+        # ignores what it receives)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return state, outputs
+
+    _, outputs = jax.lax.fori_loop(0, total_ticks, tick, (state, outputs))
+    # broadcast the last stage's outputs to every shard so out_specs can be
+    # replicated along the pipe axis
+    outputs = jax.lax.psum(
+        jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis_name)
+    return outputs
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params: Any,
+                   microbatches: jax.Array, mesh: Mesh,
+                   axis_name: str = "pipe") -> jax.Array:
+    """Run `stage_fn` as a GPipe pipeline over `axis_name`.
+
+    stacked_params: pytree with leading [n_stages] dim on every leaf;
+    microbatches: [n_microbatches, microbatch, ...] (replicated); returns
+    [n_microbatches, microbatch, ...] outputs.
+    """
+    n_micro = microbatches.shape[0]
+    params_spec = jax.tree_util.tree_map(
+        lambda x: P(axis_name), stacked_params)
+    fn = shard_map(
+        partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name,
+                n_microbatches=n_micro),
+        mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+        check_vma=False)
+    return fn(stacked_params, microbatches)

@@ -1,0 +1,56 @@
+"""GPipe pipeline-parallel schedule tests (CPU 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from fengshen_tpu.parallel.pipeline import pipeline_apply
+
+
+def _mesh_pipe4():
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("pipe", "data"))
+
+
+def test_pipeline_matches_sequential():
+    rng = np.random.RandomState(0)
+    n_stages, n_micro, mb, dim = 4, 6, 2, 8
+    ws = jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3, jnp.float32)
+    bs = jnp.asarray(rng.randn(n_stages, dim) * 0.1, jnp.float32)
+    params = {"w": ws, "b": bs}
+    x = jnp.asarray(rng.randn(n_micro, mb, dim), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    # sequential reference
+    ref = x
+    for s in range(n_stages):
+        ref = jax.vmap(lambda h: stage_fn(
+            {"w": ws[s], "b": bs[s]}, h))(ref)
+
+    out = pipeline_apply(stage_fn, params, x, mesh=_mesh_pipe4())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_grad_flows():
+    rng = np.random.RandomState(1)
+    n_stages, n_micro, mb, dim = 4, 4, 2, 4
+    params = {"w": jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3,
+                               jnp.float32)}
+    x = jnp.asarray(rng.randn(n_micro, mb, dim), jnp.float32)
+    mesh = _mesh_pipe4()
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss(p):
+        out = pipeline_apply(stage_fn, p, x, mesh=mesh)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(params)
+    assert np.isfinite(np.asarray(g["w"])).all()
+    # every stage's weights receive gradient
+    per_stage = np.abs(np.asarray(g["w"])).sum(axis=(1, 2))
+    assert (per_stage > 0).all()
