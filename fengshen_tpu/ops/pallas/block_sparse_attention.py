@@ -1,0 +1,109 @@
+"""Pallas TPU block-sparse attention.
+
+The TPU-native replacement for the reference's DeepSpeed sparse attention
+(reference: fengshen/models/megatron/layers/utils.py:187-289 —
+Fixed/Variable/LocalSlidingWindow/BigBird/BSLongformer block layouts on
+Triton kernels). The layout is a static [nQ, nK] block-presence matrix
+(built by fengshen_tpu.ops.masks at block granularity); absent blocks are
+SKIPPED entirely — compute and HBM traffic scale with the number of present
+blocks, not S².
+
+Same streaming structure as the flash kernel: grid (B*H, nQ, nK), online
+softmax in VMEM scratch, the block-presence flag prefetched to SMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _bs_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, max_ref, sum_ref,
+               *, scale: float, n_kblocks: int):
+    # layout_ref: [nQ, nK] int32 in SMEM; q/o: [1, blk_q, D]; k/v: [1, blk_k, D]
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        max_ref[:] = jnp.full_like(max_ref, _NEG_INF)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+
+    @pl.when(layout_ref[qb, kb] > 0)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row_max = max_ref[:, 0]
+        new_max = jnp.maximum(row_max, scores.max(axis=-1))
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[:, None])
+        sum_ref[:, 0] = sum_ref[:, 0] * correction + probs.sum(axis=-1)
+        max_ref[:, 0] = new_max
+        acc_ref[:] = acc_ref[:] * correction[:, None] + jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kblocks - 1)
+    def _finalize():
+        out = acc_ref[:] / jnp.maximum(sum_ref[:, 0], 1e-30)[:, None]
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           layout: np.ndarray, block_size: int,
+                           interpret: bool = False) -> jax.Array:
+    """q/k/v: [B, S, H, D]; layout: [S//block, S//block] bool — True blocks
+    are computed, False blocks skipped. Rows with no present block yield 0.
+    """
+    batch, q_len, num_heads, head_dim = q.shape
+    k_len = k.shape[1]
+    n_q, n_k = q_len // block_size, k_len // block_size
+    assert layout.shape == (n_q, n_k), \
+        f"layout {layout.shape} != block grid {(n_q, n_k)}"
+    scale = float(1.0 / (head_dim ** 0.5))
+    layout_arr = jnp.asarray(np.asarray(layout), jnp.int32)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(-1, x.shape[1], x.shape[3])
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    kernel = functools.partial(_bs_kernel, scale=scale, n_kblocks=n_k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(qb.shape[0], n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_size, head_dim),
+                         lambda b, i, j, layout: (b, i, 0)),
+            pl.BlockSpec((1, block_size, head_dim),
+                         lambda b, i, j, layout: (b, j, 0)),
+            pl.BlockSpec((1, block_size, head_dim),
+                         lambda b, i, j, layout: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_size, head_dim),
+                               lambda b, i, j, layout: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_size, head_dim), jnp.float32),
+            pltpu.VMEM((block_size, 1), jnp.float32),
+            pltpu.VMEM((block_size, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        interpret=interpret,
+    )(layout_arr, qb, kb, vb)
+    return (out.reshape(batch, num_heads, q_len, head_dim)
+               .transpose(0, 2, 1, 3))
