@@ -1,0 +1,56 @@
+"""Ziya-LLaMA inference demo.
+
+Port of reference: fengshen/examples/ziya_inference/ (HF generation demo;
+the reference also ships 8-bit/llama.cpp variants — quantized serving is a
+round-2 item, see NOTES.md). Loads an HF llama checkpoint, applies the
+"<human>:/<bot>:" chat format, and generates with sampling.
+
+    python -m fengshen_tpu.examples.ziya_inference.generate_ziya \
+        --model_path <hf-llama-dir> --query "帮我写一首诗" --top_p 0.85
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    import jax
+    import jax.numpy as jnp
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.models.llama import LlamaForCausalLM
+    from fengshen_tpu.models.llama.convert import load_hf_pretrained
+    from fengshen_tpu.utils.generate import generate
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", required=True, type=str)
+    parser.add_argument("--query", required=True, type=str)
+    parser.add_argument("--max_new_tokens", default=128, type=int)
+    parser.add_argument("--do_sample", action="store_true", default=True)
+    parser.add_argument("--temperature", default=0.8, type=float)
+    parser.add_argument("--top_k", default=0, type=int)
+    parser.add_argument("--top_p", default=0.85, type=float)
+    parser.add_argument("--seed", default=42, type=int)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    config, params = load_hf_pretrained(args.model_path)
+    model = LlamaForCausalLM(config)
+
+    prompt = f"<human>:{args.query.strip()}\n<bot>:"
+    ids = tokenizer.encode(prompt)
+    out = generate(model, params, jnp.asarray([ids], jnp.int32),
+                   max_new_tokens=args.max_new_tokens,
+                   do_sample=args.do_sample, temperature=args.temperature,
+                   top_k=args.top_k, top_p=args.top_p,
+                   eos_token_id=config.eos_token_id,
+                   pad_token_id=config.pad_token_id,
+                   rng=jax.random.PRNGKey(args.seed))
+    text = tokenizer.decode(list(out[0][len(ids):]),
+                            skip_special_tokens=True)
+    print(text.strip())
+
+
+if __name__ == "__main__":
+    main()
