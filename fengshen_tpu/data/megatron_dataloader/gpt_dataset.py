@@ -80,24 +80,39 @@ class GPTDataset:
         return len(self.sample_idx) - 1
 
     def __getitem__(self, idx: int) -> dict:
+        """One seq_length+1 token window, boundaries INCLUSIVE of the token
+        at the end offset (the one-token overlap convention of the index
+        builder, reference helpers.cpp:165).
+
+        Returns ``input_ids`` of exactly seq_length tokens (the window minus
+        its final label token) so batch shapes stay tile/mesh-aligned, and
+        unshifted ``labels == input_ids`` (with -100 at padding): the
+        training module owns the shift (CausalLMModule.training_loss
+        computes logits[:, :-1] vs labels[:, 1:]), so the dataset must NOT
+        pre-shift. The window's last token is not a target here — it is the
+        next sample's first input via the one-token overlap.
+        """
         doc_f, off_f = self.sample_idx[idx]
         doc_l, off_l = self.sample_idx[idx + 1]
         if doc_f == doc_l:
             tokens = self.indexed.get(int(self.seq_order[doc_f]),
                                       offset=int(off_f),
-                                      length=int(off_l - off_f))
+                                      length=int(off_l - off_f) + 1)
             parts = [tokens]
         else:
             parts = [self.indexed.get(int(self.seq_order[doc_f]),
                                       offset=int(off_f))]
             for d in range(int(doc_f) + 1, int(doc_l)):
                 parts.append(self.indexed[int(self.seq_order[d])])
-            if off_l > 0 and doc_l < len(self.seq_order):
+            if doc_l < len(self.seq_order):
                 parts.append(self.indexed.get(int(self.seq_order[doc_l]),
-                                              length=int(off_l)))
+                                              length=int(off_l) + 1))
         tokens = np.concatenate(parts)
-        tokens = tokens[: self.seq_length + 1]
-        if len(tokens) < self.seq_length + 1:
-            tokens = np.pad(tokens, (0, self.seq_length + 1 - len(tokens)))
-        return {"input_ids": tokens[:-1].astype(np.int32),
-                "labels": tokens[1:].astype(np.int32)}
+        tokens = tokens[: self.seq_length]
+        n_valid = len(tokens)
+        if n_valid < self.seq_length:
+            tokens = np.pad(tokens, (0, self.seq_length - n_valid))
+        labels = tokens.astype(np.int32).copy()
+        labels[n_valid:] = -100  # pad positions never contribute to the loss
+        return {"input_ids": tokens.astype(np.int32),
+                "labels": labels}

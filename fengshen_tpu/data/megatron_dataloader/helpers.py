@@ -94,8 +94,10 @@ def build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray,
         remaining = seq_length + 1
         while remaining > 0 and doc_pos < len(doc_idx):
             doc_len = int(sizes[doc_idx[doc_pos]]) - doc_offset
-            if doc_len > remaining:
-                doc_offset += remaining
+            if doc_len >= remaining:
+                # one-token overlap (reference: helpers.cpp:165): next
+                # sample re-starts at this sample's last (label) token
+                doc_offset += remaining - 1
                 remaining = 0
             else:
                 remaining -= doc_len

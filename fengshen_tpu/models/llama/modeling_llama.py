@@ -136,13 +136,16 @@ class LlamaAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         impl = cfg.attention_impl
-        if impl in ("flash", "ring") and (mask is not None and
-                                          attention_mask is not None):
-            impl = "dense"  # padding masks need the dense path
+        if impl == "ring" and attention_mask is not None:
+            impl = "dense"  # ring is causal-only; padding needs dense
         if impl in ("flash", "ring") and not is_decode:
             if impl == "flash":
                 from fengshen_tpu.ops.flash_attention import flash_attention
-                out = flash_attention(q, k, v, causal=True)
+                # a padding mask maps to segment ids (pads = segment 0), so
+                # padded SFT batches stay on the fused kernel
+                seg = None if attention_mask is None else \
+                    attention_mask.astype(jnp.int32)
+                out = flash_attention(q, k, v, causal=True, segment_ids=seg)
             else:
                 out = dot_product_attention(q, k, v, impl="ring")
         else:

@@ -16,7 +16,11 @@ from fengshen_tpu.ops.masks import (
     causal_mask,
     sliding_window_mask,
     bigbird_mask,
+    bigbird_block_layout,
     longformer_mask,
+    longformer_block_layout,
+    fixed_sparsity_mask,
+    fixed_block_layout,
     make_attention_bias,
 )
 from fengshen_tpu.ops.attention import dot_product_attention
@@ -27,6 +31,8 @@ __all__ = [
     "rotary_cos_sin", "apply_rotary_pos_emb",
     "alibi_slopes", "alibi_bias",
     "causal_mask", "sliding_window_mask", "bigbird_mask", "longformer_mask",
+    "fixed_sparsity_mask",
+    "bigbird_block_layout", "longformer_block_layout", "fixed_block_layout",
     "make_attention_bias",
     "dot_product_attention",
 ]

@@ -51,17 +51,47 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           dropout_rng: Optional[jax.Array] = None,
                           dropout_rate: float = 0.0,
                           deterministic: bool = True,
-                          impl: str = "dense") -> jax.Array:
+                          impl: str = "dense",
+                          sparse_layout=None,
+                          sparse_block_size: int = 128) -> jax.Array:
     """Attention entry point with per-layer impl dispatch.
 
     `impl` mirrors the reference's per-layer `attention_config` selection of
     dense / flash / sparse kernels
-    (reference: layers/transformer.py:259-268). Sparse layouts are expressed
-    as `mask` (see fengshen_tpu.ops.masks) and run on either backend.
+    (reference: layers/transformer.py:259-268).
+
+    `impl="sparse"` takes `sparse_layout` — a STATIC (numpy) [nQ, nK] bool
+    block-presence matrix with `sparse_block_size` tokens per block (build
+    one with the `*_block_layout` helpers in fengshen_tpu.ops.masks) — and
+    runs the Pallas block-sparse kernel when shapes are tile-aligned,
+    skipping absent blocks entirely; otherwise it falls back to
+    dense-with-expanded-mask (the layouts are also expressible as `mask`,
+    which runs on any backend).
 
     q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; mask: bool broadcastable to
     [B, H, Sq, Sk] (True = attend); bias: additive, same broadcast.
     """
+    if impl == "sparse" and sparse_layout is not None:
+        import numpy as np
+        layout = np.asarray(sparse_layout)
+        blk = sparse_block_size
+        eligible = (
+            bias is None and mask is None and
+            (deterministic or dropout_rate == 0.0) and
+            jax.default_backend() == "tpu" and
+            q.shape[1] % blk == 0 and k.shape[1] % blk == 0 and
+            blk % 128 == 0 and q.shape[-1] % 128 == 0 and
+            layout.shape == (q.shape[1] // blk, k.shape[1] // blk))
+        if eligible:
+            from fengshen_tpu.ops.pallas.block_sparse_attention import (
+                block_sparse_attention)
+            return block_sparse_attention(q, k, v, layout, blk)
+        # fall back: expand the block layout to a dense mask
+        expanded = jnp.asarray(
+            np.kron(layout, np.ones((blk, blk), dtype=bool)))
+        mask = expanded[None, None] if mask is None else \
+            (mask & expanded[None, None])
+
     if mask is not None:
         neg = jnp.asarray(-1e9, dtype=jnp.float32)
         mask_bias = jnp.where(mask, 0.0, neg)

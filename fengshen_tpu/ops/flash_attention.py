@@ -28,9 +28,13 @@ _NEG_INF = -1e30
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         bias: Optional[jax.Array] = None,
                         causal: bool = False,
-                        block_size: int = 512) -> jax.Array:
+                        block_size: int = 512,
+                        q_segment_ids: Optional[jax.Array] = None,
+                        kv_segment_ids: Optional[jax.Array] = None
+                        ) -> jax.Array:
     """Online-softmax attention. q: [B, Sq, H, D], k/v: [B, Sk, H, D],
-    bias broadcastable to [B, H, Sq, Sk]. Returns [B, Sq, H, D].
+    bias broadcastable to [B, H, Sq, Sk]; segment ids int32 [B, S] (tokens
+    attend only within equal ids). Returns [B, Sq, H, D].
 
     Prefer `causal=True` over passing a causal bias: the mask is then
     computed per block from indices, keeping memory O(Sq·block) instead of
@@ -49,6 +53,12 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 bias.shape[:-2] + (q_len, k_len))
             bias = jnp.pad(bias, ((0, 0),) * (bias.ndim - 1) + ((0, pad),),
                            constant_values=_NEG_INF)
+    if kv_segment_ids is not None and (pad or kv_segment_ids.shape[1] <
+                                       k_len + pad):
+        kv_segment_ids = jnp.pad(
+            kv_segment_ids, ((0, 0), (0, k_len + pad -
+                                      kv_segment_ids.shape[1])),
+            constant_values=-1)  # -1 never equals a real segment id
     padded_len = k_len + pad
 
     n_blocks = padded_len // blk
@@ -67,12 +77,20 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k.reshape(batch, n_blocks, blk, num_heads, head_dim), 1, 0)
     v_blocks = jnp.moveaxis(
         v.reshape(batch, n_blocks, blk, num_heads, head_dim), 1, 0)
+    if kv_segment_ids is not None:
+        kv_seg_blocks = jnp.moveaxis(
+            kv_segment_ids.reshape(batch, n_blocks, blk), 1, 0)
     blk_idx = jnp.arange(n_blocks)
 
     def step(carry, xs):
         acc, row_max, row_sum = carry
-        if bias is not None:
+        seg_blk = None
+        if bias is not None and kv_segment_ids is not None:
+            bi, k_blk, v_blk, b_blk, seg_blk = xs
+        elif bias is not None:
             bi, k_blk, v_blk, b_blk = xs
+        elif kv_segment_ids is not None:
+            bi, k_blk, v_blk, seg_blk = xs
         else:
             bi, k_blk, v_blk = xs
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
@@ -85,7 +103,13 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 (k_pos[None, :] < k_len)
         else:
             allowed = jnp.broadcast_to(k_pos[None, :] < k_len, (q_len, blk))
-        scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+        allowed = jnp.broadcast_to(allowed[None, None],
+                                   (batch, 1, q_len, blk))
+        if seg_blk is not None:
+            same = (q_segment_ids[:, :, None] ==
+                    seg_blk[:, None, :])  # [B, Sq, blk]
+            allowed = allowed & same[:, None]
+        scores = jnp.where(allowed, scores, _NEG_INF)
         blk_max = scores.max(axis=-1)                       # [B,H,Sq]
         new_max = jnp.maximum(row_max, blk_max)
         correction = jnp.exp(row_max - new_max)
@@ -105,6 +129,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     xs = (blk_idx, k_blocks, v_blocks)
     if bias is not None:
         xs = xs + (bias_blocks,)
+    if kv_segment_ids is not None:
+        xs = xs + (kv_seg_blocks,)
 
     (acc, _, row_sum), _ = jax.lax.scan(step, (acc0, max0, sum0), xs)
     out = acc / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
@@ -116,8 +142,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     dropout_rng=None, dropout_rate: float = 0.0,
                     deterministic: bool = True,
                     block_size: int = 512,
-                    causal: bool = False) -> jax.Array:
+                    causal: bool = False,
+                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Flash attention with kernel dispatch.
+
+    `segment_ids`: int32 [B, S] (or a (q_ids, kv_ids) tuple) — tokens attend
+    only within equal ids. A padded batch's attention_mask maps directly
+    (pads become segment 0), which keeps padded SFT batches on the fused
+    kernel instead of the dense O(S²) path.
 
     Attention dropout is not supported on the flash path (same restriction
     as the reference's flash branch, which bypasses the softmax-dropout,
@@ -127,12 +159,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if not deterministic and dropout_rate > 0.0:
         raise ValueError("flash attention path does not support attention "
                          "dropout; use impl='dense'")
+    if isinstance(segment_ids, (tuple, list)):
+        q_seg, kv_seg = segment_ids
+    else:
+        q_seg = kv_seg = segment_ids
+    if q_seg is not None:
+        q_seg = q_seg.astype(jnp.int32)
+        kv_seg = kv_seg.astype(jnp.int32)
     if _pallas_eligible(q, k, v, bias, causal):
         from fengshen_tpu.ops.pallas.flash_attention import (
             pallas_flash_attention)
-        return pallas_flash_attention(q, k, v, causal)
+        return pallas_flash_attention(q, k, v, q_seg, kv_seg, causal)
     return blockwise_attention(q, k, v, bias=bias, causal=causal,
-                               block_size=block_size)
+                               block_size=block_size,
+                               q_segment_ids=q_seg, kv_segment_ids=kv_seg)
 
 
 def _pallas_eligible(q, k, v, bias, causal) -> bool:

@@ -48,14 +48,11 @@ def sliding_window_mask(q_len: int, window: int,
     return jnp.abs(diff) < window
 
 
-def bigbird_mask(seq_len: int, block: int, num_random_blocks: int,
-                 num_global_blocks: int, num_window_blocks: int,
-                 seed: int = 0, causal: bool = False) -> jax.Array:
-    """BigBird layout: global + window + random blocks
-    (reference: DeepSpeed BigBirdSparsityConfig via layers/utils.py:260-267).
-    Static (trace-time) construction — the layout is a compile-time constant,
-    as block-sparse layouts must be for XLA.
-    """
+def bigbird_block_layout(seq_len: int, block: int, num_random_blocks: int,
+                         num_global_blocks: int, num_window_blocks: int,
+                         seed: int = 0, causal: bool = False) -> np.ndarray:
+    """BigBird block-presence matrix [n, n] (numpy bool, STATIC) — the form
+    the Pallas block-sparse kernel consumes directly."""
     assert seq_len % block == 0, "seq_len must be a multiple of block"
     n = seq_len // block
     rng = np.random.RandomState(seed)
@@ -74,15 +71,27 @@ def bigbird_mask(seq_len: int, block: int, num_random_blocks: int,
         layout[i, choices] = True
     if causal:
         layout &= np.tril(np.ones((n, n), dtype=bool))
+    return layout
+
+
+def bigbird_mask(seq_len: int, block: int, num_random_blocks: int,
+                 num_global_blocks: int, num_window_blocks: int,
+                 seed: int = 0, causal: bool = False) -> jax.Array:
+    """BigBird layout: global + window + random blocks
+    (reference: DeepSpeed BigBirdSparsityConfig via layers/utils.py:260-267).
+    Static (trace-time) construction — the layout is a compile-time constant,
+    as block-sparse layouts must be for XLA.
+    """
+    layout = bigbird_block_layout(seq_len, block, num_random_blocks,
+                                  num_global_blocks, num_window_blocks,
+                                  seed, causal)
     return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
 
 
-def longformer_mask(seq_len: int, block: int, num_window_blocks: int,
-                    global_block_indices: tuple[int, ...] = (0,),
-                    causal: bool = False) -> jax.Array:
-    """BSLongformer layout: sliding window + designated global blocks
-    (reference: DeepSpeed BSLongformerSparsityConfig via
-    layers/utils.py:268-275)."""
+def longformer_block_layout(seq_len: int, block: int, num_window_blocks: int,
+                            global_block_indices: tuple[int, ...] = (0,),
+                            causal: bool = False) -> np.ndarray:
+    """BSLongformer block-presence matrix [n, n] (numpy bool, STATIC)."""
     assert seq_len % block == 0
     n = seq_len // block
     layout = np.zeros((n, n), dtype=bool)
@@ -94,15 +103,24 @@ def longformer_mask(seq_len: int, block: int, num_window_blocks: int,
         layout[:, gi] = True
     if causal:
         layout &= np.tril(np.ones((n, n), dtype=bool))
+    return layout
+
+
+def longformer_mask(seq_len: int, block: int, num_window_blocks: int,
+                    global_block_indices: tuple[int, ...] = (0,),
+                    causal: bool = False) -> jax.Array:
+    """BSLongformer layout: sliding window + designated global blocks
+    (reference: DeepSpeed BSLongformerSparsityConfig via
+    layers/utils.py:268-275)."""
+    layout = longformer_block_layout(seq_len, block, num_window_blocks,
+                                     global_block_indices, causal)
     return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
 
 
-def fixed_sparsity_mask(seq_len: int, block: int, num_local_blocks: int,
-                        num_global_blocks: int = 1,
-                        causal: bool = True) -> jax.Array:
-    """Fixed layout à la Sparse Transformers: local stripes + periodic global
-    columns (reference: DeepSpeed FixedSparsityConfig via
-    layers/utils.py:236-244)."""
+def fixed_block_layout(seq_len: int, block: int, num_local_blocks: int,
+                       num_global_blocks: int = 1,
+                       causal: bool = True) -> np.ndarray:
+    """Fixed-sparsity block-presence matrix [n, n] (numpy bool, STATIC)."""
     assert seq_len % block == 0
     n = seq_len // block
     layout = np.zeros((n, n), dtype=bool)
@@ -115,6 +133,17 @@ def fixed_sparsity_mask(seq_len: int, block: int, num_local_blocks: int,
         layout &= np.tril(np.ones((n, n), dtype=bool))
     else:
         layout |= layout.T
+    return layout
+
+
+def fixed_sparsity_mask(seq_len: int, block: int, num_local_blocks: int,
+                        num_global_blocks: int = 1,
+                        causal: bool = True) -> jax.Array:
+    """Fixed layout à la Sparse Transformers: local stripes + periodic global
+    columns (reference: DeepSpeed FixedSparsityConfig via
+    layers/utils.py:236-244)."""
+    layout = fixed_block_layout(seq_len, block, num_local_blocks,
+                                num_global_blocks, causal)
     return jnp.asarray(np.kron(layout, np.ones((block, block), dtype=bool)))
 
 

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from fengshen_tpu.parallel.mesh import TENSOR_AXIS, get_mesh
+from fengshen_tpu.parallel.mesh import (BATCH_AXES, SEQUENCE_AXIS,
+                                        TENSOR_AXIS, get_mesh)
 
 
 def stable_cross_entropy(logits: jax.Array, targets: jax.Array,
@@ -66,6 +67,27 @@ def _sharded_ce_block(logits: jax.Array, targets: jax.Array,
     return jnp.log(sum_exp) - gold
 
 
+def _leading_dims_spec(shape: tuple, mesh: Mesh) -> list:
+    """Mesh axes for the leading (batch, seq, ...) dims: the batch dim over
+    whichever BATCH_AXES divide it, the sequence dim over 'sequence'; an
+    axis is only used when its size divides the dim (spec must fit shape)."""
+    dims: list = []
+    axes, div = [], 1
+    for ax in BATCH_AXES:
+        size = mesh.shape.get(ax, 1)
+        if size > 1 and shape[0] % (div * size) == 0:
+            axes.append(ax)
+            div *= size
+    dims.append(tuple(axes) if axes else None)
+    for d in range(1, len(shape)):
+        seq_size = mesh.shape.get(SEQUENCE_AXIS, 1)
+        if d == 1 and seq_size > 1 and shape[1] % seq_size == 0:
+            dims.append(SEQUENCE_AXIS)
+        else:
+            dims.append(None)
+    return dims
+
+
 def vocab_parallel_cross_entropy(logits: jax.Array, targets: jax.Array,
                                  mesh: Optional[Mesh] = None,
                                  ignore_index: int = -100) -> tuple[jax.Array, jax.Array]:
@@ -83,8 +105,13 @@ def vocab_parallel_cross_entropy(logits: jax.Array, targets: jax.Array,
     if logits.shape[-1] % mesh.shape[TENSOR_AXIS] != 0:
         return stable_cross_entropy(logits, targets, ignore_index)
 
-    batch_spec = P(*([None] * (targets.ndim)))
-    logits_spec = P(*([None] * (logits.ndim - 1)), TENSOR_AXIS)
+    # Keep the batch/sequence dims sharded inside the shard_map (the normal
+    # training layout shards them over data/fsdp/sequence); replicating them
+    # here would force an all-gather of the [B, S, V/t] logits along the
+    # batch axes and inflate per-device memory for no reason.
+    lead = _leading_dims_spec(targets.shape, mesh)
+    batch_spec = P(*lead)
+    logits_spec = P(*lead, TENSOR_AXIS)
 
     token_loss = shard_map(
         partial(_sharded_ce_block, axis_name=TENSOR_AXIS,

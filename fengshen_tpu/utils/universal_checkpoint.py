@@ -90,17 +90,32 @@ class UniversalCheckpoint:
         if step is None:
             return state
 
-        payload = {"params": state.params}
-        if not getattr(self.args, "save_weights_only", False):
-            payload["opt_state"] = state.opt_state
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=(
-                x.sharding if hasattr(x, "sharding") else None)),
-            payload)
-        restored = mgr.restore(
-            step, args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore()))
+        def _restore(with_opt: bool):
+            payload = {"params": state.params}
+            if with_opt:
+                payload["opt_state"] = state.opt_state
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=(
+                    x.sharding if hasattr(x, "sharding") else None)),
+                payload)
+            return mgr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    meta=ocp.args.JsonRestore()))
+
+        # What the checkpoint CONTAINS (not what this run's flags say) decides
+        # whether opt_state is restored: a weights-only checkpoint loaded into
+        # a full run must silently fall back to the freshly initialized
+        # optimizer state, and vice versa — matching the reference's
+        # silent-skip semantics (reference: universal_checkpoint.py:38-41).
+        try:
+            restored = _restore(with_opt=True)
+        except ValueError as e:
+            if "opt_state" not in str(e):
+                # a genuine mismatch elsewhere (param shapes/tree) must
+                # surface, not silently reset the optimizer
+                raise
+            restored = _restore(with_opt=False)
         meta = restored["meta"]
         # restore loop counters the way the reference's on_load_checkpoint
         # does (reference: examples/pretrain_erlangshen_bert/
@@ -110,7 +125,7 @@ class UniversalCheckpoint:
         new = state.replace(params=restored["state"]["params"],
                             step=jax.numpy.asarray(meta["global_step"],
                                                    jax.numpy.int32))
-        if "opt_state" in payload and "opt_state" in restored["state"]:
+        if "opt_state" in restored["state"]:
             new = new.replace(opt_state=restored["state"]["opt_state"])
         return new
 

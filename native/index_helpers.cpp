@@ -35,8 +35,11 @@ void build_sample_idx(const int32_t* sizes, const int32_t* doc_idx,
         int64_t remaining = seq_length + 1;  // +1 for the shifted label
         while (remaining > 0 && doc_pos < doc_idx_len) {
             int32_t doc_len = sizes[doc_idx[doc_pos]] - doc_offset;
-            if (doc_len > remaining) {
-                doc_offset += static_cast<int32_t>(remaining);
+            if (doc_len >= remaining) {
+                // One-token overlap (reference: helpers.cpp:165): the next
+                // sample re-starts at this sample's last (label) token, so
+                // every boundary token is both a label and the next input.
+                doc_offset += static_cast<int32_t>(remaining) - 1;
                 remaining = 0;
             } else {
                 remaining -= doc_len;

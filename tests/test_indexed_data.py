@@ -64,9 +64,17 @@ def test_gpt_dataset_packing(tmp_path):
                     cache_dir=str(tmp_path / "cache"))
     assert len(ds) >= 3
     s = ds[0]
+    # tile-aligned seq_length inputs; the training module owns the shift
     assert s["input_ids"].shape == (8,)
-    # autoregressive shift: labels are inputs shifted by one
-    np.testing.assert_array_equal(s["input_ids"][1:], s["labels"][:-1])
+    np.testing.assert_array_equal(s["input_ids"], s["labels"])
+    # contiguous packing: sample i is exactly stream[i*8 : i*8+8] of the
+    # shuffled token stream (one-token-overlap windows minus the label tail)
+    stream = np.concatenate([np.asarray(ds.indexed[int(j)])
+                             for j in ds.seq_order])
+    for i in range(len(ds)):
+        np.testing.assert_array_equal(ds[i]["input_ids"],
+                                      stream[i * 8: i * 8 + 8])
+        assert (ds[i]["labels"] != -100).all()
     # cache file written and reused
     import os
     cached = os.listdir(tmp_path / "cache")

@@ -146,3 +146,22 @@ def test_scan_layers_init_shapes():
     k = params["model"]["layers"]["layer"]["self_attn"]["q_proj"]["kernel"]
     assert k.shape == (cfg.num_hidden_layers, cfg.hidden_size,
                        cfg.hidden_size)
+
+
+def test_padded_batch_flash_matches_dense(small_pair):
+    """VERDICT r1 weak #3: padded SFT batches must stay on the flash path
+    (segment ids), matching the dense-with-mask numerics on valid rows."""
+    import dataclasses
+    params, _, cfg = small_pair
+    ids = np.array([[3, 17, 9, 42, 7, 99, 1, 5],
+                    [8, 2, 30, 11, 0, 0, 0, 0]], dtype=np.int32)
+    mask = np.array([[1] * 8, [1] * 4 + [0] * 4], dtype=np.int32)
+    dense = LlamaForCausalLM(dataclasses.replace(cfg, attention_impl="dense"))
+    flash = LlamaForCausalLM(dataclasses.replace(cfg, attention_impl="flash"))
+    out_d = dense.apply({"params": params}, jnp.asarray(ids),
+                        attention_mask=jnp.asarray(mask))
+    out_f = flash.apply({"params": params}, jnp.asarray(ids),
+                        attention_mask=jnp.asarray(mask))
+    valid = np.asarray(mask, bool)
+    np.testing.assert_allclose(np.asarray(out_f)[valid],
+                               np.asarray(out_d)[valid], atol=2e-3)

@@ -54,3 +54,59 @@ def test_rotary_variant_runs():
     assert np.isfinite(np.asarray(out)).all()
     # no learned position table in the rotary variant
     assert "position_embeddings" not in params
+
+
+def test_banded_attention_matches_dense_reference():
+    """The chunked O(S·w) attention must equal a dense-with-mask oracle:
+    local window ∪ global columns (local proj) for local rows, full
+    attention (global proj) for global rows."""
+    import flax.linen as fnn
+    from fengshen_tpu.models.longformer.modeling_longformer import (
+        LongformerConfig, LongformerSelfAttention)
+
+    cfg = LongformerConfig.small_test_config(
+        attention_window=8, max_global_tokens=4, dtype="float32")
+    batch, seq = 2, 37  # deliberately not a multiple of the chunk size
+    rng = np.random.RandomState(0)
+    hidden = jnp.asarray(rng.randn(batch, seq, cfg.hidden_size), jnp.float32)
+    mask = np.ones((batch, seq), np.int32)
+    mask[1, 30:] = 0
+    gmask = np.zeros((batch, seq), np.int32)
+    gmask[:, 0] = 1
+    gmask[0, 5] = 1
+
+    attn = LongformerSelfAttention(cfg)
+    params = attn.init(jax.random.PRNGKey(0), hidden)
+    out = attn.apply(params, hidden, jnp.asarray(mask), jnp.asarray(gmask))
+
+    # dense oracle with the same parameters
+    p = params["params"]
+
+    def proj(name, rot=False):
+        w, b = p[name]["kernel"], p[name]["bias"]
+        x = hidden @ w + b
+        return x.reshape(batch, seq, cfg.num_attention_heads, cfg.head_dim)
+
+    q, k, v = proj("query"), proj("key"), proj("value")
+    qg, kg, vg = proj("query_global"), proj("key_global"), proj("value_global")
+    half = cfg.attention_window // 2
+    pos = np.arange(seq)
+    local = np.abs(pos[:, None] - pos[None, :]) <= half
+    valid = mask.astype(bool)
+    is_global = gmask.astype(bool) & valid
+    allowed = (local[None] | is_global[:, None, :]) & valid[:, None, :]
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(jnp.asarray(allowed)[:, None], scores, -1e9)
+    probs = jax.nn.softmax(scores, -1)
+    out_local = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    g_scores = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale
+    g_scores = jnp.where(jnp.asarray(valid)[:, None, None, :], g_scores, -1e9)
+    out_glob = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(g_scores, -1), vg)
+    ref = jnp.where(jnp.asarray(is_global)[:, :, None, None],
+                    out_glob, out_local)
+    ref = ref.reshape(batch, seq, cfg.hidden_size)
+
+    valid_rows = np.asarray(valid)
+    np.testing.assert_allclose(np.asarray(out)[valid_rows],
+                               np.asarray(ref)[valid_rows], atol=2e-4)

@@ -188,12 +188,86 @@ def test_pallas_flash_interpret_matches_dense(qkv):
     from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
     q, k, v = qkv
     ref = _ref_attention(q, k, v)
-    out = pallas_flash_attention(q, k, v, False, 8, 8, True)
+    out = pallas_flash_attention(q, k, v, None, None, False, 8, 8, True)
     np.testing.assert_allclose(out, ref, atol=1e-4)
     refc = _ref_attention(q, k, v, make_attention_bias(
         causal_mask(16)[None, None]))
-    outc = pallas_flash_attention(q, k, v, True, 8, 8, True)
+    outc = pallas_flash_attention(q, k, v, None, None, True, 8, 8, True)
     np.testing.assert_allclose(outc, refc, atol=1e-4)
+
+
+def test_pallas_flash_segment_ids_interpret(qkv):
+    """Padded batch as segment ids == dense with a padding mask (on the
+    valid rows)."""
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = qkv
+    batch, seq = q.shape[0], q.shape[1]
+    n_valid = 10
+    seg = jnp.asarray(
+        np.repeat([[1] * n_valid + [0] * (seq - n_valid)], batch, 0),
+        jnp.int32)
+    mask = (seg[:, None, None, :] > 0) & causal_mask(seq)[None, None]
+    ref = _ref_attention(q, k, v, make_attention_bias(mask))
+    out = pallas_flash_attention(q, k, v, seg, seg, True, 8, 8, True)
+    np.testing.assert_allclose(np.asarray(out)[:, :n_valid],
+                               np.asarray(ref)[:, :n_valid], atol=1e-4)
+
+
+def test_pallas_flash_fused_backward_matches_xla(qkv):
+    """The fused Pallas bwd kernels (dq/dk/dv) must match XLA autodiff of
+    the blockwise implementation."""
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = qkv
+
+    def f_pallas(q, k, v):
+        return (pallas_flash_attention(
+            q, k, v, None, None, True, 8, 8, True) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (blockwise_attention(q, k, v, causal=True,
+                                    block_size=8) ** 2).sum()
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_pallas_flash_fused_backward_segments(qkv):
+    """Fused bwd with segment ids matches autodiff of masked dense."""
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    q, k, v = qkv
+    batch, seq = q.shape[0], q.shape[1]
+    seg = jnp.asarray(
+        np.repeat([[1] * 12 + [0] * (seq - 12)], batch, 0), jnp.int32)
+
+    def f_pallas(q, k, v):
+        out = pallas_flash_attention(q, k, v, seg, seg, True, 8, 8, True)
+        return (out ** 2 * (seg > 0)[:, :, None, None]).sum()
+
+    def f_ref(q, k, v):
+        mask = ((seg[:, None, None, :] == seg[:, None, :, None]) &
+                causal_mask(seq)[None, None])
+        out = _ref_attention(q, k, v, make_attention_bias(mask))
+        return (out ** 2 * (seg > 0)[:, :, None, None]).sum()
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_blockwise_attention_segment_ids(qkv):
+    q, k, v = qkv
+    batch, seq = q.shape[0], q.shape[1]
+    seg = jnp.asarray(
+        np.repeat([[1] * 9 + [2] * (seq - 9)], batch, 0), jnp.int32)
+    mask = ((seg[:, None, None, :] == seg[:, None, :, None]) &
+            causal_mask(seq)[None, None])
+    ref = _ref_attention(q, k, v, make_attention_bias(mask))
+    out = blockwise_attention(q, k, v, causal=True, block_size=4,
+                              q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
 def test_attention_ring_impl_no_mesh_falls_back(qkv):
@@ -215,7 +289,7 @@ def test_pallas_flash_decode_alignment_interpret():
     k = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
     v = jnp.asarray(rng.randn(1, 16, 2, 8), jnp.float32)
     ref = blockwise_attention(q, k, v, causal=True, block_size=8)
-    out = pallas_flash_attention(q, k, v, True, 8, 8, True)
+    out = pallas_flash_attention(q, k, v, None, None, True, 8, 8, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 

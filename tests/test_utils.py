@@ -46,6 +46,43 @@ def test_checkpoint_save_restore_roundtrip(tmp_path, mesh8):
     assert int(restored.step) == 7
 
 
+def test_checkpoint_weights_only_restore_into_full_run(tmp_path, mesh8):
+    """A --save_weights_only checkpoint restored by a run WITHOUT that flag
+    must silently keep the fresh optimizer state (ADVICE r1)."""
+    import optax
+    from fengshen_tpu.trainer.train_state import TrainState
+    from fengshen_tpu.utils.universal_checkpoint import UniversalCheckpoint
+
+    params = {"w": jnp.arange(16.0).reshape(4, 4), "b": jnp.ones((4,))}
+    tx = optax.adamw(1e-3)
+    state = TrainState.create(apply_fn=lambda: None, params=params, tx=tx)
+
+    parser = argparse.ArgumentParser()
+    UniversalCheckpoint.add_argparse_args(parser)
+    save_args = parser.parse_args(
+        ["--save_ckpt_path", str(tmp_path / "ck"),
+         "--load_ckpt_path", str(tmp_path / "ck"), "--save_weights_only"])
+
+    class FakeTrainer:
+        global_step = 3
+        consumed_samples = 30
+
+    UniversalCheckpoint(save_args).save(state, FakeTrainer())
+
+    load_args = parser.parse_args(
+        ["--save_ckpt_path", str(tmp_path / "ck"),
+         "--load_ckpt_path", str(tmp_path / "ck")])  # full run, no flag
+    fresh = TrainState.create(apply_fn=lambda: None,
+                              params=jax.tree_util.tree_map(
+                                  jnp.zeros_like, params), tx=tx)
+    t2 = FakeTrainer()
+    restored = UniversalCheckpoint(load_args).maybe_restore(fresh, t2)
+    np.testing.assert_allclose(restored.params["w"], state.params["w"])
+    # optimizer state falls back to the freshly initialized one
+    chex = __import__("chex")
+    chex.assert_trees_all_equal(restored.opt_state, fresh.opt_state)
+
+
 def test_checkpoint_missing_load_path_silently_skipped(tmp_path):
     import optax
     from fengshen_tpu.trainer.train_state import TrainState
