@@ -1,0 +1,8 @@
+"""HuBERT audio data (reference: fengshen/data/hubert/hubert_dataset.py)."""
+
+from fengshen_tpu.data.hubert.hubert_dataset import (
+    HubertDataset, HubertCollator, load_audio_manifest, load_labels,
+    read_waveform, conv_frames)
+
+__all__ = ["HubertDataset", "HubertCollator", "load_audio_manifest",
+           "load_labels", "read_waveform", "conv_frames"]

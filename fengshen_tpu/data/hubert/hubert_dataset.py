@@ -1,0 +1,183 @@
+"""HuBERT pretraining audio dataset.
+
+Behavioural port of the reference's fairseq-style dataset
+(reference: fengshen/data/hubert/hubert_dataset.py:39-360 — `load_audio`
+manifest parsing, `load_label`/`load_label_offset` frame-label loading,
+`verify_label_lengths`, random crop to max_sample_size and right-pad
+collation). TPU-native differences: numpy throughout, stdlib `wave` (PCM)
+or `.npy` waveform loading instead of soundfile, and the collator emits
+frame-aligned cluster targets for the VALID-conv frame count of
+fengshen_tpu.models.hubert.
+"""
+
+from __future__ import annotations
+
+import os
+import wave
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+def load_audio_manifest(manifest_path: str, max_keep: Optional[int] = None,
+                        min_keep: Optional[int] = None
+                        ) -> tuple[str, list[str], list[int], list[int]]:
+    """Parse a fairseq tsv manifest: first line is the root dir, then
+    `relative_path\tnum_samples` rows (reference: hubert_dataset.py:39-66).
+    Returns (root, paths, n_samples, kept_indices)."""
+    paths, sizes, inds = [], [], []
+    with open(manifest_path) as f:
+        root = f.readline().strip()
+        for i, line in enumerate(f):
+            parts = line.strip().split("\t")
+            if len(parts) < 2:
+                continue
+            sz = int(parts[1])
+            if max_keep is not None and sz > max_keep:
+                continue
+            if min_keep is not None and sz < min_keep:
+                continue
+            paths.append(parts[0])
+            sizes.append(sz)
+            inds.append(i)
+    return root, paths, sizes, inds
+
+
+def load_labels(label_path: str, inds: Sequence[int]) -> list[list[int]]:
+    """One space-separated label line per original manifest row; keep the
+    rows surviving the length filter (reference: hubert_dataset.py:67-87)."""
+    with open(label_path) as f:
+        lines = f.readlines()
+    keep = set(inds)
+    out = []
+    for i, line in enumerate(lines):
+        if i in keep:
+            out.append([int(x) for x in line.split()])
+    return out
+
+
+def read_waveform(path: str) -> np.ndarray:
+    """Load mono audio as float32 in [-1, 1]: `.npy` arrays or PCM `.wav`
+    via the stdlib (substitutes the reference's soundfile read,
+    hubert_dataset.py:188-196)."""
+    if path.endswith(".npy"):
+        wav = np.load(path).astype(np.float32)
+        return wav.reshape(-1)
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        width = w.getsampwidth()
+        raw = w.readframes(n)
+        if width == 1:
+            # 8-bit PCM WAV is UNSIGNED (0-255, 128 = silence)
+            wav = (np.frombuffer(raw, np.uint8).astype(np.float32)
+                   - 128.0) / 127.0
+        else:
+            dtype = {2: np.int16, 4: np.int32}[width]
+            wav = np.frombuffer(raw, dtype=dtype).astype(np.float32)
+            wav /= float(np.iinfo(dtype).max)
+        if w.getnchannels() > 1:
+            wav = wav.reshape(-1, w.getnchannels()).mean(-1)
+        return wav
+
+
+def conv_frames(n_samples: int,
+                conv_layers: Sequence[Sequence[int]]) -> int:
+    """Frame count after the VALID-padded conv encoder."""
+    n = n_samples
+    for _, kernel, stride in conv_layers:
+        n = (n - kernel) // stride + 1
+    return max(n, 0)
+
+
+class HubertDataset:
+    """manifest + k-means labels → {waveform, cluster_ids} samples
+    (reference: hubert_dataset.py:127-360)."""
+
+    def __init__(self, manifest_path: str, label_path: str,
+                 sample_rate: int = 16000,
+                 label_rate: float = 50.0,
+                 max_keep_sample_size: Optional[int] = None,
+                 min_keep_sample_size: Optional[int] = None,
+                 max_sample_size: Optional[int] = None,
+                 random_crop: bool = True,
+                 seed: int = 0):
+        self.root, self.paths, self.sizes, inds = load_audio_manifest(
+            manifest_path, max_keep_sample_size, min_keep_sample_size)
+        self.labels = load_labels(label_path, inds)
+        assert len(self.labels) == len(self.paths), \
+            f"{len(self.labels)} label rows != {len(self.paths)} audios"
+        self.sample_rate = sample_rate
+        self.label_rate = label_rate
+        self.max_sample_size = max_sample_size
+        self.random_crop = random_crop
+        self.rng = np.random.RandomState(seed)
+        # soft verify (reference: verify_label_lengths tolerance warning)
+        for i, (sz, lab) in enumerate(zip(self.sizes, self.labels)):
+            expect = sz / sample_rate * label_rate
+            if abs(len(lab) - expect) > max(2.0, 0.1 * expect):
+                import warnings
+                warnings.warn(
+                    f"label length {len(lab)} far from expected "
+                    f"{expect:.1f} for row {i}")
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def __getitem__(self, i: int) -> dict:
+        wav = read_waveform(os.path.join(self.root, self.paths[i]))
+        labels = np.asarray(self.labels[i], np.int32)
+        if self.max_sample_size and len(wav) > self.max_sample_size:
+            # random crop, labels cropped at label_rate (reference:
+            # hubert_dataset.py crop_to_max_size)
+            diff = len(wav) - self.max_sample_size
+            start = self.rng.randint(0, diff + 1) if self.random_crop else 0
+            wav = wav[start: start + self.max_sample_size]
+            l0 = int(start / self.sample_rate * self.label_rate)
+            l1 = int((start + self.max_sample_size) /
+                     self.sample_rate * self.label_rate)
+            labels = labels[l0: max(l1, l0 + 1)]
+        return {"waveform": wav, "cluster_ids": labels}
+
+
+class HubertCollator:
+    """Right-pad waveforms, resample cluster labels to the conv-encoder
+    frame grid, and draw the span time-mask (reference:
+    hubert_dataset.py `collater` + fairseq mask sampling)."""
+
+    def __init__(self, conv_layers: Sequence[Sequence[int]],
+                 mask_prob: float = 0.65, mask_length: int = 10,
+                 seed: int = 0):
+        self.conv_layers = conv_layers
+        self.mask_prob = mask_prob
+        self.mask_length = mask_length
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, samples: list[dict]) -> dict:
+        from fengshen_tpu.models.hubert.modeling_hubert import (
+            compute_mask_indices)
+        max_t = max(len(s["waveform"]) for s in samples)
+        batch = len(samples)
+        frames = conv_frames(max_t, self.conv_layers)
+        waveform = np.zeros((batch, max_t), np.float32)
+        targets = np.zeros((batch, frames), np.int32)
+        valid = np.zeros((batch, frames), bool)
+        for b, s in enumerate(samples):
+            wav, lab = s["waveform"], np.asarray(s["cluster_ids"])
+            waveform[b, : len(wav)] = wav
+            # labels are resampled onto THIS sample's own frame count, not
+            # the batch-max grid — shorter clips must not get dilated
+            # labels or fabricated labels over the pad region
+            n_f = min(conv_frames(len(wav), self.conv_layers), frames)
+            if len(lab) and n_f > 0:
+                idx = np.minimum(
+                    (np.arange(n_f) * len(lab) / n_f).astype(np.int64),
+                    len(lab) - 1)
+                targets[b, :n_f] = lab[idx]
+                valid[b, :n_f] = True
+        mask = compute_mask_indices((batch, frames), self.mask_prob,
+                                    self.mask_length, self.rng)
+        # the loss only counts masked frames; restricting the mask to valid
+        # frames keeps pad frames out of training
+        mask &= valid
+        return {"waveform": waveform, "cluster_ids": targets,
+                "mask_time_indices": mask}

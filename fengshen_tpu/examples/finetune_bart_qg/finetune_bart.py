@@ -1,0 +1,134 @@
+"""Randeng-BART question generation (ChineseSQuAD) finetune.
+
+Port of the reference workload
+(reference: fengshen/examples/finetune_bart_qg/finetune_bart.py:40-429):
+answer-aware question generation — the context is encoded with the answer
+span masked according to `--mask_ans_style` (normal → replace the answer
+with the mask token; unmask → keep; anstoken → a dedicated <ans> marker,
+reference: finetune_bart.py:93-130), concatenated with the answer, and BART
+generates the question.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.examples.summary.seq2seq_summary import Seq2SeqCollator
+from fengshen_tpu.models.bart import BartConfig, BartForConditionalGeneration
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class BartQGCollator(Seq2SeqCollator):
+    """{context, answer, ans_span, question} → seq2seq sample
+    (reference: finetune_bart.py:60-140). Batching (truncate/eos/shift/pad
+    and the checkpoint's decoder_start_token_id) comes from
+    Seq2SeqCollator; only the answer-masked source construction lives
+    here."""
+
+    mask_ans_style: str = "anstoken"
+    ans_token: str = "<ans>"
+
+    def mask_context(self, sample: dict) -> str:
+        """reference: finetune_bart.py:93-130."""
+        context = sample["context"]
+        if self.mask_ans_style == "unmask":
+            return context
+        answer = sample["answer"][0] if isinstance(sample["answer"], list) \
+            else sample["answer"]
+        if self.mask_ans_style == "normal":
+            token = self.tokenizer.mask_token or self.ans_token
+        else:  # anstoken
+            token = self.ans_token
+        span = sample.get("ans_span")
+        if span:
+            bos, eos = span[0] if isinstance(span[0], (list, tuple)) else span
+            return context[:bos] + token + context[eos:]
+        return context.replace(answer, token, 1)
+
+    def source_text(self, sample: dict) -> str:
+        answer = sample["answer"][0] if isinstance(sample["answer"], list) \
+            else sample["answer"]
+        sep = self.tokenizer.sep_token or ""
+        return self.mask_context(sample) + sep + answer
+
+    def target_text(self, sample: dict) -> str:
+        return sample["question"]
+
+
+class BartQGModule(TrainModule):
+    """Seq2seq QG loss (reference: finetune_bart.py BARTFinetuneModel)."""
+
+    def __init__(self, args, config: Optional[BartConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = BartConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = BartForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("BART QG")
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument("--max_target_length", type=int, default=64)
+        parser.add_argument(
+            "--mask_ans_style", default="anstoken", type=str,
+            choices=["normal", "unmask", "anstoken"])
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        return loss, {"n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = BartQGModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    module = BartQGModule(args)
+    collator = BartQGCollator(
+        tokenizer, max_src_length=args.max_seq_length,
+        max_tgt_length=args.max_target_length,
+        decoder_start_token_id=module.config.decoder_start_token_id,
+        mask_ans_style=args.mask_ans_style)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

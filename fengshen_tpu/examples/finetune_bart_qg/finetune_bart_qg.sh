@@ -1,0 +1,20 @@
+#!/bin/bash
+# Launcher for finetune_bart_qg.finetune_bart (reference pattern: fengshen/examples/finetune_bart_qg/finetune_bart.sh)
+# Multi-host TPU: run this script on every host with JAX_COORDINATOR_ADDRESS
+# set (see docs/multihost.md); single host needs no extra flags.
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-BART-139M-QG-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/finetune_bart_qg.finetune_bart}
+
+python -m fengshen_tpu.examples.finetune_bart_qg.finetune_bart \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-32} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --mask_ans_style anstoken

@@ -1,0 +1,131 @@
+"""Taiyi Stable Diffusion finetune (Chinese latent diffusion).
+
+Port of the reference workload
+(reference: fengshen/examples/finetune_taiyi_stable_diffusion/
+finetune.py:67-158): caption+image pairs → VAE latents (×0.18215) → noise +
+timesteps → UNet ε-prediction MSE, with frozen text/VAE towers
+(`--train_whole_model` to unfreeze, reference :91-100).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.data.clip_dataloader import ImageTextCSVDataset, SDCollator
+from fengshen_tpu.models.bert import BertConfig
+from fengshen_tpu.models.stable_diffusion import (DDPMScheduler,
+                                                  TaiyiStableDiffusion,
+                                                  diffusion_loss)
+from fengshen_tpu.models.stable_diffusion.autoencoder_kl import VAEConfig
+from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class TaiyiSDModule(TrainModule):
+    """reference: finetune.py StableDiffusion module."""
+
+    def __init__(self, args, text_config: Optional[BertConfig] = None,
+                 vae_config: Optional[VAEConfig] = None,
+                 unet_config: Optional[UNetConfig] = None):
+        super().__init__(args)
+        if text_config is None and getattr(args, "model_path", None):
+            text_config = BertConfig.from_pretrained(args.model_path)
+        self.model = TaiyiStableDiffusion(
+            text_config, vae_config or VAEConfig(),
+            unet_config or UNetConfig())
+        self.config = text_config
+        self.scheduler = DDPMScheduler(
+            prediction_type=getattr(args, "prediction_type", "epsilon"))
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("taiyi sd")
+        parser.add_argument("--image_size", type=int, default=512)
+        parser.add_argument("--max_length", type=int, default=77)
+        parser.add_argument("--prediction_type", type=str,
+                            default="epsilon",
+                            choices=["epsilon", "v_prediction"])
+        parser.add_argument("--train_whole_model", action="store_true",
+                            default=False,
+                            help="unfreeze text encoder + VAE "
+                                 "(reference: finetune.py:91-100)")
+        parser.add_argument("--train_csv", type=str, default=None)
+        parser.add_argument("--image_root", type=str, default=None)
+        return parent_parser
+
+    def init_params(self, rng):
+        size = getattr(self.args, "image_size", 64)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        pixels = jnp.zeros((1, size, size, 3), jnp.float32)
+        t = jnp.zeros((1,), jnp.int32)
+        latent_shape = self.model.vae_config.latent_shape(size)
+        noise = jnp.zeros((1,) + latent_shape, jnp.float32)
+        return self.model.init(rng, ids, pixels, t, noise)["params"]
+
+    def training_loss(self, params, batch, rng):
+        if not getattr(self.args, "train_whole_model", False):
+            # UNet-only training: freeze text tower + VAE
+            params = dict(params)
+            for key in list(params):
+                if key in ("text_encoder", "vae"):
+                    params[key] = jax.lax.stop_gradient(params[key])
+        rng_t, rng_n, rng_vae, rng_drop = jax.random.split(rng, 4)
+        pixels = batch["pixel_values"]
+        latent_shape = self.model.vae_config.latent_shape(pixels.shape[1])
+        timesteps = jax.random.randint(
+            rng_t, (pixels.shape[0],), 0, self.scheduler.num_train_timesteps)
+        noise = jax.random.normal(rng_n,
+                                  (pixels.shape[0],) + latent_shape)
+        pred, latents = self.model.apply(
+            {"params": params}, batch["input_ids"], pixels, timesteps,
+            noise, attention_mask=batch.get("attention_mask"),
+            rng=rng_vae, deterministic=False, rngs={"dropout": rng_drop})
+        loss = diffusion_loss(
+            pred, latents, noise, timesteps, self.scheduler,
+            prediction_type=getattr(self.args, "prediction_type", "epsilon"))
+        return loss, {}
+
+    def partition_rules(self):
+        if hasattr(self.model, "partition_rules"):
+            return self.model.partition_rules()
+        return super().partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = TaiyiSDModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    datasets = {}
+    if args.train_csv:
+        datasets["train"] = ImageTextCSVDataset(args.train_csv,
+                                                image_root=args.image_root)
+    collator = SDCollator(tokenizer, image_size=args.image_size,
+                          max_length=args.max_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets or None)
+    module = TaiyiSDModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

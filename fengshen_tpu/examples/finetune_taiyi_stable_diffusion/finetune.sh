@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for finetune_taiyi_stable_diffusion.finetune (reference pattern: fengshen/examples/finetune_taiyi_stable_diffusion/finetune.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-Stable-Diffusion-1B-Chinese-v0.1}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.finetune_taiyi_stable_diffusion.finetune \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --train_csv $TRAIN_CSV --image_size 512

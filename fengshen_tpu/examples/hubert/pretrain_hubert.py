@@ -1,0 +1,106 @@
+"""HuBERT masked-cluster-prediction pretraining.
+
+Port of the reference workload
+(reference: fengshen/examples/hubert/pretrain_hubert.py:19-230): fairseq
+manifest + k-means labels via fengshen_tpu.data.hubert.HubertDataset, span
+time-masking, and CE at masked frames (hubert_pretrain_loss).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+from fengshen_tpu.data.hubert import HubertCollator, HubertDataset
+from fengshen_tpu.models.hubert import (HubertConfig, HubertModel,
+                                        hubert_pretrain_loss)
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class HubertPretrainModule(TrainModule):
+    """reference: pretrain_hubert.py HubertLightning."""
+
+    def __init__(self, args, config: Optional[HubertConfig] = None):
+        super().__init__(args)
+        if config is None:
+            config = HubertConfig()
+        self.config = config
+        self.model = HubertModel(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("hubert pretrain")
+        parser.add_argument("--data", type=str, default=None,
+                            help="manifest dir with {split}.tsv")
+        parser.add_argument("--label_dir", type=str, default=None)
+        parser.add_argument("--labels", type=str, default="km")
+        parser.add_argument("--label_rate", type=float, default=50.0)
+        parser.add_argument("--sample_rate", type=int, default=16000)
+        parser.add_argument("--max_sample_size", type=int, default=250000)
+        parser.add_argument("--min_sample_size", type=int, default=2000)
+        parser.add_argument("--pred_nomask_weight", type=float, default=0.0)
+        return parent_parser
+
+    def init_params(self, rng):
+        wav = jnp.zeros((1, 400), jnp.float32)
+        return self.model.init(rng, wav)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits, _ = self.model.apply(
+            {"params": params}, batch["waveform"],
+            mask_time_indices=batch["mask_time_indices"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_masked = hubert_pretrain_loss(
+            logits, batch["cluster_ids"], batch["mask_time_indices"],
+            unmasked_weight=getattr(self.args, "pred_nomask_weight", 0.0))
+        acc = ((logits.argmax(-1) == batch["cluster_ids"]) *
+               batch["mask_time_indices"]).sum() / jnp.maximum(n_masked, 1)
+        return loss, {"masked_acc": acc, "n_masked": n_masked}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = HubertPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    config = HubertConfig()
+    label_dir = args.label_dir or args.data
+    datasets = {}
+    for split in ("train", "valid"):
+        manifest = os.path.join(args.data, f"{split}.tsv")
+        label = os.path.join(label_dir, f"{split}.{args.labels}")
+        if os.path.exists(manifest) and os.path.exists(label):
+            key = "train" if split == "train" else "validation"
+            datasets[key] = HubertDataset(
+                manifest, label, sample_rate=args.sample_rate,
+                label_rate=args.label_rate,
+                max_sample_size=args.max_sample_size,
+                min_keep_sample_size=args.min_sample_size)
+    collator = HubertCollator(config.conv_layers,
+                              mask_prob=config.mask_prob,
+                              mask_length=config.mask_length)
+    datamodule = UniversalDataModule(collate_fn=collator, args=args,
+                                     datasets=datasets)
+    module = HubertPretrainModule(args, config)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

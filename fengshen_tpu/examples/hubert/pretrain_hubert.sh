@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for hubert.pretrain_hubert (reference pattern: fengshen/examples/hubert/pretrain_hubert_base.sh)
+MODEL_PATH=${MODEL_PATH:-none}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.hubert.pretrain_hubert \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --data $DATA_DIR --label_dir $LABEL_DIR --labels km --label_rate 50

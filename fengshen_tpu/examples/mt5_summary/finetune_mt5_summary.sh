@@ -1,0 +1,20 @@
+#!/bin/bash
+# Launcher for mt5_summary.mt5_summary (reference pattern: fengshen/examples/mt5_summary/pretrain_mt5_summary.sh)
+# Multi-host TPU: run this script on every host with JAX_COORDINATOR_ADDRESS
+# set (see docs/multihost.md); single host needs no extra flags.
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-MT5-220M}
+ROOT_DIR=${ROOT_DIR:-./workdir/mt5_summary.mt5_summary}
+
+python -m fengshen_tpu.examples.mt5_summary.mt5_summary \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-32} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --max_src_length 512 --max_tgt_length 128

@@ -1,0 +1,53 @@
+"""Randeng-mT5 summarization finetune (LCSTS).
+
+Port of the reference workload
+(reference: fengshen/examples/mt5_summary/mt5_summary.py:1-233): mT5
+finetune over {text, summary} pairs. Reuses the shared Seq2SeqCollator /
+Seq2SeqModule from examples.summary (the reference's mt5_summary duplicates
+the summary module with an mT5 model class; here model_type='t5' covers
+mT5 checkpoints via the converter). The reference's FastAPI serving demo
+(fastapi_mt5_summary.py) maps to the framework-level REST API
+(fengshen_tpu.api.main) with a text-generation pipeline config.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.examples.summary.seq2seq_summary import (
+        Seq2SeqCollator, Seq2SeqModule, build_model)
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    group = parser.add_argument_group("mt5 summary")
+    group.add_argument("--max_src_length", default=512, type=int)
+    group.add_argument("--max_tgt_length", default=128, type=int)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    model, config = build_model("t5", args.model_path)
+    collator = Seq2SeqCollator(
+        tokenizer, max_src_length=args.max_src_length,
+        max_tgt_length=args.max_tgt_length,
+        decoder_start_token_id=getattr(config, "decoder_start_token_id", 0))
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = Seq2SeqModule(args, model, config)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

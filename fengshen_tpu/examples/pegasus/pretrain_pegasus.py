@@ -1,0 +1,164 @@
+"""Randeng-Pegasus gap-sentence-generation (GSG) pretraining.
+
+Port of the reference workload
+(reference: fengshen/examples/pegasus/pretrain_pegasus.py:30-181 +
+data_utils.py:99-319): split the document into sentences, score each
+sentence against the rest of the document, select the top `gsg_ratio`
+sentences as the pseudo-summary, replace them with a mask sentinel in the
+source, and train the seq2seq model to generate them. The reference scores
+with the `rouge` package (data_utils.py:181-199); here the score is a
+dependency-free unigram-F1 against the remaining text — same selection
+principle, no native rouge dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.examples.summary.seq2seq_summary import Seq2SeqCollator
+from fengshen_tpu.models.pegasus import (PegasusConfig,
+                                         PegasusForConditionalGeneration)
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+_SENT_SPLIT = re.compile(r"([。！？!?；;\n]+)")
+
+
+def split_sentences(text: str) -> list[str]:
+    """Sentence segmentation on Chinese terminal punctuation
+    (reference: data_utils.py:99-113 text_segmentate)."""
+    parts = _SENT_SPLIT.split(text)
+    sents = []
+    for i in range(0, len(parts) - 1, 2):
+        s = (parts[i] + parts[i + 1]).strip()
+        if s:
+            sents.append(s)
+    if len(parts) % 2 == 1 and parts[-1].strip():
+        sents.append(parts[-1].strip())
+    return sents
+
+
+def unigram_f1(source: str, target: str) -> float:
+    """Unigram-overlap F1 (character level) — the GSG selection score
+    (substitutes reference data_utils.py:181-199 compute_rouge)."""
+    a, b = Counter(source), Counter(target)
+    overlap = sum((a & b).values())
+    if overlap == 0:
+        return 0.0
+    p, r = overlap / max(sum(a.values()), 1), overlap / max(sum(b.values()), 1)
+    return 2 * p * r / (p + r)
+
+
+def gap_sentence_ids(sents: list[str], ratio: float) -> list[int]:
+    """Pick the sentences most representative of the rest of the document
+    (reference: data_utils.py pseudo_summary construction)."""
+    n_select = max(1, int(len(sents) * ratio))
+    scores = []
+    for i, s in enumerate(sents):
+        rest = "".join(sents[:i] + sents[i + 1:])
+        scores.append(unigram_f1(s, rest))
+    return sorted(np.argsort(scores)[::-1][:n_select].tolist())
+
+
+@dataclass
+class PegasusGSGCollator(Seq2SeqCollator):
+    """document → (masked source, pseudo-summary target)
+    (reference: pretrain_pegasus.py:40-88); batching inherited from
+    Seq2SeqCollator (decoder_start_token_id = pad, the pegasus convention —
+    set in main), only the GSG split here."""
+
+    gsg_ratio: float = 0.25
+    content_key: str = "text"
+    mask_sentence_token: str = "[MASK]"
+
+    def _split(self, sample: dict) -> tuple[list[str], set[int]]:
+        sents = split_sentences(sample[self.content_key])
+        if not sents:
+            sents = [sample[self.content_key] or self.mask_sentence_token]
+        return sents, set(gap_sentence_ids(sents, self.gsg_ratio))
+
+    def source_text(self, sample: dict) -> str:
+        sents, selected = self._split(sample)
+        return "".join(self.mask_sentence_token if i in selected else s
+                       for i, s in enumerate(sents))
+
+    def target_text(self, sample: dict) -> str:
+        sents, selected = self._split(sample)
+        return "".join(s for i, s in enumerate(sents) if i in selected)
+
+
+class PegasusPretrainModule(TrainModule):
+    """GSG seq2seq loss (reference: pretrain_pegasus.py:90-140)."""
+
+    def __init__(self, args, config: Optional[PegasusConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = PegasusConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = PegasusForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("Pegasus pretrain")
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument("--max_target_length", type=int, default=128)
+        parser.add_argument("--gsg_ratio", type=float, default=0.25)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        return loss, {"n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = PegasusPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = PegasusGSGCollator(
+        tokenizer, max_src_length=args.max_seq_length,
+        max_tgt_length=args.max_target_length,
+        decoder_start_token_id=tokenizer.pad_token_id or 0,
+        gsg_ratio=args.gsg_ratio)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = PegasusPretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""BERT whole-word-masking pretraining (jieba n-gram spans).
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_bert/pretrain_bert.py:36-278): jieba
+word segmentation over the raw text, n-gram span selection with p(n) ∝ 1/n,
+80/10/10 mask/keep/random replacement, and an MLM objective on BertForMaskedLM.
+Run:
+
+    python -m fengshen_tpu.examples.pretrain_bert.pretrain_bert \
+        --train_file corpus.json --model_path <bert-dir> --max_steps 10000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.bert import BertConfig, BertForMaskedLM
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class WWMBertCollator:
+    """jieba whole-word n-gram masking (reference: pretrain_bert.py:36-130
+    DataCollate: word_cuter=jieba.cut, ngram pvals 1/n, token_process
+    80/10/10)."""
+
+    tokenizer: Any
+    max_seq_length: int = 512
+    mask_rate: float = 0.15
+    max_ngram: int = 3
+    content_key: str = "text"
+    seed: int = 42
+
+    def __post_init__(self):
+        try:
+            import jieba
+            self.word_cuter = jieba.lcut
+        except ImportError:  # pragma: no cover - jieba is available in CI
+            self.word_cuter = lambda t: list(t)
+        self.np_rng = np.random.RandomState(self.seed)
+        self.ngrams = np.arange(1, self.max_ngram + 1)
+        pvals = 1.0 / np.arange(1, self.max_ngram + 1)
+        self.pvals = pvals / pvals.sum()
+        self.vocab_length = len(self.tokenizer)
+
+    def _token_process(self, token_id: int) -> int:
+        """80% [MASK] / 10% keep / 10% random
+        (reference: pretrain_bert.py:52-59)."""
+        r = self.np_rng.random()
+        if r <= 0.8:
+            return self.tokenizer.mask_token_id
+        if r <= 0.9:
+            return token_id
+        return int(self.np_rng.randint(1, self.vocab_length))
+
+    def __call__(self, samples: list[dict]) -> dict:
+        max_len = self.max_seq_length
+        batch = {"input_ids": [], "attention_mask": [], "token_type_ids": [],
+                 "labels": []}
+        for sample in samples:
+            words = self.word_cuter(sample[self.content_key])
+            mask_ids: list[int] = []
+            labels: list[int] = []
+            i = 0
+            while i < len(words):
+                rand = self.np_rng.random()
+                if rand > self.mask_rate or len(words[i]) >= 4:
+                    # unmasked word
+                    for tok in self.tokenizer.encode(
+                            words[i], add_special_tokens=False):
+                        mask_ids.append(tok)
+                        labels.append(-100)
+                    i += 1
+                    continue
+                # masked n-gram span (reference: pretrain_bert.py:85-105)
+                n = int(self.np_rng.choice(self.ngrams, p=self.pvals))
+                span = words[i: i + n]
+                for word in span:
+                    for tok in self.tokenizer.encode(
+                            word, add_special_tokens=False):
+                        mask_ids.append(self._token_process(tok))
+                        labels.append(tok)
+                i += n
+            cls, sep = self.tokenizer.cls_token_id, self.tokenizer.sep_token_id
+            pad_id = self.tokenizer.pad_token_id or 0
+            ids = [cls] + mask_ids[: max_len - 2] + [sep]
+            lab = [-100] + labels[: max_len - 2] + [-100]
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["token_type_ids"].append([0] * max_len)
+            batch["labels"].append(lab + [-100] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class BertPretrainModule(TrainModule):
+    """MLM loss on BertForMaskedLM (reference: pretrain_bert.py:160-210)."""
+
+    def __init__(self, args, config: Optional[BertConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = BertConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = BertForMaskedLM(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("Bert pretrain")
+        parser.add_argument("--masked_lm_prob", type=float, default=0.15)
+        parser.add_argument("--max_ngram", type=int, default=3)
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"mlm_acc": acc, "n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = BertPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = WWMBertCollator(tokenizer,
+                               max_seq_length=args.max_seq_length,
+                               mask_rate=args.masked_lm_prob,
+                               max_ngram=args.max_ngram)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = BertPretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

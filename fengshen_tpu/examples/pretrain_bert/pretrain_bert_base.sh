@@ -1,0 +1,20 @@
+#!/bin/bash
+# Launcher for pretrain_bert.pretrain_bert (reference pattern: fengshen/examples/pretrain_bert/pretrain_bert.sh)
+# Multi-host TPU: run this script on every host with JAX_COORDINATOR_ADDRESS
+# set (see docs/multihost.md); single host needs no extra flags.
+MODEL_PATH=${MODEL_PATH:-bert-base-chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/pretrain_bert.pretrain_bert}
+
+python -m fengshen_tpu.examples.pretrain_bert.pretrain_bert \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-32} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --max_seq_length 512 --masked_lm_prob 0.15

@@ -1,0 +1,146 @@
+"""Erlangshen-DeBERTa-v2 whole-word-masking MLM pretraining.
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_erlangshen_deberta_v2/
+pretrain_deberta.py:34-227): a DeBERTaV2Collator that tokenizes raw text,
+applies jieba whole-word masking via `create_masked_lm_predictions`
+(masking_style='bert'), and trains DebertaV2ForMaskedLM on the MLM CE. Run:
+
+    python -m fengshen_tpu.examples.pretrain_erlangshen_deberta_v2.pretrain_deberta \
+        --train_file corpus.json --model_path <deberta-dir> --max_steps 10000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.data_utils import create_masked_lm_predictions
+from fengshen_tpu.models.deberta_v2 import (DebertaV2Config,
+                                            DebertaV2ForMaskedLM)
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class DeBERTaV2Collator:
+    """text → whole-word-masked MLM sample
+    (reference: pretrain_deberta.py:34-110)."""
+
+    tokenizer: Any
+    max_seq_length: int = 512
+    masked_lm_prob: float = 0.15
+    content_key: str = "text"
+    seed: int = 42
+
+    def __post_init__(self):
+        self.np_rng = np.random.RandomState(self.seed)
+        try:
+            import jieba
+            self.zh_tokenizer = jieba.lcut
+        except ImportError:  # pragma: no cover
+            self.zh_tokenizer = None
+        vocab = self.tokenizer.get_vocab()
+        self.vocab_id_list = list(vocab.values())
+        self.vocab_id_to_token = {v: k for k, v in vocab.items()}
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        max_len = self.max_seq_length
+        batch = {"input_ids": [], "attention_mask": [], "labels": []}
+        for sample in samples:
+            body = tok.encode(sample[self.content_key],
+                              add_special_tokens=False)[: max_len - 2]
+            tokens = [tok.cls_token_id] + body + [tok.sep_token_id]
+            masked_tokens, positions, labels = create_masked_lm_predictions(
+                tokens, self.vocab_id_list, self.vocab_id_to_token,
+                self.masked_lm_prob, tok.cls_token_id, tok.sep_token_id,
+                tok.mask_token_id,
+                max_predictions_per_seq=int(
+                    self.masked_lm_prob * max_len) + 1,
+                np_rng=self.np_rng, masking_style="bert",
+                zh_tokenizer=self.zh_tokenizer)
+            mlm_labels = [-100] * len(tokens)
+            for pos, label in zip(positions, labels):
+                mlm_labels[pos] = label
+            pad_id = tok.pad_token_id or 0
+            pad = max_len - len(masked_tokens)
+            batch["input_ids"].append(masked_tokens + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(masked_tokens) +
+                                           [0] * pad)
+            batch["labels"].append(mlm_labels + [-100] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class DebertaPretrainModule(TrainModule):
+    """MLM loss (reference: pretrain_deberta.py:115-180)."""
+
+    def __init__(self, args, config: Optional[DebertaV2Config] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = DebertaV2Config.from_pretrained(args.model_path)
+        self.config = config
+        self.model = DebertaV2ForMaskedLM(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("DeBERTa pretrain")
+        parser.add_argument("--masked_lm_prob", type=float, default=0.15)
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"mlm_acc": acc, "n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = DebertaPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = DeBERTaV2Collator(tokenizer,
+                                 max_seq_length=args.max_seq_length,
+                                 masked_lm_prob=args.masked_lm_prob)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = DebertaPretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

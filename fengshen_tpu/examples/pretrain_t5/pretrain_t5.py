@@ -1,0 +1,121 @@
+"""Randeng-T5/mT5 span-corruption pretraining.
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_t5/pretrain_t5.py:17-175): mT5
+continued pretraining over an unsupervised corpus with T5 span corruption,
+including the vocab-trim path (`--keep_tokens_path`) that shrinks an mT5
+checkpoint to a Chinese+English vocabulary by index-selecting the embedding
+and lm_head rows (reference: pretrain_t5.py:29-49). Run:
+
+    python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \
+        --train_file corpus.json --model_path <mt5-dir> --max_steps 10000 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.t5_dataloader import T5SpanCorruptionCollator
+from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+def trim_vocab(params: dict, keep_tokens: list[int]) -> dict:
+    """Index-select embedding/lm_head rows to a reduced vocabulary
+    (reference: pretrain_t5.py:38-49 torch.index_select on
+    encoder/decoder/shared/lm_head weights)."""
+    idx = np.asarray(keep_tokens, np.int32)
+    out = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    inner = out["model"] if "model" in out else out
+    shared = np.asarray(inner["shared"]["embedding"])[idx]
+    inner["shared"]["embedding"] = jnp.asarray(shared)
+    if "lm_head" in out:
+        head = np.asarray(out["lm_head"]["kernel"])[:, idx]
+        out["lm_head"]["kernel"] = jnp.asarray(head)
+    return out
+
+
+class T5PretrainModule(TrainModule):
+    """Span-corruption seq2seq loss (reference: pretrain_t5.py:82-104)."""
+
+    def __init__(self, args, model=None, config: Optional[T5Config] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = T5Config.from_pretrained(args.model_path)
+        self.config = config
+        self.model = model or T5ForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("T5 pretrain")
+        parser.add_argument("--keep_tokens_path", default=None, type=str)
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument("--noise_density", type=float, default=0.15)
+        parser.add_argument("--mean_noise_span_length", type=float,
+                            default=3.0)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        params = self.model.init(rng, ids, ids)["params"]
+        keep_path = getattr(self.args, "keep_tokens_path", None)
+        if keep_path:
+            keep = json.load(open(keep_path))
+            params = trim_vocab(params, keep)
+        return params
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"acc": acc, "n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser("Pretrain Unsupervise.")
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = T5PretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = T5SpanCorruptionCollator(
+        tokenizer, max_seq_length=args.max_seq_length,
+        noise_density=args.noise_density,
+        mean_noise_span_length=args.mean_noise_span_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = T5PretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

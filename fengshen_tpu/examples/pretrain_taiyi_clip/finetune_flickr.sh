@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for pretrain_taiyi_clip.pretrain (reference pattern: fengshen/examples/pretrain_taiyi_clip/finetune.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-CLIP-Roberta-102M-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.pretrain_taiyi_clip.pretrain \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --train_csv $TRAIN_CSV --image_root $IMAGE_ROOT --freeze_image_tower

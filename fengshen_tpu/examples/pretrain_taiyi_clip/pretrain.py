@@ -1,0 +1,109 @@
+"""Taiyi-CLIP contrastive pretraining (Chinese text tower + CLIP ViT).
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_taiyi_clip/pretrain.py): image-text
+CSV data → CLIPCollator → symmetric InfoNCE over the in-batch similarity
+matrix (clip_contrastive_loss), with the vision tower optionally frozen
+(`--freeze_image_tower`, the reference's Chinese-adaptation recipe trains
+only the text tower).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.data.clip_dataloader import CLIPCollator, ImageTextCSVDataset
+from fengshen_tpu.models.bert import BertConfig
+from fengshen_tpu.models.clip import (CLIPVisionConfig, TaiyiCLIPModel,
+                                      clip_contrastive_loss)
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class TaiyiCLIPModule(TrainModule):
+    """reference: pretrain_taiyi_clip/pretrain.py contrastive module."""
+
+    def __init__(self, args, text_config: Optional[BertConfig] = None,
+                 vision_config: Optional[CLIPVisionConfig] = None):
+        super().__init__(args)
+        if text_config is None and getattr(args, "model_path", None):
+            text_config = BertConfig.from_pretrained(args.model_path)
+        self.text_config = text_config
+        self.vision_config = vision_config or CLIPVisionConfig()
+        self.model = TaiyiCLIPModel(text_config, self.vision_config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("taiyi clip")
+        parser.add_argument("--image_size", type=int, default=224)
+        parser.add_argument("--max_length", type=int, default=77)
+        parser.add_argument("--freeze_image_tower", action="store_true",
+                            default=False)
+        parser.add_argument("--train_csv", type=str, default=None)
+        parser.add_argument("--image_root", type=str, default=None)
+        return parent_parser
+
+    def init_params(self, rng):
+        size = self.vision_config.image_size
+        ids = jnp.zeros((1, 8), jnp.int32)
+        pixels = jnp.zeros((1, size, size, 3), jnp.float32)
+        return self.model.init(rng, ids, pixels)["params"]
+
+    def training_loss(self, params, batch, rng):
+        if getattr(self.args, "freeze_image_tower", False):
+            # stop grads into the vision tower (reference freezes it and
+            # trains the Chinese text tower only)
+            params = dict(params)
+            for key in list(params):
+                if key.startswith(("vision", "visual")):
+                    params[key] = jax.lax.stop_gradient(params[key])
+        text_emb, image_emb, scale = self.model.apply(
+            {"params": params}, batch["input_ids"], batch["pixel_values"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, logits = clip_contrastive_loss(text_emb, image_emb, scale)
+        labels = jnp.arange(logits.shape[0])
+        acc = (logits.argmax(1) == labels).mean()
+        return loss, {"acc": acc, "logit_scale": scale}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = TaiyiCLIPModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    datasets = {}
+    if args.train_csv:
+        datasets["train"] = ImageTextCSVDataset(args.train_csv,
+                                                image_root=args.image_root)
+    collator = CLIPCollator(tokenizer, image_size=args.image_size,
+                            max_length=args.max_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets or None)
+    module = TaiyiCLIPModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

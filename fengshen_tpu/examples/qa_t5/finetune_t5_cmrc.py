@@ -1,0 +1,111 @@
+"""Randeng-T5 QA finetune on CMRC-style extractive/generative QA.
+
+Port of the reference workload
+(reference: fengshen/examples/qa_t5/finetune_t5_cmrc.py:1-450 +
+qa_dataset.py:36-187): samples with question/context/answer are formatted as
+``question:{q}knowledge:{context}`` → ``<extra_id_0>{answer}`` (the
+reference's prompt scheme, qa_dataset.py:44-76) and trained with the
+seq2seq CE; prediction decodes with the scan-based sampler.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.examples.summary.seq2seq_summary import Seq2SeqCollator
+from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class T5QACollator(Seq2SeqCollator):
+    """question/context/answer → prompt + target
+    (reference: qa_dataset.py:36-110); batching inherited from
+    Seq2SeqCollator, only the prompt formatting here."""
+
+    max_knowledge_length: int = 425
+
+    def source_text(self, sample: dict) -> str:
+        return ("question:" + sample["question"] +
+                "knowledge:" + sample["context"][: self.max_knowledge_length])
+
+    def target_text(self, sample: dict) -> str:
+        answer = sample["answer"][0] if isinstance(sample["answer"], list) \
+            else sample["answer"]
+        return "<extra_id_0>" + answer
+
+
+class T5QAModule(TrainModule):
+    """Seq2seq QA loss (reference: finetune_t5_cmrc.py QAFinetuneModel)."""
+
+    def __init__(self, args, config: Optional[T5Config] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = T5Config.from_pretrained(args.model_path)
+        self.config = config
+        self.model = T5ForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("T5 QA")
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument("--max_knowledge_length", type=int, default=425)
+        parser.add_argument("--max_target_length", type=int, default=64)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        return loss, {"n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = T5QAModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    module = T5QAModule(args)
+    collator = T5QACollator(
+        tokenizer, max_src_length=args.max_seq_length,
+        max_tgt_length=args.max_target_length,
+        decoder_start_token_id=module.config.decoder_start_token_id,
+        max_knowledge_length=args.max_knowledge_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

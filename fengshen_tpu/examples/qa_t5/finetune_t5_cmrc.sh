@@ -1,0 +1,20 @@
+#!/bin/bash
+# Launcher for qa_t5.finetune_t5_cmrc (reference pattern: fengshen/examples/qa_t5/finetune_t5_cmrc.sh)
+# Multi-host TPU: run this script on every host with JAX_COORDINATOR_ADDRESS
+# set (see docs/multihost.md); single host needs no extra flags.
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-784M-QA-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/qa_t5.finetune_t5_cmrc}
+
+python -m fengshen_tpu.examples.qa_t5.finetune_t5_cmrc \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-32} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --max_seq_length 512 --max_target_length 64

@@ -1,0 +1,203 @@
+"""Sequence tagging (NER) finetune: linear / CRF / span heads.
+
+Port of the reference workload
+(reference: fengshen/examples/sequence_tagging/
+finetune_sequence_tagging.py:44-316): `--model_type` selects among
+bert-linear / bert-crf / bert-span heads (reference `_model_dict`), with the
+matching collator building BIO (or span start/end) labels from CoNLL data,
+and entity-level P/R/F1 via metrics.SeqEntityScore.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.sequence_tagging_dataloader import ConllDataset
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+from fengshen_tpu.models.tagging import BertCrf, BertLinear, BertSpan
+from fengshen_tpu.trainer.module import TrainModule
+
+_MODEL_DICT = {
+    "bert-linear": BertLinear,
+    "bert-crf": BertCrf,
+    "bert-span": BertSpan,
+}
+
+
+def build_label_maps(datasets: list) -> tuple[dict, dict]:
+    """Scan the corpus for the BIO tag set (reference: DataProcessor
+    get_labels)."""
+    tags = {"O"}
+    for ds in datasets:
+        for i in range(len(ds)):
+            tags.update(ds[i]["labels"])
+    id2label = {i: t for i, t in enumerate(sorted(tags))}
+    return {t: i for i, t in id2label.items()}, id2label
+
+
+@dataclass
+class TaggingCollator:
+    """char-level BIO labels → padded token batch
+    (reference: sequence_tagging_collator CollatorForLinear/Crf/Span)."""
+
+    tokenizer: Any
+    label2id: dict
+    max_seq_length: int = 128
+    model_type: str = "bert-linear"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        max_len = self.max_seq_length
+        batch: dict = {"input_ids": [], "attention_mask": [],
+                       "token_type_ids": [], "labels": []}
+        for sample in samples:
+            chars = list(sample["text"])[: max_len - 2]
+            tags = sample["labels"][: max_len - 2]
+            ids = [tok.cls_token_id] + [
+                tok.convert_tokens_to_ids(c) if hasattr(
+                    tok, "convert_tokens_to_ids") else tok.encode(
+                        c, add_special_tokens=False)[0]
+                for c in chars] + [tok.sep_token_id]
+            labels = [-100] + [self.label2id.get(t, 0) for t in tags] + [-100]
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["token_type_ids"].append([0] * max_len)
+            batch["labels"].append(labels + [-100] * pad)
+        out = {k: np.asarray(v) for k, v in batch.items()}
+        if self.model_type == "bert-span":
+            # start/end pointer labels from BIO (reference: CollatorForSpan)
+            lab = out.pop("labels")
+            start = np.zeros_like(lab)
+            end = np.zeros_like(lab)
+            id2label = {v: k for k, v in self.label2id.items()}
+            for b in range(lab.shape[0]):
+                i = 0
+                while i < lab.shape[1]:
+                    lid = lab[b, i]
+                    tag = id2label.get(int(lid), "O")
+                    if tag.startswith("B-"):
+                        ent = tag[2:]
+                        j = i
+                        while (j + 1 < lab.shape[1] and
+                               id2label.get(int(lab[b, j + 1]), "O")
+                               == "I-" + ent):
+                            j += 1
+                        etype = self.label2id.get("B-" + ent, 0)
+                        start[b, i] = etype
+                        end[b, j] = etype
+                        i = j + 1
+                    else:
+                        i += 1
+            start[lab == -100] = -100
+            end[lab == -100] = -100
+            out["start_labels"] = start
+            out["end_labels"] = end
+        return out
+
+
+class TaggingModule(TrainModule):
+    """reference: finetune_sequence_tagging.py LitModel."""
+
+    def __init__(self, args, config: Optional[MegatronBertConfig] = None,
+                 num_labels: int = 9):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = MegatronBertConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model_type = getattr(args, "model_type", "bert-linear")
+        self.model = _MODEL_DICT[self.model_type](config,
+                                                  num_labels=num_labels)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("sequence tagging")
+        parser.add_argument("--model_type", default="bert-linear", type=str,
+                            choices=sorted(_MODEL_DICT))
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument("--data_dir", default=None, type=str)
+        parser.add_argument("--decode_type", default="bio", type=str)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        # init through the loss path so label-dependent params (the CRF
+        # transitions) are created
+        if self.model_type == "bert-span":
+            return self.model.init(rng, ids, start_labels=ids,
+                                   end_labels=ids)["params"]
+        return self.model.init(rng, ids, labels=ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        if self.model_type == "bert-span":
+            loss, _ = self.model.apply(
+                {"params": params}, batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                start_labels=batch["start_labels"],
+                end_labels=batch["end_labels"],
+                deterministic=False, rngs={"dropout": rng})
+            return loss, {}
+        loss, logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            labels=batch["labels"],
+            deterministic=False, rngs={"dropout": rng})
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"token_acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    import os
+
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = TaggingModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    datasets = {}
+    for split, fname in (("train", "train.char.bio"),
+                         ("validation", "dev.char.bio"),
+                         ("test", "test.char.bio")):
+        path = os.path.join(args.data_dir, fname)
+        if os.path.exists(path):
+            datasets[split] = ConllDataset(path)
+    label2id, id2label = build_label_maps(list(datasets.values()))
+    collator = TaggingCollator(tokenizer, label2id,
+                               max_seq_length=args.max_seq_length,
+                               model_type=args.model_type)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets)
+    module = TaggingModule(args, num_labels=len(label2id))
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

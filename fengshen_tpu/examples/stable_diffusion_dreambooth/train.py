@@ -1,0 +1,194 @@
+"""DreamBooth finetuning of Taiyi Stable Diffusion.
+
+Port of the reference workload
+(reference: fengshen/examples/stable_diffusion_dreambooth/train.py +
+train_dreambooth.sh): a handful of instance images with a rare-token prompt
+("a photo of sks dog") plus optional class images with the generic prompt,
+trained jointly — instance MSE + `--prior_loss_weight` × class MSE — so the
+subject binds to the rare token without forgetting the class
+(prior-preservation loss).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.clip_dataloader.image_text import load_image
+from fengshen_tpu.examples.finetune_taiyi_stable_diffusion.finetune import (
+    TaiyiSDModule)
+from fengshen_tpu.models.stable_diffusion import diffusion_loss
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".webp", ".npy")
+
+
+class DreamBoothDataset:
+    """Pairs every instance image with (optionally) a class image, so each
+    sample carries both halves of the prior-preservation objective
+    (reference: train.py DreamBoothDataset)."""
+
+    def __init__(self, instance_data_dir: str, instance_prompt: str,
+                 class_data_dir: Optional[str] = None,
+                 class_prompt: Optional[str] = None):
+        self.instance_images = self._list(instance_data_dir)
+        if not self.instance_images:
+            raise ValueError(f"no images in {instance_data_dir}")
+        self.instance_prompt = instance_prompt
+        self.class_images = self._list(class_data_dir) if class_data_dir \
+            else []
+        self.class_prompt = class_prompt
+
+    @staticmethod
+    def _list(path: Optional[str]) -> list[str]:
+        if not path or not os.path.isdir(path):
+            return []
+        return sorted(os.path.join(path, f) for f in os.listdir(path)
+                      if f.lower().endswith(_IMG_EXTS))
+
+    def __len__(self) -> int:
+        return len(self.instance_images)
+
+    def __getitem__(self, i: int) -> dict:
+        sample = {"instance_image": self.instance_images[i],
+                  "instance_prompt": self.instance_prompt}
+        if self.class_images:
+            sample["class_image"] = self.class_images[
+                i % len(self.class_images)]
+            sample["class_prompt"] = self.class_prompt
+        return sample
+
+
+@dataclass
+class DreamBoothCollator:
+    """Stacks instance rows first, then class rows, and records the split
+    point so the loss can weight them differently."""
+
+    tokenizer: Any
+    image_size: int = 512
+    max_length: int = 77
+
+    def _encode(self, prompts, paths):
+        enc = self.tokenizer(prompts, padding="max_length", truncation=True,
+                             max_length=self.max_length,
+                             return_tensors="np")
+        images = np.stack([load_image(p, self.image_size) for p in paths])
+        return (enc["input_ids"].astype(np.int32),
+                enc["attention_mask"].astype(np.int32),
+                (images * 2.0 - 1.0).astype(np.float32))
+
+    def __call__(self, samples: list[dict]) -> dict:
+        prompts = [s["instance_prompt"] for s in samples]
+        paths = [s["instance_image"] for s in samples]
+        has_prior = "class_image" in samples[0]
+        if has_prior:
+            prompts += [s["class_prompt"] for s in samples]
+            paths += [s["class_image"] for s in samples]
+        ids, mask, pixels = self._encode(prompts, paths)
+        is_instance = np.zeros((len(prompts),), np.int32)
+        is_instance[: len(samples)] = 1
+        return {"input_ids": ids, "attention_mask": mask,
+                "pixel_values": pixels, "is_instance": is_instance}
+
+
+class DreamBoothModule(TaiyiSDModule):
+    """Instance + prior-preservation diffusion loss
+    (reference: train.py training_step with --with_prior_preservation)."""
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = TaiyiSDModule.add_module_specific_args(parent_parser)
+        group = parser.add_argument_group("dreambooth")
+        group.add_argument("--instance_data_dir", type=str, default=None)
+        group.add_argument("--instance_prompt", type=str, default=None)
+        group.add_argument("--class_data_dir", type=str, default=None)
+        group.add_argument("--class_prompt", type=str, default=None)
+        group.add_argument("--with_prior_preservation", action="store_true",
+                           default=False)
+        group.add_argument("--prior_loss_weight", type=float, default=1.0)
+        return parser
+
+    def training_loss(self, params, batch, rng):
+        if not getattr(self.args, "train_whole_model", False):
+            params = dict(params)
+            for key in list(params):
+                if key in ("text_encoder", "vae"):
+                    params[key] = jax.lax.stop_gradient(params[key])
+        rng_t, rng_n, rng_vae, rng_drop = jax.random.split(rng, 4)
+        pixels = batch["pixel_values"]
+        latent_shape = self.model.vae_config.latent_shape(pixels.shape[1])
+        timesteps = jax.random.randint(
+            rng_t, (pixels.shape[0],), 0,
+            self.scheduler.num_train_timesteps)
+        noise = jax.random.normal(rng_n, (pixels.shape[0],) + latent_shape)
+        pred, latents = self.model.apply(
+            {"params": params}, batch["input_ids"], pixels, timesteps,
+            noise, attention_mask=batch.get("attention_mask"),
+            rng=rng_vae, deterministic=False, rngs={"dropout": rng_drop})
+        if getattr(self.args, "with_prior_preservation", False) and \
+                pred.shape[0] > 1:
+            # instance rows vs class-prior rows weighted separately
+            # (reference: train.py prior_loss_weight); target honors
+            # --prediction_type, same as diffusion_loss
+            if getattr(self.args, "prediction_type",
+                       "epsilon") == "v_prediction":
+                target = self.scheduler.get_velocity(latents, noise,
+                                                     timesteps)
+            else:
+                target = noise
+            per_row = jnp.mean(jnp.square(
+                pred.astype(jnp.float32) - target.astype(jnp.float32)),
+                axis=(1, 2, 3))
+            is_inst = batch["is_instance"].astype(bool)
+            w_prior = getattr(self.args, "prior_loss_weight", 1.0)
+            inst_loss = (per_row * is_inst).sum() / \
+                jnp.maximum(is_inst.sum(), 1)
+            prior_loss = (per_row * ~is_inst).sum() / \
+                jnp.maximum((~is_inst).sum(), 1)
+            return inst_loss + w_prior * prior_loss, {
+                "instance_loss": inst_loss, "prior_loss": prior_loss}
+        loss = diffusion_loss(pred, latents, noise, timesteps,
+                              self.scheduler)
+        return loss, {}
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = DreamBoothModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    dataset = DreamBoothDataset(
+        args.instance_data_dir, args.instance_prompt,
+        class_data_dir=args.class_data_dir if
+        args.with_prior_preservation else None,
+        class_prompt=args.class_prompt)
+    collator = DreamBoothCollator(tokenizer, image_size=args.image_size,
+                                  max_length=args.max_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets={"train": dataset})
+    module = DreamBoothModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

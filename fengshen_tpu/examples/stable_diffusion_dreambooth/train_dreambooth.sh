@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for stable_diffusion_dreambooth.train (reference pattern: fengshen/examples/stable_diffusion_dreambooth/train.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-Stable-Diffusion-1B-Chinese-v0.1}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.stable_diffusion_dreambooth.train \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --instance_data_dir $INSTANCE_DIR --instance_prompt "$INSTANCE_PROMPT" --class_data_dir $CLASS_DIR --class_prompt "$CLASS_PROMPT" --with_prior_preservation --prior_loss_weight 1.0

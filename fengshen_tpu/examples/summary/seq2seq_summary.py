@@ -21,6 +21,11 @@ from fengshen_tpu.trainer.module import TrainModule
 
 @dataclass
 class Seq2SeqCollator:
+    """Generic seq2seq batching (encode → truncate → eos → shifted decoder
+    input → fixed-length pad). Task collators (QG, translation, QA) subclass
+    and override `source_text` / `target_text` only, so the padding/shift
+    contract lives in one place."""
+
     tokenizer: Any
     max_src_length: int = 512
     max_tgt_length: int = 128
@@ -28,18 +33,24 @@ class Seq2SeqCollator:
     text_key: str = "text"
     summary_key: str = "summary"
 
+    def source_text(self, sample: dict) -> str:
+        return sample[self.text_key]
+
+    def target_text(self, sample: dict) -> str:
+        return sample[self.summary_key]
+
     def __call__(self, samples: list[dict]) -> dict:
         pad = self.tokenizer.pad_token_id or 0
         eos = self.tokenizer.eos_token_id
         batch = {"input_ids": [], "attention_mask": [],
                  "decoder_input_ids": [], "labels": []}
         for s in samples:
-            src = self.tokenizer.encode(s[self.text_key],
+            src = self.tokenizer.encode(self.source_text(s),
                                         add_special_tokens=False
                                         )[: self.max_src_length - 1]
             if eos is not None:
                 src = src + [eos]
-            tgt = self.tokenizer.encode(s[self.summary_key],
+            tgt = self.tokenizer.encode(self.target_text(s),
                                         add_special_tokens=False
                                         )[: self.max_tgt_length - 1]
             if eos is not None:

@@ -1,0 +1,119 @@
+"""DeltaLM machine-translation finetune (zh↔en).
+
+Port of the reference workload
+(reference: fengshen/examples/translate/finetune_deltalm.py:85-320):
+{src, tgt} pairs (optionally reversed via --reverse_src_tgt) trained as
+seq2seq CE on DeltaLMForConditionalGeneration.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.examples.summary.seq2seq_summary import Seq2SeqCollator
+from fengshen_tpu.models.deltalm import (DeltaLMConfig,
+                                         DeltaLMForConditionalGeneration)
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class TranslationCollator(Seq2SeqCollator):
+    """{src, tgt} → seq2seq batch (reference: finetune_deltalm.py:85-123);
+    batching inherited from Seq2SeqCollator, only src/tgt selection (and
+    the --reverse_src_tgt direction flip) here."""
+
+    reverse_src_tgt: bool = False
+
+    def source_text(self, sample: dict) -> str:
+        return sample["tgt"] if self.reverse_src_tgt else sample["src"]
+
+    def target_text(self, sample: dict) -> str:
+        return sample["src"] if self.reverse_src_tgt else sample["tgt"]
+
+
+class DeltaLMTranslationModule(TrainModule):
+    """reference: finetune_deltalm.py FinetuneTranslation."""
+
+    def __init__(self, args, config: Optional[DeltaLMConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = DeltaLMConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = DeltaLMForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("deltalm translate")
+        parser.add_argument("--max_enc_length", type=int, default=256)
+        parser.add_argument("--max_dec_length", type=int, default=256)
+        parser.add_argument("--reverse_src_tgt", action="store_true",
+                            default=False)
+        parser.add_argument("--label_smooth", type=float, default=0.1)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits,
+                                                      batch["labels"])
+        smooth = getattr(self.args, "label_smooth", 0.0)
+        if smooth:
+            # uniform label smoothing (reference uses LabelSmoothingLoss,
+            # finetune_deltalm.py:30-60)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            valid = (batch["labels"] != -100)[..., None]
+            uniform = -(logp * valid).mean(-1).sum() / \
+                jnp.maximum(valid.sum(), 1)
+            loss = (1 - smooth) * loss + smooth * uniform
+        return loss, {"n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = DeltaLMTranslationModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    module = DeltaLMTranslationModule(args)
+    collator = TranslationCollator(
+        tokenizer, max_src_length=args.max_enc_length,
+        max_tgt_length=args.max_dec_length,
+        decoder_start_token_id=module.config.decoder_start_token_id,
+        reverse_src_tgt=args.reverse_src_tgt)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

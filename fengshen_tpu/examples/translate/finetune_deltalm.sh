@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for translate.finetune_deltalm (reference pattern: fengshen/examples/translate/finetune_deltalm.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-Deltalm-362M-Zh-En}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.translate.finetune_deltalm \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --train_file $TRAIN_FILE --max_enc_length 256 --max_dec_length 256

@@ -1,0 +1,50 @@
+"""UBERT unified information-extraction demo: one-call train + predict.
+
+Port of the reference driver (reference: fengshen/examples/ubert/
+example.py:7-110): instruction-style samples {task_type, subtask_type,
+text, choices:[{entity_type, entity_list:[{entity_name, entity_idx}]}]}
+fed straight to UbertPipelines.fit / .predict.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fengshen_tpu.pipelines.information_extraction import Pipeline
+
+
+TRAIN_DATA = [{
+    "task_type": "抽取任务", "subtask_type": "实体识别",
+    "text": "彭小军认为，国内银行现在走的是台湾的发卡模式",
+    "choices": [
+        {"entity_type": "地址", "label": 0, "entity_list": [
+            {"entity_name": "台湾", "entity_type": "地址",
+             "entity_idx": [[15, 16]]}]},
+        {"entity_type": "人物姓名", "label": 0, "entity_list": [
+            {"entity_name": "彭小军", "entity_type": "人物姓名",
+             "entity_idx": [[0, 2]]}]},
+    ], "id": 0}]
+
+TEST_DATA = [{
+    "task_type": "抽取任务", "subtask_type": "实体识别",
+    "text": "就天涯网推出彩票服务频道是否是业内人士所谓的打政策擦边球",
+    "choices": [{"entity_type": "公司"}, {"entity_type": "人物姓名"}],
+    "id": 1}]
+
+
+def main(argv=None, pipeline=None):
+    parser = argparse.ArgumentParser("TASK NAME")
+    parser = Pipeline.pipelines_args(parser)
+    args = parser.parse_args(argv)
+    if pipeline is None:
+        pipeline = Pipeline(args,
+                            model=getattr(args, "model_path", None))
+    pipeline.fit(TRAIN_DATA)
+    result = pipeline.predict(TEST_DATA)
+    for line in result:
+        print(line)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+#!/bin/bash
+python -m fengshen_tpu.examples.ubert.example --model_path ${MODEL_PATH:-IDEA-CCNL/Erlangshen-Ubert-110M-Chinese} --max_steps ${MAX_STEPS:-1000}

@@ -1,0 +1,36 @@
+"""UniEX unified extraction demo: typed-span prediction.
+
+Port of the reference driver (reference: fengshen/examples/uniex/
+example.py:17-80): entity-type prompts + text in one sequence; the
+triaffine-style span scorer returns typed entities per requested type.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fengshen_tpu.models.uniex import UniEXPipelines
+
+
+TEST_DATA = [{
+    "task_type": "实体识别",
+    "text": "彭小军认为，国内银行现在走的是台湾的发卡模式",
+    "choices": [{"entity_type": "地址"}, {"entity_type": "人物姓名"}],
+    "id": 0}]
+
+
+def main(argv=None, pipeline=None):
+    parser = argparse.ArgumentParser("TASK NAME")
+    parser = UniEXPipelines.pipelines_args(parser)
+    args = parser.parse_args(argv)
+    if pipeline is None:
+        pipeline = UniEXPipelines(args,
+                                  model=getattr(args, "model_path", None))
+    result = pipeline.predict(TEST_DATA)
+    for line in result:
+        print(line)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+#!/bin/bash
+python -m fengshen_tpu.examples.uniex.example --model_path ${MODEL_PATH:-IDEA-CCNL/Erlangshen-UniEX-RoBERTa-110M-Chinese}

@@ -1,0 +1,46 @@
+"""UniMC zero/few-shot multiple-choice demo: one-call train + predict.
+
+Port of the reference driver (reference: fengshen/examples/unimc/
+example.py:5-86): label options become [MASK]-prefixed choices and the
+model picks the option whose mask scores highest; train on a handful of
+labelled rows, then predict.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fengshen_tpu.pipelines.multiplechoice import Pipeline
+
+
+TRAIN_DATA = [
+    {"texta": "凌云研发的国产两轮电动车怎么样，有什么惊喜？", "textb": "",
+     "question": "下面新闻属于哪一个类别？",
+     "choices": ["教育", "科技", "军事", "旅游"], "label": 1, "id": 0},
+    {"texta": "街头偶遇2018款长安CS35，颜值美炸！", "textb": "",
+     "question": "下面新闻属于哪一个类别？",
+     "choices": ["教育", "科技", "军事", "汽车"], "label": 3, "id": 1},
+]
+
+TEST_DATA = [{
+    "texta": "街头偶遇2018款长安CS35，颜值美炸！", "textb": "",
+    "question": "下面新闻属于哪一个类别？",
+    "choices": ["房产", "汽车", "教育", "军事"], "id": 1}]
+
+
+def main(argv=None, pipeline=None):
+    parser = argparse.ArgumentParser("TASK NAME")
+    parser = Pipeline.add_pipeline_specific_args(parser)
+    args = parser.parse_args(argv)
+    if pipeline is None:
+        pipeline = Pipeline(args,
+                            model=getattr(args, "model_path", None))
+    pipeline.train(TRAIN_DATA)
+    result = pipeline.predict(TEST_DATA)
+    for line in result:
+        print(line)
+    return result
+
+
+if __name__ == "__main__":
+    main()

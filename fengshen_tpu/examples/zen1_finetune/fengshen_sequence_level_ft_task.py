@@ -1,0 +1,137 @@
+"""ZEN1 sequence-level finetune (TNEWS-style classification).
+
+Port of the reference workload
+(reference: fengshen/examples/zen1_finetune/
+fengshen_sequence_level_ft_task.py + fs_zen1_tnews.sh): texts are char
+tokenized, dictionary n-grams matched into (ngram_ids, ngram_positions)
+side inputs, and ZenForSequenceClassification is trained with CE.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.zen import (ZenConfig, ZenForSequenceClassification,
+                                     ZenNgramDict)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class ZenSequenceCollator:
+    """{sentence, label} → batch with ngram side inputs
+    (reference: convert_examples_to_features in
+    fengshen_sequence_level_ft_task.py)."""
+
+    tokenizer: Any
+    ngram_dict: ZenNgramDict
+    max_seq_length: int = 128
+    label2id: Optional[dict] = None
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        max_len = self.max_seq_length
+        M = self.ngram_dict.max_ngram_in_seq
+        batch = {"input_ids": [], "attention_mask": [], "ngram_ids": [],
+                 "ngram_positions": [], "labels": []}
+        for sample in samples:
+            text = sample.get("sentence") or sample.get("text", "")
+            chars = tok.tokenize(text)[: max_len - 2]
+            ids = [tok.cls_token_id] + tok.convert_tokens_to_ids(chars) + \
+                [tok.sep_token_id]
+            ngram_ids, positions = self.ngram_dict.match(chars)
+            # shift positions by 1 for [CLS], pad to max_len rows
+            pos = np.zeros((max_len, M), np.int32)
+            pos[1: 1 + len(chars)] = positions
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["ngram_ids"].append(ngram_ids)
+            batch["ngram_positions"].append(pos)
+            label = sample.get("label", 0)
+            if self.label2id is not None:
+                label = self.label2id.get(str(label), 0)
+            batch["labels"].append(int(label))
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class ZenSequenceModule(TrainModule):
+    def __init__(self, args, config: Optional[ZenConfig] = None,
+                 num_labels: int = 2):
+        super().__init__(args)
+        import dataclasses
+        if config is None and getattr(args, "model_path", None):
+            config = ZenConfig.from_pretrained(args.model_path)
+        config = dataclasses.replace(config, num_labels=num_labels)
+        self.config = config
+        self.model = ZenForSequenceClassification(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("zen1 finetune")
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument("--num_labels", type=int, default=15)
+        parser.add_argument("--ngram_dict_path", type=str, default=None)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        # include ngram side inputs so the ngram encoder params are created
+        ngram_ids = jnp.zeros((1, 8), jnp.int32)
+        ngram_pos = jnp.zeros((1, seq, 8), jnp.int32)
+        return self.model.init(rng, ids, ngram_ids=ngram_ids,
+                               ngram_positions=ngram_pos)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            ngram_ids=batch["ngram_ids"],
+            ngram_positions=batch["ngram_positions"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, _ = stable_cross_entropy(logits[:, None, :],
+                                       batch["labels"][:, None])
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        return loss, {"acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = ZenSequenceModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    ngram_dict = ZenNgramDict(args.ngram_dict_path or args.model_path)
+    collator = ZenSequenceCollator(tokenizer, ngram_dict,
+                                   max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = ZenSequenceModule(args, num_labels=args.num_labels)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Launcher for zen1_finetune.fengshen_sequence_level_ft_task (reference pattern: fengshen/examples/zen1_finetune/fs_zen1_tnews.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-ZEN1-224M-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.zen1_finetune.fengshen_sequence_level_ft_task \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --train_file $TRAIN_FILE --num_labels 15 --max_seq_length 128

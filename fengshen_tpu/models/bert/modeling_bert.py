@@ -58,6 +58,12 @@ class BertConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in raw.items() if k in known})
 
+    def save_pretrained(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(dataclasses.asdict(self) |
+                      {"model_type": "bert"}, f, indent=2)
+
     @classmethod
     def small_test_config(cls, **overrides: Any) -> "BertConfig":
         base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,

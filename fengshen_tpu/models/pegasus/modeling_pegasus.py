@@ -75,6 +75,12 @@ class PegasusConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in raw.items() if k in known})
 
+    def save_pretrained(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(dataclasses.asdict(self) |
+                      {"model_type": "pegasus"}, f, indent=2)
+
     @classmethod
     def small_test_config(cls, **overrides: Any) -> "PegasusConfig":
         base = dict(vocab_size=128, d_model=32, encoder_layers=2,

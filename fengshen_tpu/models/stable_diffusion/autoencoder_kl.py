@@ -32,6 +32,13 @@ class VAEConfig:
         base.update(overrides)
         return cls(**base)
 
+    def latent_shape(self, image_size: int) -> tuple[int, int, int]:
+        """(H', W', C) of the latent for a square input: one 2x downsample
+        per channel-mult stage after the first."""
+        factor = 2 ** (len(self.channel_mults) - 1)
+        return (image_size // factor, image_size // factor,
+                self.latent_channels)
+
 
 class _ResBlock(nn.Module):
     channels: int

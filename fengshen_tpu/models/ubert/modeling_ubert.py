@@ -111,6 +111,107 @@ class UbertPipelines:
         max_len = getattr(self.args, "max_length", 512) if self.args else 512
         return {"input_ids": ids[:max_len], "text_offset": text_offset}
 
+    def _collate_train(self, pairs: list[tuple]) -> dict:
+        """(sample, choice) pairs → padded batch with span-label matrices
+        (reference: fengshen/models/ubert UbertDataset span targets;
+        entity_idx are char offsets into text — one char per wordpiece for
+        Chinese BERT vocab, so token pos = text_offset + char idx)."""
+        encoded = []
+        for sample, choice in pairs:
+            etype = choice["entity_type"] if isinstance(choice, dict) \
+                else str(choice)
+            enc = self._encode(sample, etype)
+            spans = []
+            if isinstance(choice, dict):
+                for ent in choice.get("entity_list", []):
+                    for s, e in ent.get("entity_idx", []):
+                        spans.append((enc["text_offset"] + s,
+                                      enc["text_offset"] + e))
+            enc["spans"] = spans
+            encoded.append(enc)
+        # fixed max_length padding: per-batch max would give the jitted
+        # train step a new shape (and XLA recompile) nearly every batch
+        max_len = getattr(self.args, "max_length", 512) if self.args else 512
+        pad_id = self.tokenizer.pad_token_id or 0
+        batch = {"input_ids": [], "attention_mask": [], "span_labels": [],
+                 "span_mask": []}
+        for e in encoded:
+            ids = e["input_ids"][:max_len]
+            n = len(ids)
+            p = max_len - n
+            batch["input_ids"].append(ids + [pad_id] * p)
+            batch["attention_mask"].append([1] * n + [0] * p)
+            labels = np.zeros((max_len, max_len), np.float32)
+            for s, t in e["spans"]:
+                if s < n and t < n:
+                    labels[s, t] = 1.0
+            mask = np.zeros((max_len, max_len), np.float32)
+            off = e["text_offset"]
+            width = n - 1 - off
+            if width > 0:  # prompt may fill the truncated sequence
+                mask[off:n - 1, off:n - 1] = np.triu(
+                    np.ones((width, width), np.float32))
+            batch["span_labels"].append(labels)
+            batch["span_mask"].append(mask)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+    def fit(self, train_data: list[dict],
+            dev_data: Optional[list[dict]] = None) -> None:
+        """Train on instruction-style samples (reference:
+        fengshen/examples/ubert/example.py fit/predict driver)."""
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.trainer import Trainer
+        from fengshen_tpu.trainer.module import TrainModule
+
+        pipe = self
+
+        class _Module(TrainModule):
+            def __init__(self, args):
+                super().__init__(args)
+                self.model = pipe.model
+
+            def init_params(self, rng):
+                return pipe.model.init(
+                    rng, jnp.zeros((1, 16), jnp.int32))["params"]
+
+            def training_loss(self, params, batch, rng):
+                loss, _ = pipe.model.apply(
+                    {"params": params}, batch["input_ids"],
+                    attention_mask=batch["attention_mask"],
+                    span_labels=batch["span_labels"],
+                    span_mask=batch["span_mask"],
+                    deterministic=False, rngs={"dropout": rng})
+                return loss, {}
+
+            def partition_rules(self):
+                return pipe.model.partition_rules()
+
+        def expand(rows):
+            return [(s, ch) for s in rows for ch in s.get("choices", [])]
+
+        class ListDS:
+            def __init__(self, rows):
+                self.rows = rows
+
+            def __len__(self):
+                return len(self.rows)
+
+            def __getitem__(self, i):
+                return self.rows[i]
+
+        datasets = {"train": ListDS(expand(train_data))}
+        if dev_data:
+            datasets["validation"] = ListDS(expand(dev_data))
+        dm = UniversalDataModule(tokenizer=self.tokenizer,
+                                 collate_fn=self._collate_train,
+                                 args=self.args, datasets=datasets)
+        module = _Module(self.args)
+        if self.params is not None:
+            module.init_params = lambda rng: self.params
+        trainer = Trainer(self.args)
+        state = trainer.fit(module, dm)
+        self.params = state.params
+
     def predict(self, data: list[dict]) -> list[dict]:
         if self.params is None:
             self.params = self.model.init(

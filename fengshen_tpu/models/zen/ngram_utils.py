@@ -23,6 +23,10 @@ class ZenNgramDict:
         self.max_ngram_in_seq = max_ngram_in_seq
         self.max_ngram_len = max_ngram_len
         vocab: list[str] = ["[pad]"]
+        if ngram_freq_path and os.path.isdir(ngram_freq_path):
+            # checkpoint dirs ship the dict as ngram.txt (reference:
+            # ngram_utils.py NGRAM_DICT_NAME)
+            ngram_freq_path = os.path.join(ngram_freq_path, "ngram.txt")
         if ngram_freq_path and os.path.exists(ngram_freq_path):
             with open(ngram_freq_path) as f:
                 for line in f:

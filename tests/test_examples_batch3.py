@@ -1,0 +1,151 @@
+"""Smoke tests for pipeline-driver examples (ubert/unimc/uniex), DeltaLM
+translation, and ZEN1 finetune — tiny data, 8-device CPU mesh."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+def _bert_tokenizer_dir(tmp_path):
+    from transformers import BertTokenizer
+    chars = list("彭小军认为国内银行现在走的是台湾发卡模式就天涯网推出彩票服务"
+                 "频道凌云研发产两轮电动车怎么样有什惊喜街头偶遇长安颜值美炸"
+                 "教育科技军事旅游房汽产中英文测试句子好很大新闻类别属于下面")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + \
+        sorted(set(chars))
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(vocab))
+    tok = BertTokenizer(str(vf))
+    model_dir = tmp_path / "model"
+    model_dir.mkdir(exist_ok=True)
+    tok.save_pretrained(str(model_dir))
+    return tok, model_dir
+
+
+def _tiny_trainer_args(parser_builder, tmp_path, extra=()):
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = parser_builder(parser)
+    return parser.parse_args([
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"), *extra])
+
+
+def test_ubert_example_fit_predict(tmp_path, mesh8):
+    from fengshen_tpu.examples.ubert import example
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.pipelines.information_extraction import Pipeline
+    tok, _ = _bert_tokenizer_dir(tmp_path)
+    cfg = MegatronBertConfig.small_test_config(vocab_size=len(tok))
+    args = _tiny_trainer_args(Pipeline.pipelines_args, tmp_path,
+                              ["--max_length", "64"])
+    pipe = Pipeline(args, tokenizer=tok, config=cfg)
+    result = example.main(argv=[
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--max_length", "64"], pipeline=pipe)
+    assert len(result) == 1
+    assert all("entity_list" in c for c in result[0]["choices"])
+
+
+def test_unimc_example_train_predict(tmp_path, mesh8):
+    from fengshen_tpu.examples.unimc import example
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.pipelines.multiplechoice import Pipeline
+    tok, _ = _bert_tokenizer_dir(tmp_path)
+    cfg = MegatronBertConfig.small_test_config(vocab_size=len(tok))
+    args = _tiny_trainer_args(Pipeline.add_pipeline_specific_args, tmp_path)
+    pipe = Pipeline(args, tokenizer=tok, config=cfg)
+    result = example.main(argv=[
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs")], pipeline=pipe)
+    assert len(result) == 1 and 0 <= result[0] < 4
+
+
+def test_uniex_example_predict(tmp_path, mesh8):
+    from fengshen_tpu.examples.uniex import example
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.models.uniex import UniEXPipelines
+    import argparse
+    tok, _ = _bert_tokenizer_dir(tmp_path)
+    cfg = MegatronBertConfig.small_test_config(vocab_size=len(tok))
+    parser = UniEXPipelines.pipelines_args(argparse.ArgumentParser())
+    args = parser.parse_args(["--max_length", "64"])
+    pipe = UniEXPipelines(args, tokenizer=tok, config=cfg)
+    result = example.main(argv=[], pipeline=pipe)
+    assert len(result) == 1
+
+
+def test_translate_deltalm_e2e(tmp_path, mesh8):
+    from fengshen_tpu.examples.translate import finetune_deltalm
+    from fengshen_tpu.models.deltalm import DeltaLMConfig
+    import dataclasses
+    import json as _json
+    import os
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    cfg = DeltaLMConfig.small_test_config(vocab_size=len(tok))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(dataclasses.asdict(cfg), f)
+    train = tmp_path / "train.json"
+    with open(train, "w") as f:
+        for _ in range(8):
+            f.write(json.dumps({"src": "中文测试句子很好",
+                                "tgt": "英文测试句子很大"},
+                               ensure_ascii=False) + "\n")
+    finetune_deltalm.main([
+        "--model_path", str(model_dir), "--train_file", str(train),
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--max_enc_length", "16", "--max_dec_length", "16", "--seed", "1"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_zen1_finetune_e2e(tmp_path, mesh8):
+    from fengshen_tpu.examples.zen1_finetune import (
+        fengshen_sequence_level_ft_task as task)
+    from fengshen_tpu.models.zen import ZenConfig
+    import dataclasses
+    import json as _json
+    import os
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    cfg = ZenConfig.small_test_config(vocab_size=len(tok))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(dataclasses.asdict(cfg), f)
+    (model_dir / "ngram.txt").write_text("中文,5\n测试,3\n句子,2\n")
+    train = tmp_path / "train.json"
+    with open(train, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({"sentence": "中文测试句子很好",
+                                "label": i % 2}, ensure_ascii=False) + "\n")
+    task.main([
+        "--model_path", str(model_dir), "--train_file", str(train),
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--max_seq_length", "32", "--num_labels", "2", "--seed", "1"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_zen_ngram_dict_match(tmp_path):
+    from fengshen_tpu.models.zen import ZenNgramDict
+    p = tmp_path / "ngram.txt"
+    p.write_text("中文,5\n测试句,3\n")
+    d = ZenNgramDict(str(p), max_ngram_in_seq=8)
+    ids, pos = d.match(list("中文测试句子"))
+    assert (ids > 0).sum() == 2
+    # "中文" covers chars 0-1, "测试句" covers 2-4
+    assert pos[0, 0] == 1 and pos[1, 0] == 1
+    assert pos[2, 1] == 1 and pos[4, 1] == 1

@@ -1,0 +1,226 @@
+"""Smoke tests for the multimodal example workloads: hubert pretrain,
+taiyi-clip pretrain, taiyi-SD finetune, dreambooth — tiny data, CPU mesh."""
+
+import json
+import wave
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# hubert
+# ---------------------------------------------------------------------------
+
+def _write_wav(path, n_samples, sr=16000, seed=0):
+    rng = np.random.RandomState(seed)
+    pcm = (rng.randn(n_samples) * 3000).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+
+
+def _hubert_data(tmp_path, n_rows=4, n_samples=4000, label_rate=50.0):
+    audio_dir = tmp_path / "audio"
+    audio_dir.mkdir()
+    with open(tmp_path / "train.tsv", "w") as mf:
+        mf.write(str(audio_dir) + "\n")
+        for i in range(n_rows):
+            _write_wav(audio_dir / f"a{i}.wav", n_samples, seed=i)
+            mf.write(f"a{i}.wav\t{n_samples}\n")
+    n_labels = int(n_samples / 16000 * label_rate)
+    rng = np.random.RandomState(0)
+    with open(tmp_path / "train.km", "w") as lf:
+        for i in range(n_rows):
+            lf.write(" ".join(str(x) for x in
+                              rng.randint(0, 16, max(n_labels, 1))) + "\n")
+
+
+def test_hubert_dataset_and_collator(tmp_path):
+    from fengshen_tpu.data.hubert import (HubertCollator, HubertDataset,
+                                          conv_frames)
+    from fengshen_tpu.models.hubert import HubertConfig
+    _hubert_data(tmp_path)
+    cfg = HubertConfig.small_test_config()
+    ds = HubertDataset(str(tmp_path / "train.tsv"),
+                       str(tmp_path / "train.km"))
+    assert len(ds) == 4
+    s = ds[0]
+    assert s["waveform"].ndim == 1 and len(s["cluster_ids"]) > 0
+    coll = HubertCollator(cfg.conv_layers, mask_prob=0.5, mask_length=2)
+    batch = coll([ds[0], ds[1]])
+    frames = conv_frames(4000, cfg.conv_layers)
+    assert batch["waveform"].shape == (2, 4000)
+    assert batch["cluster_ids"].shape == (2, frames)
+    assert batch["mask_time_indices"].any()
+
+
+def test_hubert_dataset_crop(tmp_path):
+    from fengshen_tpu.data.hubert import HubertDataset
+    _hubert_data(tmp_path, n_samples=8000)
+    ds = HubertDataset(str(tmp_path / "train.tsv"),
+                       str(tmp_path / "train.km"),
+                       max_sample_size=4000, seed=3)
+    s = ds[0]
+    assert len(s["waveform"]) == 4000
+    assert 0 < len(s["cluster_ids"]) <= 14
+
+
+def test_pretrain_hubert_e2e(tmp_path, mesh8, monkeypatch):
+    from fengshen_tpu.examples.hubert import pretrain_hubert
+    from fengshen_tpu.models.hubert import HubertConfig
+    _hubert_data(tmp_path, n_rows=8)
+    # main() builds HubertConfig() — swap in the small test config
+    small = HubertConfig.small_test_config()
+    monkeypatch.setattr(pretrain_hubert, "HubertConfig", lambda: small)
+    pretrain_hubert.main([
+        "--data", str(tmp_path), "--train_batchsize", "2",
+        "--max_steps", "2", "--log_every_n_steps", "1",
+        "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--max_sample_size", "4000", "--min_sample_size", "10",
+        "--seed", "1"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+# ---------------------------------------------------------------------------
+# clip / sd / dreambooth
+# ---------------------------------------------------------------------------
+
+def _image_dataset(tmp_path, n=4, size=32):
+    pytest.importorskip("PIL")
+    from PIL import Image
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir(exist_ok=True)
+    import csv
+    rows = []
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        p = img_dir / f"i{i}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"image": str(p), "caption": "一张测试图片"})
+    csv_path = tmp_path / "data.csv"
+    with open(csv_path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["image", "caption"])
+        w.writeheader()
+        w.writerows(rows)
+    return img_dir, csv_path
+
+
+def _bert_dir(tmp_path):
+    from transformers import BertTokenizer
+    from fengshen_tpu.models.bert import BertConfig
+    chars = list("一张测试图片的照狗")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + sorted(set(chars))
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab))
+    tok = BertTokenizer(str(tmp_path / "vocab.txt"))
+    model_dir = tmp_path / "model"
+    model_dir.mkdir(exist_ok=True)
+    tok.save_pretrained(str(model_dir))
+    BertConfig.small_test_config(vocab_size=len(tok)).save_pretrained(
+        str(model_dir))
+    return tok, model_dir
+
+
+def test_pretrain_taiyi_clip_e2e(tmp_path, mesh8, monkeypatch):
+    from fengshen_tpu.examples.pretrain_taiyi_clip import pretrain
+    from fengshen_tpu.models.clip import CLIPVisionConfig
+    _, csv_path = _image_dataset(tmp_path)
+    tok, model_dir = _bert_dir(tmp_path)
+    small_vision = CLIPVisionConfig.small_test_config(image_size=32)
+    monkeypatch.setattr(pretrain, "CLIPVisionConfig", lambda: small_vision)
+    pretrain.main([
+        "--model_path", str(model_dir), "--train_csv", str(csv_path),
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--image_size", "32", "--max_length", "16", "--seed", "1",
+        "--freeze_image_tower"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def _small_sd_patches(monkeypatch, module):
+    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import VAEConfig
+    from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
+    monkeypatch.setattr(module, "VAEConfig",
+                        lambda: VAEConfig.small_test_config())
+    monkeypatch.setattr(module, "UNetConfig",
+                        lambda: UNetConfig.small_test_config())
+
+
+def test_finetune_taiyi_sd_e2e(tmp_path, mesh8, monkeypatch):
+    from fengshen_tpu.examples.finetune_taiyi_stable_diffusion import finetune
+    _small_sd_patches(monkeypatch, finetune)
+    _, csv_path = _image_dataset(tmp_path)
+    tok, model_dir = _bert_dir(tmp_path)
+    finetune.main([
+        "--model_path", str(model_dir), "--train_csv", str(csv_path),
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--image_size", "32", "--max_length", "16", "--seed", "1"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_dreambooth_e2e_with_prior(tmp_path, mesh8, monkeypatch):
+    from fengshen_tpu.examples.stable_diffusion_dreambooth import train
+    from fengshen_tpu.examples.finetune_taiyi_stable_diffusion import finetune
+    _small_sd_patches(monkeypatch, finetune)
+    pytest.importorskip("PIL")
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for d in ("instance", "cls"):
+        (tmp_path / d).mkdir()
+        for i in range(4):
+            arr = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / d / f"{i}.png")
+    tok, model_dir = _bert_dir(tmp_path)
+    train.main([
+        "--model_path", str(model_dir),
+        "--instance_data_dir", str(tmp_path / "instance"),
+        "--instance_prompt", "一张照片的狗",
+        "--class_data_dir", str(tmp_path / "cls"),
+        "--class_prompt", "一张照片", "--with_prior_preservation",
+        "--prior_loss_weight", "0.5",
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--image_size", "32", "--max_length", "16", "--seed", "1"])
+    lines = [json.loads(l) for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_dreambooth_dataset_pairs(tmp_path):
+    from fengshen_tpu.examples.stable_diffusion_dreambooth.train import (
+        DreamBoothDataset)
+    pytest.importorskip("PIL")
+    from PIL import Image
+    (tmp_path / "inst").mkdir()
+    (tmp_path / "cls").mkdir()
+    arr = np.zeros((8, 8, 3), np.uint8)
+    for i in range(3):
+        Image.fromarray(arr).save(tmp_path / "inst" / f"{i}.png")
+    Image.fromarray(arr).save(tmp_path / "cls" / "c.png")
+    ds = DreamBoothDataset(str(tmp_path / "inst"), "sks 狗",
+                           str(tmp_path / "cls"), "狗")
+    assert len(ds) == 3
+    s = ds[1]
+    assert s["instance_prompt"] == "sks 狗" and "class_image" in s
