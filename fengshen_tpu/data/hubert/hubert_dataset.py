@@ -146,16 +146,19 @@ class HubertCollator:
 
     def __init__(self, conv_layers: Sequence[Sequence[int]],
                  mask_prob: float = 0.65, mask_length: int = 10,
-                 seed: int = 0):
+                 seed: int = 0, pad_to: Optional[int] = None):
         self.conv_layers = conv_layers
         self.mask_prob = mask_prob
         self.mask_length = mask_length
         self.rng = np.random.RandomState(seed)
+        # fixed padding length: per-batch max would hand the jitted train
+        # step a new shape (and an XLA recompile) nearly every batch
+        self.pad_to = pad_to
 
     def __call__(self, samples: list[dict]) -> dict:
         from fengshen_tpu.models.hubert.modeling_hubert import (
             compute_mask_indices)
-        max_t = max(len(s["waveform"]) for s in samples)
+        max_t = self.pad_to or max(len(s["waveform"]) for s in samples)
         batch = len(samples)
         frames = conv_frames(max_t, self.conv_layers)
         waveform = np.zeros((batch, max_t), np.float32)
@@ -177,7 +180,8 @@ class HubertCollator:
         mask = compute_mask_indices((batch, frames), self.mask_prob,
                                     self.mask_length, self.rng)
         # the loss only counts masked frames; restricting the mask to valid
-        # frames keeps pad frames out of training
+        # frames keeps pad frames out of training. frame_mask also gates
+        # the optional unmasked (pred_nomask) loss term.
         mask &= valid
         return {"waveform": waveform, "cluster_ids": targets,
-                "mask_time_indices": mask}
+                "mask_time_indices": mask, "frame_mask": valid}

@@ -66,7 +66,10 @@ class TaiyiSDModule(TrainModule):
         noise = jnp.zeros((1,) + latent_shape, jnp.float32)
         return self.model.init(rng, ids, pixels, t, noise)["params"]
 
-    def training_loss(self, params, batch, rng):
+    def _denoise_pred(self, params, batch, rng):
+        """Shared preamble: freeze towers, sample noise/timesteps, run the
+        pipeline. Returns (pred, latents, noise, timesteps). Subclasses
+        (dreambooth) override only the loss reduction."""
         if not getattr(self.args, "train_whole_model", False):
             # UNet-only training: freeze text tower + VAE
             params = dict(params)
@@ -84,6 +87,11 @@ class TaiyiSDModule(TrainModule):
             {"params": params}, batch["input_ids"], pixels, timesteps,
             noise, attention_mask=batch.get("attention_mask"),
             rng=rng_vae, deterministic=False, rngs={"dropout": rng_drop})
+        return pred, latents, noise, timesteps
+
+    def training_loss(self, params, batch, rng):
+        pred, latents, noise, timesteps = self._denoise_pred(params, batch,
+                                                             rng)
         loss = diffusion_loss(
             pred, latents, noise, timesteps, self.scheduler,
             prediction_type=getattr(self.args, "prediction_type", "epsilon"))

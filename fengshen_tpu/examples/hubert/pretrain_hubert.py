@@ -55,7 +55,8 @@ class HubertPretrainModule(TrainModule):
             deterministic=False, rngs={"dropout": rng})
         loss, n_masked = hubert_pretrain_loss(
             logits, batch["cluster_ids"], batch["mask_time_indices"],
-            unmasked_weight=getattr(self.args, "pred_nomask_weight", 0.0))
+            unmasked_weight=getattr(self.args, "pred_nomask_weight", 0.0),
+            frame_mask=batch.get("frame_mask"))
         acc = ((logits.argmax(-1) == batch["cluster_ids"]) *
                batch["mask_time_indices"]).sum() / jnp.maximum(n_masked, 1)
         return loss, {"masked_acc": acc, "n_masked": n_masked}
@@ -93,7 +94,8 @@ def main(argv=None):
                 min_keep_sample_size=args.min_sample_size)
     collator = HubertCollator(config.conv_layers,
                               mask_prob=config.mask_prob,
-                              mask_length=config.mask_length)
+                              mask_length=config.mask_length,
+                              pad_to=args.max_sample_size)
     datamodule = UniversalDataModule(collate_fn=collator, args=args,
                                      datasets=datasets)
     module = HubertPretrainModule(args, config)

@@ -56,6 +56,11 @@ class T5PretrainModule(TrainModule):
     def add_module_specific_args(parent_parser):
         parser = parent_parser.add_argument_group("T5 pretrain")
         parser.add_argument("--keep_tokens_path", default=None, type=str)
+        parser.add_argument(
+            "--new_vocab_path", default=None, type=str,
+            help="tokenizer matching keep_tokens order (reference: "
+                 "pretrain_t5.py:29-49 continues from mT5 with a reduced "
+                 "zh/en sentencepiece model)")
         parser.add_argument("--max_seq_length", type=int, default=512)
         parser.add_argument("--noise_density", type=float, default=0.15)
         parser.add_argument("--mean_noise_span_length", type=float,
@@ -66,7 +71,24 @@ class T5PretrainModule(TrainModule):
         ids = jnp.zeros((1, 8), jnp.int32)
         params = self.model.init(rng, ids, ids)["params"]
         keep_path = getattr(self.args, "keep_tokens_path", None)
+        model_path = getattr(self.args, "model_path", None)
         if keep_path:
+            # the vocab trim only makes sense on PRETRAINED weights (the
+            # reference index-selects the loaded mT5 state dict,
+            # pretrain_t5.py:38-49) with the NEW tokenizer whose ids match
+            # keep_tokens order (--new_vocab_path). Require the checkpoint.
+            import os
+            ckpt = os.path.join(model_path or "", "pytorch_model.bin")
+            if not os.path.exists(ckpt):
+                raise ValueError(
+                    "--keep_tokens_path requires a pretrained torch "
+                    f"checkpoint at {ckpt} (trimming random weights would "
+                    "discard nothing and misalign the new vocabulary)")
+            import torch
+
+            from fengshen_tpu.models.t5.convert import torch_to_params
+            params = torch_to_params(
+                torch.load(ckpt, map_location="cpu"), self.config)
             keep = json.load(open(keep_path))
             params = trim_vocab(params, keep)
         return params
@@ -104,7 +126,8 @@ def main(argv=None):
     parser = T5PretrainModule.add_module_specific_args(parser)
     args = parser.parse_args(argv)
 
-    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    tokenizer = AutoTokenizer.from_pretrained(
+        args.new_vocab_path or args.model_path)
     collator = T5SpanCorruptionCollator(
         tokenizer, max_seq_length=args.max_seq_length,
         noise_density=args.noise_density,

@@ -72,11 +72,14 @@ class TaggingCollator:
             batch["labels"].append(labels + [-100] * pad)
         out = {k: np.asarray(v) for k, v in batch.items()}
         if self.model_type == "bert-span":
-            # start/end pointer labels from BIO (reference: CollatorForSpan)
+            # start/end pointer labels from BIO (reference: CollatorForSpan).
+            # Entity-type ids start at 1: 0 is reserved for "no entity
+            # boundary here" and must not collide with a real type.
             lab = out.pop("labels")
             start = np.zeros_like(lab)
             end = np.zeros_like(lab)
             id2label = {v: k for k, v in self.label2id.items()}
+            etype2id = self.span_type2id()
             for b in range(lab.shape[0]):
                 i = 0
                 while i < lab.shape[1]:
@@ -89,7 +92,7 @@ class TaggingCollator:
                                id2label.get(int(lab[b, j + 1]), "O")
                                == "I-" + ent):
                             j += 1
-                        etype = self.label2id.get("B-" + ent, 0)
+                        etype = etype2id[ent]
                         start[b, i] = etype
                         end[b, j] = etype
                         i = j + 1
@@ -100,6 +103,11 @@ class TaggingCollator:
             out["start_labels"] = start
             out["end_labels"] = end
         return out
+
+    def span_type2id(self) -> dict:
+        """entity type → id, 1-based (0 = background)."""
+        ents = sorted({t[2:] for t in self.label2id if t.startswith("B-")})
+        return {e: i + 1 for i, e in enumerate(ents)}
 
 
 class TaggingModule(TrainModule):
@@ -190,10 +198,15 @@ def main(argv=None):
     collator = TaggingCollator(tokenizer, label2id,
                                max_seq_length=args.max_seq_length,
                                model_type=args.model_type)
+    if args.model_type == "bert-span":
+        # span heads classify entity TYPES (+1 background), not BIO tags
+        num_labels = len(collator.span_type2id()) + 1
+    else:
+        num_labels = len(label2id)
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args,
                                      datasets=datasets)
-    module = TaggingModule(args, num_labels=len(label2id))
+    module = TaggingModule(args, num_labels=num_labels)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
     trainer.fit(module, datamodule)

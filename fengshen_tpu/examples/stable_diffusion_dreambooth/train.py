@@ -114,29 +114,15 @@ class DreamBoothModule(TaiyiSDModule):
         return parser
 
     def training_loss(self, params, batch, rng):
-        if not getattr(self.args, "train_whole_model", False):
-            params = dict(params)
-            for key in list(params):
-                if key in ("text_encoder", "vae"):
-                    params[key] = jax.lax.stop_gradient(params[key])
-        rng_t, rng_n, rng_vae, rng_drop = jax.random.split(rng, 4)
-        pixels = batch["pixel_values"]
-        latent_shape = self.model.vae_config.latent_shape(pixels.shape[1])
-        timesteps = jax.random.randint(
-            rng_t, (pixels.shape[0],), 0,
-            self.scheduler.num_train_timesteps)
-        noise = jax.random.normal(rng_n, (pixels.shape[0],) + latent_shape)
-        pred, latents = self.model.apply(
-            {"params": params}, batch["input_ids"], pixels, timesteps,
-            noise, attention_mask=batch.get("attention_mask"),
-            rng=rng_vae, deterministic=False, rngs={"dropout": rng_drop})
+        pred, latents, noise, timesteps = self._denoise_pred(params, batch,
+                                                             rng)
+        prediction_type = getattr(self.args, "prediction_type", "epsilon")
         if getattr(self.args, "with_prior_preservation", False) and \
                 pred.shape[0] > 1:
             # instance rows vs class-prior rows weighted separately
             # (reference: train.py prior_loss_weight); target honors
             # --prediction_type, same as diffusion_loss
-            if getattr(self.args, "prediction_type",
-                       "epsilon") == "v_prediction":
+            if prediction_type == "v_prediction":
                 target = self.scheduler.get_velocity(latents, noise,
                                                      timesteps)
             else:
@@ -153,7 +139,8 @@ class DreamBoothModule(TaiyiSDModule):
             return inst_loss + w_prior * prior_loss, {
                 "instance_loss": inst_loss, "prior_loss": prior_loss}
         loss = diffusion_loss(pred, latents, noise, timesteps,
-                              self.scheduler)
+                              self.scheduler,
+                              prediction_type=prediction_type)
         return loss, {}
 
 

@@ -1,0 +1,46 @@
+"""torch(HF) ↔ jax weights for BERT.
+
+The bert analog of the reference's checkpoint-loading path (the reference
+uses HF BertForMaskedLM/BertForPreTraining directly, e.g.
+fengshen/examples/pretrain_bert/pretrain_bert.py:1-8); this importer lets
+released HF bert checkpoints load into the flax family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.bert.modeling_bert import BertConfig
+from fengshen_tpu.utils.convert_common import bert_layer, make_helpers
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: BertConfig) -> dict:
+    t, lin, ln = make_helpers(state_dict)
+    bert = {
+        "word_embeddings": {
+            "embedding": t("bert.embeddings.word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": t("bert.embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding": t("bert.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        bert[f"layer_{i}"] = bert_layer(state_dict,
+                                        f"bert.encoder.layer.{i}")
+    if "bert.pooler.dense.weight" in state_dict:
+        bert["pooler"] = lin("bert.pooler.dense")
+    params: dict = {"bert": bert}
+    if "cls.predictions.transform.dense.weight" in state_dict:
+        params["transform_dense"] = lin("cls.predictions.transform.dense")
+        params["transform_ln"] = ln("cls.predictions.transform.LayerNorm")
+        params["bias"] = t("cls.predictions.bias")
+    return params
+
+
+def model_to_params(state_dict: Mapping[str, Any],
+                    config: BertConfig) -> dict:
+    """For a bare BertModel state dict (no `bert.` prefix / no MLM head)."""
+    prefixed = {f"bert.{k}": v for k, v in state_dict.items()}
+    return torch_to_params(prefixed, config)["bert"]

@@ -1,0 +1,78 @@
+"""torch(HF) → jax weights for Taiyi-CLIP.
+
+Importer for released Taiyi-CLIP checkpoints: a Chinese BertModel text
+tower + HF CLIPVisionModel vision tower and the two projection heads
+(reference: fengshen/examples/pretrain_taiyi_clip loads
+BertForSequenceClassification + CLIPVisionModel from HF).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.clip.modeling_taiyi_clip import CLIPVisionConfig
+from fengshen_tpu.utils.convert_common import bert_layer, make_helpers
+
+
+def vision_to_params(state_dict: Mapping[str, Any],
+                     config: CLIPVisionConfig,
+                     prefix: str = "vision_model") -> dict:
+    """HF CLIPVisionModel state dict → CLIPVisionTransformer params."""
+    t, lin, ln = make_helpers(state_dict)
+
+    def layer(i):
+        p = f"{prefix}.encoder.layers.{i}"
+        return {
+            "layer_norm1": ln(f"{p}.layer_norm1"),
+            "q_proj": lin(f"{p}.self_attn.q_proj"),
+            "k_proj": lin(f"{p}.self_attn.k_proj"),
+            "v_proj": lin(f"{p}.self_attn.v_proj"),
+            "out_proj": lin(f"{p}.self_attn.out_proj"),
+            "layer_norm2": ln(f"{p}.layer_norm2"),
+            "fc1": lin(f"{p}.mlp.fc1"),
+            "fc2": lin(f"{p}.mlp.fc2"),
+        }
+
+    params: dict = {
+        # torch Conv2d [out, in, kh, kw] → flax [kh, kw, in, out]
+        "patch_embedding": {
+            "kernel": t(f"{prefix}.embeddings.patch_embedding.weight"
+                        ).transpose(2, 3, 1, 0)},
+        "class_embedding": t(f"{prefix}.embeddings.class_embedding"),
+        "position_embedding":
+            t(f"{prefix}.embeddings.position_embedding.weight"),
+        "pre_layrnorm": ln(f"{prefix}.pre_layrnorm"),
+        "post_layernorm": ln(f"{prefix}.post_layernorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        params[f"layer_{i}"] = layer(i)
+    return params
+
+
+def torch_to_params(text_state: Mapping[str, Any],
+                    vision_state: Mapping[str, Any],
+                    text_config, vision_config: CLIPVisionConfig,
+                    text_projection=None, visual_projection=None,
+                    logit_scale=None) -> dict:
+    """Assemble full TaiyiCLIPModel params from the two towers."""
+    import numpy as np
+
+    from fengshen_tpu.models.bert.convert import model_to_params
+    t, _, _ = make_helpers(vision_state)
+    params: dict = {
+        "text_model": model_to_params(text_state, text_config),
+        "vision_model": vision_to_params(vision_state, vision_config),
+    }
+    if text_projection is not None:
+        x = text_projection
+        x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
+        params["text_projection"] = {"kernel": np.asarray(x).T}
+    if visual_projection is not None:
+        x = visual_projection
+        x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
+        params["visual_projection"] = {"kernel": np.asarray(x).T}
+    if logit_scale is not None:
+        x = logit_scale
+        x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
+        params["logit_scale"] = np.asarray(x)
+    return params

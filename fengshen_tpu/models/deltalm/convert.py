@@ -1,0 +1,81 @@
+"""torch → jax weights for DeltaLM.
+
+Importer for the reference's DeltaLM checkpoints
+(reference: fengshen/models/deltalm/modeling_deltalm.py — encoder layers
+use self_attn/fc1/fc2, decoder layers interleave self_attn → fc3/fc4
+(ffn_layer_norm) → encoder_attn → fc1/fc2 (final_layer_norm),
+:258-440). In this flax family the decoder's FIRST ffn is named fc1/fc2
+(ffn1_layer_norm) and the SECOND fc3/fc4 (ffn2_layer_norm) in execution
+order, so the mapping swaps the reference's pairs accordingly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.deltalm.modeling_deltalm import DeltaLMConfig
+from fengshen_tpu.utils.convert_common import (make_helpers,
+                                               seq2seq_attention)
+
+
+def _strip(state_dict: Mapping[str, Any]) -> dict:
+    """Accept raw fairseq-style dicts with or without a `model.` prefix."""
+    if any(k.startswith("model.") for k in state_dict):
+        return {k[len("model."):]: v for k, v in state_dict.items()
+                if k.startswith("model.")}
+    return dict(state_dict)
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: DeltaLMConfig) -> dict:
+    sd = _strip(state_dict)
+    t, lin, ln = make_helpers(sd)
+
+    def enc_layer(i):
+        p = f"encoder.layers.{i}"
+        return {
+            "self_attn": seq2seq_attention(sd, f"{p}.self_attn"),
+            "self_attn_layer_norm": ln(f"{p}.self_attn_layer_norm"),
+            "fc1": lin(f"{p}.fc1"),
+            "fc2": lin(f"{p}.fc2"),
+            "final_layer_norm": ln(f"{p}.final_layer_norm"),
+        }
+
+    def dec_layer(i):
+        p = f"decoder.layers.{i}"
+        return {
+            "self_attn": seq2seq_attention(sd, f"{p}.self_attn"),
+            "self_attn_layer_norm": ln(f"{p}.self_attn_layer_norm"),
+            # reference fc3/fc4 run FIRST (after self-attn) → flax fc1/fc2
+            "fc1": lin(f"{p}.fc3"),
+            "fc2": lin(f"{p}.fc4"),
+            "ffn1_layer_norm": ln(f"{p}.ffn_layer_norm"),
+            "encoder_attn": seq2seq_attention(sd, f"{p}.encoder_attn"),
+            "encoder_attn_layer_norm": ln(f"{p}.encoder_attn_layer_norm"),
+            # reference fc1/fc2 run LAST → flax fc3/fc4
+            "fc3": lin(f"{p}.fc1"),
+            "fc4": lin(f"{p}.fc2"),
+            "ffn2_layer_norm": ln(f"{p}.final_layer_norm"),
+        }
+
+    embed_key = "encoder.embed_tokens.weight" if \
+        "encoder.embed_tokens.weight" in sd else "shared.weight"
+    pos_key = "encoder.embed_positions.weight"
+    params: dict = {
+        "shared": {"embedding": t(embed_key)},
+    }
+    if pos_key in sd:
+        params["embed_positions"] = {"embedding": t(pos_key)}
+    for src, dst in (("encoder.layernorm_embedding",
+                      "encoder_emb_layer_norm"),
+                     ("decoder.layernorm_embedding",
+                      "decoder_emb_layer_norm"),
+                     ("encoder.layer_norm", "encoder_layer_norm"),
+                     ("decoder.layer_norm", "decoder_layer_norm")):
+        if f"{src}.weight" in sd:
+            params[dst] = ln(src)
+    for i in range(config.encoder_layers):
+        params[f"encoder_layer_{i}"] = enc_layer(i)
+    for i in range(config.decoder_layers):
+        params[f"decoder_layer_{i}"] = dec_layer(i)
+    return params

@@ -1,0 +1,78 @@
+"""torch(HF/fairseq) → jax weights for HuBERT.
+
+Importer for released HuBERT checkpoints in HF naming
+(reference: fengshen/examples/hubert/pretrain_hubert.py:19-55 wraps the
+fairseq HubertModel; HF `HubertModel` is the released-weights format).
+The conv feature encoder, feature projection, masked embed, weight-normed
+conv positional embedding, and transformer layers all map; the k-means
+`cluster_head` exists only in pretraining checkpoints (fairseq
+`final_proj`) and is left to the caller when absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.models.hubert.modeling_hubert import HubertConfig
+from fengshen_tpu.utils.convert_common import make_helpers, tensor
+
+
+def _weight_norm_conv(state_dict: Mapping[str, Any], prefix: str
+                      ) -> np.ndarray:
+    """Collapse fairseq/HF weight-norm (weight_g, weight_v) into an
+    effective conv weight; also accepts a plain `weight`."""
+    if f"{prefix}.weight" in state_dict:
+        return tensor(state_dict, f"{prefix}.weight")
+    g = tensor(state_dict, f"{prefix}.weight_g")
+    v = tensor(state_dict, f"{prefix}.weight_v")
+    norm = np.sqrt((v ** 2).sum(axis=(1, 2), keepdims=True))
+    return g * v / np.maximum(norm, 1e-12)
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: HubertConfig) -> dict:
+    sd = state_dict
+    if any(k.startswith("hubert.") for k in sd):
+        sd = {k[len("hubert."):]: v for k, v in sd.items()
+              if k.startswith("hubert.")}
+    t, lin, ln = make_helpers(sd)
+
+    params: dict = {}
+    for i in range(len(config.conv_layers)):
+        # torch Conv1d [out, in, k] → flax [k, in, out]
+        w = t(f"feature_extractor.conv_layers.{i}.conv.weight")
+        params[f"conv_{i}"] = {"kernel": w.transpose(2, 1, 0)}
+        if i == 0 and \
+                f"feature_extractor.conv_layers.0.layer_norm.weight" in sd:
+            params["conv_norm_0"] = ln(
+                "feature_extractor.conv_layers.0.layer_norm")
+    params["feature_projection"] = lin("feature_projection.projection")
+    params["feature_norm"] = ln("feature_projection.layer_norm")
+    if "masked_spec_embed" in sd:
+        params["mask_embedding"] = t("masked_spec_embed")
+
+    pos_w = _weight_norm_conv(sd, "encoder.pos_conv_embed.conv")
+    # grouped torch Conv1d [out, in/groups, k] → flax [k, in/groups, out]
+    params["pos_conv"] = {
+        "kernel": pos_w.transpose(2, 1, 0),
+        "bias": t("encoder.pos_conv_embed.conv.bias")}
+
+    for i in range(config.num_hidden_layers):
+        p = f"encoder.layers.{i}"
+        params[f"layer_{i}"] = {
+            "query": lin(f"{p}.attention.q_proj"),
+            "key": lin(f"{p}.attention.k_proj"),
+            "value": lin(f"{p}.attention.v_proj"),
+            "attention_output_dense": lin(f"{p}.attention.out_proj"),
+            "attention_ln": ln(f"{p}.layer_norm"),
+            "intermediate_dense": lin(
+                f"{p}.feed_forward.intermediate_dense"),
+            "output_dense": lin(f"{p}.feed_forward.output_dense"),
+            "output_ln": ln(f"{p}.final_layer_norm"),
+        }
+    # fairseq pretraining head (km logits); HF fine-tune ckpts lack it
+    if "final_proj.weight" in sd:
+        params["cluster_head"] = lin("final_proj")
+    return params

@@ -140,10 +140,11 @@ class HubertModel(nn.Module):
 
 
 def hubert_pretrain_loss(logits, cluster_targets, mask_time_indices,
-                         unmasked_weight: float = 0.0):
+                         unmasked_weight: float = 0.0, frame_mask=None):
     """CE at masked frames (+ optional unmasked term, fairseq's
     pred_nomask). The per-frame CE is computed once and reduced under the
-    two masks."""
+    two masks; `frame_mask` (1 = real frame) keeps pad frames out of the
+    unmasked term on variable-length batches."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     token_ce = -jnp.take_along_axis(logp, cluster_targets[..., None],
                                     axis=-1)[..., 0]
@@ -152,6 +153,8 @@ def hubert_pretrain_loss(logits, cluster_targets, mask_time_indices,
     loss_m = (token_ce * masked).sum() / n_m
     if unmasked_weight > 0.0:
         unmasked = 1.0 - masked
+        if frame_mask is not None:
+            unmasked = unmasked * frame_mask.astype(jnp.float32)
         loss_u = (token_ce * unmasked).sum() / jnp.maximum(unmasked.sum(),
                                                            1)
         return loss_m + unmasked_weight * loss_u, masked.sum()

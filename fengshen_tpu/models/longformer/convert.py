@@ -1,0 +1,65 @@
+"""torch(HF) → jax weights for Longformer.
+
+Importer for released Erlangshen-Longformer checkpoints (the reference
+family loads HF-format state dicts,
+reference: fengshen/models/longformer/modeling_longformer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.longformer.modeling_longformer import (
+    LongformerConfig)
+from fengshen_tpu.utils.convert_common import make_helpers
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: LongformerConfig) -> dict:
+    t, lin, ln = make_helpers(state_dict)
+
+    pos = t("longformer.embeddings.position_embeddings.weight")
+    if pos.shape[0] == config.max_position_embeddings + 2:
+        # RoBERTa-style checkpoints reserve positions 0/1 for padding
+        pos = pos[2:]
+
+    def layer(i):
+        p = f"longformer.encoder.layer.{i}"
+        out = {
+            "self": {
+                "query": lin(f"{p}.attention.self.query"),
+                "key": lin(f"{p}.attention.self.key"),
+                "value": lin(f"{p}.attention.self.value"),
+                "query_global": lin(f"{p}.attention.self.query_global"),
+                "key_global": lin(f"{p}.attention.self.key_global"),
+                "value_global": lin(f"{p}.attention.self.value_global"),
+            },
+            "attention_output_dense": lin(f"{p}.attention.output.dense"),
+            "attention_ln": ln(f"{p}.attention.output.LayerNorm"),
+            "intermediate_dense": lin(f"{p}.intermediate.dense"),
+            "output_dense": lin(f"{p}.output.dense"),
+            "output_ln": ln(f"{p}.output.LayerNorm"),
+        }
+        return out
+
+    lf = {
+        "word_embeddings": {
+            "embedding": t("longformer.embeddings.word_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding":
+                t("longformer.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("longformer.embeddings.LayerNorm"),
+    }
+    if not config.use_rotary:
+        lf["position_embeddings"] = {"embedding": pos}
+    for i in range(config.num_hidden_layers):
+        lf[f"layer_{i}"] = layer(i)
+    if "longformer.pooler.dense.weight" in state_dict:
+        lf["pooler"] = lin("longformer.pooler.dense")
+
+    params: dict = {"longformer": lf}
+    if "lm_head.dense.weight" in state_dict:
+        params["transform_dense"] = lin("lm_head.dense")
+        params["transform_ln"] = ln("lm_head.layer_norm")
+        params["bias"] = t("lm_head.bias")
+    return params

@@ -1,0 +1,54 @@
+"""torch(HF) → jax weights for Pegasus.
+
+Importer for released Randeng-Pegasus checkpoints (the reference uses HF
+PegasusForConditionalGeneration directly,
+reference: fengshen/examples/pegasus/pretrain_pegasus.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.pegasus.modeling_pegasus import PegasusConfig
+from fengshen_tpu.utils.convert_common import (make_helpers,
+                                               seq2seq_attention)
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: PegasusConfig) -> dict:
+    t, lin, ln = make_helpers(state_dict)
+
+    def enc_layer(i):
+        p = f"model.encoder.layers.{i}"
+        return {
+            "self_attn": seq2seq_attention(state_dict, f"{p}.self_attn"),
+            "self_attn_layer_norm": ln(f"{p}.self_attn_layer_norm"),
+            "fc1": lin(f"{p}.fc1"),
+            "fc2": lin(f"{p}.fc2"),
+            "final_layer_norm": ln(f"{p}.final_layer_norm"),
+        }
+
+    def dec_layer(i):
+        p = f"model.decoder.layers.{i}"
+        return {
+            "self_attn": seq2seq_attention(state_dict, f"{p}.self_attn"),
+            "self_attn_layer_norm": ln(f"{p}.self_attn_layer_norm"),
+            "encoder_attn": seq2seq_attention(state_dict,
+                                              f"{p}.encoder_attn"),
+            "encoder_attn_layer_norm": ln(f"{p}.encoder_attn_layer_norm"),
+            "fc1": lin(f"{p}.fc1"),
+            "fc2": lin(f"{p}.fc2"),
+            "final_layer_norm": ln(f"{p}.final_layer_norm"),
+        }
+
+    params: dict = {
+        "shared": {"embedding": t("model.shared.weight")},
+        "encoder_layer_norm": ln("model.encoder.layer_norm"),
+        "decoder_layer_norm": ln("model.decoder.layer_norm"),
+        "final_logits_bias": t("final_logits_bias").reshape(-1),
+    }
+    for i in range(config.encoder_layers):
+        params[f"encoder_layer_{i}"] = enc_layer(i)
+    for i in range(config.decoder_layers):
+        params[f"decoder_layer_{i}"] = dec_layer(i)
+    return params

@@ -1,0 +1,195 @@
+"""Stable Diffusion checkpoint conversion.
+
+Two surfaces:
+
+1. `diffusers_to_original(...)` — behavioural port of the reference's
+   format converter (reference:
+   fengshen/utils/convert_diffusers_to_original_stable_diffusion.py:17-235):
+   remap a HF-diffusers pipeline state dict (unet/vae/text_encoder) into the
+   original CompVis single-checkpoint layout. Pure key arithmetic — works on
+   any Mapping of arrays, no torch required.
+
+2. `text_encoder_to_params(...)` — import the Taiyi-SD Chinese text encoder
+   (a BertModel) into the flax TaiyiStableDiffusion text tower. The UNet /
+   VAE towers of this family are TPU-native re-designs, not diffusers
+   clones, so their released weights go through `diffusers_to_original` for
+   interchange rather than direct tower import.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+# -- (stable-diffusion, diffusers) fixed renames (reference :17-29) ---------
+_UNET_TOP = [
+    ("time_embed.0.", "time_embedding.linear_1."),
+    ("time_embed.2.", "time_embedding.linear_2."),
+    ("input_blocks.0.0.", "conv_in."),
+    ("out.0.", "conv_norm_out."),
+    ("out.2.", "conv_out."),
+]
+
+_UNET_RESNET = [
+    ("in_layers.0.", "norm1."),
+    ("in_layers.2.", "conv1."),
+    ("out_layers.0.", "norm2."),
+    ("out_layers.3.", "conv2."),
+    ("emb_layers.1.", "time_emb_proj."),
+    ("skip_connection.", "conv_shortcut."),
+]
+
+
+def _unet_layer_map() -> list[tuple[str, str]]:
+    """Block-index arithmetic between the two layouts (reference :41-90)."""
+    pairs = []
+    for i in range(4):
+        for j in range(2):
+            pairs.append((f"input_blocks.{3 * i + j + 1}.0.",
+                          f"down_blocks.{i}.resnets.{j}."))
+            if i < 3:
+                pairs.append((f"input_blocks.{3 * i + j + 1}.1.",
+                              f"down_blocks.{i}.attentions.{j}."))
+        for j in range(3):
+            pairs.append((f"output_blocks.{3 * i + j}.0.",
+                          f"up_blocks.{i}.resnets.{j}."))
+            if i > 0:
+                pairs.append((f"output_blocks.{3 * i + j}.1.",
+                              f"up_blocks.{i}.attentions.{j}."))
+        if i < 3:
+            pairs.append((f"input_blocks.{3 * (i + 1)}.0.op.",
+                          f"down_blocks.{i}.downsamplers.0.conv."))
+            pairs.append((f"output_blocks.{3 * i + 2}."
+                          f"{1 if i == 0 else 2}.",
+                          f"up_blocks.{i}.upsamplers.0."))
+    pairs.append(("middle_block.1.", "mid_block.attentions.0."))
+    for j in range(2):
+        pairs.append((f"middle_block.{2 * j}.", f"mid_block.resnets.{j}."))
+    return pairs
+
+
+def convert_unet_state_dict(unet_state: Mapping[str, Any]) -> dict:
+    """diffusers UNet keys → original SD keys (reference :93-110)."""
+    mapping = {k: k for k in unet_state}
+    for sd_name, hf_name in _UNET_TOP:
+        for k in list(mapping):
+            if k.startswith(hf_name):
+                mapping[k] = sd_name + k[len(hf_name):]
+    for k, v in mapping.items():
+        if "resnets" in k:
+            for sd_part, hf_part in _UNET_RESNET:
+                v = v.replace(hf_part, sd_part)
+            mapping[k] = v
+    layer_map = _unet_layer_map()
+    for k, v in mapping.items():
+        for sd_part, hf_part in layer_map:
+            v = v.replace(hf_part, sd_part)
+        mapping[k] = v
+    return {v: unet_state[k] for k, v in mapping.items()}
+
+
+def _vae_map() -> list[tuple[str, str]]:
+    pairs = [
+        ("nin_shortcut", "conv_shortcut"),
+        ("norm_out", "conv_norm_out"),
+        ("mid.attn_1.", "mid_block.attentions.0."),
+    ]
+    for i in range(4):
+        for j in range(2):
+            pairs.append((f"encoder.down.{i}.block.{j}.",
+                          f"encoder.down_blocks.{i}.resnets.{j}."))
+        if i < 3:
+            pairs.append((f"down.{i}.downsample.",
+                          f"down_blocks.{i}.downsamplers.0."))
+            pairs.append((f"up.{3 - i}.upsample.",
+                          f"up_blocks.{i}.upsamplers.0."))
+        for j in range(3):
+            pairs.append((f"decoder.up.{3 - i}.block.{j}.",
+                          f"decoder.up_blocks.{i}.resnets.{j}."))
+    for i in range(2):
+        pairs.append((f"mid.block_{i + 1}.", f"mid_block.resnets.{i}."))
+    return pairs
+
+
+_VAE_ATTN = [
+    ("norm.", "group_norm."),
+    ("q.", "query."),
+    ("k.", "key."),
+    ("v.", "value."),
+    ("proj_out.", "proj_attn."),
+]
+
+
+def convert_vae_state_dict(vae_state: Mapping[str, Any]) -> dict:
+    """diffusers VAE keys → original SD keys, reshaping the mid-attention
+    linear weights to 1x1 convs (reference :167-186)."""
+    import numpy as np
+    mapping = {k: k for k in vae_state}
+    vae_map = _vae_map()
+    for k, v in mapping.items():
+        for sd_part, hf_part in vae_map:
+            v = v.replace(hf_part, sd_part)
+        mapping[k] = v
+    for k, v in mapping.items():
+        if "attentions" in k:
+            for sd_part, hf_part in _VAE_ATTN:
+                v = v.replace(hf_part, sd_part)
+            mapping[k] = v
+    out = {v: vae_state[k] for k, v in mapping.items()}
+    patterns = tuple(f"mid.attn_1.{n}.weight" for n in
+                     ("q", "k", "v", "proj_out"))
+    for key, w in list(out.items()):
+        if any(p in key for p in patterns):
+            arr = w.detach().cpu().numpy() if hasattr(w, "detach") else \
+                np.asarray(w)
+            out[key] = arr.reshape(*arr.shape, 1, 1)
+    return out
+
+
+def diffusers_to_original(unet_state: Mapping[str, Any],
+                          vae_state: Mapping[str, Any],
+                          text_enc_state: Mapping[str, Any]) -> dict:
+    """Assemble the single original-format checkpoint dict
+    (reference :212-233; text encoder is a prefix-only no-op)."""
+    out = {}
+    out.update({"model.diffusion_model." + k: v for k, v in
+                convert_unet_state_dict(unet_state).items()})
+    out.update({"first_stage_model." + k: v for k, v in
+                convert_vae_state_dict(vae_state).items()})
+    out.update({"cond_stage_model.transformer." + k: v
+                for k, v in text_enc_state.items()})
+    return out
+
+
+def text_encoder_to_params(state_dict: Mapping[str, Any],
+                           text_config) -> dict:
+    """Taiyi-SD Chinese text encoder (HF BertModel state dict) → the flax
+    TaiyiStableDiffusion `text_encoder` params subtree."""
+    from fengshen_tpu.models.bert.convert import model_to_params
+    return model_to_params(state_dict, text_config)
+
+
+def main(argv=None):
+    """CLI parity with the reference script (reference :199-235)."""
+    import argparse
+    import os.path as osp
+
+    import torch
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", type=str, required=True)
+    parser.add_argument("--checkpoint_path", type=str, required=True)
+    parser.add_argument("--half", action="store_true")
+    args = parser.parse_args(argv)
+
+    load = lambda *p: torch.load(osp.join(*p), map_location="cpu")  # noqa
+    state = diffusers_to_original(
+        load(args.model_path, "unet", "diffusion_pytorch_model.bin"),
+        load(args.model_path, "vae", "diffusion_pytorch_model.bin"),
+        load(args.model_path, "text_encoder", "pytorch_model.bin"))
+    if args.half:
+        state = {k: v.half() for k, v in state.items()}
+    torch.save({"state_dict": state}, args.checkpoint_path)
+
+
+if __name__ == "__main__":
+    main()

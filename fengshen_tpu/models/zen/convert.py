@@ -1,0 +1,44 @@
+"""torch → jax weights for ZEN (n-gram enhanced BERT).
+
+Importer for released Erlangshen-ZEN checkpoints
+(reference: fengshen/models/zen1/modeling.py — BertEmbeddings for chars,
+BertWordEmbeddings for n-grams (:225-248), encoder with `layer` +
+`word_layers` side stack (:426-442)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.zen.modeling_zen import ZenConfig
+from fengshen_tpu.utils.convert_common import bert_layer, make_helpers
+
+
+def torch_to_params(state_dict: Mapping[str, Any],
+                    config: ZenConfig) -> dict:
+    sd = state_dict
+    if not any(k.startswith("bert.") for k in sd):
+        sd = {f"bert.{k}": v for k, v in sd.items()}
+    t, lin, ln = make_helpers(sd)
+
+    params: dict = {
+        "word_embeddings": {
+            "embedding": t("bert.embeddings.word_embeddings.weight")},
+        "position_embeddings": {
+            "embedding": t("bert.embeddings.position_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding": t("bert.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+        # n-gram side embeddings (reference BertWordEmbeddings :225-248)
+        "ngram_embeddings": {
+            "embedding": t("bert.word_embeddings.word_embeddings.weight")},
+        "ngram_ln": ln("bert.word_embeddings.LayerNorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        params[f"layer_{i}"] = bert_layer(sd, f"bert.encoder.layer.{i}")
+    for i in range(config.num_ngram_layers):
+        params[f"ngram_layer_{i}"] = bert_layer(
+            sd, f"bert.encoder.word_layers.{i}")
+    if "bert.pooler.dense.weight" in sd:
+        params["pooler"] = lin("bert.pooler.dense")
+    return params

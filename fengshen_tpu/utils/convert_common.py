@@ -1,0 +1,60 @@
+"""Shared torch→flax weight-mapping helpers used by the per-family
+convert.py modules (pattern: fengshen_tpu/models/llama/convert.py;
+replaces the reference's per-family conversion scripts under
+fengshen/utils/)."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def tensor(state_dict: Mapping[str, Any], name: str) -> np.ndarray:
+    x = state_dict[name]
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def make_helpers(state_dict: Mapping[str, Any]):
+    """(t, lin, ln) closures over one state dict: raw tensor, transposed
+    Linear, LayerNorm scale/bias."""
+
+    def t(name):
+        return tensor(state_dict, name)
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}.weight").T,
+                "bias": t(f"{prefix}.bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}
+
+    return t, lin, ln
+
+
+def bert_layer(state_dict: Mapping[str, Any], prefix: str) -> dict:
+    """HF BERT encoder layer → the shared flax BertLayer naming
+    (query/key/value/attention_output_dense/attention_ln/
+    intermediate_dense/output_dense/output_ln)."""
+    _, lin, ln = make_helpers(state_dict)
+    return {
+        "query": lin(f"{prefix}.attention.self.query"),
+        "key": lin(f"{prefix}.attention.self.key"),
+        "value": lin(f"{prefix}.attention.self.value"),
+        "attention_output_dense": lin(f"{prefix}.attention.output.dense"),
+        "attention_ln": ln(f"{prefix}.attention.output.LayerNorm"),
+        "intermediate_dense": lin(f"{prefix}.intermediate.dense"),
+        "output_dense": lin(f"{prefix}.output.dense"),
+        "output_ln": ln(f"{prefix}.output.LayerNorm"),
+    }
+
+
+def seq2seq_attention(state_dict: Mapping[str, Any], prefix: str) -> dict:
+    """HF BART-family attention block (q/k/v/out_proj)."""
+    _, lin, _ = make_helpers(state_dict)
+    return {"q_proj": lin(f"{prefix}.q_proj"),
+            "k_proj": lin(f"{prefix}.k_proj"),
+            "v_proj": lin(f"{prefix}.v_proj"),
+            "out_proj": lin(f"{prefix}.out_proj")}

@@ -1,0 +1,377 @@
+"""Weight-importer round-trip tests for the round-2 converter batch
+(bert, pegasus, longformer, clip, deltalm, zen, hubert, SD) — forward
+parity against HF torch oracles where transformers ships the family, and
+structural load tests otherwise (pattern: tests/test_llama.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _np(x):
+    return x.detach().cpu().numpy() if hasattr(x, "detach") else np.asarray(x)
+
+
+def test_bert_convert_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.bert import BertConfig, BertForMaskedLM
+    from fengshen_tpu.models.bert.convert import torch_to_params
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_act="gelu")
+    torch.manual_seed(0)
+    tm = transformers.BertForMaskedLM(hf_cfg).eval()
+
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, hidden_act="gelu",
+                     dtype="float32")
+    params = torch_to_params(tm.state_dict(), cfg)
+    ids = np.array([[2, 17, 9, 42, 7, 99, 1, 5]], np.int32)
+    logits = BertForMaskedLM(cfg).apply({"params": params},
+                                        jnp.asarray(ids))
+    with torch.no_grad():
+        ref = tm(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-3)
+
+
+def test_pegasus_convert_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.pegasus import PegasusConfig
+    from fengshen_tpu.models.pegasus.modeling_pegasus import (
+        PegasusForConditionalGeneration)
+    from fengshen_tpu.models.pegasus.convert import torch_to_params
+
+    hf_cfg = transformers.PegasusConfig(
+        vocab_size=120, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, activation_function="relu",
+        scale_embedding=False)
+    torch.manual_seed(0)
+    tm = transformers.PegasusForConditionalGeneration(hf_cfg).eval()
+
+    cfg = PegasusConfig(vocab_size=120, d_model=32, encoder_layers=2,
+                        decoder_layers=2, encoder_attention_heads=4,
+                        decoder_attention_heads=4, encoder_ffn_dim=64,
+                        decoder_ffn_dim=64, max_position_embeddings=64,
+                        activation_function="relu", scale_embedding=False,
+                        dtype="float32")
+    params = torch_to_params(tm.state_dict(), cfg)
+    enc = np.array([[2, 17, 9, 42]], np.int32)
+    dec = np.array([[0, 5, 7, 1]], np.int32)
+    logits = PegasusForConditionalGeneration(cfg).apply(
+        {"params": params}, jnp.asarray(enc), jnp.asarray(dec))
+    with torch.no_grad():
+        ref = tm(input_ids=torch.tensor(enc, dtype=torch.long),
+                 decoder_input_ids=torch.tensor(dec, dtype=torch.long)
+                 ).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-3)
+
+
+def test_longformer_convert_window_parity():
+    """Pure sliding-window case (no globals, no padding): our banded
+    attention equals HF LongformerModel, so the converter is verified by
+    forward parity."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.longformer.modeling_longformer import (
+        LongformerConfig, LongformerModel)
+    from fengshen_tpu.models.longformer.convert import torch_to_params
+
+    hf_cfg = transformers.LongformerConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=66, attention_window=[8, 8],
+        pad_token_id=0)
+    torch.manual_seed(0)
+    tm = transformers.LongformerModel(hf_cfg, add_pooling_layer=False).eval()
+
+    cfg = LongformerConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, attention_window=8, dtype="float32")
+    state = {f"longformer.{k}": v for k, v in tm.state_dict().items()}
+    params = torch_to_params(state, cfg)["longformer"]
+
+    seq = 16  # multiple of the window (HF requirement)
+    ids = np.array([np.arange(2, 2 + seq)], np.int32)
+    hidden, _ = LongformerModel(cfg, add_pooling_layer=False).apply(
+        {"params": params}, jnp.asarray(ids))
+    with torch.no_grad():
+        # HF positions are offset by pad_token_id+1=1+... (RoBERTa style);
+        # pin them to match arange used on the flax side
+        pos = torch.arange(2, 2 + seq)[None]
+        ref = tm(torch.tensor(ids, dtype=torch.long),
+                 position_ids=pos).last_hidden_state.numpy()
+    np.testing.assert_allclose(np.asarray(hidden), ref, atol=3e-3)
+
+
+def test_clip_vision_convert_forward_parity():
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.clip import CLIPVisionConfig
+    from fengshen_tpu.models.clip.modeling_taiyi_clip import (
+        CLIPVisionTransformer)
+    from fengshen_tpu.models.clip.convert import vision_to_params
+
+    hf_cfg = transformers.CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, image_size=32, patch_size=8,
+        projection_dim=16)
+    torch.manual_seed(0)
+    tm = transformers.CLIPVisionModel(hf_cfg).eval()
+
+    cfg = CLIPVisionConfig(hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           image_size=32, patch_size=8, projection_dim=16,
+                           dtype="float32")
+    params = vision_to_params(tm.state_dict(), cfg)
+    rng = np.random.RandomState(0)
+    pixels = rng.randn(1, 32, 32, 3).astype(np.float32)
+    hidden, pooled = CLIPVisionTransformer(cfg).apply(
+        {"params": params}, jnp.asarray(pixels))
+    with torch.no_grad():
+        ref = tm(torch.tensor(pixels.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(pooled),
+                               ref.pooler_output.numpy(), atol=2e-3)
+
+
+def _fake_state(shapes):
+    rng = np.random.RandomState(0)
+    return {k: rng.randn(*v).astype(np.float32) * 0.02 for k, v in
+            shapes.items()}
+
+
+def test_deltalm_convert_structural_roundtrip():
+    """No torch DeltaLM oracle exists in this env; verify that a synthetic
+    reference-named state dict converts into exactly the flax param tree
+    and that the model runs with it."""
+    from fengshen_tpu.models.deltalm import (DeltaLMConfig,
+                                             DeltaLMForConditionalGeneration)
+    from fengshen_tpu.models.deltalm.convert import torch_to_params
+
+    cfg = DeltaLMConfig.small_test_config()
+    model = DeltaLMForConditionalGeneration(cfg)
+    ids = jnp.zeros((1, 4), jnp.int32)
+    init = model.init(jax.random.PRNGKey(0), ids, ids)["params"]
+
+    d, f = cfg.d_model, cfg.encoder_ffn_dim
+    shapes = {"encoder.embed_tokens.weight": (cfg.vocab_size, d),
+              "encoder.embed_positions.weight": (
+                  cfg.max_position_embeddings + 2, d)}
+    for pre, n in (("encoder", cfg.encoder_layers),
+                   ("decoder", cfg.decoder_layers)):
+        shapes[f"{pre}.layernorm_embedding.weight"] = (d,)
+        shapes[f"{pre}.layernorm_embedding.bias"] = (d,)
+        shapes[f"{pre}.layer_norm.weight"] = (d,)
+        shapes[f"{pre}.layer_norm.bias"] = (d,)
+        for i in range(n):
+            p = f"{pre}.layers.{i}"
+            for att in (["self_attn"] if pre == "encoder" else
+                        ["self_attn", "encoder_attn"]):
+                for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                    shapes[f"{p}.{att}.{proj}.weight"] = (d, d)
+                    shapes[f"{p}.{att}.{proj}.bias"] = (d,)
+                shapes[f"{p}.{att}_layer_norm.weight"] = (d,)
+                shapes[f"{p}.{att}_layer_norm.bias"] = (d,)
+            fcs = ("fc1", "fc2") if pre == "encoder" else \
+                ("fc1", "fc2", "fc3", "fc4")
+            for fc in fcs:
+                wide = fc in ("fc1", "fc3")
+                shapes[f"{p}.{fc}.weight"] = (f, d) if wide else (d, f)
+                shapes[f"{p}.{fc}.bias"] = (f,) if wide else (d,)
+            shapes[f"{p}.final_layer_norm.weight"] = (d,)
+            shapes[f"{p}.final_layer_norm.bias"] = (d,)
+            if pre == "decoder":
+                shapes[f"{p}.ffn_layer_norm.weight"] = (d,)
+                shapes[f"{p}.ffn_layer_norm.bias"] = (d,)
+
+    params = torch_to_params(_fake_state(shapes), cfg)
+    # exact tree match with the flax init (same keys, same shapes)
+    flat_init = jax.tree_util.tree_map(lambda x: x.shape, init)
+    flat_conv = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+    # embed_positions row count may differ (fairseq +2 offset kept as-is)
+    flat_init["embed_positions"] = flat_conv["embed_positions"]
+    assert flat_init == flat_conv
+    logits = model.apply({"params": params}, ids, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_zen_convert_structural_roundtrip():
+    from fengshen_tpu.models.zen import ZenConfig, ZenModel
+    from fengshen_tpu.models.zen.convert import torch_to_params
+
+    cfg = ZenConfig.small_test_config()
+    model = ZenModel(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    ngram_ids = jnp.zeros((1, 4), jnp.int32)
+    ngram_pos = jnp.zeros((1, 8, 4), jnp.int32)
+    init = model.init(jax.random.PRNGKey(0), ids, ngram_ids,
+                      ngram_pos)["params"]
+
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    shapes = {
+        "bert.embeddings.word_embeddings.weight": (cfg.vocab_size, d),
+        "bert.embeddings.position_embeddings.weight": (
+            cfg.max_position_embeddings, d),
+        "bert.embeddings.token_type_embeddings.weight": (
+            cfg.type_vocab_size, d),
+        "bert.embeddings.LayerNorm.weight": (d,),
+        "bert.embeddings.LayerNorm.bias": (d,),
+        "bert.word_embeddings.word_embeddings.weight": (
+            cfg.ngram_vocab_size, d),
+        "bert.word_embeddings.LayerNorm.weight": (d,),
+        "bert.word_embeddings.LayerNorm.bias": (d,),
+        "bert.pooler.dense.weight": (d, d),
+        "bert.pooler.dense.bias": (d,),
+    }
+
+    def bert_layer_shapes(p):
+        for sub in ("attention.self.query", "attention.self.key",
+                    "attention.self.value", "attention.output.dense"):
+            shapes[f"{p}.{sub}.weight"] = (d, d)
+            shapes[f"{p}.{sub}.bias"] = (d,)
+        shapes[f"{p}.attention.output.LayerNorm.weight"] = (d,)
+        shapes[f"{p}.attention.output.LayerNorm.bias"] = (d,)
+        shapes[f"{p}.intermediate.dense.weight"] = (f, d)
+        shapes[f"{p}.intermediate.dense.bias"] = (f,)
+        shapes[f"{p}.output.dense.weight"] = (d, f)
+        shapes[f"{p}.output.dense.bias"] = (d,)
+        shapes[f"{p}.output.LayerNorm.weight"] = (d,)
+        shapes[f"{p}.output.LayerNorm.bias"] = (d,)
+
+    for i in range(cfg.num_hidden_layers):
+        bert_layer_shapes(f"bert.encoder.layer.{i}")
+    for i in range(cfg.num_ngram_layers):
+        bert_layer_shapes(f"bert.encoder.word_layers.{i}")
+
+    params = torch_to_params(_fake_state(shapes), cfg)
+    assert jax.tree_util.tree_map(lambda x: x.shape, init) == \
+        jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+    hidden, pooled = model.apply({"params": params}, ids, ngram_ids,
+                                 ngram_pos)
+    assert np.isfinite(np.asarray(hidden)).all()
+
+
+def test_hubert_convert_structural_roundtrip():
+    from fengshen_tpu.models.hubert import HubertConfig, HubertModel
+    from fengshen_tpu.models.hubert.convert import torch_to_params
+
+    cfg = HubertConfig.small_test_config()
+    model = HubertModel(cfg)
+    wav = jnp.zeros((1, 400))
+    init = model.init(jax.random.PRNGKey(0), wav)["params"]
+
+    d = cfg.hidden_size
+    shapes = {}
+    in_ch = 1
+    for i, (ch, k, s) in enumerate(cfg.conv_layers):
+        shapes[f"feature_extractor.conv_layers.{i}.conv.weight"] = (
+            ch, in_ch, k)
+        in_ch = ch
+    shapes["feature_extractor.conv_layers.0.layer_norm.weight"] = (
+        cfg.conv_layers[0][0],)
+    shapes["feature_extractor.conv_layers.0.layer_norm.bias"] = (
+        cfg.conv_layers[0][0],)
+    shapes["feature_projection.projection.weight"] = (d, in_ch)
+    shapes["feature_projection.projection.bias"] = (d,)
+    shapes["feature_projection.layer_norm.weight"] = (d,)
+    shapes["feature_projection.layer_norm.bias"] = (d,)
+    shapes["masked_spec_embed"] = (d,)
+    shapes["encoder.pos_conv_embed.conv.weight_g"] = (d, 1, 1)
+    shapes["encoder.pos_conv_embed.conv.weight_v"] = (
+        d, d // cfg.pos_conv_groups, cfg.pos_conv_kernel)
+    shapes["encoder.pos_conv_embed.conv.bias"] = (d,)
+    for i in range(cfg.num_hidden_layers):
+        p = f"encoder.layers.{i}"
+        for sub in ("attention.q_proj", "attention.k_proj",
+                    "attention.v_proj", "attention.out_proj"):
+            shapes[f"{p}.{sub}.weight"] = (d, d)
+            shapes[f"{p}.{sub}.bias"] = (d,)
+        shapes[f"{p}.layer_norm.weight"] = (d,)
+        shapes[f"{p}.layer_norm.bias"] = (d,)
+        shapes[f"{p}.feed_forward.intermediate_dense.weight"] = (
+            cfg.intermediate_size, d)
+        shapes[f"{p}.feed_forward.intermediate_dense.bias"] = (
+            cfg.intermediate_size,)
+        shapes[f"{p}.feed_forward.output_dense.weight"] = (
+            d, cfg.intermediate_size)
+        shapes[f"{p}.feed_forward.output_dense.bias"] = (d,)
+        shapes[f"{p}.final_layer_norm.weight"] = (d,)
+        shapes[f"{p}.final_layer_norm.bias"] = (d,)
+    shapes["final_proj.weight"] = (cfg.num_clusters, d)
+    shapes["final_proj.bias"] = (cfg.num_clusters,)
+
+    params = torch_to_params(_fake_state(shapes), cfg)
+    assert jax.tree_util.tree_map(lambda x: x.shape, init) == \
+        jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+    logits, _ = model.apply({"params": params}, wav)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_sd_diffusers_to_original_keymap():
+    """Key-arithmetic parity with the reference converter on representative
+    keys (reference: convert_diffusers_to_original_stable_diffusion.py)."""
+    from fengshen_tpu.models.stable_diffusion.convert import (
+        convert_unet_state_dict, convert_vae_state_dict,
+        diffusers_to_original)
+
+    unet = {
+        "time_embedding.linear_1.weight": np.zeros((4, 4)),
+        "conv_in.weight": np.zeros((4, 4, 3, 3)),
+        "down_blocks.0.resnets.0.norm1.weight": np.zeros((4,)),
+        "down_blocks.0.resnets.1.time_emb_proj.weight": np.zeros((4, 4)),
+        "down_blocks.1.attentions.0.proj_in.weight": np.zeros((4, 4)),
+        "down_blocks.0.downsamplers.0.conv.weight": np.zeros((4, 4, 3, 3)),
+        "up_blocks.2.resnets.2.conv_shortcut.weight": np.zeros((4, 4, 1, 1)),
+        "mid_block.attentions.0.proj_out.weight": np.zeros((4, 4)),
+        "mid_block.resnets.1.conv1.weight": np.zeros((4, 4, 3, 3)),
+        "conv_norm_out.weight": np.zeros((4,)),
+    }
+    out = convert_unet_state_dict(unet)
+    for key in ("time_embed.0.weight", "input_blocks.0.0.weight",
+                "input_blocks.1.0.in_layers.0.weight",
+                "input_blocks.2.0.emb_layers.1.weight",
+                "input_blocks.4.1.proj_in.weight",
+                "input_blocks.3.0.op.weight",
+                "output_blocks.8.0.skip_connection.weight",
+                "middle_block.1.proj_out.weight",
+                "middle_block.2.in_layers.2.weight",
+                "out.0.weight"):
+        assert key in out, (key, sorted(out))
+
+    vae = {
+        "encoder.down_blocks.0.resnets.0.conv1.weight":
+            np.zeros((4, 4, 3, 3)),
+        "encoder.down_blocks.0.downsamplers.0.conv.weight":
+            np.zeros((4, 4, 3, 3)),
+        "decoder.up_blocks.1.resnets.2.conv_shortcut.weight":
+            np.zeros((4, 4, 1, 1)),
+        "encoder.mid_block.attentions.0.query.weight": np.zeros((4, 4)),
+        "decoder.mid_block.resnets.0.conv2.weight": np.zeros((4, 4, 3, 3)),
+    }
+    out = convert_vae_state_dict(vae)
+    assert "encoder.down.0.block.0.conv1.weight" in out
+    assert "encoder.down.0.downsample.conv.weight" in out
+    assert "decoder.up.2.block.2.nin_shortcut.weight" in out
+    assert "encoder.mid.attn_1.q.weight" in out
+    # mid-attention linears are reshaped to 1x1 convs
+    assert out["encoder.mid.attn_1.q.weight"].shape == (4, 4, 1, 1)
+    assert "decoder.mid.block_1.conv2.weight" in out
+
+    full = diffusers_to_original(unet, vae, {"embeddings.x": np.zeros((2,))})
+    assert "model.diffusion_model.time_embed.0.weight" in full
+    assert "first_stage_model.encoder.down.0.block.0.conv1.weight" in full
+    assert "cond_stage_model.transformer.embeddings.x" in full
