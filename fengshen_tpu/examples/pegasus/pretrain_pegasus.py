@@ -81,7 +81,7 @@ class PegasusGSGCollator(Seq2SeqCollator):
     def _split(self, sample: dict) -> tuple[list[str], set[int]]:
         # source_text and target_text are called back-to-back per sample;
         # memoise the quadratic GSG scoring so it runs once, not twice
-        if getattr(self, "_memo_key", None) is id(sample):
+        if getattr(self, "_memo_key", None) == id(sample):
             return self._memo_val
         sents = split_sentences(sample[self.content_key])
         if not sents:

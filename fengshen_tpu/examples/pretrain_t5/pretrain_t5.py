@@ -68,30 +68,30 @@ class T5PretrainModule(TrainModule):
         return parent_parser
 
     def init_params(self, rng):
-        ids = jnp.zeros((1, 8), jnp.int32)
-        params = self.model.init(rng, ids, ids)["params"]
         keep_path = getattr(self.args, "keep_tokens_path", None)
         model_path = getattr(self.args, "model_path", None)
-        if keep_path:
-            # the vocab trim only makes sense on PRETRAINED weights (the
-            # reference index-selects the loaded mT5 state dict,
-            # pretrain_t5.py:38-49) with the NEW tokenizer whose ids match
-            # keep_tokens order (--new_vocab_path). Require the checkpoint.
-            import os
-            ckpt = os.path.join(model_path or "", "pytorch_model.bin")
-            if not os.path.exists(ckpt):
-                raise ValueError(
-                    "--keep_tokens_path requires a pretrained torch "
-                    f"checkpoint at {ckpt} (trimming random weights would "
-                    "discard nothing and misalign the new vocabulary)")
-            import torch
+        if not keep_path:
+            ids = jnp.zeros((1, 8), jnp.int32)
+            return self.model.init(rng, ids, ids)["params"]
+        # the vocab trim only makes sense on PRETRAINED weights (the
+        # reference index-selects the loaded mT5 state dict,
+        # pretrain_t5.py:38-49) with the NEW tokenizer whose ids match
+        # keep_tokens order (--new_vocab_path). Require the checkpoint.
+        import os
+        ckpt = os.path.join(model_path or "", "pytorch_model.bin")
+        if not os.path.exists(ckpt):
+            raise ValueError(
+                "--keep_tokens_path requires a pretrained torch "
+                f"checkpoint at {ckpt} (trimming random weights would "
+                "discard nothing and misalign the new vocabulary)")
+        import torch
 
-            from fengshen_tpu.models.t5.convert import torch_to_params
-            params = torch_to_params(
-                torch.load(ckpt, map_location="cpu"), self.config)
-            keep = json.load(open(keep_path))
-            params = trim_vocab(params, keep)
-        return params
+        from fengshen_tpu.models.t5.convert import torch_to_params
+        params = torch_to_params(
+            torch.load(ckpt, map_location="cpu"), self.config)
+        with open(keep_path) as f:
+            keep = json.load(f)
+        return trim_vocab(params, keep)
 
     def training_loss(self, params, batch, rng):
         logits = self.model.apply(

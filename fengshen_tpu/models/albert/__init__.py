@@ -6,3 +6,6 @@ from fengshen_tpu.models.albert.modeling_albert import (
 
 __all__ = ["AlbertConfig", "AlbertModel", "AlbertForMaskedLM",
            "AlbertForSequenceClassification"]
+
+from fengshen_tpu.models.albert.task_heads import (AlbertForTokenClassification, AlbertForQuestionAnswering, AlbertForMultipleChoice)
+__all__ += ['AlbertForTokenClassification', 'AlbertForQuestionAnswering', 'AlbertForMultipleChoice']

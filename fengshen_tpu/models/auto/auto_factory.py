@@ -63,6 +63,25 @@ MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
     "deltalm": ("fengshen_tpu.models.deltalm", "DeltaLMConfig",
                 {"conditional_generation":
                      "DeltaLMForConditionalGeneration"}),
+    "zen2": ("fengshen_tpu.models.zen2", "Zen2Config",
+             {"base": "Zen2Model", "masked_lm": "Zen2ForMaskedLM",
+              "sequence_classification": "Zen2ForSequenceClassification",
+              "token_classification": "Zen2ForTokenClassification",
+              "question_answering": "Zen2ForQuestionAnswering"}),
+    "davae": ("fengshen_tpu.models.davae", "DAVAEConfig",
+              {"base": "DAVAEModel"}),
+    "gavae": ("fengshen_tpu.models.gavae", "GAVAEConfig",
+              {"base": "GAVAEModel"}),
+    "ppvae": ("fengshen_tpu.models.ppvae", "PPVAEConfig",
+              {"base": "PPVAEModel"}),
+    "della": ("fengshen_tpu.models.deepvae", "DellaConfig",
+              {"base": "DellaModel"}),
+    "transfo-xl-paraphrase": ("fengshen_tpu.models.transfo_xl_paraphrase",
+                              "TransfoXLParaphraseConfig",
+                              {"base": "TransfoXLParaphraseModel"}),
+    "transfo-xl-reasoning": ("fengshen_tpu.models.transfo_xl_reasoning",
+                             "TransfoXLReasoningConfig",
+                             {"base": "TransfoXLReasoningModel"}),
 }
 
 

@@ -2,6 +2,8 @@
 Randeng-BART seq2seq examples)."""
 
 from fengshen_tpu.models.bart.modeling_bart import (
-    BartConfig, BartModel, BartForConditionalGeneration)
+    BartConfig, BartModel, BartForConditionalGeneration,
+    BartForTextInfill, text_infill_loss)
 
-__all__ = ["BartConfig", "BartModel", "BartForConditionalGeneration"]
+__all__ = ["BartConfig", "BartModel", "BartForConditionalGeneration",
+           "BartForTextInfill", "text_infill_loss"]

@@ -277,3 +277,72 @@ class BartForConditionalGeneration(nn.Module):
 
     def partition_rules(self):
         return PARTITION_RULES
+
+
+class BartForTextInfill(nn.Module):
+    """CBART lexically-constrained generation head
+    (reference: fengshen/models/bart/modeling_bart.py:93-260
+    `BartForTextInfill`): the ENCODER carries a per-token classification
+    head predicting edit operations (copy / replace / insert counts) over
+    the constrained input, while the DECODER reconstructs the full
+    sequence; training optimises decoder CE + loss_weight × encoder CE
+    with per-label weights (the reference's label_weights buffer).
+    """
+
+    config: BartConfig
+    num_labels: int = 3  # copy / replace / insert (reference default)
+    encoder_loss_type: int = 0  # 0 classification, 1 regression
+
+    def setup(self):
+        cfg = self.config
+        self.model = BartModel(cfg, name="model")
+        self.final_logits_bias = self.param(
+            "final_logits_bias", nn.initializers.zeros,
+            (cfg.vocab_size,), jnp.float32)
+        out_dim = self.num_labels if self.encoder_loss_type == 0 else 1
+        self.classification_dense = _dense(cfg, cfg.d_model,
+                                           "classification_dense")
+        self.classification_out = _dense(cfg, out_dim,
+                                         "classification_out")
+
+    def _encoder_logits(self, enc):
+        h = jnp.tanh(self.classification_dense(enc))
+        return self.classification_out(h)
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        enc, dec = self.model(input_ids, decoder_input_ids,
+                              attention_mask, decoder_attention_mask,
+                              deterministic)
+        emb = self.model.shared.embedding
+        lm_logits = dec @ emb.T.astype(dec.dtype) + \
+            self.final_logits_bias.astype(dec.dtype)
+        return lm_logits, self._encoder_logits(enc)
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+def text_infill_loss(lm_logits, labels, encoder_logits, encoder_labels,
+                     loss_weight: float = 1.0, label_weights=None,
+                     encoder_loss_type: int = 0):
+    """decoder CE + loss_weight × encoder edit-op loss
+    (reference: modeling_bart.py:207-245)."""
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+    dec_loss, _ = stable_cross_entropy(lm_logits, labels)
+    if encoder_loss_type == 0:
+        valid = encoder_labels != -100
+        safe = jnp.where(valid, encoder_labels, 0)
+        logp = jax.nn.log_softmax(encoder_logits.astype(jnp.float32), -1)
+        token_ce = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        if label_weights is not None:
+            w = jnp.asarray(label_weights)[safe]
+            token_ce = token_ce * w
+        enc_loss = (token_ce * valid).sum() / jnp.maximum(valid.sum(), 1)
+    else:  # regression on insert counts
+        valid = encoder_labels >= 0
+        diff = (encoder_logits[..., 0] -
+                encoder_labels.astype(jnp.float32)) ** 2
+        enc_loss = (diff * valid).sum() / jnp.maximum(valid.sum(), 1)
+    total = dec_loss + loss_weight * enc_loss
+    return total, {"decoder_loss": dec_loss, "encoder_loss": enc_loss}

@@ -6,3 +6,6 @@ from fengshen_tpu.models.bert.modeling_bert import (BertConfig, BertModel,
                                                     BertForMaskedLM)
 
 __all__ = ["BertConfig", "BertModel", "BertForMaskedLM"]
+
+from fengshen_tpu.models.bert.task_heads import (BertForSequenceClassification, BertForTokenClassification, BertForQuestionAnswering, BertForMultipleChoice)
+__all__ += ['BertForSequenceClassification', 'BertForTokenClassification', 'BertForQuestionAnswering', 'BertForMultipleChoice']

@@ -1,0 +1,10 @@
+"""DAVAE family (reference: fengshen/models/DAVAE/, 1,329 LoC)."""
+
+from fengshen_tpu.models.davae.modeling_davae import (
+    DAVAEConfig, DAVAEModel, LatentCritic, davae_losses, word_dropout,
+    latent_code_from_text_batch, text_from_latent_code_batch,
+    simulate_batch)
+
+__all__ = ["DAVAEConfig", "DAVAEModel", "LatentCritic", "davae_losses",
+           "word_dropout", "latent_code_from_text_batch",
+           "text_from_latent_code_batch", "simulate_batch"]

@@ -7,3 +7,6 @@ from fengshen_tpu.models.deberta_v2.modeling_deberta_v2 import (
 
 __all__ = ["DebertaV2Config", "DebertaV2Model", "DebertaV2ForMaskedLM",
            "DebertaV2ForSequenceClassification"]
+
+from fengshen_tpu.models.deberta_v2.task_heads import (DebertaV2ForTokenClassification, DebertaV2ForQuestionAnswering, DebertaV2ForMultipleChoice)
+__all__ += ['DebertaV2ForTokenClassification', 'DebertaV2ForQuestionAnswering', 'DebertaV2ForMultipleChoice']

@@ -1,0 +1,7 @@
+"""Della / deepVAE family (reference: fengshen/models/deepVAE/, 947 LoC)."""
+
+from fengshen_tpu.models.deepvae.modeling_deepvae import (
+    DellaConfig, DellaModel, AverageSelfAttention, LatentLayer, della_loss)
+
+__all__ = ["DellaConfig", "DellaModel", "AverageSelfAttention",
+           "LatentLayer", "della_loss"]

@@ -1,0 +1,8 @@
+"""GAVAE family (reference: fengshen/models/GAVAE/, 551 LoC)."""
+
+from fengshen_tpu.models.gavae.modeling_gavae import (
+    GAVAEConfig, GAVAEModel, LatentGenerator, LatentDiscriminator,
+    gan_d_step, gan_g_step)
+
+__all__ = ["GAVAEConfig", "GAVAEModel", "LatentGenerator",
+           "LatentDiscriminator", "gan_d_step", "gan_g_step"]

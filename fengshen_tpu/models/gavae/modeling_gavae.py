@@ -1,0 +1,183 @@
+"""GAVAE — GAN-augmented VAE for labelled text generation.
+
+Behavioural port of reference: fengshen/models/GAVAE/ (551 LoC):
+a latent-space GAN on top of the DAVAE text autoencoder — `gans_process`
+trains a generator MLP (noise+label → latent) against a
+discriminator/classifier MLP over latents (gans_model.py:37-135), and
+`GAVAEModel.generate(n)` decodes generator samples back to text
+(GAVAEModel.py:44-66). Here generator/discriminator are flax modules with
+optax training steps; decoding reuses the DAVAE surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from fengshen_tpu.models.davae.modeling_davae import (
+    DAVAEConfig, DAVAEModel, text_from_latent_code_batch)
+
+
+@dataclasses.dataclass
+class GAVAEConfig:
+    latent_size: int = 128
+    noise_size: int = 64
+    gan_hidden: int = 128
+    cls_num: int = 2
+    gan_lr: float = 1e-4
+    vae: DAVAEConfig = None
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "GAVAEConfig":
+        vae = DAVAEConfig.small_test_config()
+        base = dict(latent_size=vae.latent_size, noise_size=8,
+                    gan_hidden=16, vae=vae)
+        base.update(overrides)
+        return cls(**base)
+
+
+class LatentGenerator(nn.Module):
+    """noise (+ one-hot label) → latent (reference: gans_model.py:101-133
+    gen_model)."""
+
+    latent_size: int
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, noise, labels_onehot=None):
+        x = noise if labels_onehot is None else \
+            jnp.concatenate([noise, labels_onehot], -1)
+        x = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc1")(x))
+        x = jax.nn.leaky_relu(nn.Dense(2 * self.hidden, name="fc2")(x))
+        x = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc3")(x))
+        return nn.Dense(self.latent_size, name="out")(x)
+
+
+class LatentDiscriminator(nn.Module):
+    """latent → [real classes..., fake] logits (reference:
+    gans_model.py:37-99 cls_model — the discriminator doubles as the
+    class-conditional critic)."""
+
+    cls_num: int = 2
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, z):
+        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc1")(z))
+        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc2")(h))
+        return nn.Dense(self.cls_num + 1, name="out")(h)  # +1 = fake class
+
+
+def _ce(logits, labels):
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, labels[:, None], 1).mean()
+
+
+def gan_d_step(disc, d_params, gen, g_params, real_latents, real_labels,
+               rng, noise_size: int):
+    """Discriminator update target: real latents → their class, generated
+    latents → the fake class."""
+    batch = real_latents.shape[0]
+    fake_cls = disc.cls_num
+    noise = jax.random.normal(rng, (batch, noise_size))
+    onehot = jax.nn.one_hot(real_labels, disc.cls_num)
+    fake = gen.apply({"params": g_params}, noise, onehot)
+
+    def loss_fn(p):
+        real_logits = disc.apply({"params": p}, real_latents)
+        fake_logits = disc.apply({"params": p}, fake)
+        return (_ce(real_logits, real_labels) +
+                _ce(fake_logits,
+                    jnp.full((batch,), fake_cls, jnp.int32)))
+
+    return jax.value_and_grad(loss_fn)(d_params)
+
+
+def gan_g_step(disc, d_params, gen, g_params, labels, rng,
+               noise_size: int):
+    """Generator update target: generated latents classified as their
+    conditioning class (not fake)."""
+    batch = labels.shape[0]
+    noise = jax.random.normal(rng, (batch, noise_size))
+    onehot = jax.nn.one_hot(labels, disc.cls_num)
+
+    def loss_fn(p):
+        fake = gen.apply({"params": p}, noise, onehot)
+        logits = disc.apply({"params": d_params}, fake)
+        return _ce(logits, labels)
+
+    return jax.value_and_grad(loss_fn)(g_params)
+
+
+class GAVAEModel:
+    """train_gan / generate surface (reference: GAVAEModel.py:35-66)."""
+
+    def __init__(self, config: GAVAEConfig,
+                 vae_model: Optional[DAVAEModel] = None,
+                 vae_params=None):
+        self.config = config
+        self.vae_model = vae_model or DAVAEModel(config.vae)
+        self.vae_params = vae_params
+        self.gen = LatentGenerator(config.latent_size, config.gan_hidden)
+        self.disc = LatentDiscriminator(config.cls_num, config.gan_hidden)
+        self.g_params = None
+        self.d_params = None
+
+    def train_gan(self, latents, labels, steps: int = 200, seed: int = 0):
+        """Adversarial training over encoded latents
+        (reference: GAVAEModel.py:60-66 gan_training)."""
+        cfg = self.config
+        rng = jax.random.PRNGKey(seed)
+        rng, gk, dk = jax.random.split(rng, 3)
+        noise = jnp.zeros((1, cfg.noise_size))
+        onehot = jnp.zeros((1, cfg.cls_num))
+        self.g_params = self.gen.init(gk, noise, onehot)["params"]
+        self.d_params = self.disc.init(
+            dk, jnp.zeros((1, cfg.latent_size)))["params"]
+        g_tx = optax.adam(cfg.gan_lr)
+        d_tx = optax.adam(cfg.gan_lr)
+        g_opt = g_tx.init(self.g_params)
+        d_opt = d_tx.init(self.d_params)
+
+        @jax.jit
+        def one_round(g_params, d_params, g_opt, d_opt, rng):
+            rng, k1, k2 = jax.random.split(rng, 3)
+            d_loss, d_grads = gan_d_step(self.disc, d_params, self.gen,
+                                         g_params, latents, labels, k1,
+                                         cfg.noise_size)
+            upd, d_opt = d_tx.update(d_grads, d_opt, d_params)
+            d_params = optax.apply_updates(d_params, upd)
+            g_loss, g_grads = gan_g_step(self.disc, d_params, self.gen,
+                                         g_params, labels, k2,
+                                         cfg.noise_size)
+            upd, g_opt = g_tx.update(g_grads, g_opt, g_params)
+            g_params = optax.apply_updates(g_params, upd)
+            return g_params, d_params, g_opt, d_opt, rng, d_loss, g_loss
+
+        d_loss = g_loss = None
+        for _ in range(steps):
+            (self.g_params, self.d_params, g_opt, d_opt, rng, d_loss,
+             g_loss) = one_round(self.g_params, self.d_params, g_opt,
+                                 d_opt, rng)
+        return float(d_loss), float(g_loss)
+
+    def sample_latents(self, n: int, label: int = 0, seed: int = 0):
+        rng = jax.random.PRNGKey(seed)
+        noise = jax.random.normal(rng, (n, self.config.noise_size))
+        onehot = jax.nn.one_hot(
+            jnp.full((n,), label, jnp.int32), self.config.cls_num)
+        return self.gen.apply({"params": self.g_params}, noise, onehot)
+
+    def generate(self, n: int, label: int = 0, seed: int = 0,
+                 max_length: int = 32, bos_id: int = 0):
+        """noise → latent → text (reference: GAVAEModel.py:55-58)."""
+        assert self.vae_params is not None, "needs trained DAVAE params"
+        latents = self.sample_latents(n, label, seed)
+        return text_from_latent_code_batch(self.vae_model, self.vae_params,
+                                           latents, max_length=max_length,
+                                           bos_id=bos_id)

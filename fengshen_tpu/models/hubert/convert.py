@@ -22,12 +22,21 @@ from fengshen_tpu.utils.convert_common import make_helpers, tensor
 def _weight_norm_conv(state_dict: Mapping[str, Any], prefix: str
                       ) -> np.ndarray:
     """Collapse fairseq/HF weight-norm (weight_g, weight_v) into an
-    effective conv weight; also accepts a plain `weight`."""
+    effective conv weight; also accepts a plain `weight`.
+
+    HF/fairseq build the pos conv with ``weight_norm(conv, dim=2)``
+    (weight_g shape (1, 1, K): one gain per kernel position, norm over the
+    out/in axes). The g shape disambiguates the convention, so dim=0
+    checkpoints ((out, 1, 1) gains) also import correctly."""
     if f"{prefix}.weight" in state_dict:
         return tensor(state_dict, f"{prefix}.weight")
     g = tensor(state_dict, f"{prefix}.weight_g")
     v = tensor(state_dict, f"{prefix}.weight_v")
-    norm = np.sqrt((v ** 2).sum(axis=(1, 2), keepdims=True))
+    if g.shape[0] == 1:      # dim=2: per-kernel-position gain
+        axes = (0, 1)
+    else:                    # dim=0: per-out-channel gain
+        axes = (1, 2)
+    norm = np.sqrt((v ** 2).sum(axis=axes, keepdims=True))
     return g * v / np.maximum(norm, 1e-12)
 
 

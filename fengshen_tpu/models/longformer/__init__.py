@@ -7,3 +7,6 @@ from fengshen_tpu.models.longformer.modeling_longformer import (
 
 __all__ = ["LongformerConfig", "LongformerModel", "LongformerForMaskedLM",
            "LongformerForSequenceClassification"]
+
+from fengshen_tpu.models.longformer.task_heads import (LongformerForTokenClassification, LongformerForQuestionAnswering, LongformerForMultipleChoice)
+__all__ += ['LongformerForTokenClassification', 'LongformerForQuestionAnswering', 'LongformerForMultipleChoice']

@@ -17,3 +17,6 @@ __all__ = ["MegatronBertConfig", "MegatronBertModel",
            "MegatronBertForPreTraining", "MegatronBertForMaskedLM",
            "MegatronBertForSequenceClassification",
            "MegatronBertForTokenClassification"]
+
+from fengshen_tpu.models.megatron_bert.task_heads import (MegatronBertForQuestionAnswering, MegatronBertForMultipleChoice)
+__all__ += ['MegatronBertForQuestionAnswering', 'MegatronBertForMultipleChoice']

@@ -1,0 +1,6 @@
+"""PPVAE family (reference: fengshen/models/PPVAE/, 232 LoC)."""
+
+from fengshen_tpu.models.ppvae.modeling_ppvae import (
+    PPVAEConfig, PPVAEModel, PluginVAE, plugin_loss)
+
+__all__ = ["PPVAEConfig", "PPVAEModel", "PluginVAE", "plugin_loss"]

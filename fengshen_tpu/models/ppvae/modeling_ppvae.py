@@ -1,0 +1,158 @@
+"""PPVAE — plug-in conditional VAE over a frozen text-VAE latent space.
+
+Behavioural port of reference: fengshen/models/PPVAE/pluginVAE.py (232
+LoC): a small bottleneck VAE (Encoder fc1→fc2→mean/log_var, Decoder
+fc1→fc2→fc3, leaky-relu, :13-58) trained ONLY on latents of
+condition-positive texts (optionally pushed away from negative-sample
+latents with weight gamma, :119-149); generation decodes bottleneck noise
+back to the big latent space and then to text through the frozen DAVAE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from fengshen_tpu.models.davae.modeling_davae import (
+    DAVAEConfig, DAVAEModel, text_from_latent_code_batch)
+
+
+@dataclasses.dataclass
+class PPVAEConfig:
+    latent_dim: int = 128
+    bottle_dim: int = 20
+    kl_weight: float = 1.0
+    beta: float = 0.0          # free-bits style |kl - beta| target
+    gamma: float = 1.0         # negative-sample repulsion weight
+    neg_loss_threshold: float = 10.0
+    ppvae_lr: float = 1e-3
+    vae: DAVAEConfig = None
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "PPVAEConfig":
+        vae = DAVAEConfig.small_test_config()
+        base = dict(latent_dim=vae.latent_size, bottle_dim=4, vae=vae)
+        base.update(overrides)
+        return cls(**base)
+
+
+class PluginVAE(nn.Module):
+    """The bottleneck VAE over latents (reference: pluginVAE.py:13-78)."""
+
+    latent_dim: int = 128
+    bottle_dim: int = 20
+
+    def setup(self):
+        half, quarter = self.latent_dim // 2, self.latent_dim // 4
+        self.enc_fc1 = nn.Dense(half, name="enc_fc1")
+        self.enc_fc2 = nn.Dense(quarter, name="enc_fc2")
+        self.mean = nn.Dense(self.bottle_dim, name="mean")
+        self.log_var = nn.Dense(self.bottle_dim, name="log_var")
+        self.dec_fc1 = nn.Dense(quarter, name="dec_fc1")
+        self.dec_fc2 = nn.Dense(half, name="dec_fc2")
+        self.dec_fc3 = nn.Dense(self.latent_dim, name="dec_fc3")
+
+    def encode(self, z):
+        h = jax.nn.leaky_relu(self.enc_fc1(z))
+        h = jax.nn.leaky_relu(self.enc_fc2(h))
+        return self.mean(h), self.log_var(h)
+
+    def decode(self, enc_z):
+        h = jax.nn.leaky_relu(self.dec_fc1(enc_z))
+        h = jax.nn.leaky_relu(self.dec_fc2(h))
+        return self.dec_fc3(h)
+
+    def __call__(self, z, rng=None):
+        mean, log_var = self.encode(z)
+        kl = (-0.5 * (1 + log_var - mean ** 2 -
+                      jnp.exp(log_var)).sum(-1)).mean()
+        enc_z = mean if rng is None else \
+            mean + jnp.exp(0.5 * log_var) * jax.random.normal(rng,
+                                                              mean.shape)
+        return self.decode(enc_z), kl
+
+
+def plugin_loss(model: PluginVAE, params, z, rng, kl_weight: float,
+                beta: float):
+    """z-space reconstruction + |KL − beta| (reference:
+    pluginVAE.py:75-78)."""
+    z_out, kl = model.apply({"params": params}, z, rng=rng)
+    z_loss = ((z_out - z) ** 2).mean()
+    return z_loss + kl_weight * jnp.abs(kl - beta), kl
+
+
+class PPVAEModel:
+    """train_plugin / generate surface (reference: pluginVAE.py:86-180)."""
+
+    def __init__(self, config: PPVAEConfig,
+                 vae_model: Optional[DAVAEModel] = None, vae_params=None):
+        self.config = config
+        self.vae_model = vae_model or DAVAEModel(config.vae)
+        self.vae_params = vae_params
+        self.plugin = PluginVAE(config.latent_dim, config.bottle_dim)
+        self.params = None
+
+    def train_plugin(self, pos_latents, neg_latents=None,
+                     steps: int = 200, seed: int = 0):
+        """Train on condition-positive latents, repelled from negatives
+        (reference: pluginVAE.py:119-149 `loss = pos - gamma*neg` with the
+        runaway-negative detach)."""
+        cfg = self.config
+        rng = jax.random.PRNGKey(seed)
+        rng, init_key = jax.random.split(rng)
+        self.params = self.plugin.init(
+            init_key, jnp.zeros((1, cfg.latent_dim)))["params"]
+        tx = optax.adam(cfg.ppvae_lr)
+        opt = tx.init(self.params)
+
+        @jax.jit
+        def one_step(params, opt, rng):
+            rng, k_pos, k_neg = jax.random.split(rng, 3)
+
+            def loss_fn(p):
+                pos_loss, pos_kl = plugin_loss(self.plugin, p, pos_latents,
+                                               k_pos, cfg.kl_weight,
+                                               cfg.beta)
+                if neg_latents is None:
+                    return pos_loss, (pos_loss, pos_kl, 0.0)
+                neg_loss, _ = plugin_loss(self.plugin, p, neg_latents,
+                                          k_neg, cfg.kl_weight, cfg.beta)
+                # a runaway negative term is detached (reference :138-141)
+                neg_loss = jnp.where(
+                    neg_loss > cfg.neg_loss_threshold * pos_loss,
+                    jax.lax.stop_gradient(neg_loss), neg_loss)
+                return pos_loss - cfg.gamma * neg_loss, \
+                    (pos_loss, pos_kl, neg_loss)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn,
+                                                    has_aux=True)(params)
+            upd, opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, upd), opt, rng, loss, aux
+
+        loss = aux = None
+        for _ in range(steps):
+            self.params, opt, rng, loss, aux = one_step(self.params, opt,
+                                                        rng)
+        return float(loss), {"pos_loss": float(aux[0]),
+                             "pos_kl": float(aux[1]),
+                             "neg_loss": float(aux[2])}
+
+    def gen_latent(self, n: int, seed: int = 0):
+        """bottleneck noise → big latent (reference: pluginVAE.py:168-172)."""
+        rng = jax.random.PRNGKey(seed)
+        z = jax.random.normal(rng, (n, self.config.bottle_dim))
+        return self.plugin.apply({"params": self.params}, z,
+                                 method=PluginVAE.decode)
+
+    def generate(self, n: int, seed: int = 0, max_length: int = 32,
+                 bos_id: int = 0):
+        assert self.vae_params is not None, "needs trained DAVAE params"
+        latents = self.gen_latent(n, seed)
+        return text_from_latent_code_batch(self.vae_model, self.vae_params,
+                                           latents, max_length=max_length,
+                                           bos_id=bos_id)

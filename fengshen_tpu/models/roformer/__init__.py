@@ -7,3 +7,6 @@ from fengshen_tpu.models.roformer.modeling_roformer import (
 
 __all__ = ["RoFormerConfig", "RoFormerModel", "RoFormerForMaskedLM",
            "RoFormerForSequenceClassification"]
+
+from fengshen_tpu.models.roformer.task_heads import (RoFormerForTokenClassification, RoFormerForQuestionAnswering, RoFormerForMultipleChoice)
+__all__ += ['RoFormerForTokenClassification', 'RoFormerForQuestionAnswering', 'RoFormerForMultipleChoice']

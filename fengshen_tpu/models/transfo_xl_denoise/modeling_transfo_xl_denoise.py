@@ -51,9 +51,10 @@ class TransfoXLDenoiseModel(nn.Module):
                                     self.config.param_dtype),
                                 name="lm_head")
 
-    def __call__(self, input_ids, attention_mask=None, init_cache=False,
-                 deterministic=True):
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 init_cache=False, deterministic=True):
         hidden = self.backbone(input_ids, attention_mask=attention_mask,
+                               position_ids=position_ids,
                                init_cache=init_cache,
                                deterministic=deterministic)
         return self.lm_head(hidden)

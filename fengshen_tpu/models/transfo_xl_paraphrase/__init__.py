@@ -1,0 +1,11 @@
+"""Transfo-XL paraphrase family (reference:
+fengshen/models/transfo_xl_paraphrase/)."""
+
+from fengshen_tpu.models.transfo_xl_denoise import (
+    TransfoXLDenoiseConfig as TransfoXLParaphraseConfig,
+    TransfoXLDenoiseModel as TransfoXLParaphraseModel)
+from fengshen_tpu.models.transfo_xl_paraphrase.generate import (
+    paraphrase_generate)
+
+__all__ = ["TransfoXLParaphraseConfig", "TransfoXLParaphraseModel",
+           "paraphrase_generate"]

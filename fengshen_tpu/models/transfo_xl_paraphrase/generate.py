@@ -1,0 +1,46 @@
+"""Paraphrase generation with the fixed pretrained prompt.
+
+Port of reference: fengshen/models/transfo_xl_paraphrase/generate.py:16-60 —
+the released Randeng-TransformerXL-Paraphrase checkpoint is prompted with
+``“{text}”的相似句是“`` and sampled until the closing quote.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.utils.generate import sample_sequence_batch
+
+
+def paraphrase_generate(model: Any, params: Any, tokenizer: Any,
+                        input_text: Union[str, List[str]],
+                        max_out_seq: int = 128,
+                        temperature: float = 1.0, top_k: int = 0,
+                        top_p: float = 0.9, seed: int = 0) -> List[str]:
+    """reference: generate.py:16-60 (prompt at :25)."""
+    if isinstance(input_text, str):
+        input_text = [input_text]
+    prompts = [f"“{text}”的相似句是“" for text in input_text]
+    enc = [tokenizer.encode(p) for p in prompts]
+    enc = [ids[:-1] if ids and ids[-1] == tokenizer.eos_token_id else ids
+           for ids in enc]
+    max_len = max(len(x) for x in enc)
+    pad = tokenizer.pad_token_id or 0
+    # left-pad so every prompt ends at the same position
+    batch = np.full((len(enc), max_len), pad, np.int32)
+    for i, ids in enumerate(enc):
+        batch[i, max_len - len(ids):] = ids
+    out = sample_sequence_batch(
+        model, params, jnp.asarray(batch), max_out_seq=max_out_seq,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=tokenizer.eos_token_id,
+        rng=jax.random.PRNGKey(seed))
+    results = []
+    for row in np.asarray(out):
+        text = tokenizer.decode([int(t) for t in row[max_len:]])
+        results.append(text.split("”")[0].replace(" ", ""))
+    return results

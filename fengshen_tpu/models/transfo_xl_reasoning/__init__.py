@@ -1,0 +1,11 @@
+"""Transfo-XL reasoning family (reference:
+fengshen/models/transfo_xl_reasoning/)."""
+
+from fengshen_tpu.models.transfo_xl_denoise import (
+    TransfoXLDenoiseConfig as TransfoXLReasoningConfig,
+    TransfoXLDenoiseModel as TransfoXLReasoningModel)
+from fengshen_tpu.models.transfo_xl_reasoning.generate import (
+    abduction_generate, deduction_generate, en_to_zh)
+
+__all__ = ["TransfoXLReasoningConfig", "TransfoXLReasoningModel",
+           "deduction_generate", "abduction_generate", "en_to_zh"]

@@ -1,0 +1,74 @@
+"""Causal-reasoning generation (deduction / abduction prompts).
+
+Port of reference: fengshen/models/transfo_xl_reasoning/generate.py:22-120 —
+the Randeng-TransformerXL-Abduction/Deduction checkpoints use the fixed
+prompts ``<bos>{text}，因而`` (deduction, :39) and
+``<bos>之所以{text}，是因为`` (abduction, :87), with Chinese punctuation
+normalisation (:13-19).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.utils.generate import sample_sequence_batch
+
+
+def en_to_zh(sentence: str) -> str:
+    """reference: generate.py:13-19."""
+    en_pun = u",.!?[]()<>\"\"''"
+    zh_pun = u"，。！？【】（）《》“”‘’"
+    table = {ord(f): ord(t) for f, t in zip(en_pun, zh_pun)}
+    return sentence.translate(table)
+
+
+def _generate_with_prompt(model, params, tokenizer, prompts,
+                          max_out_seq, temperature, top_k, top_p, seed):
+    enc = [tokenizer.encode(p) for p in prompts]
+    enc = [ids[:-1] if ids and ids[-1] == tokenizer.eos_token_id else ids
+           for ids in enc]
+    max_len = max(len(x) for x in enc)
+    pad = tokenizer.pad_token_id or 0
+    batch = np.full((len(enc), max_len), pad, np.int32)
+    for i, ids in enumerate(enc):
+        batch[i, max_len - len(ids):] = ids
+    out = sample_sequence_batch(
+        model, params, jnp.asarray(batch), max_out_seq=max_out_seq,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=tokenizer.eos_token_id,
+        rng=jax.random.PRNGKey(seed))
+    return [en_to_zh(tokenizer.decode(
+        [int(t) for t in row[max_len:]])).replace(" ", "")
+        for row in np.asarray(out)]
+
+
+def deduction_generate(model: Any, params: Any, tokenizer: Any,
+                       input_text: Union[str, List[str]],
+                       max_out_seq: int = 128, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 0.6,
+                       seed: int = 0) -> List[str]:
+    """reference: generate.py:22-69 (prompt at :39)."""
+    if isinstance(input_text, str):
+        input_text = [input_text]
+    prompts = [f"<bos>{text}，因而" for text in input_text]
+    return _generate_with_prompt(model, params, tokenizer, prompts,
+                                 max_out_seq, temperature, top_k, top_p,
+                                 seed)
+
+
+def abduction_generate(model: Any, params: Any, tokenizer: Any,
+                       input_text: Union[str, List[str]],
+                       max_out_seq: int = 128, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 0.6,
+                       seed: int = 0) -> List[str]:
+    """reference: generate.py:71-120 (prompt at :87)."""
+    if isinstance(input_text, str):
+        input_text = [input_text]
+    prompts = [f"<bos>之所以{text}，是因为" for text in input_text]
+    return _generate_with_prompt(model, params, tokenizer, prompts,
+                                 max_out_seq, temperature, top_k, top_p,
+                                 seed)

@@ -9,3 +9,6 @@ from fengshen_tpu.models.zen.ngram_utils import ZenNgramDict
 
 __all__ = ["ZenConfig", "ZenModel", "ZenForSequenceClassification",
            "ZenNgramDict"]
+
+from fengshen_tpu.models.zen.task_heads import (ZenForTokenClassification, ZenForQuestionAnswering, ZenForMultipleChoice)
+__all__ += ['ZenForTokenClassification', 'ZenForQuestionAnswering', 'ZenForMultipleChoice']

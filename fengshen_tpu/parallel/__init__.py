@@ -12,8 +12,12 @@ from fengshen_tpu.parallel.mesh import (
     get_mesh,
     set_mesh,
     mesh_shape_for_devices,
+    distributed_initialize,
+    data_parallel_rank,
+    data_parallel_world_size,
     DATA_AXIS,
     FSDP_AXIS,
+    PIPE_AXIS,
     SEQUENCE_AXIS,
     TENSOR_AXIS,
     EXPERT_AXIS,
@@ -28,7 +32,8 @@ from fengshen_tpu.parallel.partition import (
     tree_paths,
 )
 from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
-from fengshen_tpu.parallel.pipeline import pipeline_apply
+from fengshen_tpu.parallel.pipeline import (pipeline_apply,
+                                            pipeline_train_step_1f1b)
 
 __all__ = [
     "MeshConfig",
@@ -50,4 +55,9 @@ __all__ = [
     "tree_paths",
     "vocab_parallel_cross_entropy",
     "pipeline_apply",
+    "pipeline_train_step_1f1b",
+    "distributed_initialize",
+    "data_parallel_rank",
+    "data_parallel_world_size",
+    "PIPE_AXIS",
 ]

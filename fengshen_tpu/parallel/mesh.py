@@ -31,12 +31,15 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+PIPE_AXIS = "pipe"
 SEQUENCE_AXIS = "sequence"
 TENSOR_AXIS = "tensor"
 EXPERT_AXIS = "expert"
 
-#: canonical axis order, outermost (slowest links, DCN) first
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+#: canonical axis order, outermost (slowest links, DCN) first; pipeline
+#: sits between the batch axes and sequence/tensor (stage hops are
+#: infrequent point-to-point transfers, Megatron's pp-outside-tp layout)
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, PIPE_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
 
 #: axes over which the global batch is sharded (a batch dim is split over all
 #: of these; this is what DeepSpeed called the "data parallel world")
@@ -56,6 +59,7 @@ class MeshConfig:
 
     data: int = -1  # -1: derive from device count
     fsdp: int = 1
+    pipe: int = 1
     sequence: int = 1
     tensor: int = 1
 
@@ -64,6 +68,10 @@ class MeshConfig:
         parser = parent_parser.add_argument_group("MeshConfig")
         parser.add_argument("--data_parallel_size", default=-1, type=int)
         parser.add_argument("--fsdp_parallel_size", default=1, type=int)
+        parser.add_argument(
+            "--pipe_model_parallel_size", default=1, type=int,
+            help="pipeline-parallel degree (same flag name as the "
+                 "reference's DeepSpeed topology)")
         parser.add_argument("--sequence_parallel_size", default=1, type=int)
         parser.add_argument(
             "--tensor_model_parallel_size", default=1, type=int,
@@ -75,23 +83,24 @@ class MeshConfig:
         return cls(
             data=getattr(args, "data_parallel_size", -1),
             fsdp=getattr(args, "fsdp_parallel_size", 1),
+            pipe=getattr(args, "pipe_model_parallel_size", 1),
             sequence=getattr(args, "sequence_parallel_size", 1),
             tensor=getattr(args, "tensor_model_parallel_size", 1),
         )
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        """Return concrete (data, fsdp, sequence, tensor) for n_devices."""
-        fixed = self.fsdp * self.sequence * self.tensor
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        """Concrete (data, fsdp, pipe, sequence, tensor) for n_devices."""
+        fixed = self.fsdp * self.pipe * self.sequence * self.tensor
         if n_devices % fixed != 0:
             raise ValueError(
                 f"device count {n_devices} not divisible by "
-                f"fsdp*sequence*tensor = {fixed}")
+                f"fsdp*pipe*sequence*tensor = {fixed}")
         data = self.data if self.data > 0 else n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.sequence}x{self.tensor} "
-                f"!= device count {n_devices}")
-        return (data, self.fsdp, self.sequence, self.tensor)
+                f"mesh {data}x{self.fsdp}x{self.pipe}x{self.sequence}"
+                f"x{self.tensor} != device count {n_devices}")
+        return (data, self.fsdp, self.pipe, self.sequence, self.tensor)
 
 
 def mesh_shape_for_devices(config: MeshConfig,

@@ -132,6 +132,31 @@ def with_sharding_constraint(x: Any, spec: P, mesh: Optional[Mesh] = None):
     if mesh is None:
         return x
 
+    # Inside shard_map the mesh axes are Manual and constraints over them
+    # are illegal — strip manual axes from the spec (model code then runs
+    # unchanged whether it executes under GSPMD or inside a shard_map
+    # stage, e.g. the pipeline-parallel body).
+    try:
+        abstract = jax.sharding.get_abstract_mesh()
+        manual = {name for name, t in zip(abstract.axis_names,
+                                          abstract.axis_types)
+                  if "Manual" in str(t)} if abstract is not None and \
+            abstract.axis_names else set()
+    except Exception:
+        manual = set()
+    if manual:
+        def strip(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(a for a in entry if a not in manual)
+                return kept or None
+            return None if entry in manual else entry
+
+        spec = P(*(strip(e) for e in spec))
+        if all(e is None for e in spec):
+            return x
+
     def constrain(leaf):
         fitted = _spec_fits(spec, mesh, tuple(leaf.shape))
         return jax.lax.with_sharding_constraint(

@@ -100,3 +100,132 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any,
         out_specs=P(),
         check_vma=False)
     return fn(stacked_params, microbatches)
+
+
+def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
+               micro_targets: jax.Array, stage_fn: Callable,
+               last_stage_loss: Callable, axis_name: str,
+               n_microbatches: int):
+    """shard_map body for the 1F1B schedule: forward activations and
+    backward cotangents flow through the pipe on EVERY tick, so each stage
+    alternates one-forward / one-backward in steady state, holding at most
+    2·n_stages microbatch inputs (independent of the microbatch count M —
+    GPipe-through-autodiff holds all M).
+
+    fwd of microbatch m at stage s happens on tick m+s; bwd on tick
+    m + 2S-1 - s. The backward recomputes the stage forward from the stored
+    input (activation recompute, the standard TPU memory/flop trade).
+    """
+    S = jax.lax.axis_size(axis_name)
+    sid = jax.lax.axis_index(axis_name)
+    is_first = sid == 0
+    is_last = sid == S - 1
+    M = n_microbatches
+    local_params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+
+    mb_shape = micro_inputs.shape[1:]
+    ring = 2 * S  # max in-flight inputs per stage is 2S-1-2s <= 2S-1
+    in_buf = jnp.zeros((ring,) + mb_shape, micro_inputs.dtype)
+    fwd_state = jnp.zeros(mb_shape, micro_inputs.dtype)
+    bwd_state = jnp.zeros(mb_shape, micro_inputs.dtype)
+    dparams = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), local_params)
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+    total_ticks = M + 2 * S - 1
+
+    def fwd_for(m):
+        """stage forward for microbatch m; last stage also evaluates the
+        per-microbatch loss so its backward can start next tick."""
+        return jnp.clip(m, 0, M - 1)
+
+    def tick(t, carry):
+        in_buf, fwd_state, bwd_state, dparams, loss_acc = carry
+
+        # ---- forward lane: microbatch m_f = t - sid ----
+        m_f = t - sid
+        fwd_live = jnp.logical_and(m_f >= 0, m_f < M)
+        feed = jnp.take(micro_inputs, fwd_for(m_f), axis=0)
+        x = jnp.where(is_first, feed, fwd_state)
+        in_buf = jax.lax.cond(
+            fwd_live,
+            lambda b: jax.lax.dynamic_update_index_in_dim(
+                b, x, fwd_for(m_f) % ring, 0),
+            lambda b: b, in_buf)
+        y = stage_fn(local_params, x)
+
+        # ---- backward lane: microbatch m_b = t - (2S - 1 - sid) ----
+        m_b = t - (2 * S - 1 - sid)
+        bwd_live = jnp.logical_and(m_b >= 0, m_b < M)
+        x_saved = jnp.take(in_buf, fwd_for(m_b) % ring, axis=0)
+        target = jnp.take(micro_targets, fwd_for(m_b), axis=0)
+
+        def stage_loss(p, x_in):
+            out = stage_fn(p, x_in)
+            # the LAST stage's backward seeds from the loss; other stages
+            # propagate the received cotangent (handled below)
+            return last_stage_loss(out, target)
+
+        # last stage: vjp through stage_fn∘loss, seeded by 1.0
+        l_val, l_vjp = jax.vjp(stage_loss, local_params, x_saved)
+        dl_p, dl_x = l_vjp(jnp.ones((), l_val.dtype))
+        # other stages: vjp through stage_fn, seeded by received cotangent
+        _, s_vjp = jax.vjp(lambda p, x_in: stage_fn(p, x_in),
+                           local_params, x_saved)
+        ds_p, ds_x = s_vjp(bwd_state)
+
+        use_last = jnp.logical_and(bwd_live, is_last)
+        use_mid = jnp.logical_and(bwd_live, jnp.logical_not(is_last))
+        dparams = jax.tree_util.tree_map(
+            lambda acc, dl, ds: acc +
+            jnp.where(use_last, dl.astype(jnp.float32), 0.0) +
+            jnp.where(use_mid, ds.astype(jnp.float32), 0.0),
+            dparams, dl_p, ds_p)
+        dx_out = jnp.where(use_last, dl_x,
+                           jnp.where(use_mid, ds_x,
+                                     jnp.zeros_like(ds_x)))
+        loss_acc = loss_acc + jnp.where(use_last, l_val, 0.0)
+
+        # ---- rotate both lanes ----
+        fwd_state = jax.lax.ppermute(y, axis_name, fwd_perm)
+        bwd_state = jax.lax.ppermute(dx_out, axis_name, bwd_perm)
+        return in_buf, fwd_state, bwd_state, dparams, loss_acc
+
+    carry = (in_buf, fwd_state, bwd_state, dparams, loss_acc)
+    _, _, _, dparams, loss_acc = jax.lax.fori_loop(0, total_ticks, tick,
+                                                   carry)
+    # every stage holds ITS OWN dparams; restore the stacked layout by
+    # keeping the local slice (shard_map out_specs put the stage dim back)
+    # mean over microbatches for BOTH loss and grads, so the returned
+    # grads are exactly d(loss)/d(params)
+    dparams = jax.tree_util.tree_map(lambda g: g[None] / M, dparams)
+    loss = jax.lax.psum(loss_acc, axis_name) / M
+    return loss, dparams
+
+
+def pipeline_train_step_1f1b(stage_fn: Callable, last_stage_loss: Callable,
+                             stacked_params: Any,
+                             micro_inputs: jax.Array,
+                             micro_targets: jax.Array, mesh: Mesh,
+                             axis_name: str = "pipe"):
+    """One 1F1B training step over the `axis_name` mesh axis.
+
+    stage_fn(stage_params, x) -> x; last_stage_loss(final_activations,
+    target) -> scalar loss (mean over the microbatch). Returns
+    (mean_loss, stacked_param_grads) — grads carry the same leading
+    [n_stages] dim as `stacked_params`.
+    """
+    n_micro = micro_inputs.shape[0]
+    params_spec = jax.tree_util.tree_map(
+        lambda x: P(axis_name), stacked_params)
+    fn = shard_map(
+        partial(_1f1b_body, stage_fn=stage_fn,
+                last_stage_loss=last_stage_loss, axis_name=axis_name,
+                n_microbatches=n_micro),
+        mesh=mesh,
+        in_specs=(params_spec, P(), P()),
+        out_specs=(P(), params_spec),
+        check_vma=False)
+    return fn(stacked_params, micro_inputs, micro_targets)

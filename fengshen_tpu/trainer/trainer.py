@@ -72,6 +72,14 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
     parser.add_argument("--gradient_clip_val", default=0.0, type=float)
     parser.add_argument("--precision", default="bf16", type=str,
                         choices=["bf16", "fp32", "16", "32", "bf16-mixed"])
+    parser.add_argument(
+        "--offload_optimizer", action="store_true", default=False,
+        help="keep adam moments in host memory (ZeRO-offload analog; "
+             "reference: demo_classification_afqmc_erlangshen_offload.sh)")
+    parser.add_argument(
+        "--profile_steps", default=None, type=str,
+        help="START,END step range to capture a jax.profiler trace "
+             "(saved under default_root_dir/profile; SURVEY.md §5.1)")
     parser.add_argument("--seed", default=42, type=int)
     parser.add_argument("--default_root_dir", default="./runs", type=str)
     # mesh flags (replaces strategy=... + DeepSpeed JSON)
@@ -113,34 +121,35 @@ class Trainer:
             pass
 
     # -- step compilation ------------------------------------------------
-    def _build_train_step(self, module: TrainModule, state_sh, batch_spec,
-                          sample_batch=None):
-        accum = max(int(getattr(self.args, "accumulate_grad_batches", 1)), 1)
-        mesh = self.mesh
+    def _make_grad_step(self, module: TrainModule):
+        """Shared gradient computation (accumulation + metrics) used by
+        both the fused train step and the offloaded two-program step."""
+        accum = max(int(getattr(self.args, "accumulate_grad_batches", 1)),
+                    1)
 
         def loss_fn(params, batch, rng):
-            loss, metrics = module.training_loss(params, batch, rng)
-            return loss, metrics
+            return module.training_loss(params, batch, rng)
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-        def train_step(state: TrainState, batch, rng):
-            rng = jax.random.fold_in(rng, state.step)
+        def grad_step(params, batch, rng, step):
+            rng = jax.random.fold_in(rng, step)
             if accum == 1:
-                (loss, metrics), grads = grad_fn(state.params, batch, rng)
+                (loss, metrics), grads = grad_fn(params, batch, rng)
             else:
                 def micro(carry, mb):
                     acc_grads, acc_loss, i = carry
-                    (l, m), g = grad_fn(state.params, mb,
+                    (l, m), g = grad_fn(params, mb,
                                         jax.random.fold_in(rng, i))
-                    acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, g)
+                    acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads,
+                                                       g)
                     return (acc_grads, acc_loss + l, i + 1), m
 
                 batch = jax.tree_util.tree_map(
                     lambda x: x.reshape((accum, x.shape[0] // accum) +
                                         x.shape[1:]), batch)
                 zero = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 (grads, loss, _), metrics = jax.lax.scan(
                     micro, (zero, 0.0, 0), batch)
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
@@ -149,12 +158,22 @@ class Trainer:
                     lambda m: m.mean() if jnp.issubdtype(m.dtype,
                                                          jnp.floating)
                     else m[-1], metrics)
-            grad_norm = optax.global_norm(grads)
-            new_state = state.apply_gradients(grads)
             metrics = dict(metrics)
             metrics["loss"] = loss
-            metrics["grad_norm"] = grad_norm
-            return new_state, metrics
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return grads, metrics
+
+        return grad_step
+
+    def _build_train_step(self, module: TrainModule, state_sh, batch_spec,
+                          sample_batch=None):
+        mesh = self.mesh
+        grad_step = self._make_grad_step(module)
+
+        def train_step(state: TrainState, batch, rng):
+            grads, metrics = grad_step(state.params, batch, rng,
+                                       state.step)
+            return state.apply_gradients(grads), metrics
 
         # fit specs to actual shapes: a debug batch smaller than the batch
         # axes degrades to replicated instead of erroring
@@ -172,12 +191,68 @@ class Trainer:
             batch_shardings = jax.tree_util.tree_map(
                 lambda spec: NamedSharding(mesh, spec), batch_spec,
                 is_leaf=lambda x: isinstance(x, P))
+
+        if getattr(self.args, "offload_optimizer", False):
+            return self._build_offloaded_train_step(
+                module, state_sh, batch_shardings), batch_shardings
+
         return jax.jit(
             train_step,
             in_shardings=(state_sh, batch_shardings, None),
             out_shardings=(state_sh, None),
             donate_argnums=(0,),
         ), batch_shardings
+
+    def _build_offloaded_train_step(self, module, state_sh, batch_sh):
+        """ZeRO-offload analog: the optimizer state lives in HOST memory
+        between steps, so the gradient pass runs with HBM holding only
+        params + grads + activations (reference capability:
+        DeepSpeed offload_optimizer, fengshen/examples/classification/
+        demo_classification_afqmc_erlangshen_offload.sh:9-33).
+
+        XLA in this build cannot annotate memory spaces inside an SPMD
+        program, so the H2D/D2H moves happen BETWEEN two jitted programs:
+        grad_step (device-only) and update_step (donated; moments are
+        device-resident only transiently during the update).
+        """
+        grad_step = self._make_grad_step(module)
+        param_sh = state_sh.params
+        opt_host_sh = state_sh.opt_state
+        opt_dev_sh = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind("device"), opt_host_sh)
+
+        grad_jit = jax.jit(
+            grad_step,
+            in_shardings=(param_sh, batch_sh, None, None),
+            out_shardings=(param_sh, None))
+
+        def update(params, grads, opt_state, step, tx):
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt,
+                    step + 1)
+
+        update_jit = None
+
+        def step_fn(state, batch, rng):
+            nonlocal update_jit
+            grads, metrics = grad_jit(state.params, batch, rng, state.step)
+            # H2D: bring the moments on-device only for the update
+            opt_dev = jax.device_put(state.opt_state, opt_dev_sh)
+            if update_jit is None:
+                import functools
+                update_jit = jax.jit(
+                    functools.partial(update, tx=state.tx),
+                    in_shardings=(param_sh, param_sh, opt_dev_sh, None),
+                    out_shardings=(param_sh, opt_dev_sh, None),
+                    donate_argnums=(0, 1, 2))
+            new_params, new_opt_dev, new_step = update_jit(
+                state.params, grads, opt_dev, state.step)
+            # D2H: park the moments back in host memory
+            new_opt = jax.device_put(new_opt_dev, opt_host_sh)
+            return state.replace(step=new_step, params=new_params,
+                                 opt_state=new_opt), metrics
+
+        return step_fn
 
     # -- fit -------------------------------------------------------------
     def fit(self, module: TrainModule, datamodule) -> TrainState:
@@ -208,7 +283,10 @@ class Trainer:
                 module.model.apply or (lambda *a, **k: None),
                 params=params, tx=tx)
 
-        state, state_sh = create_sharded_state(init_fn, rules, self.mesh)
+        state, state_sh = create_sharded_state(
+            init_fn, rules, self.mesh,
+            offload_optimizer=bool(getattr(args, "offload_optimizer",
+                                           False)))
         _, self._schedule = module.configure_optimizers(total_steps,
                                                         state.params)
 
@@ -239,6 +317,12 @@ class Trainer:
         log_every = max(int(getattr(args, "log_every_n_steps", 10)), 1)
         val_interval = int(getattr(args, "val_check_interval", 0) or 0)
 
+        profile_range = None
+        if getattr(args, "profile_steps", None):
+            lo, hi = (int(x) for x in str(args.profile_steps).split(","))
+            profile_range = (lo, hi)
+            self._profiling = False
+
         t_last = time.perf_counter()
         tokens_since = 0
         epoch = 0
@@ -247,6 +331,8 @@ class Trainer:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(epoch)
             for batch, device_batch in _prefetch(train_loader, batch_sh):
+                if profile_range is not None:
+                    self._maybe_profile(profile_range)
                 state, metrics = step_fn(state, device_batch, rng)
                 self.global_step = int(self.global_step) + 1
                 self.consumed_samples += world_batch
@@ -291,11 +377,31 @@ class Trainer:
             if not val_interval:
                 self._run_validation(module, datamodule, state, rng)
 
+        if profile_range is not None and getattr(self, "_profiling", False):
+            jax.profiler.stop_trace()
+            self._profiling = False
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_end"):
                 cb.on_fit_end(self, state)
         self._log({"event": "fit_end", "step": self.global_step})
         return state
+
+    def _maybe_profile(self, profile_range: tuple) -> None:
+        """Start/stop a jax.profiler trace over the configured step window
+        (SURVEY.md §5.1: trace-guided perf work instead of guesses)."""
+        lo, hi = profile_range
+        if not self._profiling and self.global_step == lo:
+            path = os.path.join(
+                getattr(self.args, "default_root_dir", "./runs"), "profile")
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            self._profiling = True
+            self._log({"event": "profile_start", "step": self.global_step,
+                       "path": path})
+        elif self._profiling and self.global_step >= hi:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._log({"event": "profile_stop", "step": self.global_step})
 
     # -- predict ---------------------------------------------------------
     def predict(self, module: TrainModule, dataloader, state=None,

@@ -1,0 +1,26 @@
+#!/bin/bash
+#SBATCH --job-name=fengshen-tpu
+#SBATCH --nodes=2
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=32
+# Multi-host launcher (reference pattern:
+# fengshen/examples/ziya_llama/finetune_with_tp.sh SLURM driver).
+# Usage: sbatch launchers/slurm_multihost.sh <module> [args...]
+
+MODULE=${1:-fengshen_tpu.examples.pretrain_t5.pretrain_t5}
+shift || true
+
+MASTER_ADDR=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+export FSTPU_COORDINATOR="${MASTER_ADDR}:29500"
+export FSTPU_NUM_PROCESSES=$SLURM_NTASKS
+
+srun --export=ALL bash -c "
+  FSTPU_PROCESS_ID=\$SLURM_PROCID python - <<PY
+from fengshen_tpu.parallel import distributed_initialize
+import os, runpy, sys
+distributed_initialize(os.environ['FSTPU_COORDINATOR'],
+                       int(os.environ['FSTPU_NUM_PROCESSES']),
+                       int(os.environ['FSTPU_PROCESS_ID']))
+sys.argv = ['$MODULE'] + '$*'.split()
+runpy.run_module('$MODULE', run_name='__main__')
+PY"

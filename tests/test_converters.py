@@ -290,7 +290,10 @@ def test_hubert_convert_structural_roundtrip():
     shapes["feature_projection.layer_norm.weight"] = (d,)
     shapes["feature_projection.layer_norm.bias"] = (d,)
     shapes["masked_spec_embed"] = (d,)
-    shapes["encoder.pos_conv_embed.conv.weight_g"] = (d, 1, 1)
+    # real HF/fairseq checkpoints use weight_norm(conv, dim=2):
+    # g is (1, 1, K), one gain per kernel position
+    shapes["encoder.pos_conv_embed.conv.weight_g"] = (
+        1, 1, cfg.pos_conv_kernel)
     shapes["encoder.pos_conv_embed.conv.weight_v"] = (
         d, d // cfg.pos_conv_groups, cfg.pos_conv_kernel)
     shapes["encoder.pos_conv_embed.conv.bias"] = (d,)

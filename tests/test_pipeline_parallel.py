@@ -54,3 +54,102 @@ def test_pipeline_grad_flows():
     # every stage's weights receive gradient
     per_stage = np.abs(np.asarray(g["w"])).sum(axis=(1, 2))
     assert (per_stage > 0).all()
+
+
+def test_1f1b_matches_sequential_grads():
+    """1F1B fwd/bwd schedule: loss and stacked grads must equal plain
+    autodiff of the sequential stage composition (VERDICT r1 item 8)."""
+    import numpy as np
+    from fengshen_tpu.parallel.pipeline import pipeline_train_step_1f1b
+
+    n_stages, n_micro, mb, dim = 4, 6, 2, 8
+    devices = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = jax.sharding.Mesh(devices, ("pipe",))
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(n_stages, dim, dim) * 0.3,
+                               jnp.float32),
+              "b": jnp.asarray(rng.randn(n_stages, dim) * 0.1,
+                               jnp.float32)}
+    xs = jnp.asarray(rng.randn(n_micro, mb, dim), jnp.float32)
+    ys = jnp.asarray(rng.randn(n_micro, mb, dim), jnp.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def last_stage_loss(out, target):
+        return jnp.mean((out - target) ** 2)
+
+    loss, grads = pipeline_train_step_1f1b(
+        stage_fn, last_stage_loss, params, xs, ys, mesh)
+
+    def sequential_loss(p):
+        def one(x, y):
+            h = x
+            for s in range(n_stages):
+                ps = jax.tree_util.tree_map(lambda a: a[s], p)
+                h = stage_fn(ps, h)
+            return last_stage_loss(h, y)
+        return jnp.mean(jax.vmap(one)(xs, ys))
+
+    ref_loss = sequential_loss(params)
+    ref_grads = jax.grad(sequential_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    # grads are exactly d(loss)/d(params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]), atol=1e-4)
+
+
+def test_trainer_fit_pipelined_llama_4stage(tmp_path):
+    """End-to-end: Trainer.fit trains a 4-stage LLaMA slice through the
+    GPipe pipeline over the 'pipe' mesh axis (VERDICT r1 item 8 done
+    criterion)."""
+    import argparse
+    import json
+    import numpy as np
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.llama import LlamaConfig
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.parallel import set_mesh
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.trainer.modules import PipelinedCausalLMModule
+
+    parser = argparse.ArgumentParser()
+    add_module_args(parser)
+    add_trainer_args(parser)
+    UniversalDataModule.add_data_specific_args(parser)
+    args = parser.parse_args([
+        "--max_steps", "2", "--train_batchsize", "8",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path),
+        "--pipe_model_parallel_size", "4",
+        "--data_parallel_size", "2"])
+
+    config = LlamaConfig(vocab_size=128, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=4,
+                         num_attention_heads=4,
+                         max_position_embeddings=32, dtype="float32")
+    rng = np.random.RandomState(0)
+    rows = [{"input_ids": rng.randint(0, 127, 16).tolist()}
+            for _ in range(16)]
+
+    class ListDS:
+        def __len__(self):
+            return len(rows)
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    trainer = Trainer(args)  # builds the dp2 x pipe4 mesh
+    module = PipelinedCausalLMModule(args, config)
+    dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 2
+    # stage dim is sharded over the pipe axis
+    w = jax.tree_util.tree_leaves(state.params["layers"])[0]
+    assert "pipe" in str(w.sharding.spec)
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+    set_mesh(None)

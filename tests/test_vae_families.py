@@ -1,0 +1,150 @@
+"""DAVAE / GAVAE / PPVAE / Della tests: forward shapes, loss behavior,
+latent round-trips, and the reference public surfaces (VERDICT r1
+missing #4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def davae():
+    from fengshen_tpu.models.davae import DAVAEConfig, DAVAEModel
+    cfg = DAVAEConfig.small_test_config()
+    model = DAVAEModel(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(3, 100, (2, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    return cfg, model, params, ids
+
+
+def test_davae_forward_and_loss(davae):
+    from fengshen_tpu.models.davae import davae_losses
+    cfg, model, params, ids = davae
+    logits, mean, logvar, latent = model.apply(
+        {"params": params}, ids, rng=jax.random.PRNGKey(1))
+    assert logits.shape == (2, 12, cfg.decoder.vocab_size)
+    assert mean.shape == (2, cfg.latent_size)
+    loss, _, metrics = davae_losses(logits, ids, mean, logvar)
+    assert np.isfinite(float(loss)) and metrics["kl"] >= 0
+
+
+def test_davae_adversarial_losses(davae):
+    from fengshen_tpu.models.davae import LatentCritic, davae_losses
+    cfg, model, params, ids = davae
+    logits, mean, logvar, latent = model.apply(
+        {"params": params}, ids, rng=jax.random.PRNGKey(1))
+    critic = LatentCritic(hidden=16)
+    cparams = critic.init(jax.random.PRNGKey(2), latent)["params"]
+    prior = jax.random.normal(jax.random.PRNGKey(3), latent.shape)
+    real = critic.apply({"params": cparams}, prior)
+    fake = critic.apply({"params": cparams}, latent)
+    vae_loss, critic_loss, metrics = davae_losses(
+        logits, ids, mean, logvar, critic_real=real, critic_fake=fake)
+    assert np.isfinite(float(vae_loss)) and np.isfinite(float(critic_loss))
+    assert "adv" in metrics
+
+
+def test_davae_simulate_roundtrip(davae):
+    from fengshen_tpu.models.davae import (simulate_batch,
+                                           latent_code_from_text_batch)
+    cfg, model, params, ids = davae
+    latent = latent_code_from_text_batch(model, params, ids)
+    assert latent.shape == (2, cfg.latent_size)
+    out = simulate_batch(model, params, ids, max_length=8, bos_id=1)
+    assert out.shape == (2, 8)
+    assert (np.asarray(out[:, 0]) == 1).all()
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_davae_word_dropout():
+    from fengshen_tpu.models.davae import word_dropout
+    ids = jnp.asarray(np.arange(10, 110).reshape(2, 50), jnp.int32)
+    out = word_dropout(ids, 0.5, unk_id=1, rng=jax.random.PRNGKey(0))
+    frac = float((out == 1).mean())
+    assert 0.2 < frac < 0.8
+    out0 = word_dropout(ids, 0.0, unk_id=1, rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(ids))
+
+
+def test_gavae_latent_gan_trains():
+    from fengshen_tpu.models.gavae import GAVAEConfig, GAVAEModel
+    cfg = GAVAEConfig.small_test_config()
+    gavae = GAVAEModel(cfg)
+    rng = np.random.RandomState(0)
+    # two labelled latent clusters
+    latents = jnp.asarray(np.concatenate([
+        rng.randn(16, cfg.latent_size) + 2.0,
+        rng.randn(16, cfg.latent_size) - 2.0]), jnp.float32)
+    labels = jnp.asarray([0] * 16 + [1] * 16, jnp.int32)
+    d_loss, g_loss = gavae.train_gan(latents, labels, steps=30)
+    assert np.isfinite(d_loss) and np.isfinite(g_loss)
+    sampled = gavae.sample_latents(4, label=0, seed=1)
+    assert sampled.shape == (4, cfg.latent_size)
+
+
+def test_gavae_generate_text_through_vae():
+    from fengshen_tpu.models.davae import DAVAEModel
+    from fengshen_tpu.models.gavae import GAVAEConfig, GAVAEModel
+    cfg = GAVAEConfig.small_test_config()
+    vae = DAVAEModel(cfg.vae)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    vae_params = vae.init(jax.random.PRNGKey(0), ids)["params"]
+    gavae = GAVAEModel(cfg, vae_model=vae, vae_params=vae_params)
+    latents = jnp.asarray(np.random.RandomState(0).randn(
+        8, cfg.latent_size), jnp.float32)
+    gavae.train_gan(latents, jnp.zeros((8,), jnp.int32), steps=5)
+    out = gavae.generate(3, max_length=6, bos_id=1)
+    assert out.shape == (3, 6)
+
+
+def test_ppvae_bottleneck_learns_cluster():
+    from fengshen_tpu.models.ppvae import PPVAEConfig, PPVAEModel
+    cfg = PPVAEConfig.small_test_config(kl_weight=1.0, ppvae_lr=3e-3)
+    ppvae = PPVAEModel(cfg)
+    rng = np.random.RandomState(0)
+    pos = jnp.asarray(rng.randn(32, cfg.latent_dim) * 0.1 + 3.0,
+                      jnp.float32)
+    loss, metrics = ppvae.train_plugin(pos, steps=1500)
+    # generated latents should land near the positive cluster (mean 3)
+    gen = ppvae.gen_latent(16, seed=1)
+    center_err = float(jnp.abs(gen.mean() - 3.0))
+    assert center_err < 1.0, (center_err, metrics)
+
+
+def test_ppvae_negative_repulsion_runs():
+    from fengshen_tpu.models.ppvae import PPVAEConfig, PPVAEModel
+    cfg = PPVAEConfig.small_test_config(gamma=0.1)
+    ppvae = PPVAEModel(cfg)
+    rng = np.random.RandomState(0)
+    pos = jnp.asarray(rng.randn(16, cfg.latent_dim) + 2.0, jnp.float32)
+    neg = jnp.asarray(rng.randn(16, cfg.latent_dim) - 2.0, jnp.float32)
+    loss, metrics = ppvae.train_plugin(pos, neg, steps=20)
+    assert np.isfinite(loss) and metrics["neg_loss"] >= 0
+
+
+def test_della_forward_and_hierarchical_kl():
+    from fengshen_tpu.models.deepvae import (DellaConfig, DellaModel,
+                                             della_loss)
+    cfg = DellaConfig.small_test_config()
+    model = DellaModel(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(3, 100, (2, 10)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    logits, posts, priors = model.apply({"params": params}, ids,
+                                        rng=jax.random.PRNGKey(1))
+    assert logits.shape == (2, 10, cfg.gpt2.vocab_size)
+    assert len(posts) == cfg.gpt2.n_layer == len(priors)
+    loss, metrics = della_loss(logits, ids, posts, priors)
+    assert np.isfinite(float(loss)) and float(metrics["kl"]) >= 0
+
+    # grads flow through every latent level
+    def loss_fn(p):
+        logits, posts, priors = model.apply({"params": p}, ids,
+                                            rng=jax.random.PRNGKey(1))
+        return della_loss(logits, ids, posts, priors)[0]
+    g = jax.grad(loss_fn)(params)
+    for i in range(cfg.gpt2.n_layer):
+        gnorm = float(jnp.abs(g[f"posterior_{i}"]["kernel"]).sum())
+        assert gnorm > 0, f"no grad into posterior_{i}"
