@@ -80,14 +80,16 @@ class PegasusGSGCollator(Seq2SeqCollator):
 
     def _split(self, sample: dict) -> tuple[list[str], set[int]]:
         # source_text and target_text are called back-to-back per sample;
-        # memoise the quadratic GSG scoring so it runs once, not twice
-        if getattr(self, "_memo_key", None) == id(sample):
+        # memoise the quadratic GSG scoring so it runs once, not twice.
+        # Hold the sample OBJECT (not its id) so a recycled address can
+        # never alias a stale entry.
+        if getattr(self, "_memo_sample", None) is sample:
             return self._memo_val
         sents = split_sentences(sample[self.content_key])
         if not sents:
             sents = [sample[self.content_key] or self.mask_sentence_token]
         result = (sents, set(gap_sentence_ids(sents, self.gsg_ratio)))
-        self._memo_key, self._memo_val = id(sample), result
+        self._memo_sample, self._memo_val = sample, result
         return result
 
     def source_text(self, sample: dict) -> str:

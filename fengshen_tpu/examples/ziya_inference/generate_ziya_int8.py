@@ -1,0 +1,87 @@
+"""Ziya-LLaMA int8 serving demo.
+
+Port of the reference's quantized serving paths
+(reference: fengshen/examples/ziya_inference/ — `load_in_8bit=True` and
+the llama.cpp recipe): weights are int8 at rest (half the HBM/checkpoint),
+dequantized inside the jitted decode step where XLA fuses the upcast into
+each matmul.
+
+    python -m fengshen_tpu.examples.ziya_inference.generate_ziya_int8 \
+        --model_path <ziya-dir> --prompt "帮我写一首关于春天的诗"
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from fengshen_tpu.utils.generate import generate
+from fengshen_tpu.utils.quantization import (dequantize_params,
+                                             quantize_params_int8,
+                                             quantized_nbytes)
+
+
+def quantized_generate(model, qparams, input_ids, attention_mask=None,
+                       max_new_tokens: int = 64, **kwargs):
+    """generate() over int8 weights: dequant happens inside the jitted
+    steps (generate jits the decode loop), so bf16 copies are transient."""
+
+    class _DequantApply:
+        """Adapter: model whose apply dequantizes on entry."""
+
+        def __init__(self, model):
+            self._model = model
+
+        def init(self, *a, **k):
+            return self._model.init(*a, **k)
+
+        def apply(self, variables, *a, **k):
+            variables = dict(variables)
+            variables["params"] = dequantize_params(variables["params"])
+            return self._model.apply(variables, *a, **k)
+
+    return generate(_DequantApply(model), qparams, input_ids,
+                    attention_mask=attention_mask,
+                    max_new_tokens=max_new_tokens, **kwargs)
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", type=str, required=True)
+    parser.add_argument("--prompt", type=str,
+                        default="帮我写一首关于春天的诗")
+    parser.add_argument("--max_new_tokens", type=int, default=128)
+    parser.add_argument("--temperature", type=float, default=0.85)
+    parser.add_argument("--top_p", type=float, default=0.85)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    config = LlamaConfig.from_pretrained(args.model_path)
+    model = LlamaForCausalLM(config)
+
+    import torch
+
+    from fengshen_tpu.models.llama.convert import torch_to_params
+    import os
+    params = torch_to_params(
+        torch.load(os.path.join(args.model_path, "pytorch_model.bin"),
+                   map_location="cpu"), config)
+    qparams = quantize_params_int8(params)
+    print(f"int8 weights: {quantized_nbytes(qparams) / 1e9:.2f} GB")
+
+    text = f"<human>:{args.prompt}\n<bot>:"
+    ids = jnp.asarray([tokenizer.encode(text)], jnp.int32)
+    out = quantized_generate(
+        model, qparams, ids, max_new_tokens=args.max_new_tokens,
+        do_sample=True, temperature=args.temperature, top_p=args.top_p,
+        eos_token_id=tokenizer.eos_token_id)
+    print(tokenizer.decode([int(t) for t in out[0]]))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,11 @@ class LlamaConfig:
     dtype: str = "bfloat16"            # activation/compute dtype
     param_dtype: str = "float32"
     gradient_checkpointing: bool = False
+    # remat policy for gradient checkpointing (the MFU lever VERDICT r1
+    # item 2 calls out): "nothing" recomputes the full layer;
+    # "dots_no_batch" saves matmul outputs (jax
+    # dots_with_no_batch_dims_saveable); "checkpoint_dots" saves all dots
+    remat_policy: str = "nothing"      # nothing | dots_no_batch | checkpoint_dots
     attention_impl: str = "dense"      # dense | flash | ring
     # lax.scan over layers: one compiled layer body regardless of depth —
     # keeps compile time/program size O(1) in num_hidden_layers and is the

@@ -248,12 +248,18 @@ class LlamaModel(nn.Module):
         hidden = with_sharding_constraint(
             hidden, P(BATCH_AXES, "sequence", None))
 
+        remat_policy = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+        }[getattr(cfg, "remat_policy", "nothing")]
         if cfg.scan_layers:
             body = _ScanDecoderLayer
             if cfg.gradient_checkpointing:
                 body = nn.remat(
                     body, static_argnums=(4, 5),
-                    policy=jax.checkpoint_policies.nothing_saveable,
+                    policy=remat_policy,
                     prevent_cse=False)
             scan = nn.scan(
                 body,
@@ -269,7 +275,7 @@ class LlamaModel(nn.Module):
             if cfg.gradient_checkpointing:
                 layer_cls = nn.remat(
                     layer_cls, static_argnums=(4, 5),
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                    policy=remat_policy)
             for i in range(cfg.num_hidden_layers):
                 hidden = layer_cls(cfg, name=f"layers_{i}")(
                     hidden, attention_mask, position_ids, init_cache,

@@ -2,18 +2,15 @@
 
 Port of reference: fengshen/models/transfo_xl_paraphrase/generate.py:16-60 —
 the released Randeng-TransformerXL-Paraphrase checkpoint is prompted with
-``“{text}”的相似句是“`` and sampled until the closing quote.
+``“{text}”的相似句是“`` and sampled until the closing quote. Batching and
+sampling ride the shared utils.generate.generate_with_prompts.
 """
 
 from __future__ import annotations
 
 from typing import Any, List, Union
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from fengshen_tpu.utils.generate import sample_sequence_batch
+from fengshen_tpu.utils.generate import generate_with_prompts
 
 
 def paraphrase_generate(model: Any, params: Any, tokenizer: Any,
@@ -25,22 +22,7 @@ def paraphrase_generate(model: Any, params: Any, tokenizer: Any,
     if isinstance(input_text, str):
         input_text = [input_text]
     prompts = [f"“{text}”的相似句是“" for text in input_text]
-    enc = [tokenizer.encode(p) for p in prompts]
-    enc = [ids[:-1] if ids and ids[-1] == tokenizer.eos_token_id else ids
-           for ids in enc]
-    max_len = max(len(x) for x in enc)
-    pad = tokenizer.pad_token_id or 0
-    # left-pad so every prompt ends at the same position
-    batch = np.full((len(enc), max_len), pad, np.int32)
-    for i, ids in enumerate(enc):
-        batch[i, max_len - len(ids):] = ids
-    out = sample_sequence_batch(
-        model, params, jnp.asarray(batch), max_out_seq=max_out_seq,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-        eos_token_id=tokenizer.eos_token_id,
-        rng=jax.random.PRNGKey(seed))
-    results = []
-    for row in np.asarray(out):
-        text = tokenizer.decode([int(t) for t in row[max_len:]])
-        results.append(text.split("”")[0].replace(" ", ""))
-    return results
+    outs = generate_with_prompts(
+        model, params, tokenizer, prompts, max_out_seq=max_out_seq,
+        temperature=temperature, top_k=top_k, top_p=top_p, seed=seed)
+    return [o.split("”")[0].replace(" ", "") for o in outs]

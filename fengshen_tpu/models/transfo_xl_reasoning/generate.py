@@ -4,18 +4,15 @@ Port of reference: fengshen/models/transfo_xl_reasoning/generate.py:22-120 —
 the Randeng-TransformerXL-Abduction/Deduction checkpoints use the fixed
 prompts ``<bos>{text}，因而`` (deduction, :39) and
 ``<bos>之所以{text}，是因为`` (abduction, :87), with Chinese punctuation
-normalisation (:13-19).
+normalisation (:13-19). Batching/sampling rides the shared
+utils.generate.generate_with_prompts (left-pad + mask aware).
 """
 
 from __future__ import annotations
 
 from typing import Any, List, Union
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from fengshen_tpu.utils.generate import sample_sequence_batch
+from fengshen_tpu.utils.generate import generate_with_prompts
 
 
 def en_to_zh(sentence: str) -> str:
@@ -26,24 +23,12 @@ def en_to_zh(sentence: str) -> str:
     return sentence.translate(table)
 
 
-def _generate_with_prompt(model, params, tokenizer, prompts,
-                          max_out_seq, temperature, top_k, top_p, seed):
-    enc = [tokenizer.encode(p) for p in prompts]
-    enc = [ids[:-1] if ids and ids[-1] == tokenizer.eos_token_id else ids
-           for ids in enc]
-    max_len = max(len(x) for x in enc)
-    pad = tokenizer.pad_token_id or 0
-    batch = np.full((len(enc), max_len), pad, np.int32)
-    for i, ids in enumerate(enc):
-        batch[i, max_len - len(ids):] = ids
-    out = sample_sequence_batch(
-        model, params, jnp.asarray(batch), max_out_seq=max_out_seq,
-        temperature=temperature, top_k=top_k, top_p=top_p,
-        eos_token_id=tokenizer.eos_token_id,
-        rng=jax.random.PRNGKey(seed))
-    return [en_to_zh(tokenizer.decode(
-        [int(t) for t in row[max_len:]])).replace(" ", "")
-        for row in np.asarray(out)]
+def _reason(model, params, tokenizer, prompts, max_out_seq, temperature,
+            top_k, top_p, seed):
+    outs = generate_with_prompts(
+        model, params, tokenizer, prompts, max_out_seq=max_out_seq,
+        temperature=temperature, top_k=top_k, top_p=top_p, seed=seed)
+    return [en_to_zh(o).replace(" ", "") for o in outs]
 
 
 def deduction_generate(model: Any, params: Any, tokenizer: Any,
@@ -55,9 +40,8 @@ def deduction_generate(model: Any, params: Any, tokenizer: Any,
     if isinstance(input_text, str):
         input_text = [input_text]
     prompts = [f"<bos>{text}，因而" for text in input_text]
-    return _generate_with_prompt(model, params, tokenizer, prompts,
-                                 max_out_seq, temperature, top_k, top_p,
-                                 seed)
+    return _reason(model, params, tokenizer, prompts, max_out_seq,
+                   temperature, top_k, top_p, seed)
 
 
 def abduction_generate(model: Any, params: Any, tokenizer: Any,
@@ -69,6 +53,5 @@ def abduction_generate(model: Any, params: Any, tokenizer: Any,
     if isinstance(input_text, str):
         input_text = [input_text]
     prompts = [f"<bos>之所以{text}，是因为" for text in input_text]
-    return _generate_with_prompt(model, params, tokenizer, prompts,
-                                 max_out_seq, temperature, top_k, top_p,
-                                 seed)
+    return _reason(model, params, tokenizer, prompts, max_out_seq,
+                   temperature, top_k, top_p, seed)

@@ -162,30 +162,21 @@ def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
         x_saved = jnp.take(in_buf, fwd_for(m_b) % ring, axis=0)
         target = jnp.take(micro_targets, fwd_for(m_b), axis=0)
 
-        def stage_loss(p, x_in):
-            out = stage_fn(p, x_in)
-            # the LAST stage's backward seeds from the loss; other stages
-            # propagate the received cotangent (handled below)
-            return last_stage_loss(out, target)
-
-        # last stage: vjp through stage_fn∘loss, seeded by 1.0
-        l_val, l_vjp = jax.vjp(stage_loss, local_params, x_saved)
-        dl_p, dl_x = l_vjp(jnp.ones((), l_val.dtype))
-        # other stages: vjp through stage_fn, seeded by received cotangent
-        _, s_vjp = jax.vjp(lambda p, x_in: stage_fn(p, x_in),
-                           local_params, x_saved)
-        ds_p, ds_x = s_vjp(bwd_state)
+        # ONE stage vjp serves both roles: the last stage seeds it with
+        # the loss cotangent, others with the received cotangent
+        out, s_vjp = jax.vjp(lambda p, x_in: stage_fn(p, x_in),
+                             local_params, x_saved)
+        l_val, l_vjp = jax.vjp(lambda o: last_stage_loss(o, target), out)
+        (d_out,) = l_vjp(jnp.ones((), l_val.dtype))
+        seed = jnp.where(is_last, d_out, bwd_state)
+        ds_p, ds_x = s_vjp(seed)
 
         use_last = jnp.logical_and(bwd_live, is_last)
-        use_mid = jnp.logical_and(bwd_live, jnp.logical_not(is_last))
         dparams = jax.tree_util.tree_map(
-            lambda acc, dl, ds: acc +
-            jnp.where(use_last, dl.astype(jnp.float32), 0.0) +
-            jnp.where(use_mid, ds.astype(jnp.float32), 0.0),
-            dparams, dl_p, ds_p)
-        dx_out = jnp.where(use_last, dl_x,
-                           jnp.where(use_mid, ds_x,
-                                     jnp.zeros_like(ds_x)))
+            lambda acc, ds: acc +
+            jnp.where(bwd_live, ds.astype(jnp.float32), 0.0),
+            dparams, ds_p)
+        dx_out = jnp.where(bwd_live, ds_x, jnp.zeros_like(ds_x))
         loss_acc = loss_acc + jnp.where(use_last, l_val, 0.0)
 
         # ---- rotate both lanes ----

@@ -124,13 +124,48 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
 
 
 def sample_sequence_batch(model, params, context: jax.Array,
-                          max_out_seq: int, *, temperature: float = 1.0,
+                          max_out_seq: int, *,
+                          attention_mask: Optional[jax.Array] = None,
+                          temperature: float = 1.0,
                           top_k: int = 0, top_p: float = 0.0,
                           eos_token_id: Optional[int] = None,
                           rng: Optional[jax.Array] = None) -> jax.Array:
     """Name/shape parity with the reference's sampling helper
-    (reference: fengshen/utils/transfo_xl_utils.py sample_sequence_batch)."""
+    (reference: fengshen/utils/transfo_xl_utils.py sample_sequence_batch).
+    `attention_mask` marks real tokens of a LEFT-padded context — required
+    whenever prompts in the batch have different lengths."""
     max_new = max_out_seq - context.shape[1]
-    return generate(model, params, context, max_new_tokens=max_new,
+    return generate(model, params, context,
+                    attention_mask=attention_mask, max_new_tokens=max_new,
                     do_sample=True, temperature=temperature, top_k=top_k,
                     top_p=top_p, eos_token_id=eos_token_id, rng=rng)
+
+
+def generate_with_prompts(model, params, tokenizer, prompts: list,
+                          max_out_seq: int = 128, *,
+                          temperature: float = 1.0, top_k: int = 0,
+                          top_p: float = 0.0, seed: int = 0) -> list:
+    """Encode → strip trailing eos → LEFT-pad with mask → sample → decode
+    continuations (the shared driver behind the transfo_xl paraphrase /
+    reasoning surfaces, reference: fengshen/utils/transfo_xl_utils.py).
+    Returns the decoded text AFTER each prompt."""
+    import numpy as np
+
+    enc = [tokenizer.encode(p) for p in prompts]
+    enc = [ids[:-1] if ids and ids[-1] == tokenizer.eos_token_id else ids
+           for ids in enc]
+    max_len = max(len(x) for x in enc)
+    pad = tokenizer.pad_token_id or 0
+    batch = np.full((len(enc), max_len), pad, np.int32)
+    mask = np.zeros((len(enc), max_len), np.int32)
+    for i, ids in enumerate(enc):
+        batch[i, max_len - len(ids):] = ids
+        mask[i, max_len - len(ids):] = 1
+    out = sample_sequence_batch(
+        model, params, jnp.asarray(batch),
+        attention_mask=jnp.asarray(mask), max_out_seq=max_out_seq,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=tokenizer.eos_token_id,
+        rng=jax.random.PRNGKey(seed))
+    return [tokenizer.decode([int(t) for t in row[max_len:]])
+            for row in np.asarray(out)]
