@@ -6,6 +6,9 @@ from fengshen_tpu.data.megatron_dataloader.indexed_dataset import (
 from fengshen_tpu.data.megatron_dataloader.blendable_dataset import (
     BlendableDataset)
 from fengshen_tpu.data.megatron_dataloader.gpt_dataset import GPTDataset
+from fengshen_tpu.data.megatron_dataloader.bert_dataset import BertDataset
+from fengshen_tpu.data.megatron_dataloader.bart_dataset import BartDataset
 
 __all__ = ["MMapIndexedDataset", "MMapIndexedDatasetBuilder",
-           "BlendableDataset", "GPTDataset"]
+           "BlendableDataset", "GPTDataset", "BertDataset",
+           "BartDataset"]

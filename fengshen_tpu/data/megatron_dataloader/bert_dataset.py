@@ -1,0 +1,94 @@
+"""BERT-style MLM+NSP/SOP dataset over an indexed corpus.
+
+Behavioural port of reference:
+fengshen/data/megatron_dataloader/bert_dataset.py:30-196 — sentence-window
+samples from the native `build_mapping` index, A/B segment pairing,
+truncation, [CLS]/[SEP] assembly, whole-word MLM, and fixed-length padding
+with -100 loss masking (`pad_and_convert_to_numpy`, :166).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from fengshen_tpu.data.data_utils import (create_masked_lm_predictions,
+                                          create_tokens_and_tokentypes,
+                                          get_a_and_b_segments,
+                                          truncate_segments)
+from fengshen_tpu.data.megatron_dataloader.helpers import build_mapping
+from fengshen_tpu.data.megatron_dataloader.indexed_dataset import (
+    MMapIndexedDataset)
+
+
+class BertDataset:
+    """Sentence-pair MLM+NSP samples (reference: bert_dataset.py:30-88)."""
+
+    def __init__(self, indexed: MMapIndexedDataset,
+                 tokenizer: Any,
+                 max_seq_length: int = 512,
+                 masked_lm_prob: float = 0.15,
+                 short_seq_prob: float = 0.1,
+                 seed: int = 0,
+                 zh_tokenizer: Optional[Any] = None):
+        self.indexed = indexed
+        self.tokenizer = tokenizer
+        self.max_seq_length = max_seq_length
+        self.masked_lm_prob = masked_lm_prob
+        self.seed = seed
+        # None = default to jieba (the reference's Chinese WWM);
+        # False = plain wordpiece grouping (non-Chinese corpora / tests)
+        if zh_tokenizer is None:
+            try:
+                import jieba
+                zh_tokenizer = jieba.lcut
+            except ImportError:  # pragma: no cover
+                zh_tokenizer = False
+        self.zh_tokenizer = zh_tokenizer or None
+        # sentence windows from the native mapping (reference uses the C++
+        # build_mapping over doc/sentence indices, :44-56)
+        docs = np.asarray(indexed.doc_idx, np.int64)
+        sizes = np.asarray(indexed.sizes, np.int32)
+        self.samples_mapping = build_mapping(
+            docs, sizes, max_seq_length - 3, short_seq_prob, seed)
+        vocab = tokenizer.get_vocab()
+        self.vocab_id_list = list(vocab.values())
+        self.vocab_id_to_token = {v: k for k, v in vocab.items()}
+
+    def __len__(self) -> int:
+        return len(self.samples_mapping)
+
+    def __getitem__(self, idx: int) -> dict:
+        start, end, target_len = (int(x) for x in self.samples_mapping[idx])
+        sents = [np.asarray(self.indexed[i]).tolist()
+                 for i in range(start, end)]
+        np_rng = np.random.RandomState((self.seed + idx) % (2 ** 31))
+        tok = self.tokenizer
+
+        a, b, is_random = get_a_and_b_segments(sents, np_rng)
+        truncate_segments(a, b, len(a), len(b), target_len, np_rng)
+        tokens, tokentypes = create_tokens_and_tokentypes(
+            a, b, tok.cls_token_id, tok.sep_token_id)
+        masked_tokens, positions, labels = create_masked_lm_predictions(
+            tokens, self.vocab_id_list, self.vocab_id_to_token,
+            self.masked_lm_prob, tok.cls_token_id, tok.sep_token_id,
+            tok.mask_token_id,
+            max_predictions_per_seq=int(
+                self.masked_lm_prob * self.max_seq_length) + 1,
+            np_rng=np_rng, zh_tokenizer=self.zh_tokenizer)
+
+        mlm_labels = [-100] * len(tokens)
+        for pos, label in zip(positions, labels):
+            mlm_labels[pos] = label
+        pad_id = tok.pad_token_id or 0
+        pad = self.max_seq_length - len(masked_tokens)
+        return {
+            "input_ids": np.asarray(masked_tokens + [pad_id] * pad,
+                                    np.int32),
+            "attention_mask": np.asarray(
+                [1] * len(masked_tokens) + [0] * pad, np.int32),
+            "token_type_ids": np.asarray(tokentypes + [0] * pad, np.int32),
+            "labels": np.asarray(mlm_labels + [-100] * pad, np.int32),
+            "next_sentence_label": np.asarray(int(is_random), np.int32),
+        }

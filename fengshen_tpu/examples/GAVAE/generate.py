@@ -1,0 +1,46 @@
+"""GAVAE data-augmentation demo: train the latent GAN on a handful of
+labelled latents, then sample class-conditional text
+(reference: fengshen/examples/GAVAE/generate.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.davae import DAVAEModel
+from fengshen_tpu.models.gavae import GAVAEConfig, GAVAEModel
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=4)
+    parser.add_argument("--label", type=int, default=0)
+    parser.add_argument("--gan_steps", type=int, default=20)
+    parser.add_argument("--max_length", type=int, default=12)
+    args = parser.parse_args(argv)
+
+    cfg = GAVAEConfig.small_test_config()
+    vae = DAVAEModel(cfg.vae)
+    vae_params = vae.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    gavae = GAVAEModel(cfg, vae_model=vae, vae_params=vae_params)
+
+    rng = np.random.RandomState(0)
+    latents = jnp.asarray(np.concatenate(
+        [rng.randn(8, cfg.latent_size) + 2.0,
+         rng.randn(8, cfg.latent_size) - 2.0]), jnp.float32)
+    labels = jnp.asarray([0] * 8 + [1] * 8, jnp.int32)
+    d_loss, g_loss = gavae.train_gan(latents, labels, steps=args.gan_steps)
+    print(f"gan trained: d_loss={d_loss:.3f} g_loss={g_loss:.3f}")
+    out = gavae.generate(args.n, label=args.label,
+                         max_length=args.max_length)
+    for row in np.asarray(out):
+        print(" ".join(str(int(t)) for t in row))
+    return np.asarray(out)
+
+
+if __name__ == "__main__":
+    main()

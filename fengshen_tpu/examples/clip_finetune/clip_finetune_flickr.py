@@ -1,0 +1,21 @@
+"""Taiyi-CLIP contrastive finetune on Flickr-style image-text CSVs.
+
+Port of the reference workload
+(reference: fengshen/examples/clip_finetune/clip_finetune_flickr.py):
+the same contrastive module as pretrain_taiyi_clip with both towers
+trainable and a finetune-scale LR — the reference splits pretrain/finetune
+into separate dirs; here the finetune driver reuses the pretrain module.
+"""
+
+from __future__ import annotations
+
+
+def main(argv=None):
+    from fengshen_tpu.examples.pretrain_taiyi_clip.pretrain import main \
+        as pretrain_main
+    # finetune = same driver, both towers trainable (no --freeze_image_tower)
+    pretrain_main(argv)
+
+
+if __name__ == "__main__":
+    main()

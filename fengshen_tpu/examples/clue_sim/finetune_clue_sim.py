@@ -1,0 +1,147 @@
+"""QBQTC (CLUE semantic-similarity) finetune.
+
+Port of the reference workload
+(reference: fengshen/examples/clue_sim/finetune_clue_sim.py:30-260 +
+loss.py:19-60): {query, title, label∈{0,1,2}} pairs classified with a
+BERT-family pair encoder, trained with CE / focal / label-smoothing losses
+(--loss_function, the reference's ablation surface).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.megatron_bert import (
+    MegatronBertConfig, MegatronBertForSequenceClassification)
+from fengshen_tpu.trainer.module import TrainModule
+
+
+def focal_loss(logits, labels, gamma: float = 2.0):
+    """Multi-class focal loss (reference: loss.py:19-40)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    gold = jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+    p = jnp.exp(gold)
+    return (-((1 - p) ** gamma) * gold).mean()
+
+
+def label_smoothing_ce(logits, labels, eps: float = 0.1):
+    """Label-smoothing CE (reference: loss.py:42-60)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    gold = jnp.take_along_axis(logp, labels[:, None], 1)[:, 0]
+    return (-(1 - eps) * gold - eps * logp.mean(-1)).mean()
+
+
+@dataclass
+class ClueSimCollator:
+    """query/title pair → [CLS] q [SEP] t [SEP]
+    (reference: finetune_clue_sim.py:30-80)."""
+
+    tokenizer: Any
+    max_seq_length: int = 128
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        max_len = self.max_seq_length
+        batch = {"input_ids": [], "attention_mask": [],
+                 "token_type_ids": [], "labels": []}
+        for s in samples:
+            q = tok.encode(s["query"], add_special_tokens=False)
+            t = tok.encode(s["title"], add_special_tokens=False)
+            avail = max_len - 3
+            q = q[: avail // 2]
+            t = t[: avail - len(q)]
+            ids = [tok.cls_token_id] + q + [tok.sep_token_id] + t + \
+                [tok.sep_token_id]
+            tt = [0] * (len(q) + 2) + [1] * (len(t) + 1)
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["token_type_ids"].append(tt + [0] * pad)
+            batch["labels"].append(int(s.get("label", 0)))
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class ClueSimModule(TrainModule):
+    def __init__(self, args, config: Optional[MegatronBertConfig] = None):
+        super().__init__(args)
+        import dataclasses as dc
+        if config is None and getattr(args, "model_path", None):
+            config = MegatronBertConfig.from_pretrained(args.model_path)
+        if config is None:
+            raise ValueError("ClueSimModule needs a config or --model_path")
+        config = dc.replace(config, num_labels=args.num_labels)
+        self.config = config
+        self.model = MegatronBertForSequenceClassification(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("clue_sim")
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument("--num_labels", type=int, default=3)
+        parser.add_argument("--loss_function", type=str, default="ce",
+                            choices=["ce", "focal", "lsce"])
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 16), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            token_type_ids=batch["token_type_ids"],
+            deterministic=False, rngs={"dropout": rng})
+        kind = getattr(self.args, "loss_function", "ce")
+        if kind == "focal":
+            loss = focal_loss(logits, batch["labels"])
+        elif kind == "lsce":
+            loss = label_smoothing_ce(logits, batch["labels"])
+        else:
+            from fengshen_tpu.parallel.cross_entropy import (
+                stable_cross_entropy)
+            loss, _ = stable_cross_entropy(logits[:, None, :],
+                                           batch["labels"][:, None])
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        return loss, {"acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = ClueSimModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = ClueSimCollator(tokenizer,
+                               max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = ClueSimModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Della (deepVAE) pretraining.
+
+Port of the reference workload
+(reference: fengshen/examples/deepVAE/pretrain_deep_vae.py): hierarchical
+per-layer-latent VAE training with KL annealing (beta warmup).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.deepvae import DellaConfig, DellaModel, della_loss
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class TextLMCollator:
+    tokenizer: Any
+    max_seq_length: int = 128
+    content_key: str = "text"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        batch = {"input_ids": [], "attention_mask": []}
+        for s in samples:
+            ids = tok.encode(s[self.content_key], add_special_tokens=False
+                             )[: self.max_seq_length]
+            pad = self.max_seq_length - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class DellaPretrainModule(TrainModule):
+    def __init__(self, args, config: Optional[DellaConfig] = None):
+        super().__init__(args)
+        self.config = config or DellaConfig()
+        self.model = DellaModel(self.config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("della pretrain")
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument(
+            "--kl_weight", type=float, default=1.0,
+            help="constant KL weight; pair with --free_bits for the "
+                 "posterior-collapse mitigation")
+        parser.add_argument("--free_bits", type=float, default=0.0)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        rng, sample_rng, drop_rng = jax.random.split(rng, 3)
+        logits, posts, priors = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            rng=sample_rng, deterministic=False,
+            rngs={"dropout": drop_rng})
+        loss, metrics = della_loss(
+            logits, batch["input_ids"], posts, priors,
+            kl_weight=getattr(self.args, "kl_weight", 1.0),
+            free_bits=getattr(self.args, "free_bits", 0.0))
+        return loss, metrics
+
+    def partition_rules(self):
+        return super().partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = DellaPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = TextLMCollator(tokenizer,
+                              max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = DellaPretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

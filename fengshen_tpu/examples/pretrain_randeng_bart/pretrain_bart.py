@@ -1,0 +1,97 @@
+"""Randeng-BART denoising pretraining over an indexed corpus.
+
+Port of the reference workload
+(reference: fengshen/examples/pretrain_randeng_bart/pretrain_bart.py):
+fairseq-style text infilling via data.megatron_dataloader.BartDataset
+(sentence permutation + Poisson whole-word infilling) feeding
+BartForConditionalGeneration with shifted-decoder CE.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.megatron_dataloader import (BartDataset,
+                                                   MMapIndexedDataset)
+from fengshen_tpu.models.bart import BartConfig, BartForConditionalGeneration
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class BartPretrainModule(TrainModule):
+    def __init__(self, args, config: Optional[BartConfig] = None):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = BartConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = BartForConditionalGeneration(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("bart pretrain")
+        parser.add_argument("--data_prefix", type=str, default=None,
+                            help="MMapIndexedDataset path prefix")
+        parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument("--masked_lm_prob", type=float, default=0.15)
+        return parent_parser
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        # decoder input = clean target shifted right with decoder_start
+        labels = batch["labels"]
+        start = self.config.decoder_start_token_id
+        safe = jnp.where(labels == -100, self.config.pad_token_id
+                         if hasattr(self.config, "pad_token_id") else 0,
+                         labels)
+        dec_in = jnp.concatenate(
+            [jnp.full((labels.shape[0], 1), start, labels.dtype),
+             safe[:, :-1]], axis=1)
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"], dec_in,
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, n_tokens = vocab_parallel_cross_entropy(logits, labels)
+        return loss, {"n_tokens": n_tokens}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = BartPretrainModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    dataset = BartDataset(
+        MMapIndexedDataset(args.data_prefix), tokenizer,
+        max_seq_length=args.max_seq_length,
+        masked_lm_prob=args.masked_lm_prob)
+    datamodule = UniversalDataModule(tokenizer=tokenizer, args=args,
+                                     datasets={"train": dataset})
+    module = BartPretrainModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

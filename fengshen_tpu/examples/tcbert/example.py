@@ -1,0 +1,35 @@
+"""TCBert topic-classification prompt demo.
+
+Port of the reference driver (reference: fengshen/examples/tcbert/ —
+TCBertPipelines prompt-based topic classification).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fengshen_tpu.models.tcbert import TCBertPipelines
+
+
+TEST_DATA = [{"content": "街头偶遇2018款长安CS35，颜值美炸！"},
+             {"content": "今天股市大涨，投资者信心回升"}]
+LABELS = ["汽车", "财经", "教育", "军事"]
+
+
+def main(argv=None, pipeline=None):
+    parser = argparse.ArgumentParser("TASK NAME")
+    if hasattr(TCBertPipelines, "pipelines_args"):
+        parser = TCBertPipelines.pipelines_args(parser)
+    args, _ = parser.parse_known_args(argv)
+    if pipeline is None:
+        pipeline = TCBertPipelines(args,
+                                   model=getattr(args, "model_path", None))
+    result = pipeline.predict([s["content"] for s in TEST_DATA],
+                              label_words=LABELS)
+    for line in result:
+        print(line)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -462,11 +462,16 @@ class Trainer:
                 break
             try:
                 loss, _ = val_fn(state.params, batch, rng)
-            except (TypeError, ValueError):
-                # val batch structure differs from the train batch spec —
-                # fall back to inferred shardings
-                self._val_fn = val_fn = jax.jit(module.validation_loss)
-                loss, _ = val_fn(state.params, batch, rng)
+            except (TypeError, ValueError) as e:
+                # this batch doesn't fit the train batch spec — run IT on a
+                # separately cached inferred-sharding jit, but keep the
+                # sharded val_fn for subsequent conforming batches
+                if not hasattr(self, "_val_fn_plain"):
+                    self._val_fn_plain = jax.jit(module.validation_loss)
+                    self._log({"event": "val_shard_fallback",
+                               "step": self.global_step,
+                               "error": str(e)[:200]})
+                loss, _ = self._val_fn_plain(state.params, batch, rng)
             losses.append(float(loss))
         if losses:
             self._log({"step": self.global_step,

@@ -82,10 +82,15 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
     # reference: modeling_llama.py:353-375)
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
 
-    variables = model.init(jax.random.PRNGKey(0),
+    # cache built from abstract shapes only — a real init would materialize
+    # a full-precision param tree (fatal for the int8 serving path on
+    # models sized to barely fit)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((batch, 1), jnp.int32),
-                           init_cache=True)
-    cache = variables["cache"]
+                           init_cache=True))
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
 
     logits, mutated = model.apply(
         {"params": params, "cache": cache}, input_ids,

@@ -168,3 +168,62 @@ def test_t5_collator_shapes():
     assert (batch["input_ids"] >= 110).any()
     # decoder input starts with decoder_start_token
     assert (batch["decoder_input_ids"][:, 0] == 0).all()
+
+
+class _MiniTok:
+    """Char-level tokenizer stub with BERT special ids."""
+
+    cls_token_id, sep_token_id, mask_token_id, pad_token_id = 2, 3, 4, 0
+
+    def __init__(self, n=80):
+        self._vocab = {f"tok{i}": i for i in range(n)}
+
+    def get_vocab(self):
+        return self._vocab
+
+
+def _corpus(tmp_path, n_docs=4, sents_per_doc=4):
+    rng = np.random.RandomState(0)
+    prefix = str(tmp_path / "bx")
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    for _ in range(n_docs):
+        for _ in range(sents_per_doc):
+            b.add_item(rng.randint(5, 79, rng.randint(4, 9)).tolist())
+        b.end_document()
+    b.finalize()
+    return MMapIndexedDataset(prefix)
+
+
+def test_bert_dataset_mlm_nsp(tmp_path):
+    from fengshen_tpu.data.megatron_dataloader import BertDataset
+    ds = BertDataset(_corpus(tmp_path), _MiniTok(), max_seq_length=48,
+                     seed=1, zh_tokenizer=False)
+    assert len(ds) > 0
+    s = ds[0]
+    assert s["input_ids"].shape == (48,)
+    assert s["input_ids"][0] == 2  # [CLS]
+    # MLM: some positions carry original-token labels, rest are -100
+    assert (s["labels"] != -100).sum() > 0
+    masked = s["labels"] != -100
+    assert (s["input_ids"][masked] != s["labels"][masked]).any()
+    assert s["next_sentence_label"] in (0, 1)
+    # token types mark the A/B segments
+    assert set(np.unique(s["token_type_ids"])) <= {0, 1}
+
+
+def test_bart_dataset_denoising(tmp_path):
+    from fengshen_tpu.data.megatron_dataloader import BartDataset
+    ds = BartDataset(_corpus(tmp_path), _MiniTok(), max_seq_length=64,
+                     seed=1, zh_tokenizer=False)
+    assert len(ds) == 4
+    s = ds[0]
+    assert s["input_ids"].shape == (64,)
+    assert s["input_ids"][0] == 2  # [CLS] stays first
+    n_src = int(s["attention_mask"].sum())
+    n_tgt = int((s["labels"] != -100).sum())
+    # infilling shortens the source vs the clean target (+1 for no CLS)
+    assert n_src < n_tgt + 1
+    # mask token present in the corrupted source
+    assert (s["input_ids"][:n_src] == 4).any()
+    # labels are the CLEAN text (no masks)
+    assert not (s["labels"][:n_tgt] == 4).any()
