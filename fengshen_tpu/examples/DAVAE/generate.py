@@ -39,10 +39,9 @@ def main(argv=None, model=None, params=None, tokenizer=None):
         ids = np.zeros((len(enc), max_len), np.int32)
         for i, e in enumerate(enc):
             ids[i, :len(e)] = e
-    else:  # demo path without a tokenizer: byte-ish ids
-        ids = np.asarray([[min(3 + (ord(c) % 90), 95) for c in s[:16]] +
-                          [0] * (16 - len(s[:16]))
-                          for s in args.sentences], np.int32)
+    else:  # demo path without a tokenizer: toy ids
+        from fengshen_tpu.examples.demo_utils import toy_encode_batch
+        ids = toy_encode_batch(args.sentences, max_len=16)
 
     out = simulate_batch(model, params, jnp.asarray(ids),
                          rng=jax.random.PRNGKey(1),

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Launcher for clue_sim.finetune_clue_sim (reference pattern: fengshen/examples/clue_sim/main.py)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-MegatronBert-1.3B}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.clue_sim.finetune_clue_sim \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-2e-5} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --num_labels 3 --loss_function lsce

@@ -1,0 +1,29 @@
+"""Shared helpers for the tokenizer-less demo paths of the example
+scripts (toy char→id encoding used when no checkpoint/tokenizer is
+given)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def toy_encode(text: str, max_len: int = 8) -> list[int]:
+    """Deterministic char→id toy encoding (ids 3..95, 0 = pad)."""
+    ids = [min(3 + (ord(c) % 90), 95) for c in text[:max_len]]
+    return ids + [0] * (max_len - len(ids))
+
+
+def toy_encode_batch(texts: list[str], max_len: int = 16) -> np.ndarray:
+    return np.asarray([toy_encode(t, max_len) for t in texts], np.int32)
+
+
+class ToyTokenizer:
+    """encode/decode stub with BERT-ish special ids for demo mains."""
+
+    pad_token_id, eos_token_id = 0, 2
+
+    def encode(self, text: str) -> list[int]:
+        return [min(3 + (ord(c) % 90), 95) for c in text] + [2]
+
+    def decode(self, ids) -> str:
+        return " ".join(str(int(i)) for i in ids if int(i) > 2)

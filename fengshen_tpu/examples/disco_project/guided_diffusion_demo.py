@@ -1,0 +1,106 @@
+"""CLIP-guided diffusion (disco-style) demo.
+
+Port of the reference project (reference: fengshen/examples/disco_project/
+— disco-diffusion with the Taiyi Chinese CLIP): at each DDPM step the
+latent is nudged by the gradient of the CLIP similarity between the
+decoded image and the text prompt.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def clip_guided_sample(sd_model, sd_params, clip_model, clip_params,
+                       input_ids, clip_text_ids, image_size: int = 64,
+                       num_steps: int = 20, guidance_strength: float = 0.5,
+                       rng=None):
+    """DDPM sampling with CLIP-similarity gradient guidance
+    (the disco-diffusion core loop)."""
+    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
+        SCALING_FACTOR)
+    from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
+
+    scheduler = DDPMScheduler()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    batch = input_ids.shape[0]
+    latent_shape = (batch,) + sd_model.vae_config.latent_shape(image_size)
+    text = sd_model.apply({"params": sd_params}, input_ids,
+                          method=type(sd_model).encode_text)
+    clip_text = clip_model.apply(
+        {"params": clip_params}, input_ids=clip_text_ids,
+        pixel_values=None)[0]
+
+    def clip_score(latents):
+        pixels = sd_model.apply(
+            {"params": sd_params}, latents / SCALING_FACTOR,
+            method=lambda m, z: m.vae.decode(z))
+        size = clip_model.vision_config.image_size
+        pixels = jax.image.resize(
+            pixels, (batch, size, size, pixels.shape[-1]), "bilinear")
+        _, img_emb, _ = clip_model.apply({"params": clip_params},
+                                         input_ids=None,
+                                         pixel_values=pixels)
+        return (clip_text * img_emb).sum(-1).mean()
+
+    grad_fn = jax.grad(clip_score)
+    latents = jax.random.normal(rng, latent_shape)
+    T = scheduler.num_train_timesteps
+    schedule = np.linspace(T - 1, 0, num_steps).astype(np.int32)
+    prevs = np.append(schedule[1:], -1)
+    for t, t_prev in zip(schedule, prevs):
+        tb = jnp.full((batch,), int(t), jnp.int32)
+        eps = sd_model.apply({"params": sd_params}, latents, tb, text,
+                             method=type(sd_model).denoise)
+        latents = scheduler.step(eps, int(t), latents,
+                                 prev_timestep=int(t_prev))
+        latents = latents + guidance_strength * grad_fn(latents)
+    pixels = sd_model.apply({"params": sd_params},
+                            latents / SCALING_FACTOR,
+                            method=lambda m, z: m.vae.decode(z))
+    return jnp.clip(pixels / 2.0 + 0.5, 0.0, 1.0)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--prompt", type=str, default="一幅山水画")
+    parser.add_argument("--image_size", type=int, default=32)
+    parser.add_argument("--num_steps", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    from fengshen_tpu.models.bert import BertConfig
+    from fengshen_tpu.models.clip import CLIPVisionConfig, TaiyiCLIPModel
+    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import VAEConfig
+    from fengshen_tpu.models.stable_diffusion.modeling_taiyi_sd import (
+        TaiyiStableDiffusion)
+    from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
+
+    text_cfg = BertConfig.small_test_config()
+    sd = TaiyiStableDiffusion(text_cfg, VAEConfig.small_test_config(),
+                              UNetConfig.small_test_config())
+    vis_cfg = CLIPVisionConfig.small_test_config(
+        image_size=args.image_size)
+    clip = TaiyiCLIPModel(text_cfg, vis_cfg)
+
+    from fengshen_tpu.examples.demo_utils import toy_encode
+    ids = jnp.asarray([toy_encode(args.prompt)], jnp.int32)
+    size = args.image_size
+    from fengshen_tpu.models.stable_diffusion.sampling import (
+        init_sampling_params)
+    sd_params = init_sampling_params(sd, jax.random.PRNGKey(0), size)
+    clip_params = clip.init(
+        jax.random.PRNGKey(1), ids,
+        jnp.zeros((1, vis_cfg.image_size, vis_cfg.image_size, 3)))["params"]
+
+    images = clip_guided_sample(sd, sd_params, clip, clip_params, ids, ids,
+                                image_size=size, num_steps=args.num_steps)
+    print("sampled:", images.shape)
+    return np.asarray(images)
+
+
+if __name__ == "__main__":
+    main()

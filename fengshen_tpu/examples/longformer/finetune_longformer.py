@@ -1,0 +1,116 @@
+"""Erlangshen-Longformer long-document classification finetune.
+
+Port of the reference workload (reference: fengshen/examples/longformer/ —
+long-document NLU with the sliding-window Longformer; first token carries
+global attention).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.models.longformer import (
+    LongformerConfig, LongformerForSequenceClassification)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class LongDocCollator:
+    tokenizer: Any
+    max_seq_length: int = 2048
+    content_key: str = "text"
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        max_len = self.max_seq_length
+        batch = {"input_ids": [], "attention_mask": [],
+                 "global_attention_mask": [], "labels": []}
+        for s in samples:
+            ids = [tok.cls_token_id] + tok.encode(
+                s[self.content_key], add_special_tokens=False
+            )[: max_len - 2] + [tok.sep_token_id]
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            # [CLS] gets global attention (the longformer convention)
+            batch["global_attention_mask"].append(
+                [1] + [0] * (max_len - 1))
+            batch["labels"].append(int(s.get("label", 0)))
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class LongformerClsModule(TrainModule):
+    def __init__(self, args, config: Optional[LongformerConfig] = None):
+        super().__init__(args)
+        import dataclasses as dc
+        if config is None and getattr(args, "model_path", None):
+            config = LongformerConfig.from_pretrained(args.model_path)
+        if config is None:
+            raise ValueError("needs a config or --model_path")
+        config = dc.replace(config, num_labels=args.num_labels)
+        self.config = config
+        self.model = LongformerForSequenceClassification(config)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("longformer finetune")
+        parser.add_argument("--max_seq_length", type=int, default=2048)
+        parser.add_argument("--num_labels", type=int, default=2)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        return self.model.init(rng, ids)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            attention_mask=batch["attention_mask"],
+            global_attention_mask=batch["global_attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, _ = stable_cross_entropy(logits[:, None, :],
+                                       batch["labels"][:, None])
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        return loss, {"acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = LongformerClsModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    collator = LongDocCollator(tokenizer,
+                               max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args)
+    module = LongformerClsModule(args)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Launcher for longformer.finetune_longformer (reference pattern: fengshen/examples/longformer/*.sh)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-Longformer-110M}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+
+python -m fengshen_tpu.examples.longformer.finetune_longformer \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-16} \
+    --max_steps ${MAX_STEPS:-100000} \
+    --learning_rate ${LR:-2e-5} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --max_seq_length 2048 --num_labels 2

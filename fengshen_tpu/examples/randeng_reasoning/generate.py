@@ -1,0 +1,49 @@
+"""Randeng causal-reasoning demo (deduction + abduction).
+
+Port of the reference driver (reference:
+fengshen/examples/randeng_reasoning/ — Randeng-TransformerXL-5B
+Abduction/Deduction generation with the fixed prompts).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.models.transfo_xl_reasoning import (
+    TransfoXLReasoningConfig, TransfoXLReasoningModel, abduction_generate,
+    deduction_generate)
+
+
+def main(argv=None, model=None, params=None, tokenizer=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", type=str, default=None)
+    parser.add_argument("--mode", type=str, default="deduction",
+                        choices=["deduction", "abduction"])
+    parser.add_argument("--input", type=str, default="模型训练数据变多")
+    parser.add_argument("--max_out_seq", type=int, default=64)
+    args = parser.parse_args(argv)
+
+    if model is None:
+        config = TransfoXLReasoningConfig.small_test_config()
+        model = TransfoXLReasoningModel(config)
+    if params is None:
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    if tokenizer is None:
+        from fengshen_tpu.examples.demo_utils import ToyTokenizer
+        tokenizer = ToyTokenizer()
+
+    fn = deduction_generate if args.mode == "deduction" else \
+        abduction_generate
+    out = fn(model, params, tokenizer, args.input,
+             max_out_seq=args.max_out_seq)
+    for line in out:
+        print(line)
+    return out
+
+
+if __name__ == "__main__":
+    main()

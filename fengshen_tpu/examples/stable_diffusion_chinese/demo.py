@@ -1,0 +1,81 @@
+"""Taiyi Stable Diffusion Chinese txt2img demo.
+
+Port of the reference demo (reference:
+fengshen/examples/stable_diffusion_chinese/ — diffusers
+StableDiffusionPipeline with the Taiyi Chinese text encoder): prompt →
+classifier-free-guided DDPM sampling → image grid.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None, model=None, params=None, tokenizer=None,
+         image_size=None, num_steps=None):
+    from fengshen_tpu.models.stable_diffusion.sampling import text_to_image
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_path", type=str, default=None)
+    parser.add_argument("--prompt", type=str, default="飞流直下三千尺，油画")
+    parser.add_argument("--negative_prompt", type=str, default="")
+    parser.add_argument("--image_size", type=int, default=512)
+    parser.add_argument("--num_steps", type=int, default=50)
+    parser.add_argument("--guidance_scale", type=float, default=7.5)
+    parser.add_argument("--out", type=str, default="out.png")
+    args = parser.parse_args(argv)
+    if image_size is not None:
+        args.image_size = image_size
+    if num_steps is not None:
+        args.num_steps = num_steps
+
+    if model is None:
+        # demo-scale model when no checkpoint is given
+        from fengshen_tpu.models.bert import BertConfig
+        from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
+            VAEConfig)
+        from fengshen_tpu.models.stable_diffusion.modeling_taiyi_sd import (
+            TaiyiStableDiffusion)
+        from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
+        model = TaiyiStableDiffusion(
+            BertConfig.small_test_config(), VAEConfig.small_test_config(),
+            UNetConfig.small_test_config())
+    if params is None:
+        from fengshen_tpu.models.stable_diffusion.sampling import (
+            init_sampling_params)
+        params = init_sampling_params(model, jax.random.PRNGKey(0),
+                                      args.image_size)
+
+    if tokenizer is not None:
+        ids = jnp.asarray([tokenizer.encode(args.prompt)], jnp.int32)
+        neg = jnp.asarray([tokenizer.encode(args.negative_prompt or "")],
+                          jnp.int32)
+        if neg.shape[1] != ids.shape[1]:
+            pad = tokenizer.pad_token_id or 0
+            neg = jnp.full_like(ids, pad).at[:, :neg.shape[1]].set(
+                neg[:, :ids.shape[1]])
+    else:
+        from fengshen_tpu.examples.demo_utils import toy_encode
+        ids = jnp.asarray([toy_encode(args.prompt)], jnp.int32)
+        neg = jnp.zeros_like(ids)
+
+    images = text_to_image(model, params, ids, uncond_ids=neg,
+                           image_size=args.image_size,
+                           num_steps=args.num_steps,
+                           guidance_scale=args.guidance_scale)
+    arr = np.asarray(images[0])
+    try:
+        from PIL import Image
+        Image.fromarray((arr * 255).astype(np.uint8)).save(args.out)
+        print(f"saved {args.out}")
+    except ImportError:
+        pass
+    return np.asarray(images)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Taiyi Stable Diffusion bilingual (zh/EN) txt2img demo — the _EN variant
+of stable_diffusion_chinese (reference:
+fengshen/examples/stable_diffusion_chinese_EN/), identical pipeline with a
+bilingual text-encoder checkpoint."""
+
+from fengshen_tpu.examples.stable_diffusion_chinese.demo import main
+
+if __name__ == "__main__":
+    main()

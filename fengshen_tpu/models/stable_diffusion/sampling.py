@@ -1,0 +1,79 @@
+"""Text-to-image sampling for Taiyi Stable Diffusion.
+
+The inference counterpart of the training pipeline (reference:
+fengshen/examples/stable_diffusion_chinese/ — diffusers
+StableDiffusionPipeline driven by the Chinese text encoder): DDIM-style
+ancestral loop over the DDPM scheduler with classifier-free guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
+    SCALING_FACTOR)
+from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
+
+
+def text_to_image(model, params, input_ids, uncond_ids=None,
+                  image_size: int = 512, num_steps: int = 50,
+                  guidance_scale: float = 7.5,
+                  rng: Optional[jax.Array] = None,
+                  scheduler: Optional[DDPMScheduler] = None):
+    """input_ids [B, S] (and optional unconditional ids for guidance) →
+    images [B, H, W, 3] in [0, 1]."""
+    scheduler = scheduler or DDPMScheduler()
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    batch = input_ids.shape[0]
+    latent_shape = (batch,) + model.vae_config.latent_shape(image_size)
+
+    text = model.apply({"params": params}, input_ids,
+                       method=type(model).encode_text)
+    uncond = None
+    if uncond_ids is not None and guidance_scale > 1.0:
+        uncond = model.apply({"params": params}, uncond_ids,
+                             method=type(model).encode_text)
+
+    latents = jax.random.normal(rng, latent_shape)
+    T = scheduler.num_train_timesteps
+    timesteps = jnp.linspace(T - 1, 0, num_steps).astype(jnp.int32)
+    # each step denoises to the NEXT timestep of the subsampled schedule
+    prev_timesteps = jnp.concatenate(
+        [timesteps[1:], jnp.asarray([-1], jnp.int32)])
+
+    def body(latents, ts):
+        t, t_prev = ts
+        tb = jnp.full((batch,), t, jnp.int32)
+        eps = model.apply({"params": params}, latents, tb, text,
+                          method=type(model).denoise)
+        if uncond is not None:
+            eps_u = model.apply({"params": params}, latents, tb, uncond,
+                                method=type(model).denoise)
+            eps = eps_u + guidance_scale * (eps - eps_u)
+        return scheduler.step(eps, t, latents, prev_timestep=t_prev), None
+
+    latents, _ = jax.lax.scan(body, latents,
+                              (timesteps, prev_timesteps))
+    pixels = model.apply({"params": params}, latents / SCALING_FACTOR,
+                         method=lambda m, z: m.vae.decode(z))
+    return jnp.clip(pixels / 2.0 + 0.5, 0.0, 1.0)
+
+
+def init_sampling_params(model, rng, image_size: int, seq_len: int = 8):
+    """Init params covering BOTH the training path and the decoder (the
+    training __call__ only encodes, so a plain init lacks vae.decode
+    params needed for sampling)."""
+
+    def full(m, ids, pixels, t, noise, z):
+        pred, _ = m(ids, pixels, t, noise)
+        return pred, m.vae.decode(z)
+
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    pixels = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    t = jnp.zeros((1,), jnp.int32)
+    z = jnp.zeros((1,) + model.vae_config.latent_shape(image_size),
+                  jnp.float32)
+    return model.init(rng, ids, pixels, t, z, z, method=full)["params"]

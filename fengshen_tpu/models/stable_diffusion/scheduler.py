@@ -49,11 +49,19 @@ class DDPMScheduler:
         a = self._gather(timesteps, sample.shape)
         return jnp.sqrt(a) * noise - jnp.sqrt(1 - a) * sample
 
-    def step(self, model_output, timestep, sample):
-        """One ancestral DDPM denoise step (inference)."""
+    def step(self, model_output, timestep, sample, prev_timestep=None):
+        """One ancestral DDPM denoise step (inference).
+
+        `prev_timestep` is the NEXT timestep of the (possibly subsampled)
+        inference schedule — with num_inference_steps < T the stride is
+        T//num_steps, not 1 (diffusers' prev_t convention); defaults to
+        timestep-1 for a full-schedule walk."""
+        if prev_timestep is None:
+            prev_timestep = timestep - 1
         a_t = self.alphas_cumprod[timestep]
-        a_prev = jnp.where(timestep > 0,
-                           self.alphas_cumprod[jnp.maximum(timestep - 1, 0)],
+        a_prev = jnp.where(prev_timestep >= 0,
+                           self.alphas_cumprod[
+                               jnp.maximum(prev_timestep, 0)],
                            1.0)
         if self.prediction_type == "v_prediction":
             eps = jnp.sqrt(a_t) * model_output + \

@@ -155,3 +155,49 @@ def test_ppvae_generate_demo():
     out = main(argv=["--n", "2", "--plugin_steps", "5",
                      "--max_length", "6"])
     assert out.shape == (2, 6)
+
+
+def test_longformer_finetune_e2e(tmp_path, mesh8):
+    import dataclasses
+    import json as _json
+    import os
+
+    from fengshen_tpu.examples.longformer import finetune_longformer
+    from fengshen_tpu.models.longformer.modeling_longformer import (
+        LongformerConfig)
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    cfg = LongformerConfig.small_test_config(vocab_size=len(tok),
+                                             dtype="float32")
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(dataclasses.asdict(cfg), f)
+    train = tmp_path / "train.json"
+    with open(train, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({"text": "今天天气很好我们去公园散步" * 2,
+                                "label": i % 2}, ensure_ascii=False) + "\n")
+    finetune_longformer.main(_run_args(
+        tmp_path, model_dir, train,
+        ["--max_seq_length", "48", "--num_labels", "2"]))
+    losses = _losses(tmp_path)
+    assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_sd_txt2img_demo(tmp_path):
+    from fengshen_tpu.examples.stable_diffusion_chinese.demo import main
+    out = main(argv=["--image_size", "32", "--num_steps", "3",
+                     "--out", str(tmp_path / "sd_demo.png")])
+    assert out.shape[0] == 1 and out.shape[1] == 32
+    assert np.isfinite(out).all() and 0 <= out.min() and out.max() <= 1
+
+
+def test_randeng_reasoning_demo():
+    from fengshen_tpu.examples.randeng_reasoning.generate import main
+    out = main(argv=["--mode", "abduction", "--max_out_seq", "16"])
+    assert len(out) == 1
+
+
+def test_disco_guided_diffusion_demo():
+    from fengshen_tpu.examples.disco_project.guided_diffusion_demo import (
+        main)
+    out = main(argv=["--image_size", "32", "--num_steps", "2"])
+    assert out.shape[1] == 32 and np.isfinite(out).all()
