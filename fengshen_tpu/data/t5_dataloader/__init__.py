@@ -1,8 +1,10 @@
-"""T5 pretraining data (reference: fengshen/data/t5_dataloader/)."""
+"""T5 pretraining + generation-task data
+(reference: fengshen/data/t5_dataloader/)."""
 
 from fengshen_tpu.data.t5_dataloader.t5_datasets import (
     compute_input_and_target_lengths, random_spans_noise_mask,
     T5SpanCorruptionCollator)
+from fengshen_tpu.data.t5_dataloader.t5_gen_datasets import DialogCollator
 
 __all__ = ["compute_input_and_target_lengths", "random_spans_noise_mask",
-           "T5SpanCorruptionCollator"]
+           "T5SpanCorruptionCollator", "DialogCollator"]

@@ -1,0 +1,80 @@
+"""T5 generation-task data (knowledge-grounded dialog).
+
+Behavioural port of reference:
+fengshen/data/t5_dataloader/t5_gen_datasets.py:38-343 — multi-turn dialog
+samples {context: [turns], knowledge, target} rendered as
+``[KNSTART] knowledge [KNEND] [CTSTART] context-tail [CTEND]`` with the
+context truncated from the LEFT (keep the latest turns, :155-163), target
+truncated to max_target_length with eos, and decoder inputs shifted right
+(:288-301).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+
+@dataclass
+class DialogCollator:
+    """reference: DialogDataset.regular_tokenize + DialogDataModel
+    collate_fn."""
+
+    tokenizer: Any
+    max_seq_length: int = 512
+    max_knowledge_length: int = 128
+    max_target_length: int = 128
+    decoder_start_token_id: int = 0
+
+    def _marker(self, name: str) -> int:
+        tok = self.tokenizer
+        tid = tok.convert_tokens_to_ids(name) if hasattr(
+            tok, "convert_tokens_to_ids") else None
+        unk = getattr(tok, "unk_token_id", None)
+        if tid is None or tid == unk:
+            # markers absent from the vocab degrade to [SEP]
+            return tok.sep_token_id
+        return tid
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        eos = tok.eos_token_id
+        kn_s, kn_e = self._marker("[KNSTART]"), self._marker("[KNEND]")
+        ct_s, ct_e = self._marker("[CTSTART]"), self._marker("[CTEND]")
+        batch = {"input_ids": [], "attention_mask": [],
+                 "decoder_input_ids": [], "labels": []}
+        for s in samples:
+            context = s.get("context", [])
+            if isinstance(context, str):
+                context = [context]
+            flat: list[int] = []
+            for turn in context:
+                flat.extend(tok.encode(turn, add_special_tokens=False))
+            knowledge = tok.encode(s.get("knowledge", ""),
+                                   add_special_tokens=False
+                                   )[: self.max_knowledge_length - 2]
+            kn = [kn_s] + knowledge + [kn_e]
+            # knowledge itself must leave room for the context markers
+            kn = kn[: max(self.max_seq_length - 2, 0)]
+            # keep the TAIL of the context (latest turns); clamp at 0 so an
+            # oversized knowledge never flips the slice to the HEAD
+            l_ct = max(0, min(len(flat),
+                              self.max_seq_length - len(kn) - 2))
+            ct = [ct_s] + (flat[-l_ct:] if l_ct else []) + [ct_e]
+            src = kn + ct  # ≤ max_seq_length by construction, CTEND kept
+
+            tgt = tok.encode(s["target"], add_special_tokens=False
+                             )[: self.max_target_length - 1]
+            if eos is not None:
+                tgt = tgt + [eos]
+            dec_in = [self.decoder_start_token_id] + tgt[:-1]
+            ps = self.max_seq_length - len(src)
+            pt = self.max_target_length - len(tgt)
+            batch["input_ids"].append(src + [pad_id] * ps)
+            batch["attention_mask"].append([1] * len(src) + [0] * ps)
+            batch["decoder_input_ids"].append(dec_in + [pad_id] * pt)
+            batch["labels"].append(tgt + [-100] * pt)
+        return {k: np.asarray(v) for k, v in batch.items()}

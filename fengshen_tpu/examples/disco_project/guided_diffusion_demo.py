@@ -19,18 +19,14 @@ def clip_guided_sample(sd_model, sd_params, clip_model, clip_params,
                        input_ids, clip_text_ids, image_size: int = 64,
                        num_steps: int = 20, guidance_strength: float = 0.5,
                        rng=None):
-    """DDPM sampling with CLIP-similarity gradient guidance
-    (the disco-diffusion core loop)."""
+    """DDPM sampling with CLIP-similarity gradient guidance: the shared
+    text_to_image loop with a per-step latent-guidance hook (the
+    disco-diffusion core)."""
     from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
         SCALING_FACTOR)
-    from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
+    from fengshen_tpu.models.stable_diffusion.sampling import text_to_image
 
-    scheduler = DDPMScheduler()
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
     batch = input_ids.shape[0]
-    latent_shape = (batch,) + sd_model.vae_config.latent_shape(image_size)
-    text = sd_model.apply({"params": sd_params}, input_ids,
-                          method=type(sd_model).encode_text)
     clip_text = clip_model.apply(
         {"params": clip_params}, input_ids=clip_text_ids,
         pixel_values=None)[0]
@@ -48,21 +44,14 @@ def clip_guided_sample(sd_model, sd_params, clip_model, clip_params,
         return (clip_text * img_emb).sum(-1).mean()
 
     grad_fn = jax.grad(clip_score)
-    latents = jax.random.normal(rng, latent_shape)
-    T = scheduler.num_train_timesteps
-    schedule = np.linspace(T - 1, 0, num_steps).astype(np.int32)
-    prevs = np.append(schedule[1:], -1)
-    for t, t_prev in zip(schedule, prevs):
-        tb = jnp.full((batch,), int(t), jnp.int32)
-        eps = sd_model.apply({"params": sd_params}, latents, tb, text,
-                             method=type(sd_model).denoise)
-        latents = scheduler.step(eps, int(t), latents,
-                                 prev_timestep=int(t_prev))
-        latents = latents + guidance_strength * grad_fn(latents)
-    pixels = sd_model.apply({"params": sd_params},
-                            latents / SCALING_FACTOR,
-                            method=lambda m, z: m.vae.decode(z))
-    return jnp.clip(pixels / 2.0 + 0.5, 0.0, 1.0)
+
+    def guide(latents):
+        return latents + guidance_strength * grad_fn(latents)
+
+    return text_to_image(sd_model, sd_params, input_ids,
+                         image_size=image_size, num_steps=num_steps,
+                         guidance_scale=0.0, rng=rng,
+                         latent_guidance_fn=guide)
 
 
 def main(argv=None):

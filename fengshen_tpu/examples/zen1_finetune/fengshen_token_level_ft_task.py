@@ -1,0 +1,150 @@
+"""ZEN1 token-level (NER) finetune.
+
+Port of the reference workload
+(reference: fengshen/examples/zen1_finetune/fengshen_token_level_ft_task.py
++ ner_zen1_ontonotes4.sh): char-level BIO tagging with n-gram side inputs
+on ZenForTokenClassification.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.data.sequence_tagging_dataloader import ConllDataset
+from fengshen_tpu.examples.sequence_tagging.finetune_sequence_tagging \
+    import build_label_maps
+from fengshen_tpu.models.zen import (ZenConfig, ZenForTokenClassification,
+                                     ZenNgramDict)
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+@dataclass
+class ZenTaggingCollator:
+    """char BIO labels + matched n-grams → padded batch
+    (reference: convert_examples_to_features of the token-level task)."""
+
+    tokenizer: Any
+    ngram_dict: ZenNgramDict
+    label2id: dict
+    max_seq_length: int = 128
+
+    def __call__(self, samples: list[dict]) -> dict:
+        tok = self.tokenizer
+        pad_id = tok.pad_token_id or 0
+        max_len = self.max_seq_length
+        M = self.ngram_dict.max_ngram_in_seq
+        batch = {"input_ids": [], "attention_mask": [], "ngram_ids": [],
+                 "ngram_positions": [], "labels": []}
+        for sample in samples:
+            chars = list(sample["text"])[: max_len - 2]
+            tags = sample["labels"][: max_len - 2]
+            ids = [tok.cls_token_id] + [
+                tok.convert_tokens_to_ids(c) for c in chars] + \
+                [tok.sep_token_id]
+            labels = [-100] + [self.label2id.get(t, 0) for t in tags] + \
+                [-100]
+            ngram_ids, positions = self.ngram_dict.match(chars)
+            pos = np.zeros((max_len, M), np.int32)
+            pos[1: 1 + len(chars)] = positions
+            pad = max_len - len(ids)
+            batch["input_ids"].append(ids + [pad_id] * pad)
+            batch["attention_mask"].append([1] * len(ids) + [0] * pad)
+            batch["ngram_ids"].append(ngram_ids)
+            batch["ngram_positions"].append(pos)
+            batch["labels"].append(labels + [-100] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class ZenTaggingModule(TrainModule):
+    def __init__(self, args, config: Optional[ZenConfig] = None,
+                 num_labels: int = 9):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = ZenConfig.from_pretrained(args.model_path)
+        self.config = config
+        self.model = ZenForTokenClassification(config,
+                                               num_labels=num_labels)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("zen1 ner")
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument("--ngram_dict_path", type=str, default=None)
+        parser.add_argument("--data_dir", type=str, default=None)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        ngram_ids = jnp.zeros((1, 8), jnp.int32)
+        ngram_pos = jnp.zeros((1, seq, 8), jnp.int32)
+        return self.model.init(rng, ids, ngram_ids=ngram_ids,
+                               ngram_positions=ngram_pos)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            ngram_ids=batch["ngram_ids"],
+            ngram_positions=batch["ngram_positions"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, _ = stable_cross_entropy(logits, batch["labels"])
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"token_acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    import os
+
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = ZenTaggingModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    if not args.data_dir:
+        parser.error("--data_dir with train.char.bio is required")
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    ngram_dict = ZenNgramDict(args.ngram_dict_path or args.model_path)
+    datasets = {}
+    for split, fname in (("train", "train.char.bio"),
+                         ("validation", "dev.char.bio")):
+        path = os.path.join(args.data_dir, fname)
+        if os.path.exists(path):
+            datasets[split] = ConllDataset(path)
+    if "train" not in datasets:
+        parser.error(f"no train.char.bio under {args.data_dir}")
+    label2id, _ = build_label_maps(list(datasets.values()))
+    collator = ZenTaggingCollator(tokenizer, ngram_dict, label2id,
+                                  max_seq_length=args.max_seq_length)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets)
+    module = ZenTaggingModule(args, num_labels=len(label2id))
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

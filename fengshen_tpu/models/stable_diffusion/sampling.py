@@ -22,9 +22,13 @@ def text_to_image(model, params, input_ids, uncond_ids=None,
                   image_size: int = 512, num_steps: int = 50,
                   guidance_scale: float = 7.5,
                   rng: Optional[jax.Array] = None,
-                  scheduler: Optional[DDPMScheduler] = None):
+                  scheduler: Optional[DDPMScheduler] = None,
+                  latent_guidance_fn=None):
     """input_ids [B, S] (and optional unconditional ids for guidance) →
-    images [B, H, W, 3] in [0, 1]."""
+    images [B, H, W, 3] in [0, 1].
+
+    `latent_guidance_fn(latents) -> latents` runs after every denoise step
+    (the hook CLIP-guided/disco sampling plugs into)."""
     scheduler = scheduler or DDPMScheduler()
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     batch = input_ids.shape[0]
@@ -53,7 +57,10 @@ def text_to_image(model, params, input_ids, uncond_ids=None,
             eps_u = model.apply({"params": params}, latents, tb, uncond,
                                 method=type(model).denoise)
             eps = eps_u + guidance_scale * (eps - eps_u)
-        return scheduler.step(eps, t, latents, prev_timestep=t_prev), None
+        latents = scheduler.step(eps, t, latents, prev_timestep=t_prev)
+        if latent_guidance_fn is not None:
+            latents = latent_guidance_fn(latents)
+        return latents, None
 
     latents, _ = jax.lax.scan(body, latents,
                               (timesteps, prev_timesteps))

@@ -84,6 +84,16 @@ class UniEXPipelines:
         parser = parent_parser.add_argument_group("uniex")
         parser.add_argument("--max_length", default=512, type=int)
         parser.add_argument("--threshold", default=0.5, type=float)
+        parser.add_argument("--max_entity_types", default=16, type=int)
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.models.model_utils import add_module_args
+        from fengshen_tpu.trainer import add_trainer_args
+        from fengshen_tpu.utils import UniversalCheckpoint
+        parent_parser = add_module_args(parent_parser)
+        parent_parser = add_trainer_args(parent_parser)
+        parent_parser = UniversalDataModule.add_data_specific_args(
+            parent_parser)
+        parent_parser = UniversalCheckpoint.add_argparse_args(parent_parser)
         return parent_parser
 
     def __init__(self, args=None, model: Optional[str] = None,
@@ -101,6 +111,138 @@ class UniEXPipelines:
         self.model = UniEXBertModel(config)
         self.params = params
 
+
+    def _max_len(self) -> int:
+        """Effective max input length — ALWAYS capped by the position
+        table (train and predict must agree)."""
+        return min(getattr(self.args, "max_length", 512) if self.args
+                   else 512, self.config.max_position_embeddings)
+
+    def _encode_instruction(self, text: str, types: list[str]
+                            ) -> tuple[list[int], list[int], int]:
+        """[CLS] type1 [SEP] type2 [SEP] ... text [SEP] — the ONE encoding
+        used by both fit and predict. Returns (ids, type_positions,
+        text_offset)."""
+        tok = self.tokenizer
+        max_len = self._max_len()
+        ids = [tok.cls_token_id]
+        type_positions = []
+        for t in types:
+            type_positions.append(len(ids))
+            ids.extend(tok.encode(t, add_special_tokens=False))
+            ids.append(tok.sep_token_id)
+        text_offset = len(ids)
+        text_ids = tok.encode(text, add_special_tokens=False)
+        ids = (ids + text_ids)[: max_len - 1] + [tok.sep_token_id]
+        return ids, type_positions, text_offset
+
+    def _encode_train(self, sample: dict, n_types: int) -> dict:
+        """Instruction encoding plus span labels from choices' entity_idx
+        (char offsets; one char per wordpiece for Chinese BERT vocab)."""
+        choices = sample.get("choices", [])
+        types = [c["entity_type"] if isinstance(c, dict) else str(c)
+                 for c in choices]
+        ids, type_positions, text_offset = self._encode_instruction(
+            sample["text"], types)
+        spans = []  # (type_idx, start_tok, end_tok)
+        for ti, ch in enumerate(choices):
+            if isinstance(ch, dict):
+                for ent in ch.get("entity_list", []):
+                    for s, e in ent.get("entity_idx", []):
+                        spans.append((ti, text_offset + s, text_offset + e))
+        type_positions = (type_positions + [0] * n_types)[:n_types]
+        return {"input_ids": ids, "type_positions": type_positions,
+                "text_offset": text_offset, "spans": spans,
+                "n_types": len(types)}
+
+    def _collate_train(self, samples: list[dict]) -> dict:
+        import numpy as np
+        max_len = self._max_len()
+        # fixed type-dim so the jitted train step keeps ONE shape across
+        # batches (per-batch max would recompile per distinct count)
+        n_types = getattr(self.args, "max_entity_types", 16) if self.args \
+            else 16
+        pad_id = self.tokenizer.pad_token_id or 0
+        encoded = [self._encode_train(s, n_types) for s in samples]
+        batch = {"input_ids": [], "attention_mask": [],
+                 "type_positions": [], "span_labels": [], "span_mask": []}
+        for e in encoded:
+            ids = e["input_ids"]
+            n = len(ids)
+            p = max_len - n
+            batch["input_ids"].append(ids + [pad_id] * p)
+            batch["attention_mask"].append([1] * n + [0] * p)
+            batch["type_positions"].append(e["type_positions"])
+            labels = np.zeros((n_types, max_len, max_len), np.float32)
+            for ti, s, t in e["spans"]:
+                if s < n and t < n:
+                    labels[ti, s, t] = 1.0
+            mask = np.zeros((n_types, max_len, max_len), np.float32)
+            off = e["text_offset"]
+            width = n - 1 - off
+            if width > 0:
+                tri = np.triu(np.ones((width, width), np.float32))
+                mask[: e["n_types"], off:n - 1, off:n - 1] = tri[None]
+            batch["span_labels"].append(labels)
+            batch["span_mask"].append(mask)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+    def fit(self, train_data: list[dict],
+            dev_data: Optional[list[dict]] = None) -> None:
+        """Train on instruction-style samples (reference:
+        fengshen/examples/uniex/example.py fit/predict driver)."""
+        from fengshen_tpu.data import UniversalDataModule
+        from fengshen_tpu.trainer import Trainer
+        from fengshen_tpu.trainer.module import TrainModule
+
+        pipe = self
+
+        class _Module(TrainModule):
+            def __init__(self, args):
+                super().__init__(args)
+                self.model = pipe.model
+
+            def init_params(self, rng):
+                return pipe.model.init(
+                    rng, jnp.zeros((1, 16), jnp.int32),
+                    jnp.zeros((1, 1), jnp.int32))["params"]
+
+            def training_loss(self, params, batch, rng):
+                loss, _ = pipe.model.apply(
+                    {"params": params}, batch["input_ids"],
+                    batch["type_positions"],
+                    attention_mask=batch["attention_mask"],
+                    span_labels=batch["span_labels"],
+                    span_mask=batch["span_mask"],
+                    deterministic=False, rngs={"dropout": rng})
+                return loss, {}
+
+            def partition_rules(self):
+                return pipe.model.partition_rules()
+
+        class ListDS:
+            def __init__(self, rows):
+                self.rows = rows
+
+            def __len__(self):
+                return len(self.rows)
+
+            def __getitem__(self, i):
+                return self.rows[i]
+
+        datasets = {"train": ListDS(train_data)}
+        if dev_data:
+            datasets["validation"] = ListDS(dev_data)
+        dm = UniversalDataModule(tokenizer=self.tokenizer,
+                                 collate_fn=self._collate_train,
+                                 args=self.args, datasets=datasets)
+        module = _Module(self.args)
+        if self.params is not None:
+            module.init_params = lambda rng: self.params
+        trainer = Trainer(self.args)
+        state = trainer.fit(module, dm)
+        self.params = state.params
+
     def predict(self, data: list[dict]) -> list[dict]:
         """data rows: {text, choices: [entity types]}"""
         if self.params is None:
@@ -111,21 +253,12 @@ class UniEXPipelines:
         tok = self.tokenizer
         threshold = getattr(self.args, "threshold", 0.5) if self.args \
             else 0.5
-        max_len = min(getattr(self.args, "max_length", 512) if self.args
-                      else 512, self.config.max_position_embeddings)
         results = []
         for row in data:
             types = [c["entity_type"] if isinstance(c, dict) else str(c)
                      for c in row.get("choices", [])]
-            ids = [tok.cls_token_id]
-            type_positions = []
-            for t in types:
-                type_positions.append(len(ids))
-                ids.extend(tok.encode(t, add_special_tokens=False))
-                ids.append(tok.sep_token_id)
-            text_offset = len(ids)
-            text_ids = tok.encode(row["text"], add_special_tokens=False)
-            ids = (ids + text_ids)[: max_len - 1] + [tok.sep_token_id]
+            ids, type_positions, text_offset = self._encode_instruction(
+                row["text"], types)
             arr = jnp.asarray([ids], jnp.int32)
             tpos = jnp.asarray([type_positions], jnp.int32)
             scores = np.asarray(self.model.apply(

@@ -201,3 +201,55 @@ def test_disco_guided_diffusion_demo():
         main)
     out = main(argv=["--image_size", "32", "--num_steps", "2"])
     assert out.shape[1] == 32 and np.isfinite(out).all()
+
+
+def test_uniex_fit_and_predict(tmp_path, mesh8):
+    """UniEX now trains (fit + predict round trip, completing the
+    ubert/unimc/uniex pipeline trio)."""
+    import argparse
+
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.models.uniex import UniEXPipelines
+    tok, _ = _bert_tokenizer_dir(tmp_path)
+    cfg = MegatronBertConfig.small_test_config(vocab_size=len(tok))
+    parser = UniEXPipelines.pipelines_args(argparse.ArgumentParser())
+    args = parser.parse_args([
+        "--max_length", "48", "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs")])
+    pipe = UniEXPipelines(args, tokenizer=tok, config=cfg)
+    train = [{"text": "今天天气很好我们去公园散步",
+              "choices": [
+                  {"entity_type": "天气",
+                   "entity_list": [{"entity_idx": [[0, 3]]}]},
+                  {"entity_type": "地名",
+                   "entity_list": [{"entity_idx": [[9, 10]]}]}]}] * 4
+    pipe.fit(train)
+    out = pipe.predict([{"text": "今天天气很好",
+                         "choices": [{"entity_type": "天气"}]}])
+    assert len(out) == 1 and "entity_list" in out[0]
+
+
+def test_zen1_token_level_e2e(tmp_path, mesh8):
+    import dataclasses
+    import json as _json
+    import os
+
+    from fengshen_tpu.examples.zen1_finetune import (
+        fengshen_token_level_ft_task as task)
+    from fengshen_tpu.models.zen import ZenConfig
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    cfg = ZenConfig.small_test_config(vocab_size=len(tok))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(dataclasses.asdict(cfg), f)
+    (model_dir / "ngram.txt").write_text("中文,5\n测试,3\n")
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    conll = "\n".join(["中 B-LOC", "文 I-LOC", "测 O", "试 O", "",
+                       "句 B-LOC", "子 I-LOC", "很 O", "好 O", ""])
+    (data_dir / "train.char.bio").write_text(conll * 4)
+    task.main(_run_args(
+        tmp_path, model_dir, tmp_path / "unused.json",
+        ["--max_seq_length", "32", "--data_dir", str(data_dir)]))
+    losses = _losses(tmp_path)
+    assert len(losses) == 2 and all(np.isfinite(losses))

@@ -227,3 +227,30 @@ def test_bart_dataset_denoising(tmp_path):
     assert (s["input_ids"][:n_src] == 4).any()
     # labels are the CLEAN text (no masks)
     assert not (s["labels"][:n_tgt] == 4).any()
+
+
+def test_dialog_collator():
+    from fengshen_tpu.data.t5_dataloader import DialogCollator
+
+    class FakeTok:
+        eos_token_id = 1
+        pad_token_id = 0
+        sep_token_id = 3
+        unk_token_id = 2
+
+        def encode(self, text, add_special_tokens=True, **kw):
+            return [5 + (ord(c) % 90) for c in text]
+
+        def convert_tokens_to_ids(self, name):
+            return self.unk_token_id  # markers not in vocab -> [SEP]
+
+    coll = DialogCollator(FakeTok(), max_seq_length=32,
+                          max_knowledge_length=8, max_target_length=8)
+    batch = coll([{"context": ["你好", "你好呀今天想聊什么"],
+                   "knowledge": "天气知识",
+                   "target": "今天晴天"}])
+    assert batch["input_ids"].shape == (1, 32)
+    assert batch["labels"].shape == (1, 8)
+    assert batch["decoder_input_ids"][0, 0] == 0
+    # markers degraded to [SEP]=3 delimit knowledge/context
+    assert (batch["input_ids"][0] == 3).sum() >= 4
