@@ -136,18 +136,17 @@ class LlamaAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         impl = cfg.attention_impl
-        if impl == "ring" and attention_mask is not None:
-            impl = "dense"  # ring is causal-only; padding needs dense
         if impl in ("flash", "ring") and not is_decode:
+            # a padding mask maps to segment ids (pads = segment 0), so
+            # padded SFT batches stay on the fused/ring paths
+            seg = None if attention_mask is None else \
+                attention_mask.astype(jnp.int32)
             if impl == "flash":
                 from fengshen_tpu.ops.flash_attention import flash_attention
-                # a padding mask maps to segment ids (pads = segment 0), so
-                # padded SFT batches stay on the fused kernel
-                seg = None if attention_mask is None else \
-                    attention_mask.astype(jnp.int32)
                 out = flash_attention(q, k, v, causal=True, segment_ids=seg)
             else:
-                out = dot_product_attention(q, k, v, impl="ring")
+                out = dot_product_attention(q, k, v, impl="ring",
+                                            segment_ids=seg)
         else:
             out = dot_product_attention(q, k, v, mask=mask)
 

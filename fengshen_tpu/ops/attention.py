@@ -53,7 +53,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           deterministic: bool = True,
                           impl: str = "dense",
                           sparse_layout=None,
-                          sparse_block_size: int = 128) -> jax.Array:
+                          sparse_block_size: int = 128,
+                          segment_ids: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """Attention entry point with per-layer impl dispatch.
 
     `impl` mirrors the reference's per-layer `attention_config` selection of
@@ -92,6 +94,12 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = expanded[None, None] if mask is None else \
             (mask & expanded[None, None])
 
+    if segment_ids is not None and impl in ("dense", "sparse"):
+        # dense path honors segments as an explicit mask
+        seg_mask = (segment_ids[:, None, None, :] ==
+                    segment_ids[:, None, :, None])
+        mask = seg_mask if mask is None else (mask & seg_mask)
+
     if mask is not None:
         neg = jnp.asarray(-1e9, dtype=jnp.float32)
         mask_bias = jnp.where(mask, 0.0, neg)
@@ -105,11 +113,14 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return flash_attention(q, k, v, bias=bias,
                                dropout_rng=dropout_rng,
                                dropout_rate=dropout_rate,
-                               deterministic=deterministic)
+                               deterministic=deterministic,
+                               segment_ids=segment_ids)
     if impl == "ring":
         if bias is not None:
-            raise ValueError("impl='ring' supports causal masking only; "
-                             "express other patterns via impl='dense'")
+            raise ValueError("impl='ring' supports causal/segment masking "
+                             "only; express other patterns via "
+                             "impl='dense'")
         from fengshen_tpu.ops.ring_attention import ring_attention_sharded
-        return ring_attention_sharded(q, k, v, causal=True)
+        return ring_attention_sharded(q, k, v, segment_ids=segment_ids,
+                                      causal=True)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -34,13 +34,17 @@ def _block_scores(q, k, scale):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   segment_ids: Optional[jax.Array] = None,
                    axis_name: str = SEQUENCE_AXIS,
                    causal: bool = True) -> jax.Array:
     """Attention over a sequence-sharded batch; call inside shard_map.
 
-    q/k/v: local shards [B, S_local, H, D]. The local shard index along
-    `axis_name` determines global positions (contiguous layout: shard i holds
-    positions [i*S_local, (i+1)*S_local)).
+    q/k/v: local shards [B, S_local, H, D]; segment_ids: local int32
+    [B, S_local] shard (tokens attend only within equal ids — a padded
+    batch's attention_mask maps directly, pads = segment 0; the kv-shard's
+    ids rotate around the ring with k/v). The local shard index along
+    `axis_name` determines global positions (contiguous layout: shard i
+    holds positions [i*S_local, (i+1)*S_local)).
     """
     ring_size = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -53,16 +57,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     row_max = jnp.full((batch, num_heads, s_local), _NEG_INF, jnp.float32)
     row_sum = jnp.zeros((batch, num_heads, s_local), jnp.float32)
 
+    has_segments = segment_ids is not None
+    seg_kv0 = segment_ids if has_segments else \
+        jnp.zeros((batch, s_local), jnp.int32)
+
     def body(step, carry):
-        acc, row_max, row_sum, k_cur, v_cur = carry
+        acc, row_max, row_sum, k_cur, v_cur, seg_cur = carry
         # shard that k_cur originated from
         src_idx = (my_idx - step) % ring_size
         k_pos = src_idx * s_local + jnp.arange(s_local)
 
         scores = _block_scores(q, k_cur, scale)  # [B,H,Sq,Sk]
+        allowed = None
         if causal:
-            allowed = k_pos[None, :] <= q_pos[:, None]
-            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+            allowed = (k_pos[None, :] <= q_pos[:, None])[None]
+        if has_segments:
+            same = (segment_ids[:, :, None] ==
+                    seg_cur[:, None, :])  # [B, Sq, Sk]
+            allowed = same if allowed is None else (allowed & same)
+        if allowed is not None:
+            scores = jnp.where(allowed[:, None], scores, _NEG_INF)
 
         blk_max = scores.max(axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
@@ -74,15 +88,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                              ).astype(jnp.float32)
         acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
 
-        # rotate k/v to the next device; overlap with the next step's compute
+        # rotate k/v (+ their segment ids) to the next device; overlap
+        # with the next step's compute
         perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (acc, new_max, new_sum, k_next, v_next)
+        seg_next = jax.lax.ppermute(seg_cur, axis_name, perm) \
+            if has_segments else seg_cur  # no dead collective without segs
+        return (acc, new_max, new_sum, k_next, v_next, seg_next)
 
-    carry = (acc, row_max, row_sum, k, v)
+    carry = (acc, row_max, row_sum, k, v, seg_kv0)
     carry = jax.lax.fori_loop(0, ring_size, body, carry)
-    acc, row_max, row_sum, _, _ = carry
+    acc, row_max, row_sum = carry[0], carry[1], carry[2]
 
     # fully-masked rows (can happen for the first queries under causal with
     # padding) keep sum==0; guard the divide
@@ -91,15 +108,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           segment_ids: Optional[jax.Array] = None,
                            mesh: Optional[Mesh] = None,
                            causal: bool = True) -> jax.Array:
     """shard_map wrapper: q/k/v globally [B, S, H, D], sequence dim sharded
-    over the 'sequence' axis, batch over the batch axes."""
+    over the 'sequence' axis, batch over the batch axes; segment_ids
+    int32 [B, S] (padded batches map their attention_mask here, so
+    sequence parallelism no longer downgrades to dense under padding)."""
     mesh = mesh or get_mesh()
     if mesh is None or SEQUENCE_AXIS not in mesh.shape or \
             mesh.shape[SEQUENCE_AXIS] == 1:
         from fengshen_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)
 
     # fit the batch spec to the actual shape (init passes batch=1, which is
     # not divisible by the batch axes — replicate instead)
@@ -108,9 +129,16 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                       tuple(q.shape))
     if SEQUENCE_AXIS not in jax.tree_util.tree_leaves(tuple(spec)):
         from fengshen_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
-    fn = shard_map(
-        partial(ring_attention, axis_name=SEQUENCE_AXIS, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    body = partial(ring_attention, axis_name=SEQUENCE_AXIS, causal=causal)
+    if segment_ids is None:
+        body = partial(body, segment_ids=None)
+    else:
+        in_specs = in_specs + (P(*spec[:2]),)
+        args = args + (segment_ids.astype(jnp.int32),)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                   check_vma=False)
+    return fn(*args)

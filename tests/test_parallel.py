@@ -133,7 +133,7 @@ def test_ring_attention_matches_dense(mesh_seq4):
 
     from fengshen_tpu.ops import dot_product_attention, causal_mask
     ref = dot_product_attention(q, k, v, mask=causal_mask(16)[None, None])
-    out = ring_attention_sharded(q, k, v, mesh_seq4, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh=mesh_seq4, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
@@ -144,5 +144,31 @@ def test_ring_attention_non_causal(mesh_seq4):
     v = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
     from fengshen_tpu.ops import dot_product_attention
     ref = dot_product_attention(q, k, v)
-    out = ring_attention_sharded(q, k, v, mesh_seq4, causal=False)
+    out = ring_attention_sharded(q, k, v, mesh=mesh_seq4, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_attention_segment_ids(mesh_seq4):
+    """Ring attention with segment ids (padded batch) matches
+    dense-with-mask on valid rows — sequence parallelism no longer
+    downgrades under padding (SURVEY §5.7)."""
+    import numpy as np
+    from fengshen_tpu.ops.attention import dot_product_attention
+    from fengshen_tpu.ops.masks import causal_mask
+    from fengshen_tpu.ops.ring_attention import ring_attention_sharded
+
+    rng = np.random.RandomState(0)
+    batch, seq = 2, 16
+    q = jnp.asarray(rng.randn(batch, seq, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(batch, seq, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(batch, seq, 2, 8), jnp.float32)
+    n_valid = 11
+    seg = jnp.asarray(
+        np.repeat([[1] * n_valid + [0] * (seq - n_valid)], batch, 0),
+        jnp.int32)
+
+    out = ring_attention_sharded(q, k, v, segment_ids=seg)
+    mask = (seg[:, None, None, :] > 0) & causal_mask(seq)[None, None]
+    ref = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out)[:, :n_valid],
+                               np.asarray(ref)[:, :n_valid], atol=1e-4)
