@@ -383,3 +383,57 @@ print("DEVICES", jax.device_count(), "PROC", jax.process_count())
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
         assert "PROC 2" in out, out
+
+
+def test_offload_optimizer_checkpoint_roundtrip(tmp_path, mesh8):
+    """Offloaded (host-resident) optimizer state must survive an orbax
+    save + restore and come back onto the host memory space."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    def build_args(extra=()):
+        return _parse([
+            "--train_batchsize", "4", "--log_every_n_steps", "1",
+            "--warmup_steps", "1", "--default_root_dir", str(tmp_path),
+            "--save_ckpt_path", str(tmp_path / "ckpt"),
+            "--load_ckpt_path", str(tmp_path / "ckpt"),
+            "--offload_optimizer", *extra])
+
+    config = LlamaConfig(vocab_size=64, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=1,
+                         num_attention_heads=4,
+                         max_position_embeddings=16, dtype="float32")
+    rng = np.random.RandomState(0)
+    rows = [{"input_ids": rng.randint(0, 63, 8).tolist()}
+            for _ in range(16)]
+
+    class ListDS:
+        def __len__(self):
+            return len(rows)
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    # run 2 steps and save
+    args = build_args(["--max_steps", "2", "--every_n_train_steps", "2"])
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    module = CausalLMModule(args, LlamaForCausalLM(config), config)
+    dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 2
+
+    # fresh trainer restores and continues, moments back on the host
+    args2 = build_args(["--max_steps", "4"])
+    trainer2 = Trainer(args2)
+    trainer2.callbacks.append(UniversalCheckpoint(args2))
+    module2 = CausalLMModule(args2, LlamaForCausalLM(config), config)
+    dm2 = UniversalDataModule(args=args2, datasets={"train": ListDS()})
+    state2 = trainer2.fit(module2, dm2)
+    assert trainer2.global_step == 4 and int(state2.step) == 4
+    kinds = {leaf.sharding.memory_kind
+             for leaf in jax.tree_util.tree_leaves(state2.opt_state)
+             if hasattr(leaf, "sharding")}
+    assert kinds == {"pinned_host"}
