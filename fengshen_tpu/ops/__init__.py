@@ -24,6 +24,9 @@ from fengshen_tpu.ops.masks import (
     make_attention_bias,
 )
 from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.init_functions import get_init_methods
+from fengshen_tpu.ops.gmlp import GMLPBlock, SpatialGatingUnit, TinyAttention
+from fengshen_tpu.ops.soft_embedding import SoftEmbedding
 
 __all__ = [
     "RMSNorm", "LayerNorm", "ScaleNorm", "get_norm",
@@ -35,4 +38,7 @@ __all__ = [
     "bigbird_block_layout", "longformer_block_layout", "fixed_block_layout",
     "make_attention_bias",
     "dot_product_attention",
+    "get_init_methods",
+    "GMLPBlock", "SpatialGatingUnit", "TinyAttention",
+    "SoftEmbedding",
 ]

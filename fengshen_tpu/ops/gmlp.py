@@ -1,0 +1,134 @@
+"""gMLP blocks (spatial-gating MLP, optionally with tiny attention).
+
+Reference: fengshen/models/megatron/layers/gmlp.py:28-141 —
+`TinyAttention` (single-head attention over the gate path),
+`SpatialGatingUnit` (split-channel gate with a learned causal S×S spatial
+projection, zero-init weight / ones-init bias so the block starts as
+identity), `GMLPBlock` (norm → in-proj to 2*ff → activation → SGU →
+out-proj).
+
+TPU-native differences: batch-major [B, S, D] layout throughout (the
+reference is seq-major [S, B, D] with transposes); the spatial projection
+is a single fp32 einsum over the sequence axis that XLA maps onto the MXU;
+causality is enforced by masking the S×S weight with a lower-triangular
+matrix inside the forward (static shapes, no data-dependent slicing); TP
+sharding comes from partition rules on the in/out projections rather than
+Column/RowParallelLinear classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.masks import causal_mask
+from fengshen_tpu.ops.norms import LayerNorm
+
+
+class TinyAttention(nn.Module):
+    """Single-head attention on the (2*ff)-wide gate input
+    (reference: gmlp.py:28-50). Delegates the masked softmax to
+    `dot_product_attention` (fp32 scores/softmax, shared numerics)."""
+
+    d_attn: int
+    d_ff: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        qkv = nn.Dense(3 * self.d_attn, dtype=self.dtype, name="proj_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        out = dot_product_attention(
+            q[:, :, None], k[:, :, None], v[:, :, None],
+            mask=attention_mask)[:, :, 0]
+        return nn.Dense(self.d_ff, dtype=self.dtype, name="proj_ffn")(out)
+
+
+class SpatialGatingUnit(nn.Module):
+    """Split-channel spatial gate (reference: gmlp.py:53-90).
+
+    The input [B, S, 2*ff] splits into residual/gate halves; the gate is
+    normed, mixed across the sequence axis by a learned S×S projection
+    (zero-init weight, ones bias → identity gate at init), optionally
+    augmented by tiny attention on the full input, then multiplied with
+    the residual half.
+    """
+
+    d_ff: int
+    max_seq_len: int
+    d_attn: Optional[int] = None
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        seq_len = x.shape[1]
+        res, gate = jnp.split(x, 2, axis=-1)
+        gate = LayerNorm(dtype=self.dtype, name="norm")(gate)
+
+        # learned spatial mixing weight over positions (fp32 master copy;
+        # zero/ones init as in reference gmlp.py:69-70)
+        weight = self.param("spatial_weight", nn.initializers.zeros,
+                            (self.max_seq_len, self.max_seq_len), jnp.float32)
+        bias = self.param("spatial_bias", nn.initializers.ones,
+                          (self.max_seq_len,), jnp.float32)
+        w = weight[:seq_len, :seq_len]
+        if self.causal:
+            w = jnp.tril(w)  # output position n sees inputs m <= n
+        gate = (jnp.einsum("bmd,nm->bnd", gate.astype(jnp.float32), w)
+                + bias[:seq_len, None]).astype(x.dtype)
+
+        if self.d_attn is not None:
+            if attention_mask is None and self.causal:
+                # causality must not depend on the caller remembering the
+                # mask — build it here (reference gmlp.py passes the global
+                # ltor mask via mask_fn)
+                attention_mask = causal_mask(seq_len)
+            gate = gate + TinyAttention(
+                d_attn=self.d_attn, d_ff=self.d_ff, dtype=self.dtype,
+                name="attn")(x, attention_mask)
+        return gate * res
+
+
+class GMLPBlock(nn.Module):
+    """Pre-norm gMLP block (reference: gmlp.py:93-141): norm → Dense to
+    2*ff → activation → SpatialGatingUnit → Dense to hidden. Pass
+    `d_attn` to get the "amlp" variant (reference: gmlp.py:117-120)."""
+
+    hidden_size: int
+    intermediate_size: int
+    max_seq_len: int
+    activation: str = "gelu"
+    d_attn: Optional[int] = None
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 attention_mask: Optional[jax.Array] = None) -> jax.Array:
+        h = LayerNorm(dtype=self.dtype, name="norm")(x)
+        h = nn.Dense(2 * self.intermediate_size, dtype=self.dtype,
+                     name="input_linear")(h)
+        h = get_activation(self.activation)(h)
+        h = SpatialGatingUnit(
+            d_ff=self.intermediate_size, max_seq_len=self.max_seq_len,
+            d_attn=self.d_attn, causal=self.causal, dtype=self.dtype,
+            name="sgu")(h, attention_mask)
+        return nn.Dense(self.hidden_size, dtype=self.dtype,
+                        name="output_linear")(h)
+
+
+# TP partition rules for the gMLP projections (column-shard the widening
+# proj, row-shard the narrowing proj — same layout as ParallelMLP).
+GMLP_PARTITION_RULES = (
+    (r".*input_linear/kernel", ("embed", "mlp")),
+    (r".*output_linear/kernel", ("mlp", "embed")),
+    (r".*spatial_weight", (None, None)),
+)

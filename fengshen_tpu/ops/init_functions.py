@@ -1,0 +1,104 @@
+"""Parameter-init dispatch.
+
+Reference: fengshen/models/megatron/layers/init_functions.py:20-127 —
+normal, scaled-normal (sigma/sqrt(2L), used for output projections),
+orthogonal (fp32 QR then cast, gain sqrt(2/L)), xavier uniform/normal,
+small-init (Nguyen & Salazar), wang-init (2/L/sqrt(d)), and the
+`get_init_methods(config)` pair dispatch (`init_method`,
+`output_layer_init_method`).
+
+TPU-native: these return `jax.nn.initializers`-style callables
+`(key, shape, dtype) -> Array`, usable directly as `flax.linen` param
+initializers; the fp16-orthogonal patch the reference carries is
+unnecessary because we always draw in fp32 and cast.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[..., jax.Array]
+
+
+def init_method_normal(sigma: float) -> Initializer:
+    """N(0, sigma) (reference: init_functions.py:20-27)."""
+    return jax.nn.initializers.normal(stddev=sigma)
+
+
+def scaled_init_method_normal(sigma: float, num_layers: int) -> Initializer:
+    """N(0, sigma/sqrt(2L)) for residual-output projections
+    (reference: init_functions.py:30-37)."""
+    return jax.nn.initializers.normal(
+        stddev=sigma / math.sqrt(2.0 * num_layers))
+
+
+def orthogonal_init_method(n_layers: int = 1) -> Initializer:
+    """(Semi-)orthogonal init (Saxe et al. 2013), gain sqrt(2/L)
+    (reference: init_functions.py:40-78)."""
+    return jax.nn.initializers.orthogonal(scale=math.sqrt(2.0 / n_layers))
+
+
+def xavier_uniform_init_method() -> Initializer:
+    """Glorot & Bengio (2010), uniform (reference: init_functions.py:81-88)."""
+    return jax.nn.initializers.glorot_uniform()
+
+
+def xavier_normal_init_method() -> Initializer:
+    """Glorot & Bengio (2010), normal (reference: init_functions.py:91-98)."""
+    return jax.nn.initializers.glorot_normal()
+
+
+def small_init_init_method(dim: int) -> Initializer:
+    """N(0, sqrt(2/(5d))) — "Transformers without Tears"
+    (reference: init_functions.py:101-109)."""
+    return jax.nn.initializers.normal(stddev=math.sqrt(2.0 / (5.0 * dim)))
+
+
+def wang_init_method(n_layers: int, dim: int) -> Initializer:
+    """N(0, 2/(L*sqrt(d))) (reference: init_functions.py:112-118)."""
+    return jax.nn.initializers.normal(stddev=2.0 / n_layers / math.sqrt(dim))
+
+
+_BY_NAME = {
+    "normal": lambda cfg: init_method_normal(cfg.init_method_std),
+    "scaled_normal": lambda cfg: scaled_init_method_normal(
+        cfg.init_method_std, cfg.num_hidden_layers),
+    "orthogonal": lambda cfg: orthogonal_init_method(),
+    "scaled_orthogonal": lambda cfg: orthogonal_init_method(
+        cfg.num_hidden_layers),
+    "xavier_uniform": lambda cfg: xavier_uniform_init_method(),
+    "xavier_normal": lambda cfg: xavier_normal_init_method(),
+    "small_init": lambda cfg: small_init_init_method(cfg.hidden_size),
+    "wang_init": lambda cfg: wang_init_method(
+        cfg.num_hidden_layers, cfg.hidden_size),
+}
+
+
+def get_init_methods(config) -> Tuple[Initializer, Initializer]:
+    """(init_method, output_layer_init_method) pair from config names
+    (reference: init_functions.py:121-127 `get_init_methods`).
+
+    `config` needs `init_method` / `output_layer_init_method` name fields
+    plus `init_method_std`, `hidden_size`, `num_hidden_layers` — the same
+    surface as the reference's NeoX-style config.
+    """
+    def _get(name: str) -> Initializer:
+        factory = _BY_NAME.get(name)
+        if factory is None:
+            raise ValueError(
+                f"unknown init method {name!r}; known: {sorted(_BY_NAME)}")
+        return factory(config)
+
+    return (_get(getattr(config, "init_method", "normal")),
+            _get(getattr(config, "output_layer_init_method",
+                         "scaled_normal")))
+
+
+def embedding_init_method(sigma: float) -> Initializer:
+    """Embedding tables stay fp32-drawn then cast (same as all of the
+    above; kept as a named alias for partition-rule readability)."""
+    return init_method_normal(sigma)

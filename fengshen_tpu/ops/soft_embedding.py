@@ -1,0 +1,84 @@
+"""Soft-prompt embedding (prompt tuning).
+
+Reference: fengshen/models/megatron/layers/word_embeddings.py:157-215
+(`SoftEmbedding`) — a learned [n_tokens, hidden] prompt prepended to the
+token embeddings, initialised either uniformly in [-r, r] or from the
+embedding rows of a tokenised init string (tiled/truncated to n_tokens);
+during incremental decoding the prompt is only prepended on the first
+step (it is already in the KV cache afterwards).
+
+TPU-native: a flax module returning (embeddings, attention_mask) with
+static shapes — the "first decode step" switch is the `prepend` flag the
+caller sets from its cache state rather than a `layer_past.numel()` check.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def init_prompt_from_string(wte: np.ndarray, token_ids, n_tokens: int
+                            ) -> np.ndarray:
+    """Prompt init = embedding rows of `token_ids`, tiled/truncated to
+    n_tokens (reference: word_embeddings.py:178-192)."""
+    rows = np.asarray(wte)[np.asarray(token_ids, dtype=np.int32)]
+    if rows.shape[0] < n_tokens:
+        reps = math.ceil(n_tokens / rows.shape[0])
+        rows = np.tile(rows, (reps, 1))
+    return rows[:n_tokens]
+
+
+class SoftEmbedding(nn.Module):
+    """Learnable prompt prefix (reference: word_embeddings.py:157-215)."""
+
+    n_tokens: int = 10
+    hidden_size: int = 768
+    init_range: float = 0.5
+    # optional fixed init table (e.g. from init_prompt_from_string)
+    init_value: Optional[np.ndarray] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, embeddings: jax.Array,
+                 attention_mask: Optional[jax.Array] = None,
+                 prepend: bool = True,
+                 max_len: Optional[int] = None):
+        if self.init_value is not None:
+            table = np.asarray(self.init_value, dtype=np.float32)
+            if table.shape != (self.n_tokens, self.hidden_size):
+                raise ValueError(
+                    f"init_value shape {table.shape} != "
+                    f"({self.n_tokens}, {self.hidden_size}); tile it with "
+                    "init_prompt_from_string first")
+            init = lambda *_: jnp.asarray(table)
+        else:
+            # stored param IS the prompt: draw uniform in [-r, r) directly
+            # (the reference's uniform_(-r, r), word_embeddings.py:193-195)
+            init = (lambda key, shape, dtype=jnp.float32:
+                    jax.random.uniform(key, shape, dtype,
+                                       -self.init_range, self.init_range))
+        prompt = self.param("soft_embedding_weight", init,
+                            (self.n_tokens, self.hidden_size), jnp.float32)
+        if not prepend:  # incremental decode: prompt already in the cache
+            return embeddings, attention_mask
+
+        batch = embeddings.shape[0]
+        prompt = jnp.broadcast_to(
+            prompt.astype(embeddings.dtype)[None],
+            (batch, self.n_tokens, self.hidden_size))
+        out = jnp.concatenate([prompt, embeddings], axis=1)
+        mask = attention_mask
+        if mask is not None:
+            ones = jnp.ones((batch, self.n_tokens), mask.dtype)
+            mask = jnp.concatenate([ones, mask], axis=1)
+        if max_len is not None:  # clamp to max positions (ref :204-205)
+            out = out[:, :max_len]
+            if mask is not None:
+                mask = mask[:, :max_len]
+        return out, mask

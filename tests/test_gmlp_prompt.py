@@ -1,0 +1,130 @@
+"""Tests for gMLP, SoftEmbedding, and init-function dispatch.
+
+Reference behaviors: fengshen/models/megatron/layers/gmlp.py (zero-init
+spatial gate → identity-like start, causal masking),
+layers/word_embeddings.py:157-215 (prompt prepend + mask extension,
+string init tiling), layers/init_functions.py (std formulas).
+"""
+
+import math
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.ops import GMLPBlock, SoftEmbedding, get_init_methods
+from fengshen_tpu.ops.gmlp import SpatialGatingUnit
+from fengshen_tpu.ops.soft_embedding import init_prompt_from_string
+
+
+def test_sgu_zero_init_is_identity_gate():
+    # zero spatial weight + ones bias => gate path == normed gate * 1,
+    # so output == res * (bias-only mix) with no cross-position leakage.
+    sgu = SpatialGatingUnit(d_ff=8, max_seq_len=16, causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 16))
+    params = sgu.init(jax.random.PRNGKey(1), x)
+    out = sgu.apply(params, x)
+    assert out.shape == (2, 6, 8)
+    # at init the spatial weight is zero: perturbing position 0 of the
+    # *gate* half must not change output at position 3
+    x2 = x.at[:, 0, 8:].add(10.0)
+    out2 = sgu.apply(params, x2)
+    np.testing.assert_allclose(out[:, 3], out2[:, 3], atol=1e-6)
+
+
+def test_gmlp_block_causality():
+    blk = GMLPBlock(hidden_size=16, intermediate_size=32, max_seq_len=8,
+                    causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 16))
+    params = blk.init(jax.random.PRNGKey(1), x)
+    # make the spatial weight non-trivial so mixing actually happens
+    params = jax.tree_util.tree_map(lambda p: p, params)
+    flat = params["params"]["sgu"]["spatial_weight"]
+    params["params"]["sgu"]["spatial_weight"] = flat + 0.1
+    out = blk.apply(params, x)
+    # random (not constant — LayerNorm is shift-invariant) perturbations:
+    noise = jax.random.normal(jax.random.PRNGKey(2), (16,))
+    # perturb the LAST position: earlier positions must be unchanged
+    x2 = x.at[:, -1].add(noise)
+    out2 = blk.apply(params, x2)
+    np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-5)
+    # perturb the FIRST position: later positions must change
+    x3 = x.at[:, 0].add(noise)
+    out3 = blk.apply(params, x3)
+    assert float(jnp.abs(out3[:, -1] - out[:, -1]).max()) > 1e-4
+
+
+def test_gmlp_amlp_variant_runs():
+    blk = GMLPBlock(hidden_size=16, intermediate_size=32, max_seq_len=8,
+                    d_attn=8, causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    mask = jnp.tril(jnp.ones((8, 8), bool))[None]
+    params = blk.init(jax.random.PRNGKey(1), x, mask)
+    out = blk.apply(params, x, mask)
+    assert out.shape == (2, 8, 16)
+
+
+def test_gmlp_amlp_causal_without_mask():
+    # causal=True must be safe even when the caller passes no mask — the
+    # SGU builds the causal mask for tiny attention internally
+    blk = GMLPBlock(hidden_size=16, intermediate_size=32, max_seq_len=8,
+                    d_attn=8, causal=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 16))
+    params = blk.init(jax.random.PRNGKey(1), x)
+    out = blk.apply(params, x)
+    noise = jax.random.normal(jax.random.PRNGKey(2), (16,))
+    out2 = blk.apply(params, x.at[:, -1].add(noise))
+    np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-5)
+
+
+def test_soft_embedding_prepend_and_mask():
+    mod = SoftEmbedding(n_tokens=4, hidden_size=8)
+    emb = jnp.ones((2, 5, 8))
+    mask = jnp.ones((2, 5), jnp.int32)
+    params = mod.init(jax.random.PRNGKey(0), emb, mask)
+    out, m = mod.apply(params, emb, mask)
+    assert out.shape == (2, 9, 8) and m.shape == (2, 9)
+    # prompt rows are the learned table, token rows untouched
+    np.testing.assert_allclose(np.asarray(out[:, 4:]), np.ones((2, 5, 8)))
+    # incremental decode: prepend=False passes through
+    out2, m2 = mod.apply(params, emb, mask, prepend=False)
+    assert out2.shape == (2, 5, 8)
+    # max_len clamp (reference word_embeddings.py:204-205)
+    out3, m3 = mod.apply(params, emb, mask, max_len=6)
+    assert out3.shape == (2, 6, 8) and m3.shape == (2, 6)
+
+
+def test_soft_embedding_string_init_tiles():
+    wte = np.arange(40, dtype=np.float32).reshape(10, 4)
+    init = init_prompt_from_string(wte, [3, 7], n_tokens=5)
+    assert init.shape == (5, 4)
+    np.testing.assert_allclose(init[0], wte[3])
+    np.testing.assert_allclose(init[1], wte[7])
+    np.testing.assert_allclose(init[2], wte[3])  # tiled
+    mod = SoftEmbedding(n_tokens=5, hidden_size=4, init_value=init)
+    emb = jnp.zeros((1, 2, 4))
+    params = mod.init(jax.random.PRNGKey(0), emb)
+    out, _ = mod.apply(params, emb)
+    np.testing.assert_allclose(np.asarray(out[0, :5]), init)
+
+
+def test_init_method_stds():
+    cfg = SimpleNamespace(init_method="normal",
+                          output_layer_init_method="scaled_normal",
+                          init_method_std=0.02, hidden_size=256,
+                          num_hidden_layers=8)
+    init, out_init = get_init_methods(cfg)
+    k = jax.random.PRNGKey(0)
+    a = init(k, (2000, 2000), jnp.float32)
+    b = out_init(k, (2000, 2000), jnp.float32)
+    assert abs(float(a.std()) - 0.02) < 2e-3
+    assert abs(float(b.std()) - 0.02 / math.sqrt(16)) < 2e-3
+    # wang / small_init formulas
+    cfg.init_method = "wang_init"
+    cfg.output_layer_init_method = "small_init"
+    w, s = get_init_methods(cfg)
+    assert abs(float(w(k, (2000, 2000), jnp.float32).std())
+               - 2 / 8 / math.sqrt(256)) < 2e-3
+    assert abs(float(s(k, (2000, 2000), jnp.float32).std())
+               - math.sqrt(2 / (5 * 256))) < 2e-3
