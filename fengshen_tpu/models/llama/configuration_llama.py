@@ -38,7 +38,7 @@ class LlamaConfig:
     # "dots_no_batch" saves matmul outputs (jax
     # dots_with_no_batch_dims_saveable); "checkpoint_dots" saves all dots
     remat_policy: str = "nothing"      # nothing | dots_no_batch | checkpoint_dots
-    attention_impl: str = "dense"      # dense | flash | ring
+    attention_impl: str = "dense"      # dense | flash | ring | ulysses | sequence
     # lax.scan over layers: one compiled layer body regardless of depth —
     # keeps compile time/program size O(1) in num_hidden_layers and is the
     # standard TPU pattern for deep stacks. Params gain a leading [L] dim.

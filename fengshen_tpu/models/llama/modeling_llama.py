@@ -136,7 +136,8 @@ class LlamaAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         impl = cfg.attention_impl
-        if impl in ("flash", "ring") and not is_decode:
+        if impl in ("flash", "ring", "ulysses", "sequence") and \
+                not is_decode:
             # a padding mask maps to segment ids (pads = segment 0), so
             # padded SFT batches stay on the fused/ring paths
             seg = None if attention_mask is None else \
@@ -145,7 +146,7 @@ class LlamaAttention(nn.Module):
                 from fengshen_tpu.ops.flash_attention import flash_attention
                 out = flash_attention(q, k, v, causal=True, segment_ids=seg)
             else:
-                out = dot_product_attention(q, k, v, impl="ring",
+                out = dot_product_attention(q, k, v, impl=impl,
                                             segment_ids=seg)
         else:
             out = dot_product_attention(q, k, v, mask=mask)

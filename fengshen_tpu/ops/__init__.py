@@ -24,6 +24,8 @@ from fengshen_tpu.ops.masks import (
     make_attention_bias,
 )
 from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.ulysses_attention import (
+    ulysses_attention_sharded, sequence_parallel_attention)
 from fengshen_tpu.ops.init_functions import get_init_methods
 from fengshen_tpu.ops.gmlp import GMLPBlock, SpatialGatingUnit, TinyAttention
 from fengshen_tpu.ops.soft_embedding import SoftEmbedding
@@ -38,6 +40,7 @@ __all__ = [
     "bigbird_block_layout", "longformer_block_layout", "fixed_block_layout",
     "make_attention_bias",
     "dot_product_attention",
+    "ulysses_attention_sharded", "sequence_parallel_attention",
     "get_init_methods",
     "GMLPBlock", "SpatialGatingUnit", "TinyAttention",
     "SoftEmbedding",

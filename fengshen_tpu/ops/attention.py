@@ -115,12 +115,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                dropout_rate=dropout_rate,
                                deterministic=deterministic,
                                segment_ids=segment_ids)
-    if impl == "ring":
+    if impl in ("ring", "ulysses", "sequence"):
         if bias is not None:
-            raise ValueError("impl='ring' supports causal/segment masking "
-                             "only; express other patterns via "
+            raise ValueError(f"impl={impl!r} supports causal/segment "
+                             "masking only; express other patterns via "
                              "impl='dense'")
-        from fengshen_tpu.ops.ring_attention import ring_attention_sharded
-        return ring_attention_sharded(q, k, v, segment_ids=segment_ids,
-                                      causal=True)
+        from fengshen_tpu.ops.ulysses_attention import (
+            sequence_parallel_attention)
+        prefer = {"ring": "ring", "ulysses": "ulysses",
+                  "sequence": "auto"}[impl]
+        return sequence_parallel_attention(q, k, v, segment_ids=segment_ids,
+                                           causal=True, prefer=prefer)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -86,11 +86,13 @@ class SpatialGatingUnit(nn.Module):
                 + bias[:seq_len, None]).astype(x.dtype)
 
         if self.d_attn is not None:
-            if attention_mask is None and self.causal:
-                # causality must not depend on the caller remembering the
-                # mask — build it here (reference gmlp.py passes the global
-                # ltor mask via mask_fn)
-                attention_mask = causal_mask(seq_len)
+            if self.causal:
+                # causality must not depend on the caller's mask — AND the
+                # causal constraint into whatever (padding) mask was given
+                # (reference gmlp.py passes the global ltor mask via mask_fn)
+                cmask = causal_mask(seq_len)
+                attention_mask = cmask if attention_mask is None \
+                    else (attention_mask & cmask)
             gate = gate + TinyAttention(
                 d_attn=self.d_attn, d_ff=self.d_ff, dtype=self.dtype,
                 name="attn")(x, attention_mask)

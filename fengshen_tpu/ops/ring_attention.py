@@ -107,33 +107,38 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return (acc / denom).astype(q.dtype)
 
 
-def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
-                           segment_ids: Optional[jax.Array] = None,
-                           mesh: Optional[Mesh] = None,
-                           causal: bool = True) -> jax.Array:
-    """shard_map wrapper: q/k/v globally [B, S, H, D], sequence dim sharded
-    over the 'sequence' axis, batch over the batch axes; segment_ids
-    int32 [B, S] (padded batches map their attention_mask here, so
-    sequence parallelism no longer downgrades to dense under padding)."""
+def sequence_sharded_call(body_fn, q: jax.Array, k: jax.Array, v: jax.Array,
+                          segment_ids: Optional[jax.Array] = None,
+                          mesh: Optional[Mesh] = None,
+                          causal: bool = True) -> jax.Array:
+    """Shared shard_map plumbing for context-parallel attention bodies
+    (ring / Ulysses): shard the sequence dim over the 'sequence' axis and
+    the batch over the batch axes, falling back to plain flash attention
+    when the mesh has no usable sequence axis (or the shape doesn't fit —
+    init passes batch=1, which is not divisible by the batch axes).
+
+    `body_fn(q, k, v, segment_ids=..., axis_name=..., causal=...)` runs on
+    local shards with `axis_name` in scope.
+    """
     mesh = mesh or get_mesh()
-    if mesh is None or SEQUENCE_AXIS not in mesh.shape or \
-            mesh.shape[SEQUENCE_AXIS] == 1:
+
+    def _flash_fallback():
         from fengshen_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal,
                                segment_ids=segment_ids)
 
-    # fit the batch spec to the actual shape (init passes batch=1, which is
-    # not divisible by the batch axes — replicate instead)
+    if mesh is None or SEQUENCE_AXIS not in mesh.shape or \
+            mesh.shape[SEQUENCE_AXIS] == 1:
+        return _flash_fallback()
+
     from fengshen_tpu.parallel.partition import _spec_fits
     spec = _spec_fits(P(BATCH_AXES, SEQUENCE_AXIS, None, None), mesh,
                       tuple(q.shape))
     if SEQUENCE_AXIS not in jax.tree_util.tree_leaves(tuple(spec)):
-        from fengshen_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal,
-                               segment_ids=segment_ids)
+        return _flash_fallback()
     in_specs = (spec, spec, spec)
     args = (q, k, v)
-    body = partial(ring_attention, axis_name=SEQUENCE_AXIS, causal=causal)
+    body = partial(body_fn, axis_name=SEQUENCE_AXIS, causal=causal)
     if segment_ids is None:
         body = partial(body, segment_ids=None)
     else:
@@ -142,3 +147,16 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
                    check_vma=False)
     return fn(*args)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           segment_ids: Optional[jax.Array] = None,
+                           mesh: Optional[Mesh] = None,
+                           causal: bool = True) -> jax.Array:
+    """shard_map wrapper: q/k/v globally [B, S, H, D], sequence dim sharded
+    over the 'sequence' axis, batch over the batch axes; segment_ids
+    int32 [B, S] (padded batches map their attention_mask here, so
+    sequence parallelism no longer downgrades to dense under padding)."""
+    return sequence_sharded_call(ring_attention, q, k, v,
+                                 segment_ids=segment_ids, mesh=mesh,
+                                 causal=causal)
