@@ -6,16 +6,15 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from fengshen_tpu.utils.convert_common import tensor as _tensor
+
 from fengshen_tpu.models.albert.modeling_albert import AlbertConfig
 
 
 def torch_to_params(state_dict: Mapping[str, Any],
                     config: AlbertConfig) -> dict:
     def t(name):
-        x = state_dict[name]
-        if hasattr(x, "detach"):
-            x = x.detach().cpu().float().numpy()
-        return np.asarray(x)
+        return _tensor(state_dict, name)
 
     def lin(prefix):
         return {"kernel": t(f"{prefix}.weight").T,

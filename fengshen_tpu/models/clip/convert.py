@@ -66,13 +66,13 @@ def torch_to_params(text_state: Mapping[str, Any],
     if text_projection is not None:
         x = text_projection
         x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
-        params["text_projection"] = {"kernel": np.asarray(x).T}
+        params["text_projection"] = {"kernel": np.array(x).T}
     if visual_projection is not None:
         x = visual_projection
         x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
-        params["visual_projection"] = {"kernel": np.asarray(x).T}
+        params["visual_projection"] = {"kernel": np.array(x).T}
     if logit_scale is not None:
         x = logit_scale
         x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
-        params["logit_scale"] = np.asarray(x)
+        params["logit_scale"] = np.array(x, copy=True)
     return params

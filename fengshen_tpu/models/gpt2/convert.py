@@ -10,16 +10,15 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from fengshen_tpu.utils.convert_common import tensor as _tensor
+
 from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
 
 
 def torch_to_params(state_dict: Mapping[str, Any],
                     config: GPT2Config) -> dict:
     def t(name):
-        x = state_dict[name]
-        if hasattr(x, "detach"):
-            x = x.detach().cpu().float().numpy()
-        return np.asarray(x)
+        return _tensor(state_dict, name)
 
     def ln(prefix):
         return {"scale": t(f"{prefix}.weight"), "bias": t(f"{prefix}.bias")}

@@ -14,6 +14,8 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from fengshen_tpu.utils.convert_common import tensor as _tensor
+
 from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
 
 
@@ -28,11 +30,8 @@ def torch_to_params(state_dict: Mapping[str, Any],
     ColumnParallel layout).
     """
 
-    def t(name):  # tensor → numpy
-        x = state_dict[name]
-        if hasattr(x, "detach"):
-            x = x.detach().cpu().float().numpy()
-        return np.asarray(x)
+    def t(name):
+        return _tensor(state_dict, name)
 
     params: dict = {"model": {"embed_tokens": {
         "embedding": t("model.embed_tokens.weight")}}}

@@ -141,7 +141,7 @@ def convert_vae_state_dict(vae_state: Mapping[str, Any]) -> dict:
         if any(p in key for p in patterns):
             arr = w.detach().cpu().numpy() if hasattr(w, "detach") else \
                 np.asarray(w)
-            out[key] = arr.reshape(*arr.shape, 1, 1)
+            out[key] = np.array(arr.reshape(*arr.shape, 1, 1), copy=True)
     return out
 
 

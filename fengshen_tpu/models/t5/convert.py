@@ -6,15 +6,14 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from fengshen_tpu.utils.convert_common import tensor as _tensor
+
 from fengshen_tpu.models.t5.configuration_t5 import T5Config
 
 
 def torch_to_params(state_dict: Mapping[str, Any], config: T5Config) -> dict:
     def t(name):
-        x = state_dict[name]
-        if hasattr(x, "detach"):
-            x = x.detach().cpu().float().numpy()
-        return np.asarray(x)
+        return _tensor(state_dict, name)
 
     def lin(prefix):
         return {"kernel": t(f"{prefix}.weight").T}

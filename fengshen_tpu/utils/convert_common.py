@@ -14,7 +14,12 @@ def tensor(state_dict: Mapping[str, Any], name: str) -> np.ndarray:
     x = state_dict[name]
     if hasattr(x, "detach"):
         x = x.detach().cpu().float().numpy()
-    return np.asarray(x)
+    # ALWAYS copy: for fp32 params `.float()` is a no-op and `.numpy()`
+    # shares the torch storage — and `jnp.asarray` on the CPU backend can
+    # be zero-copy, so without this a later in-place torch update (e.g.
+    # optimizer.step() in a parity test) would silently mutate the
+    # already-converted jax params through the aliased buffer.
+    return np.array(x, copy=True)
 
 
 def make_helpers(state_dict: Mapping[str, Any]):

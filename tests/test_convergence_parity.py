@@ -1,0 +1,167 @@
+"""Training-dynamics parity vs the torch oracle.
+
+The zero-egress environment blocks downloading released checkpoints
+(QUALITY_r02.md), so quality parity is established on what CAN be
+measured: starting from IDENTICAL weights on IDENTICAL data with the
+SAME optimizer hyperparameters, the per-step loss trajectory of this
+framework must track torch's step for step. This subsumes forward parity
+(step 0) and extends it to gradients + AdamW update semantics
+(optax.adamw == torch.optim.AdamW: decoupled weight decay, bias
+correction, eps-after-sqrt).
+
+Mirrors the reference's own verification doctrine of comparable loss
+curves (SURVEY.md §4, reference publishes wandb loss curves for Ziya,
+fengshen/examples/ziya_llama/README.md:47-48).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+LR, WD, BETAS, EPS = 1e-3, 0.01, (0.9, 0.999), 1e-8
+N_STEPS = 25
+
+
+def _torch_adamw(model):
+    return torch.optim.AdamW(model.parameters(), lr=LR, betas=BETAS,
+                             eps=EPS, weight_decay=WD)
+
+
+def _optax_adamw():
+    return optax.adamw(LR, b1=BETAS[0], b2=BETAS[1], eps=EPS,
+                       weight_decay=WD)
+
+
+def test_bert_classifier_loss_curve_matches_torch():
+    from fengshen_tpu.models.bert import BertConfig
+    from fengshen_tpu.models.bert.convert import torch_to_params
+    from fengshen_tpu.models.bert.task_heads import (
+        BertForSequenceClassification)
+    from fengshen_tpu.utils.convert_common import make_helpers
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, num_labels=3,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        classifier_dropout=0.0)
+    torch.manual_seed(0)
+    tm = transformers.BertForSequenceClassification(hf_cfg).train()
+
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=32, dtype="float32",
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    sd = tm.state_dict()
+    _, lin, _ = make_helpers(sd)
+    params = {"bert": torch_to_params(sd, cfg)["bert"],
+              "classifier": lin("classifier")}
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.asarray(x), jnp.float32), params)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (4, 8, 16)).astype(np.int64)  # 4 batches
+    labels = rng.randint(0, 3, (4, 8)).astype(np.int64)
+
+    model = BertForSequenceClassification(cfg, num_labels=3)
+    tx = _optax_adamw()
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o, ids, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    opt = _torch_adamw(tm)
+    torch_losses, jax_losses = [], []
+    for i in range(N_STEPS):
+        b = i % 4
+        out = tm(torch.tensor(ids[b]), labels=torch.tensor(labels[b]))
+        opt.zero_grad()
+        out.loss.backward()
+        opt.step()
+        torch_losses.append(float(out.loss.detach()))
+
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(ids[b], jnp.int32),
+                                       jnp.asarray(labels[b], jnp.int32))
+        jax_losses.append(float(loss))
+
+    diffs = np.abs(np.array(torch_losses) - np.array(jax_losses))
+    print(f"\nBERT-cls loss parity: torch[0]={torch_losses[0]:.4f} "
+          f"jax[0]={jax_losses[0]:.4f} torch[-1]={torch_losses[-1]:.4f} "
+          f"jax[-1]={jax_losses[-1]:.4f} max|d|={diffs.max():.5f}")
+    assert diffs.max() < 5e-3, (torch_losses, jax_losses)
+    # the run must actually learn something, or parity is vacuous
+    assert torch_losses[-1] < torch_losses[0] - 0.1
+
+
+def test_llama_causal_lm_loss_curve_matches_torch():
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.models.llama.convert import torch_to_params
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, rms_norm_eps=1e-6,
+        attn_implementation="eager", tie_word_embeddings=False)
+    torch.manual_seed(0)
+    tm = transformers.LlamaForCausalLM(hf_cfg).train()
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=32,
+                      rms_norm_eps=1e-6, dtype="float32")
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.asarray(x), jnp.float32),
+        torch_to_params(tm.state_dict(), cfg))
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 128, (4, 4, 16)).astype(np.int64)
+
+    model = LlamaForCausalLM(cfg)
+    tx = _optax_adamw()
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o, ids):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids)
+            return stable_cross_entropy(logits[:, :-1], ids[:, 1:])[0]
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    opt = _torch_adamw(tm)
+    torch_losses, jax_losses = [], []
+    for i in range(N_STEPS):
+        b = i % 4
+        t_ids = torch.tensor(ids[b])
+        out = tm(t_ids, labels=t_ids)  # HF shifts internally
+        opt.zero_grad()
+        out.loss.backward()
+        opt.step()
+        torch_losses.append(float(out.loss.detach()))
+
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(ids[b], jnp.int32))
+        jax_losses.append(float(loss))
+
+    diffs = np.abs(np.array(torch_losses) - np.array(jax_losses))
+    print(f"\nLLaMA-lm loss parity: torch[0]={torch_losses[0]:.4f} "
+          f"jax[0]={jax_losses[0]:.4f} torch[-1]={torch_losses[-1]:.4f} "
+          f"jax[-1]={jax_losses[-1]:.4f} max|d|={diffs.max():.5f}")
+    assert diffs.max() < 5e-3, (torch_losses, jax_losses)
+    assert torch_losses[-1] < torch_losses[0] - 0.1
