@@ -46,6 +46,10 @@ class LlamaConfig:
     # `multiple_of` rounding of the SwiGLU hidden dim
     # (reference: fengshen/models/megatron/layers/transformer.py:589-590)
     multiple_of: int = 256
+    # MoE: >0 replaces the dense MLP with a SwitchMoE of that many
+    # experts, sharded over the 'expert' mesh axis (beyond-reference)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.num_key_value_heads is None:

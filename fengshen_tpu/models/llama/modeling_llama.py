@@ -41,6 +41,8 @@ PARTITION_RULES: list[tuple[str, P]] = [
     ("embed_tokens/embedding", P("tensor", "fsdp")),
     (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", P("fsdp", "tensor")),
     (r"(o_proj|down_proj)/kernel", P("tensor", "fsdp")),
+    (r"experts_(gate|up)", P("expert", None, "tensor")),
+    (r"experts_down", P("expert", "tensor", None)),
     ("lm_head/kernel", P("fsdp", "tensor")),
     ("norm", P(None)),
     (".*", P(None)),
@@ -53,6 +55,8 @@ SCAN_PARTITION_RULES: list[tuple[str, P]] = [
     (r"layers/.*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel",
      P(None, "fsdp", "tensor")),
     (r"layers/.*(o_proj|down_proj)/kernel", P(None, "tensor", "fsdp")),
+    (r"layers/.*experts_(gate|up)", P(None, "expert", None, "tensor")),
+    (r"layers/.*experts_down", P(None, "expert", "tensor", None)),
     ("lm_head/kernel", P("fsdp", "tensor")),
     ("norm", P(None)),
     (".*", P(None)),
@@ -212,7 +216,21 @@ class LlamaDecoderLayer(nn.Module):
         hidden = hidden + h
         h = RMSNorm(epsilon=cfg.rms_norm_eps,
                     name="post_attention_layernorm")(hidden)
-        h = LlamaMLP(cfg, name="mlp")(h)
+        if cfg.moe_experts > 0:
+            # routed expert MLP instead of the dense one (beyond-reference
+            # capability; aux loss sowed under ("losses","moe_aux_loss"))
+            from fengshen_tpu.ops.moe import SwitchMoE
+            h, _ = SwitchMoE(
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                num_experts=cfg.moe_experts,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=_dt(cfg),
+                param_dtype=jnp.dtype(cfg.param_dtype),
+                name="moe_mlp")(h, token_mask=attention_mask,
+                                deterministic=deterministic)
+        else:
+            h = LlamaMLP(cfg, name="mlp")(h)
         return hidden + h
 
 
@@ -263,7 +281,7 @@ class LlamaModel(nn.Module):
                     prevent_cse=False)
             scan = nn.scan(
                 body,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast,) * 4,
                 length=cfg.num_hidden_layers)
@@ -316,3 +334,45 @@ class LlamaForCausalLM(nn.Module):
     def partition_rules(self):
         return SCAN_PARTITION_RULES if self.config.scan_layers \
             else PARTITION_RULES
+
+
+def resize_token_embeddings(params: dict, config, new_num_tokens: int,
+                            rng=None):
+    """Grow/shrink the vocab dim of embed_tokens + lm_head, preserving the
+    existing rows (reference: models/llama/modeling_llama.py:386-405 —
+    there it rebuilds Embedding/ParallelLinear modules and copies the old
+    weight rows; here params are a pytree, so this is a pure function
+    returning (new_params, new_config)).
+
+    New rows draw from N(0, config.initializer_range) like the
+    reference's init_method. Works for both tied (no lm_head entry) and
+    untied heads.
+    """
+    import dataclasses
+
+    old = config.vocab_size
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def _resize_rows(table, key):
+        n, h = table.shape
+        if new_num_tokens <= n:
+            return table[:new_num_tokens]
+        extra = (jax.random.normal(key, (new_num_tokens - n, h),
+                                   jnp.float32)
+                 * config.initializer_range).astype(table.dtype)
+        return jnp.concatenate([table, extra], axis=0)
+
+    k_embed, k_head = jax.random.split(rng)
+    embed = params["model"]["embed_tokens"]["embedding"]
+    assert embed.shape[0] == old, (embed.shape, old)
+    new_params = {**params,
+                  "model": {**params["model"],
+                            "embed_tokens": {
+                                "embedding": _resize_rows(embed, k_embed)}}}
+    if "lm_head" in params:
+        kernel = params["lm_head"]["kernel"]  # [H, V]
+        new_params["lm_head"] = {
+            "kernel": _resize_rows(kernel.T, k_head).T}
+    return new_params, dataclasses.replace(config,
+                                           vocab_size=new_num_tokens)

@@ -38,8 +38,11 @@ EXPERT_AXIS = "expert"
 
 #: canonical axis order, outermost (slowest links, DCN) first; pipeline
 #: sits between the batch axes and sequence/tensor (stage hops are
-#: infrequent point-to-point transfers, Megatron's pp-outside-tp layout)
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, PIPE_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+#: infrequent point-to-point transfers, Megatron's pp-outside-tp layout);
+#: expert sits next to the batch axes (MoE dispatch is an all-to-all over
+#: tokens, which rides the same links the batch is sharded over)
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQUENCE_AXIS,
+             TENSOR_AXIS)
 
 #: axes over which the global batch is sharded (a batch dim is split over all
 #: of these; this is what DeepSpeed called the "data parallel world")
@@ -59,6 +62,7 @@ class MeshConfig:
 
     data: int = -1  # -1: derive from device count
     fsdp: int = 1
+    expert: int = 1
     pipe: int = 1
     sequence: int = 1
     tensor: int = 1
@@ -74,6 +78,10 @@ class MeshConfig:
                  "reference's DeepSpeed topology)")
         parser.add_argument("--sequence_parallel_size", default=1, type=int)
         parser.add_argument(
+            "--expert_parallel_size", default=1, type=int,
+            help="expert-parallel degree for MoE layers (no reference "
+                 "equivalent; experts shard over this axis)")
+        parser.add_argument(
             "--tensor_model_parallel_size", default=1, type=int,
             help="tensor-parallel degree (same flag name as the reference)")
         return parent_parser
@@ -83,24 +91,28 @@ class MeshConfig:
         return cls(
             data=getattr(args, "data_parallel_size", -1),
             fsdp=getattr(args, "fsdp_parallel_size", 1),
+            expert=getattr(args, "expert_parallel_size", 1),
             pipe=getattr(args, "pipe_model_parallel_size", 1),
             sequence=getattr(args, "sequence_parallel_size", 1),
             tensor=getattr(args, "tensor_model_parallel_size", 1),
         )
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
-        """Concrete (data, fsdp, pipe, sequence, tensor) for n_devices."""
-        fixed = self.fsdp * self.pipe * self.sequence * self.tensor
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int, int]:
+        """Concrete (data, fsdp, expert, pipe, sequence, tensor)."""
+        fixed = (self.fsdp * self.expert * self.pipe * self.sequence *
+                 self.tensor)
         if n_devices % fixed != 0:
             raise ValueError(
                 f"device count {n_devices} not divisible by "
-                f"fsdp*pipe*sequence*tensor = {fixed}")
+                f"fsdp*expert*pipe*sequence*tensor = {fixed}")
         data = self.data if self.data > 0 else n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.pipe}x{self.sequence}"
-                f"x{self.tensor} != device count {n_devices}")
-        return (data, self.fsdp, self.pipe, self.sequence, self.tensor)
+                f"mesh {data}x{self.fsdp}x{self.expert}x{self.pipe}"
+                f"x{self.sequence}x{self.tensor} != device count "
+                f"{n_devices}")
+        return (data, self.fsdp, self.expert, self.pipe, self.sequence,
+                self.tensor)
 
 
 def mesh_shape_for_devices(config: MeshConfig,

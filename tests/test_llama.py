@@ -165,3 +165,36 @@ def test_padded_batch_flash_matches_dense(small_pair):
     valid = np.asarray(mask, bool)
     np.testing.assert_allclose(np.asarray(out_f)[valid],
                                np.asarray(out_d)[valid], atol=2e-3)
+
+
+def test_resize_token_embeddings():
+    """Reference: models/llama/modeling_llama.py:386-405 — grow the vocab,
+    old rows preserved, old-token logits unchanged; shrink truncates."""
+    import dataclasses
+    from fengshen_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                           resize_token_embeddings)
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=16,
+                      dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 8)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    base = model.apply({"params": params}, ids)
+
+    grown, cfg2 = resize_token_embeddings(params, cfg, 80,
+                                          rng=jax.random.PRNGKey(1))
+    assert cfg2.vocab_size == 80
+    assert grown["model"]["embed_tokens"]["embedding"].shape[0] == 80
+    assert grown["lm_head"]["kernel"].shape == (16, 80)
+    out = LlamaForCausalLM(cfg2).apply({"params": grown}, ids)
+    np.testing.assert_allclose(np.asarray(out)[..., :64],
+                               np.asarray(base), atol=1e-5)
+
+    shrunk, cfg3 = resize_token_embeddings(params, cfg, 48)
+    assert shrunk["model"]["embed_tokens"]["embedding"].shape[0] == 48
+    out3 = LlamaForCausalLM(cfg3).apply(
+        {"params": shrunk}, jnp.clip(ids, 0, 47))
+    assert out3.shape[-1] == 48

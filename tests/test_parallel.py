@@ -20,7 +20,7 @@ from fengshen_tpu.ops.ring_attention import ring_attention_sharded
 
 def test_mesh_shapes():
     cfg = MeshConfig(data=-1, fsdp=2, sequence=1, tensor=2)
-    assert cfg.resolve(8) == (2, 2, 1, 1, 2)
+    assert cfg.resolve(8) == (2, 2, 1, 1, 1, 2)
     with pytest.raises(ValueError):
         MeshConfig(data=3, fsdp=2, tensor=2).resolve(8)
     with pytest.raises(ValueError):
@@ -28,9 +28,8 @@ def test_mesh_shapes():
 
 
 def test_mesh_build(mesh8):
-    assert dict(mesh8.shape) == {"data": 2, "fsdp": 2, "pipe": 1,
-                                 "sequence": 1,
-                                 "tensor": 2}
+    assert dict(mesh8.shape) == {"data": 2, "fsdp": 2, "expert": 1,
+                                 "pipe": 1, "sequence": 1, "tensor": 2}
 
 
 def test_match_partition_rules():
