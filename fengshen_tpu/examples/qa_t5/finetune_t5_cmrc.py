@@ -57,7 +57,19 @@ class T5QAModule(TrainModule):
         parser.add_argument("--max_seq_length", type=int, default=512)
         parser.add_argument("--max_knowledge_length", type=int, default=425)
         parser.add_argument("--max_target_length", type=int, default=64)
+        parser.add_argument("--num_beams", type=int, default=4)
+        parser.add_argument("--length_penalty", type=float, default=1.0)
         return parent_parser
+
+    jit_predict = True
+
+    def predict_step(self, params, batch):
+        """Beam-search decode (reference: finetune_t5_cmrc.py:217-224
+        decodes with `model.generate(num_beams=4|10)`)."""
+        from fengshen_tpu.utils.generate import seq2seq_predict_step
+        return seq2seq_predict_step(
+            self.model, self.config, self.args, params, batch,
+            max_new_tokens=self.args.max_target_length)
 
     def init_params(self, rng):
         ids = jnp.zeros((1, 8), jnp.int32)

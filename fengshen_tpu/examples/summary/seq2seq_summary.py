@@ -87,6 +87,17 @@ class Seq2SeqModule(TrainModule):
     def partition_rules(self):
         return self.model.partition_rules()
 
+    jit_predict = True
+
+    def predict_step(self, params, batch):
+        """Beam-search summary decode (reference: the mt5_summary /
+        qa_t5 predict paths call HF `generate(num_beams=...)`, e.g.
+        fengshen/examples/mt5_summary/fastapi_mt5_summary.py:51-55)."""
+        from fengshen_tpu.utils.generate import seq2seq_predict_step
+        return seq2seq_predict_step(
+            self.model, self.config, self.args, params, batch,
+            max_new_tokens=self.args.max_tgt_length)
+
 
 def build_model(model_type: str, model_path=None, config=None):
     if model_type == "t5":
@@ -129,6 +140,8 @@ def main(argv=None):
                        choices=["t5", "bart", "pegasus"])
     group.add_argument("--max_src_length", default=512, type=int)
     group.add_argument("--max_tgt_length", default=128, type=int)
+    group.add_argument("--num_beams", default=1, type=int)
+    group.add_argument("--length_penalty", default=1.0, type=float)
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)

@@ -58,6 +58,78 @@ class LlamaSFTCollator:
         return {k: np.asarray(v) for k, v in batch.items()}
 
 
+@dataclass
+class LlamaSFTPackedCollator:
+    """Sequence-packing variant of `LlamaSFTCollator` (beyond-reference:
+    the flash kernel's segment-id support makes packing free, so short
+    SFT samples stop wasting pad FLOPs).
+
+    Greedily packs samples into rows of `max_seq_length`. Emits
+    `attention_mask` holding per-example SEGMENT IDS (1..n per row,
+    0 = pad) and `position_ids` restarting at 0 per example — the
+    contract of `LlamaConfig.packed_sequences=True`. Loss semantics are
+    identical to the padded collator: prompt tokens and pads are -100,
+    and the cross-example shift position lands on the next example's
+    prompt start (always -100), so no token leaks across examples.
+
+    `fixed_rows` pins the output row count (all-pad filler rows added,
+    overflow rows dropped) so every batch has the same shape — variable
+    shapes would retrigger XLA compilation per step on TPU.
+    """
+
+    tokenizer: Any
+    max_seq_length: int = 1024
+    prompt_key: str = "query"
+    answer_key: str = "answer"
+    fixed_rows: Any = None
+
+    def _encode(self, s: dict) -> tuple[list, list]:
+        eos_id = self.tokenizer.eos_token_id
+        prompt = f"<human>:{s[self.prompt_key].strip()}\n<bot>:"
+        prompt_ids = self.tokenizer.encode(prompt)
+        answer_ids = self.tokenizer.encode(
+            s[self.answer_key], add_special_tokens=False)
+        if eos_id is not None:
+            answer_ids = answer_ids + [eos_id]
+        ids = (prompt_ids + answer_ids)[: self.max_seq_length]
+        labels = ([-100] * len(prompt_ids) + answer_ids)[
+            : self.max_seq_length]
+        return ids, labels
+
+    def __call__(self, samples: list[dict]) -> dict:
+        pad_id = self.tokenizer.pad_token_id or 0
+        rows, cur = [], {"ids": [], "labels": [], "segs": [], "pos": []}
+        seg = 1
+        for s in samples:
+            ids, labels = self._encode(s)
+            if cur["ids"] and \
+                    len(cur["ids"]) + len(ids) > self.max_seq_length:
+                rows.append(cur)
+                cur = {"ids": [], "labels": [], "segs": [], "pos": []}
+                seg = 1
+            cur["ids"] += ids
+            cur["labels"] += labels
+            cur["segs"] += [seg] * len(ids)
+            cur["pos"] += list(range(len(ids)))
+            seg += 1
+        if cur["ids"]:
+            rows.append(cur)
+        if self.fixed_rows is not None:
+            rows = rows[: self.fixed_rows]
+            empty = {"ids": [], "labels": [], "segs": [], "pos": []}
+            rows += [empty] * (self.fixed_rows - len(rows))
+
+        batch = {"input_ids": [], "attention_mask": [], "labels": [],
+                 "position_ids": []}
+        for r in rows:
+            pad = self.max_seq_length - len(r["ids"])
+            batch["input_ids"].append(r["ids"] + [pad_id] * pad)
+            batch["attention_mask"].append(r["segs"] + [0] * pad)
+            batch["labels"].append(r["labels"] + [-100] * pad)
+            batch["position_ids"].append(r["pos"] + [0] * pad)
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
 class Llama(CausalLMModule):
     """Reference: finetune_ziya_llama.py:98-182."""
 
@@ -74,6 +146,12 @@ class Llama(CausalLMModule):
         parser.add_argument("--max_seq_length", type=int, default=1024)
         parser.add_argument("--prompt_key", type=str, default="query")
         parser.add_argument("--answer_key", type=str, default="answer")
+        parser.add_argument("--packed", action="store_true",
+                            help="sequence-pack SFT samples (segment-id "
+                                 "attention; no pad FLOPs)")
+        parser.add_argument("--packed_rows", type=int, default=None,
+                            help="fixed packed-row count per batch "
+                                 "(static shapes for TPU jit)")
         return parent_parser
 
     def setup(self, stage: str = "fit") -> None:
@@ -126,13 +204,24 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
-    collator = LlamaSFTCollator(tokenizer,
-                                max_seq_length=args.max_seq_length,
-                                prompt_key=args.prompt_key,
-                                answer_key=args.answer_key)
+    if args.packed:
+        # static shapes are mandatory under jit: derive a row count when
+        # none is given (assume ~2× packing; overflow rows are dropped)
+        rows = args.packed_rows or max(1, args.train_batchsize // 2)
+        collator = LlamaSFTPackedCollator(
+            tokenizer, max_seq_length=args.max_seq_length,
+            prompt_key=args.prompt_key, answer_key=args.answer_key,
+            fixed_rows=rows)
+    else:
+        collator = LlamaSFTCollator(tokenizer,
+                                    max_seq_length=args.max_seq_length,
+                                    prompt_key=args.prompt_key,
+                                    answer_key=args.answer_key)
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args)
     module = Llama(args)
+    if args.packed:
+        module.config.packed_sequences = True
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
     trainer.fit(module, datamodule)

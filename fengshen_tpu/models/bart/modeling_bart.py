@@ -275,6 +275,19 @@ class BartForConditionalGeneration(nn.Module):
         logits = dec @ emb.T.astype(dec.dtype)
         return logits + self.final_logits_bias.astype(logits.dtype)
 
+    def encode(self, input_ids, attention_mask=None, deterministic=True):
+        return self.model.encode(input_ids, attention_mask, deterministic)
+
+    def decode_logits(self, decoder_input_ids, encoder_hidden,
+                      attention_mask=None, deterministic=True):
+        """Decoder-only re-run for the generate loop (the encoder runs once
+        via `encode`)."""
+        dec = self.model.decode(decoder_input_ids, encoder_hidden,
+                                attention_mask, None, deterministic)
+        emb = self.model.shared.embedding
+        logits = dec @ emb.T.astype(dec.dtype)
+        return logits + self.final_logits_bias.astype(logits.dtype)
+
     def partition_rules(self):
         return PARTITION_RULES
 

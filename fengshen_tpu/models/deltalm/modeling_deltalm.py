@@ -150,40 +150,65 @@ class DeltaLMDecoderLayer(nn.Module):
 
 
 class DeltaLMForConditionalGeneration(nn.Module):
+    """setup-based (not @nn.compact) so the generate loop can run the
+    encoder ONCE via `encode` and re-run only `decode_logits` per step;
+    attribute names keep the original parameter paths."""
+
     config: DeltaLMConfig
 
-    @nn.compact
+    def setup(self):
+        cfg = self.config
+        self.shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std))
+        self.embed_positions = nn.Embed(
+            cfg.max_position_embeddings + _POS_OFFSET, cfg.d_model,
+            dtype=_dt(cfg), param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std))
+        self.encoder_emb_layer_norm = LayerNorm()
+        for i in range(cfg.encoder_layers):
+            setattr(self, f"encoder_layer_{i}", DeltaLMEncoderLayer(cfg))
+        self.encoder_layer_norm = LayerNorm()
+        self.decoder_emb_layer_norm = LayerNorm()
+        for i in range(cfg.decoder_layers):
+            setattr(self, f"decoder_layer_{i}", DeltaLMDecoderLayer(cfg))
+        self.decoder_layer_norm = LayerNorm()
+
+    def _embed(self, ids):
+        cfg = self.config
+        scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
+        return self.shared(ids) * scale + \
+            self.embed_positions(jnp.arange(ids.shape[1]) + _POS_OFFSET)[None]
+
+    def encode(self, input_ids, attention_mask=None, deterministic=True):
+        enc = self.encoder_emb_layer_norm(self._embed(input_ids))
+        for i in range(self.config.encoder_layers):
+            enc = getattr(self, f"encoder_layer_{i}")(
+                enc, attention_mask, deterministic)
+        return self.encoder_layer_norm(enc)
+
+    def _decode(self, decoder_input_ids, encoder_hidden,
+                decoder_attention_mask, encoder_attention_mask,
+                deterministic):
+        dec = self.decoder_emb_layer_norm(self._embed(decoder_input_ids))
+        for i in range(self.config.decoder_layers):
+            dec = getattr(self, f"decoder_layer_{i}")(
+                dec, encoder_hidden, decoder_attention_mask,
+                encoder_attention_mask, deterministic)
+        dec = self.decoder_layer_norm(dec)
+        return dec @ self.shared.embedding.T.astype(dec.dtype)
+
+    def decode_logits(self, decoder_input_ids, encoder_hidden,
+                      attention_mask=None, deterministic=True):
+        return self._decode(decoder_input_ids, encoder_hidden, None,
+                            attention_mask, deterministic)
+
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
                  decoder_attention_mask=None, deterministic=True):
-        cfg = self.config
-        shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
-                          param_dtype=jnp.dtype(cfg.param_dtype),
-                          embedding_init=nn.initializers.normal(
-                              cfg.init_std), name="shared")
-        pos = nn.Embed(cfg.max_position_embeddings + _POS_OFFSET,
-                       cfg.d_model, dtype=_dt(cfg),
-                       param_dtype=jnp.dtype(cfg.param_dtype),
-                       embedding_init=nn.initializers.normal(cfg.init_std),
-                       name="embed_positions")
-        scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
-
-        enc = shared(input_ids) * scale + \
-            pos(jnp.arange(input_ids.shape[1]) + _POS_OFFSET)[None]
-        enc = LayerNorm(name="encoder_emb_layer_norm")(enc)
-        for i in range(cfg.encoder_layers):
-            enc = DeltaLMEncoderLayer(cfg, name=f"encoder_layer_{i}")(
-                enc, attention_mask, deterministic)
-        enc = LayerNorm(name="encoder_layer_norm")(enc)
-
-        dec = shared(decoder_input_ids) * scale + \
-            pos(jnp.arange(decoder_input_ids.shape[1]) + _POS_OFFSET)[None]
-        dec = LayerNorm(name="decoder_emb_layer_norm")(dec)
-        for i in range(cfg.decoder_layers):
-            dec = DeltaLMDecoderLayer(cfg, name=f"decoder_layer_{i}")(
-                dec, enc, decoder_attention_mask, attention_mask,
-                deterministic)
-        dec = LayerNorm(name="decoder_layer_norm")(dec)
-        return dec @ shared.embedding.T.astype(dec.dtype)
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        return self._decode(decoder_input_ids, enc, decoder_attention_mask,
+                            attention_mask, deterministic)
 
     def partition_rules(self):
         return PARTITION_RULES

@@ -50,6 +50,12 @@ class LlamaConfig:
     # experts, sharded over the 'expert' mesh axis (beyond-reference)
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # Switch aux-loss coefficient (α)
+    # sequence packing: attention_mask carries per-example segment ids
+    # (0 = pad) and position ids restart per example — the flash kernel's
+    # segment support makes packing free; dense builds the block-diagonal
+    # mask from segment equality
+    packed_sequences: bool = False
 
     def __post_init__(self):
         if self.num_key_value_heads is None:

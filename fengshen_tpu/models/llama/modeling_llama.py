@@ -132,7 +132,15 @@ class LlamaAttention(nn.Module):
         else:
             mask = causal_mask(seq, k.shape[1])[None, None]
             if attention_mask is not None:
-                mask = mask & attention_mask[:, None, None, :].astype(bool)
+                if getattr(cfg, "packed_sequences", False):
+                    # packed rows: attention_mask carries per-example
+                    # segment ids (0 = pad) — block-diagonal causal mask
+                    seg_m = attention_mask.astype(jnp.int32)
+                    mask = mask & (seg_m[:, None, :, None] ==
+                                   seg_m[:, None, None, :])
+                else:
+                    mask = mask & \
+                        attention_mask[:, None, None, :].astype(bool)
 
         if n_kv != n_heads:  # GQA: repeat kv heads
             rep = n_heads // n_kv
@@ -220,6 +228,14 @@ class LlamaDecoderLayer(nn.Module):
             # routed expert MLP instead of the dense one (beyond-reference
             # capability; aux loss sowed under ("losses","moe_aux_loss"))
             from fengshen_tpu.ops.moe import SwitchMoE
+            # cached decode feeds a 1-token hidden with the full-prompt
+            # mask; the live decode token is always real, so no mask
+            tok_mask = attention_mask
+            if tok_mask is not None and tok_mask.shape[1] != h.shape[1]:
+                tok_mask = None
+            elif tok_mask is not None:
+                # packed rows carry segment ids; MoE only needs real/pad
+                tok_mask = (tok_mask > 0).astype(jnp.int32)
             h, _ = SwitchMoE(
                 hidden_size=cfg.hidden_size,
                 intermediate_size=cfg.intermediate_size,
@@ -227,7 +243,7 @@ class LlamaDecoderLayer(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 dtype=_dt(cfg),
                 param_dtype=jnp.dtype(cfg.param_dtype),
-                name="moe_mlp")(h, token_mask=attention_mask,
+                name="moe_mlp")(h, token_mask=tok_mask,
                                 deterministic=deterministic)
         else:
             h = LlamaMLP(cfg, name="mlp")(h)

@@ -158,39 +158,65 @@ class _PegasusDecoderLayer(nn.Module):
 
 
 class PegasusForConditionalGeneration(nn.Module):
+    """setup-based (not @nn.compact) so the generate loop can run the
+    encoder ONCE via `encode` and re-run only `decode_logits` per step;
+    attribute names keep the original parameter paths."""
+
     config: PegasusConfig
 
-    @nn.compact
-    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
-                 decoder_attention_mask=None, deterministic=True):
+    def setup(self):
         cfg = self.config
-        shared = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
-                          param_dtype=jnp.dtype(cfg.param_dtype),
-                          embedding_init=nn.initializers.normal(
-                              cfg.init_std), name="shared")
+        self.shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.init_std))
+        for i in range(cfg.encoder_layers):
+            setattr(self, f"encoder_layer_{i}", _PegasusEncoderLayer(cfg))
+        for i in range(cfg.decoder_layers):
+            setattr(self, f"decoder_layer_{i}", _PegasusDecoderLayer(cfg))
+        self.encoder_layer_norm = LayerNorm()
+        self.decoder_layer_norm = LayerNorm()
+        self.final_logits_bias = self.param(
+            "final_logits_bias", nn.initializers.zeros,
+            (cfg.vocab_size,), jnp.float32)
+
+    def _embed(self, ids):
+        cfg = self.config
         scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
         pos_table = sinusoidal_positions(cfg.max_position_embeddings,
                                          cfg.d_model)
+        return self.shared(ids) * scale + \
+            pos_table[None, :ids.shape[1]].astype(_dt(cfg))
 
-        enc = shared(input_ids) * scale + \
-            pos_table[None, :input_ids.shape[1]].astype(_dt(cfg))
-        for i in range(cfg.encoder_layers):
-            enc = _PegasusEncoderLayer(cfg, name=f"encoder_layer_{i}")(
+    def encode(self, input_ids, attention_mask=None, deterministic=True):
+        enc = self._embed(input_ids)
+        for i in range(self.config.encoder_layers):
+            enc = getattr(self, f"encoder_layer_{i}")(
                 enc, attention_mask, deterministic)
-        enc = LayerNorm(name="encoder_layer_norm")(enc)
+        return self.encoder_layer_norm(enc)
 
-        dec = shared(decoder_input_ids) * scale + \
-            pos_table[None, :decoder_input_ids.shape[1]].astype(_dt(cfg))
-        for i in range(cfg.decoder_layers):
-            dec = _PegasusDecoderLayer(cfg, name=f"decoder_layer_{i}")(
-                dec, enc, decoder_attention_mask, attention_mask,
-                deterministic)
-        dec = LayerNorm(name="decoder_layer_norm")(dec)
+    def _decode(self, decoder_input_ids, encoder_hidden,
+                decoder_attention_mask, encoder_attention_mask,
+                deterministic):
+        dec = self._embed(decoder_input_ids)
+        for i in range(self.config.decoder_layers):
+            dec = getattr(self, f"decoder_layer_{i}")(
+                dec, encoder_hidden, decoder_attention_mask,
+                encoder_attention_mask, deterministic)
+        dec = self.decoder_layer_norm(dec)
+        logits = dec @ self.shared.embedding.T.astype(dec.dtype)
+        return logits + self.final_logits_bias.astype(logits.dtype)
 
-        logits = dec @ shared.embedding.T.astype(dec.dtype)
-        bias = self.param("final_logits_bias", nn.initializers.zeros,
-                          (cfg.vocab_size,), jnp.float32)
-        return logits + bias.astype(logits.dtype)
+    def decode_logits(self, decoder_input_ids, encoder_hidden,
+                      attention_mask=None, deterministic=True):
+        return self._decode(decoder_input_ids, encoder_hidden, None,
+                            attention_mask, deterministic)
+
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
+                 decoder_attention_mask=None, deterministic=True):
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        return self._decode(decoder_input_ids, enc, decoder_attention_mask,
+                            attention_mask, deterministic)
 
     def partition_rules(self):
         return PARTITION_RULES

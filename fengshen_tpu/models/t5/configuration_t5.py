@@ -31,6 +31,7 @@ class T5Config:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     gradient_checkpointing: bool = False
+    decode_cache_length: int = 512  # KV-cache capacity for generation
 
     def __post_init__(self):
         if self.num_decoder_layers is None:

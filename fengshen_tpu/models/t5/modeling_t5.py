@@ -154,7 +154,7 @@ class T5Attention(nn.Module):
     def _update_cache(self, k, v):
         cfg = self.config
         batch, seq, n_heads, d_kv = k.shape
-        max_len = 512
+        max_len = getattr(cfg, "decode_cache_length", 512)
         is_initialized = self.has_variable("cache", "cached_key")
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                  (batch, max_len, n_heads, d_kv), k.dtype)

@@ -34,18 +34,31 @@ class CausalLMModule(TrainModule):
 
     def training_loss(self, params, batch, rng):
         labels = batch.get("labels", batch["input_ids"])
-        logits = self.model.apply(
+        extra = {}
+        if "position_ids" in batch:  # packed rows restart positions
+            extra["position_ids"] = batch["position_ids"]
+        logits, mutated = self.model.apply(
             {"params": params}, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
-            deterministic=False)
+            deterministic=False, mutable=["losses"], **extra)
         shifted_logits = logits[:, :-1]
         shifted_labels = labels[:, 1:]
         loss, n_tokens = vocab_parallel_cross_entropy(
             shifted_logits, shifted_labels)
+        metrics = {}
+        # auxiliary losses sowed by nested layers (e.g. the SwitchMoE
+        # load-balance term under ("losses","moe_aux_loss"))
+        aux_leaves = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+        if aux_leaves:
+            aux = sum(jnp.sum(leaf) for leaf in aux_leaves)
+            weight = getattr(self.config, "moe_aux_weight", 0.01)
+            loss = loss + weight * aux
+            metrics["aux_loss"] = aux
         acc = (shifted_logits.argmax(-1) == shifted_labels)
         valid = shifted_labels != -100
         acc = (acc * valid).sum() / jnp.maximum(valid.sum(), 1)
-        return loss, {"acc": acc, "n_tokens": n_tokens}
+        metrics.update({"acc": acc, "n_tokens": n_tokens})
+        return loss, metrics
 
     def partition_rules(self):
         if hasattr(self.model, "partition_rules"):

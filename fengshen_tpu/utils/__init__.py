@@ -2,9 +2,12 @@
 
 from fengshen_tpu.utils.universal_checkpoint import UniversalCheckpoint
 from fengshen_tpu.utils.generate import (top_k_logits, top_p_logits,
-                                         sample_sequence_batch, generate)
+                                         sample_sequence_batch, generate,
+                                         seq2seq_generate,
+                                         seq2seq_beam_search)
 from fengshen_tpu.utils.chinese import chinese_char_tokenize, is_chinese_char
 
 __all__ = ["UniversalCheckpoint", "top_k_logits", "top_p_logits",
-           "sample_sequence_batch", "generate", "chinese_char_tokenize",
+           "sample_sequence_batch", "generate", "seq2seq_generate",
+           "seq2seq_beam_search", "chinese_char_tokenize",
            "is_chinese_char"]

@@ -190,3 +190,55 @@ def test_ziya_sft_north_star_tp_flash_e2e(tmp_path, mesh8):
     outs = trainer.predict(module, [{"input_ids": prompt}],
                            params=params, max_new_tokens=4)
     assert outs[0].shape == (1, prompt.shape[1] + 4)
+
+
+def test_ziya_sft_packed_e2e(tmp_path, mesh8):
+    """--packed: sequence-packed SFT fit end-to-end on the mesh (the
+    packed collator + segment-id attention + restarting position ids)."""
+    from fengshen_tpu.examples.ziya_llama import finetune_ziya_llama
+    from fengshen_tpu.models.llama import LlamaConfig
+
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+
+    class CharTok:
+        pad_token_id = 0
+        eos_token_id = 2
+
+        def encode(self, text, add_special_tokens=True):
+            ids = [min(3 + (ord(c) % 90), 95) for c in text]
+            return ([1] + ids) if add_special_tokens else ids
+
+        @classmethod
+        def from_pretrained(cls, path):
+            return cls()
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64, dtype="float32",
+                      attention_impl="flash")
+    cfg.save_pretrained(str(model_dir))
+
+    train = tmp_path / "sft.json"
+    with open(train, "w") as f:
+        for i in range(16):
+            f.write(json.dumps({"query": "你好" * (1 + i % 3),
+                                "answer": "hello"},
+                               ensure_ascii=False) + "\n")
+
+    import unittest.mock as mock
+    with mock.patch("transformers.AutoTokenizer.from_pretrained",
+                    CharTok.from_pretrained):
+        finetune_ziya_llama.main([
+            "--model_path", str(model_dir), "--train_file", str(train),
+            "--train_batchsize", "4", "--max_steps", "2",
+            "--max_seq_length", "64", "--log_every_n_steps", "1",
+            "--warmup_steps", "1", "--packed",
+            "--default_root_dir", str(tmp_path / "runs"),
+            "--save_ckpt_path", str(tmp_path / "ckpt"),
+            "--seed", "1"])
+
+    lines = [json.loads(l) for l in
+             open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
