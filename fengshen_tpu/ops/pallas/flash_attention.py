@@ -60,7 +60,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
                 *, blk_k: int, causal: bool, scale: float,
                 n_kblocks: int, q_offset: int, has_segments: bool):
     # q_ref/o_ref: [1, 1, blk_q, D]; k_ref/v_ref: [1, 1, blk_k, D]
-    # seg refs: [1, blk]; lse_ref: [1, 1, blk_q]
+    # seg refs: [1, 1, blk] and lse_ref: [1, 1, 1, blk_q] — the singleton
+    # dims keep each block's last two dims Mosaic-tileable
     # q_offset = k_len - q_len: queries right-aligned with keys (the KV-cache
     # decode convention, same as ops.flash_attention.blockwise)
     blk_q, head_dim = q_ref.shape[2], q_ref.shape[3]
@@ -82,8 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [blk_q, blk_k]
-        seg_q = seg_q_ref[0] if has_segments else None
-        seg_k = seg_k_ref[0] if has_segments else None
+        seg_q = seg_q_ref[0, 0] if has_segments else None
+        seg_k = seg_k_ref[0, 0] if has_segments else None
         scores = _mask_scores(scores, causal, q_start, k_start,
                               blk_q, blk_k, seg_q, seg_k)
         row_max = max_ref[:, 0]
@@ -107,12 +108,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
     def _finalize():
         denom = jnp.maximum(sum_ref[:, 0], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = max_ref[:, 0] + jnp.log(denom)
+        lse_ref[0, 0, 0] = max_ref[:, 0] + jnp.log(denom)
 
 
 def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
     """q/k/v: [B, H, S, D]; segs: [B, S] int32 or None.
-    Returns (out [B, H, Sq, D], lse [B, H, Sq])."""
+    Returns (out [B, H, Sq, D], lse [B, H, 1, Sq])."""
     batch, num_heads, q_len, head_dim = q.shape
     k_len = k.shape[2]
     blk_q = min(blk_q, q_len)
@@ -124,6 +125,9 @@ def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
     if not has_segments:  # dummy operands keep one kernel signature
         q_seg = jnp.zeros((batch, q_len), jnp.int32)
         kv_seg = jnp.zeros((batch, k_len), jnp.int32)
+    # [B, 1, S]: Mosaic needs the block's last two dims (8,128)-tileable
+    # or equal to the array's — the singleton middle dim satisfies that
+    q_seg3, kv_seg3 = q_seg[:, None, :], kv_seg[:, None, :]
 
     kernel = functools.partial(
         _fwd_kernel, blk_k=blk_k, causal=causal, scale=scale,
@@ -139,17 +143,19 @@ def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
                          lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, blk_k, head_dim),
                          lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, blk_q), lambda b, h, i, j: (b, i)),
-            pl.BlockSpec((1, blk_k), lambda b, h, i, j: (b, j)),
+            pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, blk_k), lambda b, h, i, j: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, blk_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, 1, blk_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, num_heads, q_len), jnp.float32),
+            jax.ShapeDtypeStruct((batch, num_heads, 1, q_len),
+                                 jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, head_dim), jnp.float32),  # acc
@@ -157,7 +163,7 @@ def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
             pltpu.VMEM((blk_q, 1), jnp.float32),         # running sum
         ],
         interpret=interpret,
-    )(q, k, v, q_seg, kv_seg)
+    )(q, k, v, q_seg3, kv_seg3)
     return out, lse
 
 
@@ -188,13 +194,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_blk = k_ref[0, 0].astype(jnp.float32)
         v_blk = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]      # [blk_q]
-        delta = delta_ref[0, 0]  # [blk_q]
+        lse = lse_ref[0, 0, 0]      # [blk_q]
+        delta = delta_ref[0, 0, 0]  # [blk_q]
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        seg_q = seg_q_ref[0] if has_segments else None
-        seg_k = seg_k_ref[0] if has_segments else None
+        seg_q = seg_q_ref[0, 0] if has_segments else None
+        seg_k = seg_k_ref[0, 0] if has_segments else None
         scores = _mask_scores(scores, causal, q_start, k_start,
                               blk_q, blk_k, seg_q, seg_k)
         p = jnp.exp(scores - lse[:, None])              # [blk_q, blk_k]
@@ -243,13 +249,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_blk = k_ref[0, 0].astype(jnp.float32)
         v_blk = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, 0]
+        delta = delta_ref[0, 0, 0]
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        seg_q = seg_q_ref[0] if has_segments else None
-        seg_k = seg_k_ref[0] if has_segments else None
+        seg_q = seg_q_ref[0, 0] if has_segments else None
+        seg_k = seg_k_ref[0, 0] if has_segments else None
         scores = _mask_scores(scores, causal, q_start, k_start,
                               blk_q, blk_k, seg_q, seg_k)
         p = jnp.exp(scores - lse[:, None])
@@ -284,14 +290,18 @@ def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do,
     if not has_segments:
         q_seg = jnp.zeros((batch, q_len), jnp.int32)
         kv_seg = jnp.zeros((batch, k_len), jnp.int32)
+    q_seg3, kv_seg3 = q_seg[:, None, :], kv_seg[:, None, :]
 
-    # delta_i = sum_d dO_i·O_i (rowwise); cheap, XLA fuses it
-    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    # delta_i = sum_d dO_i·O_i (rowwise); cheap, XLA fuses it.
+    # lse arrives as [B, H, 1, S]; delta matches that layout
+    delta = (do.astype(jnp.float32) *
+             out.astype(jnp.float32)).sum(-1)[:, :, None, :]
 
     qspec = pl.BlockSpec((1, 1, blk_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0))
-    rowspec = pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, h, i))
-    segq_spec = pl.BlockSpec((1, blk_q), lambda b, h, i, j: (b, i))
+    rowspec = pl.BlockSpec((1, 1, 1, blk_q),
+                           lambda b, h, i, j: (b, h, 0, i))
+    segq_spec = pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, 0, i))
 
     # dkv: grid over k blocks, stream q blocks innermost
     dkv_kernel = functools.partial(
@@ -310,10 +320,12 @@ def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do,
                          lambda b, h, i, j: (b, h, i, 0)),   # v by outer i
             pl.BlockSpec((1, 1, blk_q, head_dim),
                          lambda b, h, i, j: (b, h, j, 0)),   # do by inner j
-            pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, h, j)),
-            pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, h, j)),
-            pl.BlockSpec((1, blk_q), lambda b, h, i, j: (b, j)),
-            pl.BlockSpec((1, blk_k), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, 1, 1, blk_q),
+                         lambda b, h, i, j: (b, h, 0, j)),
+            pl.BlockSpec((1, 1, 1, blk_q),
+                         lambda b, h, i, j: (b, h, 0, j)),
+            pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, blk_k), lambda b, h, i, j: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, blk_k, head_dim),
@@ -330,7 +342,7 @@ def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do,
             pltpu.VMEM((blk_k, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, q_seg, kv_seg)
+    )(q, k, v, do, lse, delta, q_seg3, kv_seg3)
 
     # dq: grid over q blocks, stream k blocks innermost
     dq_kernel = functools.partial(
@@ -346,12 +358,12 @@ def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do,
                   pl.BlockSpec((1, 1, blk_k, head_dim),
                                lambda b, h, i, j: (b, h, j, 0)),
                   qspec, rowspec, rowspec, segq_spec,
-                  pl.BlockSpec((1, blk_k), lambda b, h, i, j: (b, j))],
+                  pl.BlockSpec((1, 1, blk_k), lambda b, h, i, j: (b, 0, j))],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, head_dim), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, q_seg, kv_seg)
+    )(q, k, v, do, lse, delta, q_seg3, kv_seg3)
 
     return dq, dk, dv
 
