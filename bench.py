@@ -52,10 +52,12 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — retry only compile OOM
             if "Ran out of memory" not in str(e):
                 raise
-            last_err = e
+            # keep only the message: holding the exception would pin the
+            # failed attempt's device buffers via its traceback frames
+            last_err = str(e)[:2000]
             print(f"bench: batch {per_chip} OOM, retrying smaller",
                   file=__import__("sys").stderr, flush=True)
-    raise last_err
+    raise RuntimeError(f"bench: all batch sizes OOM; last: {last_err}")
 
 
 def _run(per_chip_batch: int) -> None:

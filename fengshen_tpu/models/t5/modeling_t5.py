@@ -84,7 +84,8 @@ class T5Attention(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, kv=None, mask=None, position_bias=None,
-                 init_cache=False, deterministic=True):
+                 init_cache=False, cross_from_cache=False,
+                 deterministic=True):
         cfg = self.config
         batch, q_len, _ = hidden.shape
         inner = cfg.num_heads * cfg.d_kv
@@ -93,13 +94,32 @@ class T5Attention(nn.Module):
             param_dtype=jnp.dtype(cfg.param_dtype),
             kernel_init=nn.initializers.normal(
                 cfg.initializer_factor * (cfg.d_model ** -0.5)), name=name)
-        kv_in = hidden if kv is None else kv
         q = dense(inner, "q")(hidden).reshape(batch, q_len, cfg.num_heads,
                                               cfg.d_kv)
-        k = dense(inner, "k")(kv_in).reshape(batch, kv_in.shape[1],
-                                             cfg.num_heads, cfg.d_kv)
-        v = dense(inner, "v")(kv_in).reshape(batch, kv_in.shape[1],
-                                             cfg.num_heads, cfg.d_kv)
+        if kv is not None and (cross_from_cache or init_cache or
+                               self.has_variable("cache", "cross_key")):
+            # cross-attention K/V cache: the encoder projections are the
+            # dominant per-step cost of cached decode (2·S_src·d² per
+            # layer) — project ONCE on the priming call, then read.
+            # `cross_from_cache` is STATIC so the projection matmuls are
+            # absent from the scan-body trace entirely.
+            shape = (batch, kv.shape[1], cfg.num_heads, cfg.d_kv)
+            ck = self.variable("cache", "cross_key", jnp.zeros, shape,
+                               _dt(cfg))
+            cv = self.variable("cache", "cross_value", jnp.zeros, shape,
+                               _dt(cfg))
+            if cross_from_cache:
+                k, v = ck.value, cv.value
+            else:
+                k = dense(inner, "k")(kv).reshape(shape)
+                v = dense(inner, "v")(kv).reshape(shape)
+                ck.value, cv.value = k, v
+        else:
+            kv_in = hidden if kv is None else kv
+            k = dense(inner, "k")(kv_in).reshape(batch, kv_in.shape[1],
+                                                 cfg.num_heads, cfg.d_kv)
+            v = dense(inner, "v")(kv_in).reshape(batch, kv_in.shape[1],
+                                                 cfg.num_heads, cfg.d_kv)
 
         use_cache = self.causal and kv is None and (
             self.has_variable("cache", "cached_key") or init_cache)
@@ -212,7 +232,8 @@ class T5Block(nn.Module):
     @nn.compact
     def __call__(self, hidden, mask=None, encoder_hidden=None,
                  encoder_mask=None, position_bias=None,
-                 encdec_bias=None, init_cache=False, deterministic=True):
+                 encdec_bias=None, init_cache=False,
+                 cross_from_cache=False, deterministic=True):
         cfg = self.config
         drop = lambda x: nn.Dropout(cfg.dropout_rate)(  # noqa: E731
             x, deterministic=deterministic)
@@ -227,7 +248,9 @@ class T5Block(nn.Module):
             h = T5LayerNorm(cfg.layer_norm_epsilon, name="ln_cross")(hidden)
             h, encdec_bias = T5Attention(cfg, name="cross_attention")(
                 h, kv=encoder_hidden, mask=encoder_mask,
-                position_bias=encdec_bias, deterministic=deterministic)
+                position_bias=encdec_bias, init_cache=init_cache,
+                cross_from_cache=cross_from_cache,
+                deterministic=deterministic)
             hidden = hidden + drop(h)
         h = T5LayerNorm(cfg.layer_norm_epsilon, name="ln_ff")(hidden)
         h = T5FF(cfg, name="ff")(h, deterministic)
@@ -242,7 +265,8 @@ class T5Stack(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, mask=None, encoder_hidden=None,
-                 encoder_mask=None, init_cache=False, deterministic=True):
+                 encoder_mask=None, init_cache=False,
+                 cross_from_cache=False, deterministic=True):
         cfg = self.config
         n_layers = cfg.num_decoder_layers if self.causal else cfg.num_layers
         hidden = nn.Dropout(cfg.dropout_rate)(hidden,
@@ -256,7 +280,7 @@ class T5Stack(nn.Module):
                             name=f"block_{i}")
             hidden, position_bias, encdec_bias = block(
                 hidden, mask, encoder_hidden, encoder_mask, position_bias,
-                encdec_bias, init_cache, deterministic)
+                encdec_bias, init_cache, cross_from_cache, deterministic)
         hidden = T5LayerNorm(cfg.layer_norm_epsilon,
                              name="final_layer_norm")(hidden)
         return nn.Dropout(cfg.dropout_rate)(hidden,
@@ -282,12 +306,13 @@ class T5Model(nn.Module):
 
     def decode(self, decoder_input_ids, encoder_hidden, attention_mask=None,
                decoder_attention_mask=None, init_cache=False,
-               deterministic=True):
+               cross_from_cache=False, deterministic=True):
         return self.decoder(self.shared(decoder_input_ids),
                             mask=decoder_attention_mask,
                             encoder_hidden=encoder_hidden,
                             encoder_mask=attention_mask,
                             init_cache=init_cache,
+                            cross_from_cache=cross_from_cache,
                             deterministic=deterministic)
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
@@ -295,7 +320,8 @@ class T5Model(nn.Module):
                  deterministic=True):
         enc = self.encode(input_ids, attention_mask, deterministic)
         dec = self.decode(decoder_input_ids, enc, attention_mask,
-                          decoder_attention_mask, init_cache, deterministic)
+                          decoder_attention_mask, init_cache=init_cache,
+                          deterministic=deterministic)
         return enc, dec
 
 
@@ -334,10 +360,10 @@ class T5ForConditionalGeneration(nn.Module):
 
     def decode_logits(self, decoder_input_ids, encoder_hidden,
                       attention_mask=None, init_cache=False,
-                      deterministic=True):
+                      cross_from_cache=False, deterministic=True):
         dec = self.model.decode(decoder_input_ids, encoder_hidden,
                                 attention_mask, None, init_cache,
-                                deterministic)
+                                cross_from_cache, deterministic)
         return self._logits(dec)
 
     def partition_rules(self):

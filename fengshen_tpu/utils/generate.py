@@ -256,37 +256,61 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
     return buf
 
 
+def _cross_cache_kwargs(model) -> dict:
+    """{'cross_from_cache': True} when decode_logits supports reading the
+    cross-attention K/V from the cache (T5) — the priming call projects
+    the encoder K/V once and scan steps skip those matmuls entirely."""
+    import inspect
+    if "cross_from_cache" in \
+            inspect.signature(model.decode_logits).parameters:
+        return {"cross_from_cache": True}
+    return {}
+
+
 def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
                            max_new_tokens, decoder_start_token_id,
                            eos_token_id, pad_token_id, do_sample,
                            temperature, top_k, top_p, rng):
     """Greedy/sampling decode through the model's KV cache: the encoder
-    runs once, each step runs the decoder on ONE token (O(L) attention
-    per step instead of the O(L²) full-prefix re-run)."""
+    runs once, cross-attention K/V are projected once on the priming
+    call, and each scan step runs the decoder on ONE token (O(L)
+    attention per step instead of the O(L²) full-prefix re-run)."""
     batch = input_ids.shape[0]
     enc = model.apply({"params": params}, input_ids, attention_mask,
                       method=model.encode)
     cache = _init_seq2seq_cache(model, input_ids,
                                 jnp.zeros((batch, 1), jnp.int32))
+    cross_kw = _cross_cache_kwargs(model)
 
-    def step(carry, step_rng):
-        cache, tok, finished = carry
+    def decode(cache, tok, kw):
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, tok[:, None], enc,
             attention_mask, init_cache=True, mutable=["cache"],
-            method=model.decode_logits)
-        nxt = _select_token(logits[:, -1], step_rng, do_sample,
+            method=model.decode_logits, **kw)
+        return mutated["cache"], logits[:, -1]
+
+    start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
+    rng, prime_rng = jax.random.split(rng)
+    cache, logits = decode(cache, start, {})  # prime: projects cross K/V
+    tok = _select_token(logits, prime_rng, do_sample, temperature,
+                        top_k, top_p).astype(jnp.int32)
+    finished = jnp.zeros((batch,), bool)
+    if eos_token_id is not None:
+        finished = finished | (tok == eos_token_id)
+
+    def step(carry, step_rng):
+        cache, tok, finished = carry
+        cache, logits = decode(cache, tok, cross_kw)
+        nxt = _select_token(logits, step_rng, do_sample,
                             temperature, top_k, top_p)
         nxt = jnp.where(finished, pad_token_id, nxt).astype(jnp.int32)
         if eos_token_id is not None:
             finished = finished | (nxt == eos_token_id)
-        return (mutated["cache"], nxt, finished), nxt
+        return (cache, nxt, finished), nxt
 
-    start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
-    finished = jnp.zeros((batch,), bool)
-    _, toks = jax.lax.scan(step, (cache, start, finished),
-                           jax.random.split(rng, max_new_tokens))
-    return jnp.concatenate([start[:, None], toks.T], axis=1)
+    _, toks = jax.lax.scan(step, (cache, tok, finished),
+                           jax.random.split(rng, max_new_tokens - 1))
+    return jnp.concatenate([start[:, None], tok[:, None], toks.T], axis=1)
 
 
 _BEAM_NEG = jnp.float32(-1e9)
@@ -370,31 +394,53 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
     alive_buf, alive_scores, fin_buf, fin_scores = _beam_init(
         batch, K, length, pad_token_id, decoder_start_token_id)
     last_tok = jnp.full((batch, K), decoder_start_token_id, jnp.int32)
+    cross_kw = _cross_cache_kwargs(model)
+
+    def decode(cache, last_tok, kw):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, last_tok.reshape(N, 1),
+            enc, mask, init_cache=True, mutable=["cache"],
+            method=model.decode_logits, **kw)
+        log_probs = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), -1).reshape(batch, K, -1)
+        return mutated["cache"], log_probs
+
+    def reorder(cache, src_beam):
+        # gather the self-attention cache rows onto the surviving beams'
+        # source beams; cross K/V are identical across a row's beams
+        # (encoder output is repeated), so gathering them would be pure
+        # wasted HBM traffic — skip by key name
+        flat = (jnp.arange(batch)[:, None] * K + src_beam).reshape(-1)
+
+        def gather(path, c):
+            if c.ndim != 4 or any("cross" in str(p) for p in path):
+                return c
+            return c[flat]
+        return jax.tree_util.tree_map_with_path(gather, cache)
+
+    # priming step (t=1): projects the cross-attention K/V into the cache
+    cache, log_probs = decode(cache, last_tok, {})
+    (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
+     last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
+                              fin_scores, log_probs, jnp.int32(1), K,
+                              eos_token_id, length_penalty)
+    cache = reorder(cache, src_beam)
 
     def step(carry, t):
         (alive_buf, alive_scores, fin_buf, fin_scores, cache,
          last_tok) = carry
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache}, last_tok.reshape(N, 1),
-            enc, mask, init_cache=True, mutable=["cache"],
-            method=model.decode_logits)
-        cache = mutated["cache"]
-        log_probs = jax.nn.log_softmax(
-            logits[:, -1].astype(jnp.float32), -1).reshape(batch, K, -1)
+        cache, log_probs = decode(cache, last_tok, cross_kw)
         (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
          last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
                                   fin_scores, log_probs, t, K,
                                   eos_token_id, length_penalty)
-        # reorder the cache rows onto the surviving beams' source beams
-        flat = (jnp.arange(batch)[:, None] * K + src_beam).reshape(-1)
-        cache = jax.tree_util.tree_map(
-            lambda c: c[flat] if c.ndim == 4 else c, cache)
+        cache = reorder(cache, src_beam)
         return (alive_buf, alive_scores, fin_buf, fin_scores, cache,
                 last_tok), None
 
     carry = (alive_buf, alive_scores, fin_buf, fin_scores, cache, last_tok)
     (alive_buf, alive_scores, fin_buf, fin_scores, _, _), _ = jax.lax.scan(
-        step, carry, jnp.arange(1, length))
+        step, carry, jnp.arange(2, length))
     return _beam_finish(alive_buf, alive_scores, fin_buf, fin_scores,
                         max_new_tokens, length_penalty)
 
