@@ -58,6 +58,7 @@ class BartConfig:
     decoder_start_token_id: int = 2
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    decode_cache_length: int = 512  # KV-cache capacity for generation
 
     @property
     def hidden_size(self) -> int:
@@ -114,25 +115,48 @@ class BartAttention(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, kv=None, attention_mask=None,
-                 deterministic=True):
+                 deterministic=True, init_cache=False,
+                 cross_from_cache=False):
         cfg = self.config
         batch, q_len, _ = hidden.shape
         head_dim = cfg.d_model // self.num_heads
-        kv_in = hidden if kv is None else kv
         q = _dense(cfg, cfg.d_model, "q_proj")(hidden)
-        k = _dense(cfg, cfg.d_model, "k_proj")(kv_in)
-        v = _dense(cfg, cfg.d_model, "v_proj")(kv_in)
         q = q.reshape(batch, q_len, self.num_heads, head_dim)
-        k = k.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
-        v = v.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
+        if kv is not None and (cross_from_cache or init_cache or
+                               self.has_variable("cache", "cross_key")):
+            # cross-attention K/V: projected once on the priming decode
+            # call, read back inside the scan (same contract as T5)
+            shape = (batch, kv.shape[1], self.num_heads, head_dim)
+            ck = self.variable("cache", "cross_key", jnp.zeros, shape,
+                               _dt(cfg))
+            cv = self.variable("cache", "cross_value", jnp.zeros, shape,
+                               _dt(cfg))
+            if cross_from_cache:
+                k, v = ck.value, cv.value
+            else:
+                k = _dense(cfg, cfg.d_model, "k_proj")(kv).reshape(shape)
+                v = _dense(cfg, cfg.d_model, "v_proj")(kv).reshape(shape)
+                ck.value, cv.value = k, v
+        else:
+            kv_in = hidden if kv is None else kv
+            k = _dense(cfg, cfg.d_model, "k_proj")(kv_in)
+            v = _dense(cfg, cfg.d_model, "v_proj")(kv_in)
+            k = k.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
+            v = v.reshape(batch, kv_in.shape[1], self.num_heads, head_dim)
 
-        mask = None
-        if self.causal:
+        use_cache = self.causal and kv is None and (
+            self.has_variable("cache", "cached_key") or init_cache)
+        if use_cache:
+            k, v, decode_mask = self._update_cache(k, v)
+            mask = decode_mask[:, None]
+        elif self.causal:
             mask = causal_mask(q_len, k.shape[1])[None, None]
             if attention_mask is not None:
                 mask = mask & attention_mask[:, None, None, :].astype(bool)
         elif attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
+        else:
+            mask = None
 
         out = dot_product_attention(q, k, v, mask=mask,
                                     deterministic=deterministic)
@@ -140,6 +164,37 @@ class BartAttention(nn.Module):
             out, P(BATCH_AXES, "sequence", "tensor", None))
         out = out.reshape(batch, q_len, cfg.d_model)
         return _dense(cfg, cfg.d_model, "out_proj")(out)
+
+    def _update_cache(self, k, v):
+        """Static-shape decoder KV cache (same scheme as llama/T5)."""
+        cfg = self.config
+        batch, seq, n_heads, head_dim = k.shape
+        max_len = getattr(cfg, "decode_cache_length", 512)
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (batch, max_len, n_heads, head_dim),
+                                 k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (batch, max_len, n_heads, head_dim),
+                                 v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+        if not is_initialized:
+            valid = jnp.broadcast_to(
+                (jnp.arange(seq)[None, :] <=
+                 jnp.arange(seq)[:, None])[None], (batch, seq, seq))
+            return k, v, valid
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k,
+                                             (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v,
+                                             (0, idx, 0, 0))
+        cached_k.value, cached_v.value = k_all, v_all
+        cache_index.value = idx + seq
+        q_pos = idx + jnp.arange(seq)
+        valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+        valid = jnp.broadcast_to(valid[None], (batch, seq, max_len))
+        return k_all, v_all, valid
 
 
 class BartEncoderLayer(nn.Module):
@@ -167,19 +222,21 @@ class BartDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, encoder_hidden, attention_mask=None,
-                 encoder_attention_mask=None, deterministic=True):
+                 encoder_attention_mask=None, deterministic=True,
+                 init_cache=False, cross_from_cache=False):
         cfg = self.config
         h = BartAttention(cfg, cfg.decoder_attention_heads, causal=True,
                           name="self_attn")(
             hidden, attention_mask=attention_mask,
-            deterministic=deterministic)
+            deterministic=deterministic, init_cache=init_cache)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         hidden = LayerNorm(name="self_attn_layer_norm")(hidden + h)
         h = BartAttention(cfg, cfg.decoder_attention_heads,
                           name="encoder_attn")(
             hidden, kv=encoder_hidden,
             attention_mask=encoder_attention_mask,
-            deterministic=deterministic)
+            deterministic=deterministic, init_cache=init_cache,
+            cross_from_cache=cross_from_cache)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         hidden = LayerNorm(name="encoder_attn_layer_norm")(hidden + h)
         h = get_activation(cfg.activation_function)(
@@ -237,17 +294,20 @@ class BartModel(nn.Module):
 
     def decode(self, decoder_input_ids, encoder_hidden,
                attention_mask=None, decoder_attention_mask=None,
-               deterministic=True):
+               deterministic=True, init_cache=False,
+               cross_from_cache=False, position_offset=0):
         cfg = self.config
         seq = decoder_input_ids.shape[1]
-        pos = jnp.arange(seq) + _POS_OFFSET
+        pos = position_offset + jnp.arange(seq) + _POS_OFFSET
         hidden = self.shared(decoder_input_ids) * self.embed_scale + \
             self.decoder_embed_positions(pos)[None]
         hidden = self.decoder_layernorm_embedding(hidden)
         hidden = self.dropout_layer(hidden, deterministic=deterministic)
         for layer in self.decoder_layers:
             hidden = layer(hidden, encoder_hidden, decoder_attention_mask,
-                           attention_mask, deterministic)
+                           attention_mask, deterministic,
+                           init_cache=init_cache,
+                           cross_from_cache=cross_from_cache)
         return hidden
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
@@ -268,9 +328,12 @@ class BartForConditionalGeneration(nn.Module):
             (self.config.vocab_size,), jnp.float32)
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
-                 decoder_attention_mask=None, deterministic=True):
-        _, dec = self.model(input_ids, decoder_input_ids, attention_mask,
-                            decoder_attention_mask, deterministic)
+                 decoder_attention_mask=None, deterministic=True,
+                 init_cache=False):
+        enc = self.model.encode(input_ids, attention_mask, deterministic)
+        dec = self.model.decode(decoder_input_ids, enc, attention_mask,
+                                decoder_attention_mask, deterministic,
+                                init_cache=init_cache)
         emb = self.model.shared.embedding
         logits = dec @ emb.T.astype(dec.dtype)
         return logits + self.final_logits_bias.astype(logits.dtype)
@@ -279,11 +342,16 @@ class BartForConditionalGeneration(nn.Module):
         return self.model.encode(input_ids, attention_mask, deterministic)
 
     def decode_logits(self, decoder_input_ids, encoder_hidden,
-                      attention_mask=None, deterministic=True):
-        """Decoder-only re-run for the generate loop (the encoder runs once
-        via `encode`)."""
+                      attention_mask=None, deterministic=True,
+                      init_cache=False, cross_from_cache=False,
+                      position_offset=0):
+        """Decoder step for the generate loop: the encoder runs once via
+        `encode`, self/cross K/V ride the cache when `init_cache`."""
         dec = self.model.decode(decoder_input_ids, encoder_hidden,
-                                attention_mask, None, deterministic)
+                                attention_mask, None, deterministic,
+                                init_cache=init_cache,
+                                cross_from_cache=cross_from_cache,
+                                position_offset=position_offset)
         emb = self.model.shared.embedding
         logits = dec @ emb.T.astype(dec.dtype)
         return logits + self.final_logits_bias.astype(logits.dtype)

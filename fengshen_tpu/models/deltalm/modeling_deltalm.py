@@ -128,12 +128,14 @@ class DeltaLMDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, encoder_hidden, attention_mask=None,
-                 encoder_attention_mask=None, deterministic=True):
+                 encoder_attention_mask=None, deterministic=True,
+                 init_cache=False, cross_from_cache=False):
         cfg = self.config
         h = LayerNorm(name="self_attn_layer_norm")(hidden)
         h = BartAttention(cfg, cfg.decoder_attention_heads, causal=True,
                           name="self_attn")(
-            h, attention_mask=attention_mask, deterministic=deterministic)
+            h, attention_mask=attention_mask, deterministic=deterministic,
+            init_cache=init_cache)
         hidden = hidden + h
         h = LayerNorm(name="ffn1_layer_norm")(hidden)
         h = _ffn(cfg, h, "fc1", "fc2", deterministic)
@@ -142,7 +144,8 @@ class DeltaLMDecoderLayer(nn.Module):
         h = BartAttention(cfg, cfg.decoder_attention_heads,
                           name="encoder_attn")(
             h, kv=encoder_hidden, attention_mask=encoder_attention_mask,
-            deterministic=deterministic)
+            deterministic=deterministic, init_cache=init_cache,
+            cross_from_cache=cross_from_cache)
         hidden = hidden + h
         h = LayerNorm(name="ffn2_layer_norm")(hidden)
         h = _ffn(cfg, h, "fc3", "fc4", deterministic)
@@ -175,11 +178,11 @@ class DeltaLMForConditionalGeneration(nn.Module):
             setattr(self, f"decoder_layer_{i}", DeltaLMDecoderLayer(cfg))
         self.decoder_layer_norm = LayerNorm()
 
-    def _embed(self, ids):
+    def _embed(self, ids, position_offset=0):
         cfg = self.config
         scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
-        return self.shared(ids) * scale + \
-            self.embed_positions(jnp.arange(ids.shape[1]) + _POS_OFFSET)[None]
+        pos = position_offset + jnp.arange(ids.shape[1]) + _POS_OFFSET
+        return self.shared(ids) * scale + self.embed_positions(pos)[None]
 
     def encode(self, input_ids, attention_mask=None, deterministic=True):
         enc = self.encoder_emb_layer_norm(self._embed(input_ids))
@@ -190,25 +193,33 @@ class DeltaLMForConditionalGeneration(nn.Module):
 
     def _decode(self, decoder_input_ids, encoder_hidden,
                 decoder_attention_mask, encoder_attention_mask,
-                deterministic):
-        dec = self.decoder_emb_layer_norm(self._embed(decoder_input_ids))
+                deterministic, init_cache=False, cross_from_cache=False,
+                position_offset=0):
+        dec = self.decoder_emb_layer_norm(
+            self._embed(decoder_input_ids, position_offset))
         for i in range(self.config.decoder_layers):
             dec = getattr(self, f"decoder_layer_{i}")(
                 dec, encoder_hidden, decoder_attention_mask,
-                encoder_attention_mask, deterministic)
+                encoder_attention_mask, deterministic,
+                init_cache=init_cache, cross_from_cache=cross_from_cache)
         dec = self.decoder_layer_norm(dec)
         return dec @ self.shared.embedding.T.astype(dec.dtype)
 
     def decode_logits(self, decoder_input_ids, encoder_hidden,
-                      attention_mask=None, deterministic=True):
+                      attention_mask=None, deterministic=True,
+                      init_cache=False, cross_from_cache=False,
+                      position_offset=0):
         return self._decode(decoder_input_ids, encoder_hidden, None,
-                            attention_mask, deterministic)
+                            attention_mask, deterministic, init_cache,
+                            cross_from_cache, position_offset)
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
-                 decoder_attention_mask=None, deterministic=True):
+                 decoder_attention_mask=None, deterministic=True,
+                 init_cache=False):
         enc = self.encode(input_ids, attention_mask, deterministic)
         return self._decode(decoder_input_ids, enc, decoder_attention_mask,
-                            attention_mask, deterministic)
+                            attention_mask, deterministic,
+                            init_cache=init_cache)
 
     def partition_rules(self):
         return PARTITION_RULES

@@ -134,18 +134,21 @@ class _PegasusDecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, encoder_hidden, attention_mask=None,
-                 encoder_attention_mask=None, deterministic=True):
+                 encoder_attention_mask=None, deterministic=True,
+                 init_cache=False, cross_from_cache=False):
         cfg = self.config
         h = LayerNorm(name="self_attn_layer_norm")(hidden)
         h = BartAttention(cfg, cfg.decoder_attention_heads, causal=True,
                           name="self_attn")(
-            h, attention_mask=attention_mask, deterministic=deterministic)
+            h, attention_mask=attention_mask, deterministic=deterministic,
+            init_cache=init_cache)
         hidden = hidden + h
         h = LayerNorm(name="encoder_attn_layer_norm")(hidden)
         h = BartAttention(cfg, cfg.decoder_attention_heads,
                           name="encoder_attn")(
             h, kv=encoder_hidden, attention_mask=encoder_attention_mask,
-            deterministic=deterministic)
+            deterministic=deterministic, init_cache=init_cache,
+            cross_from_cache=cross_from_cache)
         hidden = hidden + h
         h = LayerNorm(name="final_layer_norm")(hidden)
         h = get_activation(cfg.activation_function)(
@@ -180,13 +183,14 @@ class PegasusForConditionalGeneration(nn.Module):
             "final_logits_bias", nn.initializers.zeros,
             (cfg.vocab_size,), jnp.float32)
 
-    def _embed(self, ids):
+    def _embed(self, ids, position_offset=0):
         cfg = self.config
         scale = (cfg.d_model ** 0.5) if cfg.scale_embedding else 1.0
         pos_table = sinusoidal_positions(cfg.max_position_embeddings,
                                          cfg.d_model)
-        return self.shared(ids) * scale + \
-            pos_table[None, :ids.shape[1]].astype(_dt(cfg))
+        pos = jax.lax.dynamic_slice_in_dim(pos_table, position_offset,
+                                           ids.shape[1], axis=0)
+        return self.shared(ids) * scale + pos[None].astype(_dt(cfg))
 
     def encode(self, input_ids, attention_mask=None, deterministic=True):
         enc = self._embed(input_ids)
@@ -197,26 +201,33 @@ class PegasusForConditionalGeneration(nn.Module):
 
     def _decode(self, decoder_input_ids, encoder_hidden,
                 decoder_attention_mask, encoder_attention_mask,
-                deterministic):
-        dec = self._embed(decoder_input_ids)
+                deterministic, init_cache=False, cross_from_cache=False,
+                position_offset=0):
+        dec = self._embed(decoder_input_ids, position_offset)
         for i in range(self.config.decoder_layers):
             dec = getattr(self, f"decoder_layer_{i}")(
                 dec, encoder_hidden, decoder_attention_mask,
-                encoder_attention_mask, deterministic)
+                encoder_attention_mask, deterministic,
+                init_cache=init_cache, cross_from_cache=cross_from_cache)
         dec = self.decoder_layer_norm(dec)
         logits = dec @ self.shared.embedding.T.astype(dec.dtype)
         return logits + self.final_logits_bias.astype(logits.dtype)
 
     def decode_logits(self, decoder_input_ids, encoder_hidden,
-                      attention_mask=None, deterministic=True):
+                      attention_mask=None, deterministic=True,
+                      init_cache=False, cross_from_cache=False,
+                      position_offset=0):
         return self._decode(decoder_input_ids, encoder_hidden, None,
-                            attention_mask, deterministic)
+                            attention_mask, deterministic, init_cache,
+                            cross_from_cache, position_offset)
 
     def __call__(self, input_ids, decoder_input_ids, attention_mask=None,
-                 decoder_attention_mask=None, deterministic=True):
+                 decoder_attention_mask=None, deterministic=True,
+                 init_cache=False):
         enc = self.encode(input_ids, attention_mask, deterministic)
         return self._decode(decoder_input_ids, enc, decoder_attention_mask,
-                            attention_mask, deterministic)
+                            attention_mask, deterministic,
+                            init_cache=init_cache)
 
     def partition_rules(self):
         return PARTITION_RULES

@@ -181,8 +181,14 @@ def _init_seq2seq_cache(model, src, dec1):
 
 
 def _cache_capacity(model) -> int:
-    return getattr(getattr(model, "config", None), "decode_cache_length",
-                   512)
+    cfg = getattr(model, "config", None)
+    cap = getattr(cfg, "decode_cache_length", 512)
+    if _takes_position_offset(model):
+        # absolute-position decoders cannot place tokens past their
+        # position table; keep overflow on the buffer path, which fails
+        # loudly instead of silently clamping the position lookup
+        cap = min(cap, getattr(cfg, "max_position_embeddings", cap))
+    return cap
 
 
 def seq2seq_generate(model, params, input_ids: jax.Array,
@@ -258,13 +264,21 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
 
 def _cross_cache_kwargs(model) -> dict:
     """{'cross_from_cache': True} when decode_logits supports reading the
-    cross-attention K/V from the cache (T5) — the priming call projects
-    the encoder K/V once and scan steps skip those matmuls entirely."""
+    cross-attention K/V from the cache — the priming call projects the
+    encoder K/V once and scan steps skip those matmuls entirely."""
     import inspect
     if "cross_from_cache" in \
             inspect.signature(model.decode_logits).parameters:
         return {"cross_from_cache": True}
     return {}
+
+
+def _takes_position_offset(model) -> bool:
+    """Absolute-position decoders (BART family) need the decode step's
+    position explicitly; T5's relative bias derives it from the cache."""
+    import inspect
+    return "position_offset" in \
+        inspect.signature(model.decode_logits).parameters
 
 
 def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
@@ -281,8 +295,11 @@ def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
     cache = _init_seq2seq_cache(model, input_ids,
                                 jnp.zeros((batch, 1), jnp.int32))
     cross_kw = _cross_cache_kwargs(model)
+    has_pos = _takes_position_offset(model)
 
-    def decode(cache, tok, kw):
+    def decode(cache, tok, kw, offset):
+        if has_pos:
+            kw = dict(kw, position_offset=offset)
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, tok[:, None], enc,
             attention_mask, init_cache=True, mutable=["cache"],
@@ -290,17 +307,21 @@ def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
         return mutated["cache"], logits[:, -1]
 
     start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
-    rng, prime_rng = jax.random.split(rng)
-    cache, logits = decode(cache, start, {})  # prime: projects cross K/V
-    tok = _select_token(logits, prime_rng, do_sample, temperature,
+    # same key stream as the buffer path (split(rng, max_new)): the two
+    # implementations must sample identically for a given seed
+    keys = jax.random.split(rng, max_new_tokens)
+    # prime: projects cross K/V, decodes the start token at position 0
+    cache, logits = decode(cache, start, {}, jnp.int32(0))
+    tok = _select_token(logits, keys[0], do_sample, temperature,
                         top_k, top_p).astype(jnp.int32)
     finished = jnp.zeros((batch,), bool)
     if eos_token_id is not None:
         finished = finished | (tok == eos_token_id)
 
-    def step(carry, step_rng):
+    def step(carry, inp):
         cache, tok, finished = carry
-        cache, logits = decode(cache, tok, cross_kw)
+        t, step_rng = inp
+        cache, logits = decode(cache, tok, cross_kw, t)
         nxt = _select_token(logits, step_rng, do_sample,
                             temperature, top_k, top_p)
         nxt = jnp.where(finished, pad_token_id, nxt).astype(jnp.int32)
@@ -308,8 +329,8 @@ def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
             finished = finished | (nxt == eos_token_id)
         return (cache, nxt, finished), nxt
 
-    _, toks = jax.lax.scan(step, (cache, tok, finished),
-                           jax.random.split(rng, max_new_tokens - 1))
+    ts = jnp.arange(1, max_new_tokens)  # token t sits at position t
+    _, toks = jax.lax.scan(step, (cache, tok, finished), (ts, keys[1:]))
     return jnp.concatenate([start[:, None], tok[:, None], toks.T], axis=1)
 
 
@@ -395,8 +416,11 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
         batch, K, length, pad_token_id, decoder_start_token_id)
     last_tok = jnp.full((batch, K), decoder_start_token_id, jnp.int32)
     cross_kw = _cross_cache_kwargs(model)
+    has_pos = _takes_position_offset(model)
 
-    def decode(cache, last_tok, kw):
+    def decode(cache, last_tok, kw, offset):
+        if has_pos:
+            kw = dict(kw, position_offset=offset)
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, last_tok.reshape(N, 1),
             enc, mask, init_cache=True, mutable=["cache"],
@@ -419,7 +443,7 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
         return jax.tree_util.tree_map_with_path(gather, cache)
 
     # priming step (t=1): projects the cross-attention K/V into the cache
-    cache, log_probs = decode(cache, last_tok, {})
+    cache, log_probs = decode(cache, last_tok, {}, jnp.int32(0))
     (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
      last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
                               fin_scores, log_probs, jnp.int32(1), K,
@@ -429,7 +453,8 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
     def step(carry, t):
         (alive_buf, alive_scores, fin_buf, fin_scores, cache,
          last_tok) = carry
-        cache, log_probs = decode(cache, last_tok, cross_kw)
+        # last_tok was selected at step t-1 and sits at position t-1
+        cache, log_probs = decode(cache, last_tok, cross_kw, t - 1)
         (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
          last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
                                   fin_scores, log_probs, t, K,

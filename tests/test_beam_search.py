@@ -179,14 +179,43 @@ def test_trainer_predict_beam_qa_t5(tmp_path):
     assert int(outs[0][0, 0]) == module.config.decoder_start_token_id
 
 
-def test_t5_cached_equals_buffer_paths(tiny_t5, monkeypatch):
-    """T5 decodes through the KV cache; forcing the full-prefix buffer
-    fallback must give identical sequences for greedy AND beam — the two
-    decode implementations are numerically the same decoder."""
+def _tiny_family(family):
+    if family == "t5":
+        from fengshen_tpu.models.t5 import (T5Config,
+                                            T5ForConditionalGeneration)
+        cfg = T5Config(vocab_size=VOCAB, d_model=16, d_kv=4, d_ff=32,
+                       num_layers=1, num_decoder_layers=1, num_heads=2,
+                       dtype="float32", param_dtype="float32")
+        return T5ForConditionalGeneration(cfg)
+    if family == "bart":
+        from fengshen_tpu.models.bart import (BartConfig,
+                                              BartForConditionalGeneration)
+        return BartForConditionalGeneration(BartConfig.small_test_config(
+            vocab_size=VOCAB, dtype="float32"))
+    if family == "pegasus":
+        from fengshen_tpu.models.pegasus import (
+            PegasusConfig, PegasusForConditionalGeneration)
+        return PegasusForConditionalGeneration(
+            PegasusConfig.small_test_config(vocab_size=VOCAB,
+                                            dtype="float32"))
+    from fengshen_tpu.models.deltalm import (
+        DeltaLMConfig, DeltaLMForConditionalGeneration)
+    return DeltaLMForConditionalGeneration(
+        DeltaLMConfig.small_test_config(vocab_size=VOCAB, dtype="float32"))
+
+
+@pytest.mark.parametrize("family", ["t5", "bart", "pegasus", "deltalm"])
+def test_cached_equals_buffer_paths(family, monkeypatch):
+    """Every seq2seq family decodes through the KV cache (self + cross);
+    forcing the full-prefix buffer fallback must give identical sequences
+    for greedy AND beam — the two decode implementations are numerically
+    the same decoder (positions, cache masking, cross K/V included)."""
     import importlib
     G = importlib.import_module("fengshen_tpu.utils.generate")
-    model, params = tiny_t5
+    model = _tiny_family(family)
     src = jnp.asarray([[2, 3, 4, 5], [5, 2, 2, 3]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), src,
+                        src[:, :2])["params"]
 
     def run():
         greedy = seq2seq_generate(
@@ -195,13 +224,17 @@ def test_t5_cached_equals_buffer_paths(tiny_t5, monkeypatch):
         beam = seq2seq_generate(
             model, params, src, max_new_tokens=5,
             decoder_start_token_id=START, eos_token_id=EOS, num_beams=3)
-        return np.asarray(greedy), np.asarray(beam)
+        sampled = seq2seq_generate(
+            model, params, src, max_new_tokens=5,
+            decoder_start_token_id=START, eos_token_id=EOS,
+            do_sample=True, top_k=4, rng=jax.random.PRNGKey(5))
+        return np.asarray(greedy), np.asarray(beam), np.asarray(sampled)
 
-    cached_g, cached_b = run()
+    cached = run()
     monkeypatch.setattr(G, "_seq2seq_supports_cache", lambda m: False)
-    buffer_g, buffer_b = run()
-    np.testing.assert_array_equal(cached_g, buffer_g)
-    np.testing.assert_array_equal(cached_b, buffer_b)
+    buffered = run()
+    for c, b in zip(cached, buffered):
+        np.testing.assert_array_equal(c, b)
 
 
 def test_full_call_protocol_beam():
