@@ -142,12 +142,15 @@ class LlamaAttention(nn.Module):
                     mask = mask & \
                         attention_mask[:, None, None, :].astype(bool)
 
-        if n_kv != n_heads:  # GQA: repeat kv heads
+        impl = cfg.attention_impl
+        if n_kv != n_heads and not (impl == "flash" and not is_decode):
+            # GQA: repeat kv heads for the dense/decode/ring paths; the
+            # flash dispatch handles grouped KV natively (the Pallas
+            # kernel reads each KV head once per group from HBM)
             rep = n_heads // n_kv
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        impl = cfg.attention_impl
         if impl in ("flash", "ring", "ulysses", "sequence") and \
                 not is_decode:
             # a padding mask maps to segment ids (pads = segment 0), so

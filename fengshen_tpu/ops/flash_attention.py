@@ -170,6 +170,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         from fengshen_tpu.ops.pallas.flash_attention import (
             pallas_flash_attention)
         return pallas_flash_attention(q, k, v, q_seg, kv_seg, causal)
+    if k.shape[2] != q.shape[2]:  # GQA fallback: repeat for blockwise
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     return blockwise_attention(q, k, v, bias=bias, causal=causal,
                                block_size=block_size,
                                q_segment_ids=q_seg, kv_segment_ids=kv_seg)
@@ -178,11 +182,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _pallas_eligible(q, k, v, bias, causal) -> bool:
     """Kernel-eligibility check in the spirit of the reference's
     `FusedScaleMaskSoftmax.is_kernel_available`
-    (reference: layers/fused_softmax.py:148-168)."""
+    (reference: layers/fused_softmax.py:148-168). GQA (fewer KV heads)
+    is kernel-native — the grid index maps read each KV head once per
+    group — as long as the head counts divide."""
     if bias is not None:
         return False
     if jax.default_backend() != "tpu":
         return False
-    _, q_len, _, head_dim = q.shape
-    k_len = k.shape[1]
+    _, q_len, n_heads, head_dim = q.shape
+    k_len, kv_heads = k.shape[1], k.shape[2]
+    if n_heads % kv_heads != 0:
+        return False
     return (head_dim % 128 == 0 and q_len % 128 == 0 and k_len % 128 == 0)

@@ -112,10 +112,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
 
 
 def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
-    """q/k/v: [B, H, S, D]; segs: [B, S] int32 or None.
+    """q: [B, H, S, D]; k/v: [B, KVH, S, D] with H % KVH == 0 (GQA reads
+    each KV head from HBM once per group instead of materialising the
+    repeated tensor); segs: [B, S] int32 or None.
     Returns (out [B, H, Sq, D], lse [B, H, 1, Sq])."""
     batch, num_heads, q_len, head_dim = q.shape
     k_len = k.shape[2]
+    rep = num_heads // k.shape[1]  # q heads per kv head (1 = MHA)
     blk_q = min(blk_q, q_len)
     blk_k = min(blk_k, k_len)
     assert q_len % blk_q == 0 and k_len % blk_k == 0
@@ -140,9 +143,9 @@ def _fwd_impl(q, k, v, q_seg, kv_seg, causal, blk_q, blk_k, interpret):
             pl.BlockSpec((1, 1, blk_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, blk_k, head_dim),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
             pl.BlockSpec((1, 1, blk_k, head_dim),
-                         lambda b, h, i, j: (b, h, j, 0)),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
             pl.BlockSpec((1, 1, blk_q), lambda b, h, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, blk_k), lambda b, h, i, j: (b, 0, j)),
         ],
@@ -279,8 +282,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do,
               causal, blk_q, blk_k, interpret):
-    """All tensors [B, H, S, D]; returns (dq, dk, dv)."""
+    """q/out/do: [B, H, S, D]; k/v: [B, KVH, S, D]; returns (dq, dk, dv)
+    with dk/dv at the KV head count. GQA backward runs the MHA kernels on
+    transiently repeated K/V and group-sums dk/dv — only the forward
+    avoids the repeat (the backward already reads full-size dO)."""
     batch, num_heads, q_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    if kv_heads != num_heads:
+        rep = num_heads // kv_heads
+        dq, dk_full, dv_full = _bwd_impl(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            q_seg, kv_seg, out, lse, do, causal, blk_q, blk_k, interpret)
+        k_len = k.shape[2]
+        dk = dk_full.reshape(batch, kv_heads, rep, k_len,
+                             head_dim).sum(2).astype(k.dtype)
+        dv = dv_full.reshape(batch, kv_heads, rep, k_len,
+                             head_dim).sum(2).astype(v.dtype)
+        return dq, dk, dv
     k_len = k.shape[2]
     blk_q = min(blk_q, q_len)
     blk_k = min(blk_k, k_len)
