@@ -19,6 +19,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import LayerNorm
@@ -251,7 +252,7 @@ class BartModel(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.shared = nn.Embed(
+        self.shared = VocabParallelEmbed(
             cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.init_std),

@@ -13,6 +13,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.parallel.mesh import BATCH_AXES
@@ -139,12 +140,13 @@ class BertModel(nn.Module):
             token_type_ids = jnp.zeros_like(input_ids)
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
-        embed = lambda n, name: nn.Embed(  # noqa: E731
+        embed = lambda n, name, cls=nn.Embed: cls(  # noqa: E731
             n, cfg.hidden_size, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name=name)
-        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+        hidden = embed(cfg.vocab_size, "word_embeddings",
+                       VocabParallelEmbed)(input_ids) + \
             embed(cfg.max_position_embeddings,
                   "position_embeddings")(position_ids) + \
             embed(cfg.type_vocab_size,

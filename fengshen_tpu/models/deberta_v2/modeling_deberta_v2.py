@@ -21,6 +21,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.parallel.mesh import BATCH_AXES
 from fengshen_tpu.parallel.partition import with_sharding_constraint
@@ -247,11 +248,11 @@ class DebertaV2Model(nn.Module):
         batch, seq = input_ids.shape
         if attention_mask is None:
             attention_mask = jnp.ones((batch, seq), jnp.int32)
-        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
-                          param_dtype=jnp.dtype(cfg.param_dtype),
-                          embedding_init=nn.initializers.normal(
-                              cfg.initializer_range),
-                          name="word_embeddings")(input_ids)
+        hidden = VocabParallelEmbed(
+            cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="word_embeddings")(input_ids)
         if cfg.position_biased_input:
             pos = jnp.arange(seq)[None]
             hidden = hidden + nn.Embed(

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.bart.modeling_bart import BartAttention
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.parallel.mesh import BATCH_AXES
 from fengshen_tpu.parallel.partition import with_sharding_constraint
@@ -161,7 +162,7 @@ class DeltaLMForConditionalGeneration(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.shared = nn.Embed(
+        self.shared = VocabParallelEmbed(
             cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.init_std))

@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import LayerNorm
@@ -165,10 +166,11 @@ class GPT2Model(nn.Module):
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
                  init_cache=False, deterministic=True):
         cfg = self.config
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=_dt(cfg),
-                       param_dtype=jnp.dtype(cfg.param_dtype),
-                       embedding_init=nn.initializers.normal(
-                           cfg.initializer_range), name="wte")
+        wte = VocabParallelEmbed(
+            cfg.vocab_size, cfg.n_embd, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(
+                cfg.initializer_range), name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=_dt(cfg),
                        param_dtype=jnp.dtype(cfg.param_dtype),
                        embedding_init=nn.initializers.normal(

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
 from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import RMSNorm
 from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
@@ -275,12 +276,12 @@ class LlamaModel(nn.Module):
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
                  init_cache=False, deterministic=True):
         cfg = self.config
-        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
-                         dtype=_dt(cfg),
-                         param_dtype=jnp.dtype(cfg.param_dtype),
-                         embedding_init=nn.initializers.normal(
-                             cfg.initializer_range),
-                         name="embed_tokens")
+        embed = VocabParallelEmbed(cfg.vocab_size, cfg.hidden_size,
+                                   dtype=_dt(cfg),
+                                   param_dtype=jnp.dtype(cfg.param_dtype),
+                                   embedding_init=nn.initializers.normal(
+                                       cfg.initializer_range),
+                                   name="embed_tokens")
         hidden = embed(input_ids)
         hidden = with_sharding_constraint(
             hidden, P(BATCH_AXES, "sequence", None))

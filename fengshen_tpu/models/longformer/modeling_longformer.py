@@ -29,6 +29,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import sliding_window_mask
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
@@ -283,11 +284,11 @@ class LongformerModel(nn.Module):
         batch, seq = input_ids.shape
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
-                          param_dtype=jnp.dtype(cfg.param_dtype),
-                          embedding_init=nn.initializers.normal(
-                              cfg.initializer_range),
-                          name="word_embeddings")(input_ids)
+        hidden = VocabParallelEmbed(
+            cfg.vocab_size, cfg.hidden_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="word_embeddings")(input_ids)
         if not cfg.use_rotary:
             if position_ids is None:
                 position_ids = jnp.arange(seq)[None]

@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 from fengshen_tpu.models.megatron_bert.configuration_megatron_bert import (
     MegatronBertConfig)
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.parallel.mesh import BATCH_AXES
@@ -140,13 +141,13 @@ class MegatronBertModel(nn.Module):
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
 
-        embed = lambda n, v, name: nn.Embed(  # noqa: E731
+        embed = lambda n, v, name, cls=nn.Embed: cls(  # noqa: E731
             n, cfg.hidden_size, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name=name)
         hidden = embed(cfg.vocab_size, cfg.hidden_size,
-                       "word_embeddings")(input_ids) \
+                       "word_embeddings", VocabParallelEmbed)(input_ids) \
             + embed(cfg.max_position_embeddings, cfg.hidden_size,
                     "position_embeddings")(position_ids) \
             + embed(cfg.type_vocab_size, cfg.hidden_size,

@@ -19,6 +19,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.parallel.mesh import BATCH_AXES
@@ -164,11 +165,11 @@ class RoFormerModel(nn.Module):
         cfg = self.config
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        hidden = nn.Embed(cfg.vocab_size, cfg.embedding_size, dtype=_dt(cfg),
-                          param_dtype=jnp.dtype(cfg.param_dtype),
-                          embedding_init=nn.initializers.normal(
-                              cfg.initializer_range),
-                          name="word_embeddings")(input_ids)
+        hidden = VocabParallelEmbed(
+            cfg.vocab_size, cfg.embedding_size, dtype=_dt(cfg),
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            name="word_embeddings")(input_ids)
         hidden = hidden + nn.Embed(
             cfg.type_vocab_size, cfg.embedding_size, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),

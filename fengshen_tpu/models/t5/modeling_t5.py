@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.t5.configuration_t5 import T5Config
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.parallel.mesh import BATCH_AXES
 from fengshen_tpu.parallel.partition import with_sharding_constraint
@@ -292,7 +293,7 @@ class T5Model(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.shared = nn.Embed(
+        self.shared = VocabParallelEmbed(
             cfg.vocab_size, cfg.d_model, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.initializer_factor),

@@ -15,6 +15,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.bert.modeling_bert import (BertConfig, BertLayer,
@@ -56,12 +57,13 @@ class ZenModel(nn.Module):
         batch, seq = input_ids.shape
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        embed = lambda n, name: nn.Embed(  # noqa: E731
+        embed = lambda n, name, cls=nn.Embed: cls(  # noqa: E731
             n, cfg.hidden_size, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name=name)
-        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+        hidden = embed(cfg.vocab_size, "word_embeddings",
+                       VocabParallelEmbed)(input_ids) + \
             embed(cfg.max_position_embeddings, "position_embeddings")(
                 jnp.arange(seq)[None]) + \
             embed(cfg.type_vocab_size,
@@ -74,8 +76,8 @@ class ZenModel(nn.Module):
         ngram_hidden = None
         ngram_mask = None
         if ngram_ids is not None:
-            ngram_hidden = embed(cfg.ngram_vocab_size,
-                                 "ngram_embeddings")(ngram_ids)
+            ngram_hidden = embed(cfg.ngram_vocab_size, "ngram_embeddings",
+                                 VocabParallelEmbed)(ngram_ids)
             ngram_hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
                                      name="ngram_ln")(ngram_hidden)
             ngram_mask = (ngram_ids != 0).astype(jnp.int32)

@@ -25,6 +25,7 @@ from flax import linen as nn
 from fengshen_tpu.models.bert.modeling_bert import (PARTITION_RULES,
                                                     BertConfig, _dense)
 from fengshen_tpu.ops.activations import get_activation
+from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
 
 
@@ -147,13 +148,14 @@ class Zen2Model(nn.Module):
         cfg = self.config
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        embed = lambda n, name: nn.Embed(  # noqa: E731
+        embed = lambda n, name, cls=nn.Embed: cls(  # noqa: E731
             n, cfg.hidden_size, dtype=_dt(cfg),
             param_dtype=jnp.dtype(cfg.param_dtype),
             embedding_init=nn.initializers.normal(cfg.initializer_range),
             name=name)
         # NOTE: no absolute position embeddings — relative attention
-        hidden = embed(cfg.vocab_size, "word_embeddings")(input_ids) + \
+        hidden = embed(cfg.vocab_size, "word_embeddings",
+                       VocabParallelEmbed)(input_ids) + \
             embed(cfg.type_vocab_size,
                   "token_type_embeddings")(token_type_ids)
         hidden = LayerNorm(epsilon=cfg.layer_norm_eps,

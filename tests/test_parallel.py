@@ -171,3 +171,106 @@ def test_ring_attention_segment_ids(mesh_seq4):
     ref = dot_product_attention(q, k, v, mask=mask)
     np.testing.assert_allclose(np.asarray(out)[:, :n_valid],
                                np.asarray(ref)[:, :n_valid], atol=1e-4)
+
+
+# -- vocab-parallel embedding (SPMD full-rematerialization hazard) ---------
+
+def test_embed_lookup_onehot_matches_take(mesh8):
+    """embed_lookup's one-hot matmul path (vocab sharded over 'tensor')
+    matches a plain take bit-for-bit in fp32."""
+    from fengshen_tpu.ops.embedding import embed_lookup, vocab_shards
+
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(64, 16), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, 64, (4, 8)), jnp.int32)
+    assert vocab_shards(64) == 2  # one-hot path active under mesh8
+    out = embed_lookup(table, ids)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(jnp.take(table, ids, axis=0)))
+    # grads flow as a matmul, matching the take gradient
+    g_onehot = jax.grad(lambda t: embed_lookup(t, ids).sum())(table)
+    g_take = jax.grad(lambda t: jnp.take(t, ids, axis=0).sum())(table)
+    np.testing.assert_allclose(np.asarray(g_onehot), np.asarray(g_take),
+                               atol=1e-6)
+
+
+def test_embed_lookup_unsharded_uses_take():
+    from fengshen_tpu.ops.embedding import vocab_shards
+    assert vocab_shards(64) == 1  # no mesh installed
+    assert vocab_shards(63) == 1
+
+
+def test_no_involuntary_rematerialization_in_sharded_train_step(capfd):
+    """Compiling the fsdp+sp+tp-sharded train step must not trigger XLA's
+    'Involuntary full rematerialization' fallback (the multi-chip embedding
+    hazard VERDICT r2 flagged: a gather on the vocab-sharded table would
+    all-gather the whole embedding every step on a real pod)."""
+    import optax
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel import (MeshConfig, make_mesh, set_mesh,
+                                       make_shardings, match_partition_rules)
+    from fengshen_tpu.parallel.partition import shard_batch_spec
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, sequence=2, tensor=2))
+    set_mesh(mesh)
+    try:
+        config = LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, dtype="float32")
+        model = LlamaForCausalLM(config)
+        ids = jnp.zeros((4, 32), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+        shardings = make_shardings(
+            match_partition_rules(model.partition_rules(), params),
+            params, mesh)
+        params = jax.device_put(params, shardings)
+        batch_sharding = make_shardings(
+            shard_batch_spec(2, sequence_axis=1), ids, mesh)
+        ids = jax.device_put(ids, batch_sharding)
+        tx = optax.adamw(1e-4)
+        opt_state = tx.init(params)
+
+        def train_step(params, opt_state, input_ids):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, input_ids)
+                tgt = jnp.roll(input_ids, -1, axis=1)
+                loss, _ = stable_cross_entropy(
+                    logits[:, :-1].astype(jnp.float32), tgt[:, :-1])
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        capfd.readouterr()  # drain anything emitted before compile
+        compiled = jax.jit(train_step).lower(params, opt_state, ids).compile()
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err, err
+        _, _, loss = compiled(params, opt_state, ids)
+        assert np.isfinite(float(loss))
+    finally:
+        set_mesh(None)
+
+
+def test_embed_lookup_oob_ids_zero_both_paths(mesh8):
+    """Out-of-range/negative ids embed to the zero vector on BOTH the take
+    and one-hot paths (reference semantics: an id outside every rank's
+    vocab slice psums to zero, mpu/layers.py:106-129) — so single-device
+    and pod runs agree."""
+    from fengshen_tpu.ops.embedding import embed_lookup
+    from fengshen_tpu.parallel import set_mesh, get_mesh
+
+    rng = np.random.RandomState(1)
+    table = jnp.asarray(rng.randn(64, 8), jnp.float32)
+    ids = jnp.asarray([[0, 63, 64, 100, -1, -100]], jnp.int32)
+    sharded = np.asarray(embed_lookup(table, ids))
+    mesh = get_mesh()
+    set_mesh(None)
+    try:
+        unsharded = np.asarray(embed_lookup(table, ids))
+    finally:
+        set_mesh(mesh)
+    np.testing.assert_allclose(sharded, unsharded, atol=1e-6)
+    assert (sharded[0, 2:] == 0).all()
+    np.testing.assert_allclose(sharded[0, 1], np.asarray(table)[63],
+                               atol=1e-6)
