@@ -143,7 +143,7 @@ def with_sharding_constraint(x: Any, spec: P, mesh: Optional[Mesh] = None):
                   if "Manual" in str(t)} if abstract is not None and \
             abstract.axis_names else set()
     except Exception:
-        manual = set()
+        abstract, manual = None, set()
     if manual:
         def strip(entry):
             if entry is None:
@@ -157,9 +157,15 @@ def with_sharding_constraint(x: Any, spec: P, mesh: Optional[Mesh] = None):
         if all(e is None for e in spec):
             return x
 
+    # Inside a partial-manual shard_map the constraint must be built on the
+    # abstract mesh (whose axis types mark the manual axes) — a NamedSharding
+    # over the concrete all-Auto mesh is rejected for values varying over a
+    # Manual axis.
+    constraint_mesh = abstract if manual else mesh
+
     def constrain(leaf):
         fitted = _spec_fits(spec, mesh, tuple(leaf.shape))
         return jax.lax.with_sharding_constraint(
-            leaf, NamedSharding(mesh, fitted))
+            leaf, NamedSharding(constraint_mesh, fitted))
 
     return jax.tree_util.tree_map(constrain, x)

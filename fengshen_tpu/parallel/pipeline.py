@@ -30,6 +30,20 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _pvary(x, axis_name):
+    """pvary that is a no-op when `x` is already varying over `axis_name`
+    (pvary itself rejects invariant->variant re-application)."""
+    try:
+        if axis_name in jax.typeof(x).vma:
+            return x
+    except Exception:  # pragma: no cover - non-traced values
+        pass
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)  # pragma: no cover - older jax
+
+
 def _pipeline_body(stage_params: Any, microbatches: jax.Array,
                    stage_fn: Callable, axis_name: str,
                    n_microbatches: int) -> jax.Array:
@@ -45,8 +59,12 @@ def _pipeline_body(stage_params: Any, microbatches: jax.Array,
     local_params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
 
     mb_shape = microbatches.shape[1:]
-    state = jnp.zeros(mb_shape, microbatches.dtype)  # current activation
-    outputs = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+    # carries are pipe-varying (each stage holds different values); pvary
+    # marks them so check_vma accepts the cond/where mixing below
+    state = _pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name)
+    outputs = _pvary(
+        jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype),
+        axis_name)
 
     total_ticks = n_microbatches + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -55,8 +73,9 @@ def _pipeline_body(stage_params: Any, microbatches: jax.Array,
         state, outputs = carry
         # stage 0 ingests microbatch t while t < M; later stages use the
         # activation that arrived from the previous stage
-        feed = jnp.take(microbatches, jnp.clip(t, 0, n_microbatches - 1),
-                        axis=0)
+        feed = _pvary(
+            jnp.take(microbatches, jnp.clip(t, 0, n_microbatches - 1),
+                     axis=0), axis_name)
         x = jnp.where(is_first, feed, state)
         y = stage_fn(local_params, x)
         # last stage emits microbatch (t - n_stages + 1) when it's valid
@@ -92,13 +111,19 @@ def pipeline_apply(stage_fn: Callable, stacked_params: Any,
     n_micro = microbatches.shape[0]
     params_spec = jax.tree_util.tree_map(
         lambda x: P(axis_name), stacked_params)
+    # Manual ONLY over the pipe axis: every other mesh axis stays Auto, so
+    # GSPMD keeps sharding the within-stage math (fsdp/tensor/sequence) —
+    # PP composes with the other parallelism kinds in one SPMD program
+    # (the reference's pipe-outer/model-inner topology,
+    # fengshen/strategies/megatron_deepspeed.py:347-354).
     fn = shard_map(
         partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis_name,
                 n_microbatches=n_micro),
         mesh=mesh,
         in_specs=(params_spec, P()),
         out_specs=P(),
-        check_vma=False)
+        axis_names=frozenset({axis_name}),
+        check_vma=True)
     return fn(stacked_params, microbatches)
 
 
@@ -125,12 +150,13 @@ def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
 
     mb_shape = micro_inputs.shape[1:]
     ring = 2 * S  # max in-flight inputs per stage is 2S-1-2s <= 2S-1
-    in_buf = jnp.zeros((ring,) + mb_shape, micro_inputs.dtype)
-    fwd_state = jnp.zeros(mb_shape, micro_inputs.dtype)
-    bwd_state = jnp.zeros(mb_shape, micro_inputs.dtype)
+    pv = lambda x: _pvary(x, axis_name)  # noqa: E731
+    in_buf = pv(jnp.zeros((ring,) + mb_shape, micro_inputs.dtype))
+    fwd_state = pv(jnp.zeros(mb_shape, micro_inputs.dtype))
+    bwd_state = pv(jnp.zeros(mb_shape, micro_inputs.dtype))
     dparams = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), local_params)
-    loss_acc = jnp.zeros((), jnp.float32)
+        lambda p: pv(jnp.zeros(p.shape, jnp.float32)), local_params)
+    loss_acc = pv(jnp.zeros((), jnp.float32))
 
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [((i + 1) % S, i) for i in range(S)]
@@ -147,7 +173,7 @@ def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
         # ---- forward lane: microbatch m_f = t - sid ----
         m_f = t - sid
         fwd_live = jnp.logical_and(m_f >= 0, m_f < M)
-        feed = jnp.take(micro_inputs, fwd_for(m_f), axis=0)
+        feed = pv(jnp.take(micro_inputs, fwd_for(m_f), axis=0))
         x = jnp.where(is_first, feed, fwd_state)
         in_buf = jax.lax.cond(
             fwd_live,
@@ -160,14 +186,14 @@ def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
         m_b = t - (2 * S - 1 - sid)
         bwd_live = jnp.logical_and(m_b >= 0, m_b < M)
         x_saved = jnp.take(in_buf, fwd_for(m_b) % ring, axis=0)
-        target = jnp.take(micro_targets, fwd_for(m_b), axis=0)
+        target = pv(jnp.take(micro_targets, fwd_for(m_b), axis=0))
 
         # ONE stage vjp serves both roles: the last stage seeds it with
         # the loss cotangent, others with the received cotangent
         out, s_vjp = jax.vjp(lambda p, x_in: stage_fn(p, x_in),
                              local_params, x_saved)
         l_val, l_vjp = jax.vjp(lambda o: last_stage_loss(o, target), out)
-        (d_out,) = l_vjp(jnp.ones((), l_val.dtype))
+        (d_out,) = l_vjp(pv(jnp.ones((), l_val.dtype)))
         seed = jnp.where(is_last, d_out, bwd_state)
         ds_p, ds_x = s_vjp(seed)
 
@@ -218,5 +244,6 @@ def pipeline_train_step_1f1b(stage_fn: Callable, last_stage_loss: Callable,
         mesh=mesh,
         in_specs=(params_spec, P(), P()),
         out_specs=(P(), params_spec),
-        check_vma=False)
+        axis_names=frozenset({axis_name}),
+        check_vma=True)
     return fn(stacked_params, micro_inputs, micro_targets)

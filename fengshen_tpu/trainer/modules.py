@@ -96,9 +96,11 @@ class PipelinedCausalLMModule(TrainModule):
         from fengshen_tpu.ops.norms import RMSNorm
         from flax import linen as nn
 
+        from fengshen_tpu.ops.embedding import VocabParallelEmbed
+
         self.config = config
         self.layer_mod = LlamaDecoderLayer(config)
-        self.embed_mod = nn.Embed(
+        self.embed_mod = VocabParallelEmbed(
             config.vocab_size, config.hidden_size,
             embedding_init=nn.initializers.normal(
                 config.initializer_range))
@@ -162,7 +164,17 @@ class PipelinedCausalLMModule(TrainModule):
         return loss, {"n_tokens": n_tokens}
 
     def partition_rules(self):
+        # stage dim over 'pipe'; within a stage the stacked layer kernels
+        # [stage, per_stage, in, out] keep the Megatron column/row layout
+        # over fsdp/tensor (pipe composes with tp/fsdp in one SPMD program,
+        # mirroring the reference's pipe-outer/model-inner topology,
+        # reference: fengshen/strategies/megatron_deepspeed.py:347-354)
         return [
+            (r"embed/embedding", P("tensor", "fsdp")),
+            (r"layers/.*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel",
+             P("pipe", None, "fsdp", "tensor")),
+            (r"layers/.*(o_proj|down_proj)/kernel",
+             P("pipe", None, "tensor", "fsdp")),
             (r"layers/.*", P("pipe")),
             (r".*", P(None)),
         ]

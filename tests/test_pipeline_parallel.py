@@ -153,3 +153,63 @@ def test_trainer_fit_pipelined_llama_4stage(tmp_path):
     losses = [l["loss"] for l in lines if "loss" in l]
     assert len(losses) == 2 and all(np.isfinite(losses))
     set_mesh(None)
+
+
+def test_trainer_fit_pipeline_composes_with_fsdp_tp(tmp_path):
+    """pipe=2 composed with fsdp=2 and tensor=2 in ONE SPMD program
+    (VERDICT r2 item 8): the pipeline shard_map is manual only over
+    'pipe', so GSPMD still shards the within-stage math, and the stacked
+    stage kernels carry pipe+fsdp+tensor shardings simultaneously."""
+    import argparse
+    import json
+    import numpy as np
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.llama import LlamaConfig
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.parallel import set_mesh
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.trainer.modules import PipelinedCausalLMModule
+
+    parser = argparse.ArgumentParser()
+    add_module_args(parser)
+    add_trainer_args(parser)
+    UniversalDataModule.add_data_specific_args(parser)
+    args = parser.parse_args([
+        "--max_steps", "1", "--train_batchsize", "4",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path),
+        "--pipe_model_parallel_size", "2",
+        "--fsdp_parallel_size", "2",
+        "--tensor_model_parallel_size", "2",
+        "--data_parallel_size", "1"])
+
+    config = LlamaConfig(vocab_size=128, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=4,
+                         max_position_embeddings=32, dtype="float32")
+    rng = np.random.RandomState(0)
+    rows = [{"input_ids": rng.randint(0, 127, 16).tolist()}
+            for _ in range(8)]
+
+    class ListDS:
+        def __len__(self):
+            return len(rows)
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    trainer = Trainer(args)
+    module = PipelinedCausalLMModule(args, config)
+    dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 1
+    qk = state.params["layers"]["self_attn"]["q_proj"]["kernel"]
+    spec = str(qk.sharding.spec)
+    assert "pipe" in spec and "tensor" in spec and "fsdp" in spec, spec
+    emb = state.params["embed"]["embedding"]
+    assert "tensor" in str(emb.sharding.spec)
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert losses and all(np.isfinite(losses))
+    set_mesh(None)

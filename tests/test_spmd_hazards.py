@@ -1,0 +1,83 @@
+"""Regression guard for SPMD compilation hazards (VERDICT r2 item 1).
+
+Round 2's 8-device dryrun log carried two XLA warnings — "Involuntary full
+rematerialization ... SPMD will replicate the tensor" — on the vocab-sharded
+embedding gather: a plain `take` on a P("tensor","fsdp") table forces XLA to
+all-gather the full table every step on a real pod. The fix is the
+vocab-parallel lookup (masked local take + psum over the vocab shards,
+mirroring the reference's VocabParallelEmbedding,
+reference: fengshen/models/megatron/mpu/layers.py:55-130).
+
+This test compiles the SAME fsdp+tensor-sharded train step the driver's
+dryrun runs and fails if any "Involuntary full rematerialization" warning
+comes back — XLA prints it from the C++ SPMD partitioner, so we capture at
+the file-descriptor level (pytest's capfd).
+"""
+
+import argparse
+import json
+
+import numpy as np
+import pytest
+
+
+def _fit_sharded_llama(tmp_path, capfd):
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.parallel import set_mesh
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    parser = argparse.ArgumentParser()
+    add_module_args(parser)
+    add_trainer_args(parser)
+    UniversalDataModule.add_data_specific_args(parser)
+    args = parser.parse_args([
+        "--max_steps", "1", "--train_batchsize", "4",
+        "--data_parallel_size", "1", "--fsdp_parallel_size", "2",
+        "--sequence_parallel_size", "2",
+        "--tensor_model_parallel_size", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path)])
+
+    config = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, dtype="float32",
+        attention_impl="ring")
+    model = LlamaForCausalLM(config)
+    rng = np.random.RandomState(0)
+    rows = [{"input_ids": rng.randint(0, 511, 32).tolist()}
+            for _ in range(8)]
+
+    class ListDS:
+        def __len__(self):
+            return len(rows)
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    capfd.readouterr()  # drop anything buffered before compilation
+    trainer = Trainer(args)
+    module = CausalLMModule(args, model, config)
+    dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+    state = trainer.fit(module, dm)
+    set_mesh(None)
+    captured = capfd.readouterr()
+    return state, captured.err + captured.out
+
+
+@pytest.mark.slow
+def test_sharded_train_step_has_no_involuntary_rematerialization(
+        tmp_path, capfd):
+    state, log = _fit_sharded_llama(tmp_path, capfd)
+    assert int(state.step) == 1
+    assert "Involuntary full rematerialization" not in log, (
+        "the compiled fsdp+tp train step reintroduced an SPMD "
+        "full-rematerialization (likely the embedding lookup):\n" +
+        "\n".join(l for l in log.splitlines()
+                  if "rematerialization" in l.lower()))
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert losses and all(np.isfinite(losses))
