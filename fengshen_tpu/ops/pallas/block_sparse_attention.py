@@ -62,7 +62,9 @@ def _bs_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         denom = jnp.maximum(sum_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = max_ref[:, 0] + jnp.log(denom)
+        # lse block is [1, 1, blk]: the singleton sublane dim satisfies
+        # Mosaic's (8, 128) tiling rule (sublane == full array dim)
+        lse_ref[0, 0] = max_ref[:, 0] + jnp.log(denom)
 
 
 def _bs_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -83,8 +85,8 @@ def _bs_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -123,8 +125,8 @@ def _bs_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -173,7 +175,8 @@ def _bs_fwd_impl(q, k, v, layout_arr, block_size, interpret):
         out_specs=[
             pl.BlockSpec((1, block_size, head_dim),
                          lambda b, i, j, layout: (b, i, 0)),
-            pl.BlockSpec((1, block_size), lambda b, i, j, layout: (b, i)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda b, i, j, layout: (b, 0, i)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_size, head_dim), jnp.float32),
@@ -185,7 +188,7 @@ def _bs_fwd_impl(q, k, v, layout_arr, block_size, interpret):
         kernel, grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(qb.shape, q.dtype),
-            jax.ShapeDtypeStruct((qb.shape[0], q_len), jnp.float32),
+            jax.ShapeDtypeStruct((qb.shape[0], 1, q_len), jnp.float32),
         ],
         interpret=interpret,
     )(layout_arr, qb, kb, vb)
@@ -213,7 +216,9 @@ def _block_sparse_vjp_bwd(block_size, interpret, res, g):
     scale = float(1.0 / (head_dim ** 0.5))
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     do = _to_bh(g)
-    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    # [BH, 1, S] to match the lse layout (singleton sublane dim for Mosaic)
+    delta = (do.astype(jnp.float32) *
+             out.astype(jnp.float32)).sum(-1)[:, None, :]
 
     dkv_kernel = functools.partial(_bs_bwd_dkv_kernel, scale=scale,
                                    n_qblocks=n_q)
@@ -229,8 +234,10 @@ def _block_sparse_vjp_bwd(block_size, interpret, res, g):
                          lambda b, i, j, layout: (b, i, 0)),  # v outer
             pl.BlockSpec((1, block_size, head_dim),
                          lambda b, i, j, layout: (b, j, 0)),  # do inner
-            pl.BlockSpec((1, block_size), lambda b, i, j, layout: (b, j)),
-            pl.BlockSpec((1, block_size), lambda b, i, j, layout: (b, j)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda b, i, j, layout: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda b, i, j, layout: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_size, head_dim),
@@ -266,8 +273,10 @@ def _block_sparse_vjp_bwd(block_size, interpret, res, g):
                          lambda b, i, j, layout: (b, j, 0)),
             pl.BlockSpec((1, block_size, head_dim),
                          lambda b, i, j, layout: (b, i, 0)),
-            pl.BlockSpec((1, block_size), lambda b, i, j, layout: (b, i)),
-            pl.BlockSpec((1, block_size), lambda b, i, j, layout: (b, i)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda b, i, j, layout: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_size),
+                         lambda b, i, j, layout: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_size, head_dim),
                                lambda b, i, j, layout: (b, i, 0)),

@@ -401,7 +401,11 @@ def pallas_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            causal: bool = False,
                            blk_q: int = 256, blk_k: int = 256,
                            interpret: bool = False) -> jax.Array:
-    """q: [B, Sq, H, D], k/v: [B, Sk, H, D] → [B, Sq, H, D].
+    """q: [B, Sq, H, D], k/v: [B, Sk, KVH, D] → [B, Sq, H, D].
+
+    GQA: KVH may be smaller than H as long as H % KVH == 0 — each group of
+    H // KVH query heads reads the same k/v head inside the kernel (no HBM
+    repeat); the backward computes per-query-head dk/dv and group-sums.
 
     segment ids: int32 [B, S]; tokens attend only within equal ids (pads are
     segment 0 when derived from an attention_mask). Requires Sq % blk_q == 0,

@@ -301,3 +301,40 @@ def test_gelu_exact_vs_tanh():
     np.testing.assert_allclose(np.asarray(exact), ref, atol=1e-6)
     approx = get_activation("gelu_new")(x)
     assert float(jnp.abs(exact - approx).max()) > 1e-5
+
+
+def test_pallas_flash_gqa_interpret_matches_dense():
+    """Kernel-native GQA (KVH < H): fwd + fused bwd vs dense with k/v
+    repeated on the host (ADVICE r2: the GQA BlockSpec index maps h//rep
+    and the backward group-sum had no interpret-mode coverage)."""
+    from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+    rng = np.random.RandomState(1)
+    H, KVH = 8, 2
+    q = jnp.asarray(rng.randn(2, 16, H, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, KVH, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, KVH, 8), jnp.float32)
+    rep = H // KVH
+    k_full = jnp.repeat(k, rep, axis=2)
+    v_full = jnp.repeat(v, rep, axis=2)
+
+    out = pallas_flash_attention(q, k, v, None, None, True, 8, 8, True)
+    mask = causal_mask(16)[None, None]
+    ref = _ref_attention(q, k_full, v_full, make_attention_bias(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def f_gqa(q, k, v):
+        return (pallas_flash_attention(
+            q, k, v, None, None, True, 8, 8, True) ** 2).sum()
+
+    def f_ref(q, k_full, v_full):
+        out = _ref_attention(q, k_full, v_full, make_attention_bias(mask))
+        return (out ** 2).sum()
+
+    gq, gk, gv = jax.grad(f_gqa, argnums=(0, 1, 2))(q, k, v)
+    rq, rkf, rvf = jax.grad(f_ref, argnums=(0, 1, 2))(q, k_full, v_full)
+    # dense grads for repeated k/v heads group-sum back onto the shared head
+    rk = rkf.reshape(2, 16, KVH, rep, 8).sum(axis=3)
+    rv = rvf.reshape(2, 16, KVH, rep, 8).sum(axis=3)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=1e-3)

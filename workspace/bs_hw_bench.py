@@ -1,0 +1,70 @@
+"""Block-sparse vs dense-flash timing on the real chip (VERDICT r2 #4).
+
+Longformer and BigBird block layouts at seq 4096/8192, bf16, fwd+bwd.
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.ops.masks import (bigbird_block_layout,
+                                    longformer_block_layout)
+from fengshen_tpu.ops.pallas.block_sparse_attention import (
+    block_sparse_attention)
+from fengshen_tpu.ops.pallas.flash_attention import pallas_flash_attention
+
+print("backend:", jax.default_backend())
+BLK = 128
+
+
+def bench(fn, *args, iters=20):
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+for S in (4096, 8192):
+    B, H, D = 1, 8, 128
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+
+    lf = np.asarray(longformer_block_layout(S, BLK, num_window_blocks=3,
+                                            num_global_blocks=1))
+    bb = np.asarray(bigbird_block_layout(S, BLK, num_random_blocks=3,
+                                         num_window_blocks=3,
+                                         num_global_blocks=1))
+
+    def run_sparse(layout):
+        f = jax.jit(lambda q, k, v: block_sparse_attention(q, k, v, layout,
+                                                           BLK))
+        g = jax.jit(jax.grad(lambda q, k, v: (
+            block_sparse_attention(q, k, v, layout, BLK)
+            .astype(jnp.float32) ** 2).sum(), argnums=(0, 1, 2)))
+        return bench(f, q, k, v), bench(g, q, k, v)
+
+    def run_dense():
+        f = jax.jit(lambda q, k, v: pallas_flash_attention(q, k, v,
+                                                           causal=False))
+        g = jax.jit(jax.grad(lambda q, k, v: (
+            pallas_flash_attention(q, k, v, causal=False)
+            .astype(jnp.float32) ** 2).sum(), argnums=(0, 1, 2)))
+        return bench(f, q, k, v), bench(g, q, k, v)
+
+    d_f, d_g = run_dense()
+    for name, lay in (("longformer", lf), ("bigbird", bb)):
+        s_f, s_g = run_sparse(lay)
+        frac = lay.sum() / lay.size
+        print(f"S={S} {name}: present={frac:.2%} "
+              f"fwd {s_f*1e3:.2f}ms (dense {d_f*1e3:.2f}ms, "
+              f"{d_f/s_f:.2f}x) | grad {s_g*1e3:.2f}ms "
+              f"(dense {d_g*1e3:.2f}ms, {d_g/s_g:.2f}x)")
+print("DONE")

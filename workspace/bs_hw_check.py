@@ -1,0 +1,56 @@
+"""Hardware-compile check for the block-sparse Pallas kernel (VERDICT r2 #4).
+
+Runs fwd + bwd non-interpret on the real chip, compares vs dense reference
+with the same block mask. Small shapes first.
+"""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.ops.pallas.block_sparse_attention import (
+    block_sparse_attention)
+
+print("backend:", jax.default_backend())
+
+B, S, H, D = 1, 512, 4, 128
+BLK = 128
+nb = S // BLK
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32) * 0.5
+k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32) * 0.5
+v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32) * 0.5
+
+# causal-ish block layout with a hole
+layout = np.tril(np.ones((nb, nb), bool))
+layout[3, 1] = False
+
+def dense_ref(q, k, v):
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (D ** 0.5)
+    mask = np.repeat(np.repeat(layout, BLK, 0), BLK, 1)
+    scores = jnp.where(jnp.asarray(mask)[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+def loss_sparse(q, k, v):
+    return (block_sparse_attention(q, k, v, layout, BLK) ** 2).sum()
+
+def loss_dense(q, k, v):
+    return (dense_ref(q, k, v) ** 2).sum()
+
+out_s = jax.jit(lambda q, k, v: block_sparse_attention(q, k, v, layout, BLK))(q, k, v)
+jax.block_until_ready(out_s)
+print("fwd compiled OK")
+out_d = dense_ref(q, k, v)
+print("fwd max abs diff:", float(jnp.abs(out_s - out_d).max()))
+
+gs = jax.jit(jax.grad(loss_sparse, argnums=(0, 1, 2)))(q, k, v)
+jax.block_until_ready(gs)
+print("bwd compiled OK")
+gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+for name, a, b in zip("qkv", gs, gd):
+    print(f"d{name} max abs diff:", float(jnp.abs(a - b).max()))
+print("DONE")
