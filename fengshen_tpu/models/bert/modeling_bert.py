@@ -173,7 +173,7 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic=True):
+                 deterministic=True, return_hidden=False):
         cfg = self.config
         hidden, _ = BertModel(cfg, add_pooling_layer=False, name="bert")(
             input_ids, attention_mask, token_type_ids,
@@ -186,7 +186,8 @@ class BertForMaskedLM(nn.Module):
         logits = h @ wte.T.astype(h.dtype)
         bias = self.param("bias", nn.initializers.zeros,
                           (cfg.vocab_size,), jnp.dtype(cfg.param_dtype))
-        return logits + bias
+        logits = logits + bias
+        return (logits, hidden) if return_hidden else logits
 
     def partition_rules(self):
         return PARTITION_RULES

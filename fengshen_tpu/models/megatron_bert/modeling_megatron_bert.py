@@ -232,14 +232,16 @@ class MegatronBertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 position_ids=None, deterministic=True):
+                 position_ids=None, deterministic=True,
+                 return_hidden=False):
         hidden, _ = MegatronBertModel(self.config, add_pooling_layer=False,
                                       name="bert")(
             input_ids, attention_mask, token_type_ids, position_ids,
             deterministic)
         wte = self.variables["params"]["bert"]["word_embeddings"][
             "embedding"]
-        return MLMHead(self.config, name="cls_predictions")(hidden, wte)
+        logits = MLMHead(self.config, name="cls_predictions")(hidden, wte)
+        return (logits, hidden) if return_hidden else logits
 
     def partition_rules(self):
         return SCAN_PARTITION_RULES if self.config.scan_layers \

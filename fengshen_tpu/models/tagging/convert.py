@@ -1,0 +1,61 @@
+"""Reference tagging checkpoints → flax params.
+
+One converter for the four heads of
+fengshen/models/tagging_models/bert_for_tagging.py (all over a plain HF
+BertModel tower `bert.*`):
+
+- BertLinear: `classifier`
+- BertCrf:    `classifier` + `crf.{start_transitions,end_transitions,
+              transitions}` (layers/crf.py:32-36)
+- BertSpan:   `start_fc.dense` + `end_fc.{dense_0,LayerNorm,dense_1}`
+              (layers/linears.py:18-40)
+- BertBiaffine: 2-layer bi-LSTM `lstm.*` + `start_layer.0`/`end_layer.0`
+              + `biaffne_layer.U` [d+1, L, d+1] (sic — the reference
+              misspells "biaffine" in the attr name)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                               encoder_tower_params,
+                                               lstm_cell_params,
+                                               make_helpers, tensor,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config,
+                    head: str = "linear",
+                    backbone_type: str | None = None) -> dict:
+    """`head` ∈ {linear, crf, span, biaffine} matching the four flax
+    heads in modeling_tagging.py."""
+    sd = unwrap_lightning(state_dict)
+    if backbone_type is None:
+        backbone_type = detect_bert_arch(sd)
+    t, lin, ln = make_helpers(sd)
+    params: dict = {"bert": encoder_tower_params(sd, config, backbone_type)}
+
+    if head in ("linear", "crf"):
+        params["classifier"] = lin("classifier")
+    if head == "crf":
+        params["crf"] = {
+            "start_transitions": t("crf.start_transitions"),
+            "end_transitions": t("crf.end_transitions"),
+            "transitions": t("crf.transitions"),
+        }
+    if head == "span":
+        params["start_classifier"] = lin("start_fc.dense")
+        params["end_dense_0"] = lin("end_fc.dense_0")
+        params["end_ln"] = ln("end_fc.LayerNorm")
+        params["end_dense_1"] = lin("end_fc.dense_1")
+    if head == "biaffine":
+        params["start_mlp"] = lin("start_layer.0")
+        params["end_mlp"] = lin("end_layer.0")
+        params["biaffine_u"] = tensor(sd, "biaffne_layer.U")
+        for li in range(2):
+            params[f"lstm_l{li}_fwd"] = lstm_cell_params(
+                sd, "lstm", li, reverse=False)
+            params[f"lstm_l{li}_bwd"] = lstm_cell_params(
+                sd, "lstm", li, reverse=True)
+    return params

@@ -11,8 +11,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
-                                               MegatronBertModel)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
 from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
     PARTITION_RULES, SCAN_PARTITION_RULES, _dense)
 from fengshen_tpu.models.tagging.crf import CRF
@@ -20,8 +19,13 @@ from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
 
 
 class _TaggingBase(nn.Module):
+    """`backbone_type="bert"` matches the published checkpoints (the
+    reference heads wrap a plain HF BertModel,
+    reference: fengshen/models/tagging_models/bert_for_tagging.py:25)."""
+
     config: MegatronBertConfig
     num_labels: int = 9
+    backbone_type: str = "megatron_bert"
 
     def partition_rules(self):
         return SCAN_PARTITION_RULES if self.config.scan_layers \
@@ -29,8 +33,8 @@ class _TaggingBase(nn.Module):
 
     def _encode(self, input_ids, attention_mask, token_type_ids,
                 deterministic):
-        hidden, _ = MegatronBertModel(self.config, add_pooling_layer=False,
-                                      name="bert")(
+        from fengshen_tpu.models.towers import encoder_tower
+        hidden, _ = encoder_tower(self.config, self.backbone_type)(
             input_ids, attention_mask, token_type_ids,
             deterministic=deterministic)
         return nn.Dropout(self.config.hidden_dropout_prob)(
@@ -71,15 +75,40 @@ class BertCrf(_TaggingBase):
 
 
 class BertSpan(_TaggingBase):
+    """Start/end pointer head. The end pointer conditions on the start
+    labels — one-hot (soft_label) or the raw label id as one float
+    feature (hard label) during training, softmax/argmax of the start
+    logits at inference — through dense_0 → tanh → LayerNorm → dense_1
+    (reference: fengshen/models/tagging_models/layers/linears.py:27-40
+    PoolerEndLogits; bert_for_tagging.py:140-155 soft/hard wiring)."""
+
+    soft_label: bool = True
+
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  start_labels=None, end_labels=None, deterministic=True):
+        from fengshen_tpu.ops.norms import LayerNorm
         hidden = self._encode(input_ids, attention_mask, token_type_ids,
                               deterministic)
         start_logits = _dense(self.config, self.num_labels,
                               "start_classifier")(hidden)
+        training = start_labels is not None and not deterministic
+        if self.soft_label:
+            label_feat = (
+                jax.nn.one_hot(start_labels, self.num_labels,
+                               dtype=hidden.dtype) if training
+                else jax.nn.softmax(start_logits, -1).astype(hidden.dtype))
+        else:
+            label_feat = (
+                start_labels if training
+                else jnp.argmax(start_logits, -1)
+            ).astype(hidden.dtype)[..., None]
+        x = jnp.concatenate([hidden, label_feat], axis=-1)
+        x = jnp.tanh(_dense(self.config, x.shape[-1], "end_dense_0")(x))
+        x = LayerNorm(epsilon=self.config.layer_norm_eps,
+                      name="end_ln")(x)
         end_logits = _dense(self.config, self.num_labels,
-                            "end_classifier")(hidden)
+                            "end_dense_1")(x)
         if start_labels is None:
             return start_logits, end_logits
         s_loss, _ = stable_cross_entropy(start_logits, start_labels)
@@ -88,11 +117,13 @@ class BertSpan(_TaggingBase):
 
 
 class BertBiaffine(_TaggingBase):
-    """Span scorer: per-span label logits via a biaffine form
-    (reference: tagging_models BertBiaffine; also the Triaffine pattern of
-    UniEX, reference: fengshen/models/uniex/)."""
+    """Span scorer: bi-LSTM context mixer + per-span label logits via a
+    biaffine form (reference: tagging_models BertBiaffine,
+    bert_for_tagging.py:77-96 — 2-layer bidirectional LSTM over the
+    encoder output, ReLU start/end projections, [d+1, L, d+1] U)."""
 
     biaffine_size: int = 128
+    use_lstm: bool = True
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -100,9 +131,21 @@ class BertBiaffine(_TaggingBase):
         cfg = self.config
         hidden = self._encode(input_ids, attention_mask, token_type_ids,
                               deterministic)
-        start = jax.nn.gelu(_dense(cfg, self.biaffine_size, "start_mlp")(
+        if self.use_lstm:
+            half = cfg.hidden_size // 2
+            for li in range(2):
+                fwd = nn.RNN(nn.OptimizedLSTMCell(
+                    half, name=f"lstm_l{li}_fwd"))
+                bwd = nn.RNN(nn.OptimizedLSTMCell(
+                    half, name=f"lstm_l{li}_bwd"), reverse=True,
+                    keep_order=True)
+                hidden = jnp.concatenate([fwd(hidden), bwd(hidden)],
+                                         axis=-1)
+            hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+                hidden, deterministic=deterministic)
+        start = jax.nn.relu(_dense(cfg, self.biaffine_size, "start_mlp")(
             hidden))
-        end = jax.nn.gelu(_dense(cfg, self.biaffine_size, "end_mlp")(
+        end = jax.nn.relu(_dense(cfg, self.biaffine_size, "end_mlp")(
             hidden))
         U = self.param("biaffine_u", nn.initializers.normal(0.02),
                        (self.biaffine_size + 1, self.num_labels,

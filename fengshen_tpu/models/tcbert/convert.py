@@ -1,0 +1,36 @@
+"""Reference TCBert checkpoint → flax params.
+
+Reference state-dict naming (fengshen/models/tcbert/modeling_tcbert.py:
+203-233): `bert.*` wraps a full *ForMaskedLM (so the inner keys are
+`bert.bert.*` + `bert.cls.*`), plus `linear_classifier` over the [CLS]
+hidden state. Tower dispatch mirrors the reference's "1.3B → MegatronBert
+else Bert" rule via key detection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                               make_helpers, strip_prefix,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config,
+                    backbone_type: str | None = None) -> dict:
+    sd = unwrap_lightning(state_dict)
+    _, lin, _ = make_helpers(sd)
+    params: dict = {}
+    if "linear_classifier.weight" in sd:
+        params["classifier"] = lin("linear_classifier")
+    inner = strip_prefix(sd, "bert.")
+    if backbone_type is None:
+        backbone_type = detect_bert_arch(inner)
+    if backbone_type == "bert":
+        from fengshen_tpu.models.bert.convert import torch_to_params as conv
+        params["backbone"] = conv(inner, config)
+    else:
+        from fengshen_tpu.models.megatron_bert.convert import \
+            torch_to_params as conv
+        params["backbone"] = conv(inner, config, head="masked_lm")
+    return params

@@ -15,21 +15,41 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
-                                               MegatronBertForMaskedLM)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
 
 
 class TCBertModel(nn.Module):
-    """MLM backbone scoring label words at mask positions."""
+    """MLM backbone scoring label words at mask positions.
+
+    `backbone_type` mirrors the reference's tower dispatch (reference:
+    fengshen/models/tcbert/modeling_tcbert.py:203-212 — MegatronBert for
+    the 1.3B checkpoints, plain Bert otherwise)."""
 
     config: MegatronBertConfig
+    backbone_type: str = "megatron_bert"
+    num_labels: int = 0  # >0 adds the reference's [CLS] linear classifier
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  deterministic=True):
-        return MegatronBertForMaskedLM(self.config, name="backbone")(
+        from fengshen_tpu.models.towers import mlm_tower
+        backbone = mlm_tower(self.config, self.backbone_type)
+        if self.num_labels <= 0:
+            return backbone(input_ids, attention_mask, token_type_ids,
+                            deterministic=deterministic)
+        # reference: modeling_tcbert.py:214-231 — a linear classifier over
+        # the dropped-out [CLS] hidden state, returned next to the MLM
+        # label-word logits
+        mlm_logits, hidden = backbone(
             input_ids, attention_mask, token_type_ids,
-            deterministic=deterministic)
+            deterministic=deterministic, return_hidden=True)
+        cls_h = nn.Dropout(0.1)(hidden[:, 0], deterministic=deterministic)
+        cls_logits = nn.Dense(
+            self.num_labels,
+            kernel_init=nn.initializers.normal(
+                self.config.initializer_range),
+            name="classifier")(cls_h)
+        return mlm_logits, cls_logits
 
     def partition_rules(self):
         from fengshen_tpu.models.megatron_bert.modeling_megatron_bert \
@@ -56,7 +76,8 @@ class TCBertPipelines:
 
     def __init__(self, args=None, model: Optional[str] = None,
                  tokenizer=None, config=None, params=None,
-                 label_words: Optional[list[str]] = None):
+                 label_words: Optional[list[str]] = None,
+                 backbone_type: str = "megatron_bert"):
         self.args = args
         if config is None and model is not None:
             config = MegatronBertConfig.from_pretrained(model)
@@ -67,7 +88,7 @@ class TCBertPipelines:
             from transformers import AutoTokenizer
             tokenizer = AutoTokenizer.from_pretrained(model)
         self.tokenizer = tokenizer
-        self.model = TCBertModel(config)
+        self.model = TCBertModel(config, backbone_type=backbone_type)
         self.params = params
         self.label_words = label_words or []
 

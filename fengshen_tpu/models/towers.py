@@ -1,0 +1,39 @@
+"""Encoder-tower dispatch for the task-head families.
+
+The reference task heads are built over either a plain HF BertModel
+(ubert, uniex, tagging: e.g. fengshen/models/ubert/modeling_ubert.py
+`self.bert = BertModel(config)`) or MegatronBert (unimc/tcbert 1.3B:
+fengshen/models/unimc/modeling_unimc.py:297-308). The flax heads take a
+`backbone_type` field so published checkpoints of either architecture
+import faithfully.
+"""
+
+from __future__ import annotations
+
+
+def encoder_tower(config, backbone_type: str, name: str = "bert",
+                  add_pooling_layer: bool = False):
+    """Instantiate the encoder module (returns (hidden, pooled))."""
+    if backbone_type == "bert":
+        from fengshen_tpu.models.bert.modeling_bert import BertModel
+        return BertModel(config, add_pooling_layer=add_pooling_layer,
+                         name=name)
+    from fengshen_tpu.models.megatron_bert import MegatronBertModel
+    return MegatronBertModel(config, add_pooling_layer=add_pooling_layer,
+                             name=name)
+
+
+def mlm_tower(config, backbone_type: str, name: str = "backbone"):
+    """Instantiate the MaskedLM module (returns vocab logits)."""
+    if backbone_type == "bert":
+        from fengshen_tpu.models.bert.modeling_bert import BertForMaskedLM
+        return BertForMaskedLM(config, name=name)
+    from fengshen_tpu.models.megatron_bert import MegatronBertForMaskedLM
+    return MegatronBertForMaskedLM(config, name=name)
+
+
+def gelu_exact(x):
+    """erf-form GELU — the reference heads use torch.nn.GELU(), not the
+    tanh approximation jax.nn.gelu defaults to."""
+    import jax
+    return jax.nn.gelu(x, approximate=False)

@@ -1,0 +1,34 @@
+"""Reference UBERT checkpoint → flax params.
+
+Reference state-dict naming (fengshen/models/ubert/modeling_ubert.py:
+257-267): `bert.*` (plain HF BertModel tower), `query_layer.0` /
+`key_layer.0` (Linear+GELU projections feeding the biaffine), and
+`biaffine_query_key_cls.U` of shape [d+1, 1, d+1] (out_size=1). Our
+`UbertModel` stores the same form as a 2-D `biaffine_u` (the singleton
+out axis squeezed); query→start, key→end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                               encoder_tower_params,
+                                               make_helpers, tensor,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config,
+                    backbone_type: str | None = None) -> dict:
+    sd = unwrap_lightning(state_dict)
+    if backbone_type is None:
+        backbone_type = detect_bert_arch(sd)
+    _, lin, _ = make_helpers(sd)
+    u = tensor(sd, "biaffine_query_key_cls.U")
+    assert u.shape[1] == 1, f"ubert biaffine out_size != 1: {u.shape}"
+    return {
+        "bert": encoder_tower_params(sd, config, backbone_type),
+        "start_mlp": lin("query_layer.0"),
+        "end_mlp": lin("key_layer.0"),
+        "biaffine_u": u[:, 0, :],
+    }

@@ -15,29 +15,34 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
-                                               MegatronBertModel)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+from fengshen_tpu.models.towers import gelu_exact
 from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
     PARTITION_RULES, _dense)
 
 
 class UbertModel(nn.Module):
-    """Encoder + span biaffine with sigmoid scores."""
+    """Encoder + span biaffine with sigmoid scores.
+
+    `backbone_type="bert"` matches the published Erlangshen-Ubert
+    checkpoints (reference: fengshen/models/ubert/modeling_ubert.py:259
+    `self.bert = BertModel(config)`)."""
 
     config: MegatronBertConfig
     biaffine_size: int = 128
+    backbone_type: str = "megatron_bert"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  span_labels=None, span_mask=None, deterministic=True):
+        from fengshen_tpu.models.towers import encoder_tower
         cfg = self.config
-        hidden, _ = MegatronBertModel(cfg, add_pooling_layer=False,
-                                      name="bert")(
+        hidden, _ = encoder_tower(cfg, self.backbone_type)(
             input_ids, attention_mask, token_type_ids,
             deterministic=deterministic)
-        start = jax.nn.gelu(_dense(cfg, self.biaffine_size,
+        start = gelu_exact(_dense(cfg, self.biaffine_size,
                                    "start_mlp")(hidden))
-        end = jax.nn.gelu(_dense(cfg, self.biaffine_size,
+        end = gelu_exact(_dense(cfg, self.biaffine_size,
                                  "end_mlp")(hidden))
         U = self.param("biaffine_u", nn.initializers.normal(0.02),
                        (self.biaffine_size + 1, self.biaffine_size + 1),
@@ -86,7 +91,8 @@ class UbertPipelines:
         return parent_parser
 
     def __init__(self, args=None, model: Optional[str] = None,
-                 tokenizer=None, config=None, params=None):
+                 tokenizer=None, config=None, params=None,
+                 backbone_type: str = "megatron_bert"):
         self.args = args
         if config is None and model is not None:
             config = MegatronBertConfig.from_pretrained(model)
@@ -97,7 +103,7 @@ class UbertPipelines:
             from transformers import AutoTokenizer
             tokenizer = AutoTokenizer.from_pretrained(model)
         self.tokenizer = tokenizer
-        self.model = UbertModel(config)
+        self.model = UbertModel(config, backbone_type=backbone_type)
         self.params = params
 
     def _encode(self, sample: dict, entity_type: str) -> dict:

@@ -1,0 +1,41 @@
+"""Reference UniEX checkpoint → flax params.
+
+Reference state-dict naming (fengshen/models/uniex/modeling_uniex.py:
+885-900): `bert.*` (plain HF BertModel tower), `mlp_start.mlp.0` /
+`mlp_end.mlp.0` / `mlp_cls.mlp.0` (Linear+GELU projections), and
+`triaffine.weight` of shape [T, T, T] scoring
+start_i · W[i,o,j] · end_j · type_o. Our `UniEXBertModel` uses the same
+trilinear form with bias-augmented start/end features, so the reference
+weight fills `triaffine_u[:T, :, :T]` (axes (start, type, end)) and the
+bias rows stay zero.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                               encoder_tower_params,
+                                               make_helpers, tensor,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config,
+                    backbone_type: str | None = None) -> dict:
+    sd = unwrap_lightning(state_dict)
+    if backbone_type is None:
+        backbone_type = detect_bert_arch(sd)
+    _, lin, _ = make_helpers(sd)
+    w = tensor(sd, "triaffine.weight")  # [T, T, T] = (start, type, end)
+    d = w.shape[0]
+    u = np.zeros((d + 1, d, d + 1), w.dtype)
+    u[:d, :, :d] = w
+    return {
+        "bert": encoder_tower_params(sd, config, backbone_type),
+        "start_mlp": lin("mlp_start.mlp.0"),
+        "end_mlp": lin("mlp_end.mlp.0"),
+        "type_mlp": lin("mlp_cls.mlp.0"),
+        "triaffine_u": u,
+    }

@@ -16,8 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
-                                               MegatronBertModel)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+from fengshen_tpu.models.towers import gelu_exact
 from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
     PARTITION_RULES, _dense)
 
@@ -31,24 +31,25 @@ class UniEXBertModel(nn.Module):
 
     config: MegatronBertConfig
     biaffine_size: int = 128
+    backbone_type: str = "megatron_bert"
 
     @nn.compact
     def __call__(self, input_ids, type_positions, attention_mask=None,
                  token_type_ids=None, span_labels=None, span_mask=None,
                  deterministic=True):
+        from fengshen_tpu.models.towers import encoder_tower
         cfg = self.config
-        hidden, _ = MegatronBertModel(cfg, add_pooling_layer=False,
-                                      name="bert")(
+        hidden, _ = encoder_tower(cfg, self.backbone_type)(
             input_ids, attention_mask, token_type_ids,
             deterministic=deterministic)
         d = self.biaffine_size
-        start = jax.nn.gelu(_dense(cfg, d, "start_mlp")(hidden))
-        end = jax.nn.gelu(_dense(cfg, d, "end_mlp")(hidden))
+        start = gelu_exact(_dense(cfg, d, "start_mlp")(hidden))
+        end = gelu_exact(_dense(cfg, d, "end_mlp")(hidden))
         type_hidden = jnp.take_along_axis(
             hidden, jnp.broadcast_to(
                 type_positions[..., None],
                 type_positions.shape + (hidden.shape[-1],)), axis=1)
-        typ = jax.nn.gelu(_dense(cfg, d, "type_mlp")(type_hidden))
+        typ = gelu_exact(_dense(cfg, d, "type_mlp")(type_hidden))
 
         U = self.param("triaffine_u", nn.initializers.normal(0.02),
                        (d + 1, d, d + 1), jnp.float32)
@@ -97,7 +98,8 @@ class UniEXPipelines:
         return parent_parser
 
     def __init__(self, args=None, model: Optional[str] = None,
-                 tokenizer=None, config=None, params=None):
+                 tokenizer=None, config=None, params=None,
+                 backbone_type: str = "megatron_bert"):
         self.args = args
         if config is None and model is not None:
             config = MegatronBertConfig.from_pretrained(model)
@@ -108,7 +110,8 @@ class UniEXPipelines:
             from transformers import AutoTokenizer
             tokenizer = AutoTokenizer.from_pretrained(model)
         self.tokenizer = tokenizer
-        self.model = UniEXBertModel(config)
+        self.model = UniEXBertModel(config,
+                                    backbone_type=backbone_type)
         self.params = params
 
 

@@ -1,0 +1,39 @@
+"""Reference UniMC checkpoint → flax params.
+
+The reference `UniMCModel` is an MLM tower under the attr `bert`
+(reference: fengshen/models/unimc/modeling_unimc.py:297-310 — dispatching
+on model_type between MegatronBertForMaskedLM / BertForMaskedLM / Albert /
+DebertaV2) and NO extra head parameters: option scoring reads the
+yes-token logit at each option's mask position. So importing is tower
+delegation: strip the `bert.` attr prefix and run the matching backbone
+converter with its MLM head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                               strip_prefix,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config,
+                    backbone_type: str | None = None) -> dict:
+    """Returns {"backbone": <ForMaskedLM params>} matching `UniMCModel`.
+
+    Accepts a UniMCLitModel checkpoint (`model.bert.*`), a bare UniMCModel
+    state dict (`bert.*`), or a raw ForMaskedLM state dict.
+    """
+    sd = unwrap_lightning(state_dict)
+    if any(k.startswith("bert.bert.") or k.startswith("bert.cls.")
+           for k in sd):
+        sd = strip_prefix(sd, "bert.")
+    if backbone_type is None:
+        backbone_type = detect_bert_arch(sd)
+    if backbone_type == "bert":
+        from fengshen_tpu.models.bert.convert import torch_to_params as conv
+        return {"backbone": conv(sd, config)}
+    from fengshen_tpu.models.megatron_bert.convert import \
+        torch_to_params as conv
+    return {"backbone": conv(sd, config, head="masked_lm")}

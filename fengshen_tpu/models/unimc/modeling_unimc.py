@@ -18,22 +18,30 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from fengshen_tpu.models.megatron_bert import (MegatronBertConfig,
-                                               MegatronBertForMaskedLM)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig
 
 
 class UniMCModel(nn.Module):
-    """MLM backbone + option-position scoring."""
+    """MLM backbone + option-position scoring.
+
+    `backbone_type` selects the tower the checkpoint was trained with
+    (reference: fengshen/models/unimc/modeling_unimc.py:297-308 dispatches
+    on config.model_type between MegatronBert / Bert / Albert / DebertaV2;
+    the published UniMC-MegatronBERT-1.3B is megatron_bert, the RoBERTa
+    variants are bert-architecture).
+    """
 
     config: MegatronBertConfig
     yes_token_id: int = 1
+    backbone_type: str = "megatron_bert"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  option_positions=None, deterministic=True):
         """option_positions: [B, n_options] indices of each option's mask
         token. Returns per-option scores [B, n_options]."""
-        logits = MegatronBertForMaskedLM(self.config, name="backbone")(
+        from fengshen_tpu.models.towers import mlm_tower
+        logits = mlm_tower(self.config, self.backbone_type)(
             input_ids, attention_mask, token_type_ids,
             deterministic=deterministic)
         if option_positions is None:
@@ -72,7 +80,8 @@ class UniMCPipelines:
         return parent_parser
 
     def __init__(self, args=None, model: Optional[str] = None,
-                 tokenizer=None, config=None, params=None):
+                 tokenizer=None, config=None, params=None,
+                 backbone_type: str = "megatron_bert"):
         self.args = args
         if config is None and model is not None:
             config = MegatronBertConfig.from_pretrained(model)
@@ -88,7 +97,8 @@ class UniMCPipelines:
             ids = tokenizer.convert_tokens_to_ids(["是"])
             if ids and ids[0] != tokenizer.unk_token_id:
                 yes_id = ids[0]
-        self.model = UniMCModel(config, yes_token_id=yes_id)
+        self.model = UniMCModel(config, yes_token_id=yes_id,
+                                backbone_type=backbone_type)
         self.params = params
 
     def _encode(self, sample: dict) -> dict:

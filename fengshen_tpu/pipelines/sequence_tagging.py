@@ -97,7 +97,8 @@ class SequenceTaggingPipeline:
 
     def __init__(self, args=None, model: Optional[str] = None,
                  tokenizer=None, labels: Optional[list[str]] = None,
-                 config=None, params=None, **kwargs):
+                 config=None, params=None,
+                 backbone_type: str = "megatron_bert", **kwargs):
         self.args = args
         self.labels = labels or ["O"]
         self.label2id = {l: i for i, l in enumerate(self.labels)}
@@ -111,7 +112,8 @@ class SequenceTaggingPipeline:
         self.config = config
         model_cls = _model_dict[
             "bert-crf" if decode_type == "crf" else "bert-linear"]
-        self.model = model_cls(config, num_labels=len(self.labels))
+        self.model = model_cls(config, num_labels=len(self.labels),
+                               backbone_type=backbone_type)
         self.decode_type = decode_type
         if tokenizer is None and model is not None:
             from transformers import AutoTokenizer

@@ -63,3 +63,65 @@ def seq2seq_attention(state_dict: Mapping[str, Any], prefix: str) -> dict:
             "k_proj": lin(f"{prefix}.k_proj"),
             "v_proj": lin(f"{prefix}.v_proj"),
             "out_proj": lin(f"{prefix}.out_proj")}
+
+
+def strip_prefix(state_dict: Mapping[str, Any], prefix: str) -> dict:
+    """Sub-dict of keys under `prefix` with the prefix removed."""
+    return {k[len(prefix):]: v for k, v in state_dict.items()
+            if k.startswith(prefix)}
+
+
+def unwrap_lightning(state_dict: Mapping[str, Any]) -> Mapping[str, Any]:
+    """Strip the `model.` prefix Lightning's save_checkpoint adds (the
+    reference trains every task head inside a LightningModule whose model
+    attr is `self.model`, e.g. fengshen/models/unimc/modeling_unimc.py:351);
+    also unwraps a nested `state_dict` key from a raw torch.save(ckpt)."""
+    if "state_dict" in state_dict and not hasattr(
+            state_dict["state_dict"], "detach"):
+        state_dict = state_dict["state_dict"]
+    if any(k.startswith("model.") for k in state_dict):
+        return strip_prefix(state_dict, "model.")
+    return state_dict
+
+
+def detect_bert_arch(state_dict: Mapping[str, Any]) -> str:
+    """'bert' (post-LN HF naming: attention.output.LayerNorm) vs
+    'megatron_bert' (pre-LN HF naming: attention.ln / encoder.ln)."""
+    for k in state_dict:
+        if ".attention.output.LayerNorm." in k:
+            return "bert"
+        if ".attention.ln." in k or k.endswith("encoder.ln.weight"):
+            return "megatron_bert"
+    raise ValueError("cannot detect bert architecture from state dict keys")
+
+
+def encoder_tower_params(state_dict: Mapping[str, Any], config,
+                         backbone_type: str) -> dict:
+    """Map a `bert.`-prefixed tower state dict → flax tower params (the
+    sub-tree that lives under the head's name="bert" module)."""
+    if backbone_type == "bert":
+        from fengshen_tpu.models.bert.convert import torch_to_params
+        return torch_to_params(state_dict, config)["bert"]
+    from fengshen_tpu.models.megatron_bert.convert import torch_to_params
+    return torch_to_params(state_dict, config, head="none")["bert"]
+
+
+def lstm_cell_params(state_dict: Mapping[str, Any], prefix: str,
+                     layer: int, reverse: bool) -> dict:
+    """torch nn.LSTM layer → flax OptimizedLSTMCell tree. torch packs the
+    four gates as rows of weight_ih/weight_hh in (i, f, g, o) order with
+    two bias vectors; flax keeps per-gate Denses (input side bias-free,
+    hidden side carrying the sum of both torch biases)."""
+    sfx = f"l{layer}" + ("_reverse" if reverse else "")
+    w_ih = tensor(state_dict, f"{prefix}.weight_ih_{sfx}")
+    w_hh = tensor(state_dict, f"{prefix}.weight_hh_{sfx}")
+    b = (tensor(state_dict, f"{prefix}.bias_ih_{sfx}") +
+         tensor(state_dict, f"{prefix}.bias_hh_{sfx}"))
+    h = w_hh.shape[1]
+    gates = ("i", "f", "g", "o")
+    cell = {}
+    for gi, g in enumerate(gates):
+        cell[f"i{g}"] = {"kernel": w_ih[gi * h:(gi + 1) * h].T}
+        cell[f"h{g}"] = {"kernel": w_hh[gi * h:(gi + 1) * h].T,
+                         "bias": b[gi * h:(gi + 1) * h]}
+    return cell

@@ -1,0 +1,309 @@
+"""Importer tests for the task-head families (VERDICT r2 item 3).
+
+Each test builds a torch model with the REFERENCE state-dict naming —
+HF towers straight from transformers, head math re-stated inline from the
+reference definitions (fengshen/models/{unimc,ubert,uniex}/,
+fengshen/models/tagging_models/) — converts with the family's convert.py,
+and checks forward parity against the flax model.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _tiny_bert_cfg():
+    from transformers import BertConfig as HFBertConfig
+    return HFBertConfig(vocab_size=64, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64, max_position_embeddings=32,
+                        type_vocab_size=2)
+
+
+def _our_bert_cfg():
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    return MegatronBertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2, dtype="float32")
+
+
+@pytest.fixture
+def ids():
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 64, (2, 12))
+
+
+def test_unimc_convert_megatron_backbone(ids):
+    """UniMC import path for the published MegatronBERT-1.3B family:
+    `bert.` attr prefix + MegatronBertForMaskedLM inside, Lightning
+    `model.` wrapper on top (reference: modeling_unimc.py:297-310)."""
+    import jax.numpy as jnp
+    from transformers import MegatronBertConfig as HFCfg
+    from transformers import MegatronBertForMaskedLM as HFMLM
+
+    from fengshen_tpu.models.unimc.convert import torch_to_params
+    from fengshen_tpu.models.unimc.modeling_unimc import UniMCModel
+
+    hf_cfg = HFCfg(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=64,
+                   max_position_embeddings=32, type_vocab_size=2)
+    torch.manual_seed(0)
+    tm = HFMLM(hf_cfg).eval()
+    sd = {f"model.bert.{k}": v for k, v in tm.state_dict().items()}
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg)
+    model = UniMCModel(cfg, yes_token_id=3)
+    opts = np.asarray([[1, 4], [2, 6]])
+    scores = model.apply({"params": params}, jnp.asarray(ids),
+                         option_positions=jnp.asarray(opts))
+
+    with torch.no_grad():
+        logits = tm(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    ref = np.take_along_axis(logits, opts[..., None].repeat(64, -1),
+                             axis=1)[..., 3]
+    np.testing.assert_allclose(np.asarray(scores), ref, atol=2e-4)
+
+
+def test_ubert_convert_forward_parity(ids):
+    """Reference UbertModel head (modeling_ubert.py:257-300): GELU
+    query/key projections + [d+1, 1, d+1] biaffine over a plain Bert
+    tower."""
+    import jax.numpy as jnp
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.ubert.convert import torch_to_params
+    from fengshen_tpu.models.ubert.modeling_ubert import UbertModel
+
+    torch.manual_seed(1)
+    tower = HFBert(_tiny_bert_cfg()).eval()
+    d = 8
+    q = torch.nn.Linear(32, d)
+    k = torch.nn.Linear(32, d)
+    U = torch.randn(d + 1, 1, d + 1)
+    sd = {f"bert.{key}": v for key, v in tower.state_dict().items()}
+    for name, lin_mod in (("query_layer.0", q), ("key_layer.0", k)):
+        sd[f"{name}.weight"] = lin_mod.weight
+        sd[f"{name}.bias"] = lin_mod.bias
+    sd["biaffine_query_key_cls.U"] = U
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg)
+    model = UbertModel(cfg, biaffine_size=d, backbone_type="bert")
+    ours = model.apply({"params": params}, jnp.asarray(ids))
+
+    with torch.no_grad():
+        hidden = tower(torch.tensor(ids, dtype=torch.long)
+                       ).last_hidden_state
+        gelu = torch.nn.GELU()
+        x = gelu(q(hidden))
+        y = gelu(k(hidden))
+        x = torch.cat([x, torch.ones_like(x[..., :1])], -1)
+        y = torch.cat([y, torch.ones_like(y[..., :1])], -1)
+        span = torch.einsum("bxi,ioj,byj->bxyo", x, U, y)[..., 0]
+        ref = torch.sigmoid(span).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4)
+
+
+def test_uniex_convert_forward_parity(ids):
+    """Reference UniEX head (modeling_uniex.py:858-900): three GELU MLPs
+    + [T, T, T] triaffine; our bias-augmented U embeds it at [:T, :, :T]."""
+    import jax.numpy as jnp
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.uniex.convert import torch_to_params
+    from fengshen_tpu.models.uniex.modeling_uniex import UniEXBertModel
+
+    torch.manual_seed(2)
+    tower = HFBert(_tiny_bert_cfg()).eval()
+    d = 8
+    mlps = {n: torch.nn.Linear(32, d)
+            for n in ("mlp_start", "mlp_end", "mlp_cls")}
+    W = torch.randn(d, d, d)
+    sd = {f"bert.{key}": v for key, v in tower.state_dict().items()}
+    for n, m in mlps.items():
+        sd[f"{n}.mlp.0.weight"] = m.weight
+        sd[f"{n}.mlp.0.bias"] = m.bias
+    sd["triaffine.weight"] = W
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg)
+    model = UniEXBertModel(cfg, biaffine_size=d, backbone_type="bert")
+    tpos = np.asarray([[1, 3], [2, 5]])
+    ours = model.apply({"params": params}, jnp.asarray(ids),
+                       jnp.asarray(tpos))
+
+    with torch.no_grad():
+        hidden = tower(torch.tensor(ids, dtype=torch.long)
+                       ).last_hidden_state
+        gelu = torch.nn.GELU()
+        start = gelu(mlps["mlp_start"](hidden))
+        end = gelu(mlps["mlp_end"](hidden))
+        th = torch.gather(hidden, 1, torch.tensor(
+            tpos[..., None].repeat(32, -1), dtype=torch.long))
+        typ = gelu(mlps["mlp_cls"](th))
+        span = torch.einsum("bxi,ioj,byj->bxyo", start, W, end)
+        logits = torch.einsum("bxyo,bzo->bxyz", span, typ)
+        ref = torch.sigmoid(logits).permute(0, 3, 1, 2).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4)
+
+
+def test_tcbert_convert_forward_parity(ids):
+    """Reference TCBert (modeling_tcbert.py:203-233): full ForMaskedLM
+    under `bert.` + `linear_classifier` on the [CLS] hidden state."""
+    import jax.numpy as jnp
+    from transformers import BertForMaskedLM as HFMLM
+
+    from fengshen_tpu.models.tcbert.convert import torch_to_params
+    from fengshen_tpu.models.tcbert.modeling_tcbert import TCBertModel
+
+    torch.manual_seed(3)
+    tm = HFMLM(_tiny_bert_cfg()).eval()
+    clf = torch.nn.Linear(32, 5)
+    sd = {f"bert.{k}": v for k, v in tm.state_dict().items()}
+    sd["linear_classifier.weight"] = clf.weight
+    sd["linear_classifier.bias"] = clf.bias
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg)
+    model = TCBertModel(cfg, backbone_type="bert", num_labels=5)
+    mlm_ours, cls_ours = model.apply({"params": params}, jnp.asarray(ids))
+
+    with torch.no_grad():
+        out = tm(torch.tensor(ids, dtype=torch.long),
+                 output_hidden_states=True)
+        mlm_ref = out.logits.numpy()
+        cls_ref = clf(out.hidden_states[-1][:, 0]).numpy()
+    np.testing.assert_allclose(np.asarray(mlm_ours), mlm_ref, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(cls_ours), cls_ref, atol=2e-4)
+
+
+def test_tagging_linear_and_crf_convert(ids):
+    """BertLinear + BertCrf: classifier mapping and verbatim CRF
+    transition tensors (reference: layers/crf.py:32-36)."""
+    import jax.numpy as jnp
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.tagging.convert import torch_to_params
+    from fengshen_tpu.models.tagging.modeling_tagging import (BertCrf,
+                                                              BertLinear)
+
+    torch.manual_seed(4)
+    tower = HFBert(_tiny_bert_cfg()).eval()
+    L = 5
+    clf = torch.nn.Linear(32, L)
+    sd = {f"bert.{k}": v for k, v in tower.state_dict().items()}
+    sd["classifier.weight"] = clf.weight
+    sd["classifier.bias"] = clf.bias
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg, head="linear")
+    model = BertLinear(cfg, num_labels=L, backbone_type="bert")
+    ours = model.apply({"params": params}, jnp.asarray(ids))
+    with torch.no_grad():
+        hidden = tower(torch.tensor(ids, dtype=torch.long)
+                       ).last_hidden_state
+        ref = clf(hidden).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-4)
+
+    sd["crf.start_transitions"] = torch.randn(L)
+    sd["crf.end_transitions"] = torch.randn(L)
+    sd["crf.transitions"] = torch.randn(L, L)
+    params = torch_to_params(sd, cfg, head="crf")
+    crf_model = BertCrf(cfg, num_labels=L, backbone_type="bert")
+    logits = crf_model.apply({"params": params}, jnp.asarray(ids))
+    assert logits.shape == (2, 12, L)
+    np.testing.assert_allclose(np.asarray(params["crf"]["transitions"]),
+                               sd["crf.transitions"].numpy())
+
+
+def test_tagging_span_convert_forward_parity(ids):
+    """BertSpan: PoolerStartLogits/PoolerEndLogits with softmax start
+    conditioning at inference (reference: bert_for_tagging.py:140-155,
+    layers/linears.py:18-40)."""
+    import jax.numpy as jnp
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.tagging.convert import torch_to_params
+    from fengshen_tpu.models.tagging.modeling_tagging import BertSpan
+
+    torch.manual_seed(5)
+    tower = HFBert(_tiny_bert_cfg()).eval()
+    L, H = 5, 32
+    start_fc = torch.nn.Linear(H, L)
+    dense_0 = torch.nn.Linear(H + L, H + L)
+    lnorm = torch.nn.LayerNorm(H + L)
+    dense_1 = torch.nn.Linear(H + L, L)
+    sd = {f"bert.{k}": v for k, v in tower.state_dict().items()}
+    sd["start_fc.dense.weight"] = start_fc.weight
+    sd["start_fc.dense.bias"] = start_fc.bias
+    sd["end_fc.dense_0.weight"] = dense_0.weight
+    sd["end_fc.dense_0.bias"] = dense_0.bias
+    sd["end_fc.LayerNorm.weight"] = lnorm.weight
+    sd["end_fc.LayerNorm.bias"] = lnorm.bias
+    sd["end_fc.dense_1.weight"] = dense_1.weight
+    sd["end_fc.dense_1.bias"] = dense_1.bias
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg, head="span")
+    model = BertSpan(cfg, num_labels=L, backbone_type="bert")
+    s_ours, e_ours = model.apply({"params": params}, jnp.asarray(ids))
+
+    with torch.no_grad():
+        hidden = tower(torch.tensor(ids, dtype=torch.long)
+                       ).last_hidden_state
+        s_ref = start_fc(hidden)
+        soft = torch.softmax(s_ref, -1)
+        x = dense_1(lnorm(torch.tanh(dense_0(
+            torch.cat([hidden, soft], -1)))))
+    np.testing.assert_allclose(np.asarray(s_ours), s_ref.numpy(),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(e_ours), x.numpy(), atol=2e-4)
+
+
+def test_tagging_biaffine_convert_forward_parity(ids):
+    """BertBiaffine: 2-layer bi-LSTM + ReLU projections + [d+1, L, d+1]
+    biaffine (reference: bert_for_tagging.py:77-96) — exercises the torch
+    LSTM → flax OptimizedLSTMCell gate mapping."""
+    import jax.numpy as jnp
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.tagging.convert import torch_to_params
+    from fengshen_tpu.models.tagging.modeling_tagging import BertBiaffine
+
+    torch.manual_seed(6)
+    tower = HFBert(_tiny_bert_cfg()).eval()
+    L, H, d = 5, 32, 8
+    lstm = torch.nn.LSTM(H, H // 2, num_layers=2, batch_first=True,
+                         bidirectional=True).eval()
+    start_l = torch.nn.Linear(H, d)
+    end_l = torch.nn.Linear(H, d)
+    U = torch.randn(d + 1, L, d + 1)
+    sd = {f"bert.{k}": v for k, v in tower.state_dict().items()}
+    for k, v in lstm.state_dict().items():
+        sd[f"lstm.{k}"] = v
+    sd["start_layer.0.weight"] = start_l.weight
+    sd["start_layer.0.bias"] = start_l.bias
+    sd["end_layer.0.weight"] = end_l.weight
+    sd["end_layer.0.bias"] = end_l.bias
+    sd["biaffne_layer.U"] = U
+
+    cfg = _our_bert_cfg()
+    params = torch_to_params(sd, cfg, head="biaffine")
+    model = BertBiaffine(cfg, num_labels=L, biaffine_size=d,
+                         backbone_type="bert")
+    ours = model.apply({"params": params}, jnp.asarray(ids))
+
+    with torch.no_grad():
+        hidden = tower(torch.tensor(ids, dtype=torch.long)
+                       ).last_hidden_state
+        mixed = lstm(hidden)[0]
+        relu = torch.nn.ReLU()
+        s = relu(start_l(mixed))
+        e = relu(end_l(mixed))
+        s = torch.cat([s, torch.ones_like(s[..., :1])], -1)
+        e = torch.cat([e, torch.ones_like(e[..., :1])], -1)
+        ref = torch.einsum("bxi,ioj,byj->bxyo", s, U, e).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=5e-4)
