@@ -32,6 +32,7 @@ class ZenSequenceCollator:
     ngram_dict: ZenNgramDict
     max_seq_length: int = 128
     label2id: Optional[dict] = None
+    freq_weighted: bool = False  # True for zen2
 
     def __call__(self, samples: list[dict]) -> dict:
         tok = self.tokenizer
@@ -45,10 +46,20 @@ class ZenSequenceCollator:
             chars = tok.tokenize(text)[: max_len - 2]
             ids = [tok.cls_token_id] + tok.convert_tokens_to_ids(chars) + \
                 [tok.sep_token_id]
-            ngram_ids, positions = self.ngram_dict.match(chars)
+            ngram_ids, positions, freqs = self.ngram_dict.match(
+                chars, with_freqs=True)
             # shift positions by 1 for [CLS], pad to max_len rows
-            pos = np.zeros((max_len, M), np.int32)
+            pos = np.zeros((max_len, M), np.float32)
             pos[1: 1 + len(chars)] = positions
+            if self.freq_weighted:
+                # zen2 data prep: weight each span by its dictionary
+                # frequency, then row-normalise (reference:
+                # examples/zen2_finetune/fengshen_sequence_level_ft_task
+                # .py:393-404); zen1 feeds the raw 0/1 matrix (reference:
+                # examples/zen1_finetune/...:284-286, fusion = plain sum)
+                pos = pos * freqs[None, :]
+                cover = np.maximum(pos.sum(axis=1, keepdims=True), 1e-10)
+                pos = pos / cover
             pad = max_len - len(ids)
             batch["input_ids"].append(ids + [pad_id] * pad)
             batch["attention_mask"].append([1] * len(ids) + [0] * pad)

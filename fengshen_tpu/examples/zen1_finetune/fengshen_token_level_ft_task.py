@@ -33,6 +33,7 @@ class ZenTaggingCollator:
     ngram_dict: ZenNgramDict
     label2id: dict
     max_seq_length: int = 128
+    freq_weighted: bool = False  # True for zen2
 
     def __call__(self, samples: list[dict]) -> dict:
         tok = self.tokenizer
@@ -49,9 +50,19 @@ class ZenTaggingCollator:
                 [tok.sep_token_id]
             labels = [-100] + [self.label2id.get(t, 0) for t in tags] + \
                 [-100]
-            ngram_ids, positions = self.ngram_dict.match(chars)
-            pos = np.zeros((max_len, M), np.int32)
+            ngram_ids, positions, freqs = self.ngram_dict.match(
+                chars, with_freqs=True)
+            pos = np.zeros((max_len, M), np.float32)
             pos[1: 1 + len(chars)] = positions
+            if self.freq_weighted:
+                # zen2 data prep: weight each span by its dictionary
+                # frequency, then row-normalise (reference:
+                # examples/zen2_finetune/fengshen_sequence_level_ft_task
+                # .py:393-404); zen1 feeds the raw 0/1 matrix (reference:
+                # examples/zen1_finetune/...:284-286, fusion = plain sum)
+                pos = pos * freqs[None, :]
+                cover = np.maximum(pos.sum(axis=1, keepdims=True), 1e-10)
+                pos = pos / cover
             pad = max_len - len(ids)
             batch["input_ids"].append(ids + [pad_id] * pad)
             batch["attention_mask"].append([1] * len(ids) + [0] * pad)

@@ -83,7 +83,8 @@ def main(argv=None):
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     ngram_dict = ZenNgramDict(args.ngram_dict_path or args.model_path)
     collator = ZenSequenceCollator(tokenizer, ngram_dict,
-                                   max_seq_length=args.max_seq_length)
+                                   max_seq_length=args.max_seq_length,
+                                   freq_weighted=True)
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args)
     module = Zen2SequenceModule(args, num_labels=args.num_labels)

@@ -29,9 +29,13 @@ def torch_to_params(state_dict: Mapping[str, Any],
         "token_type_embeddings": {
             "embedding": t("bert.embeddings.token_type_embeddings.weight")},
         "embeddings_ln": ln("bert.embeddings.LayerNorm"),
-        # n-gram side embeddings (reference BertWordEmbeddings :225-248)
+        # n-gram side embeddings (reference BertWordEmbeddings :225-248,
+        # word + token_type + LayerNorm)
         "ngram_embeddings": {
             "embedding": t("bert.word_embeddings.word_embeddings.weight")},
+        "ngram_token_type_embeddings": {
+            "embedding": t(
+                "bert.word_embeddings.token_type_embeddings.weight")},
         "ngram_ln": ln("bert.word_embeddings.LayerNorm"),
     }
     for i in range(config.num_hidden_layers):

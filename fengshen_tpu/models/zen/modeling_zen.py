@@ -76,8 +76,13 @@ class ZenModel(nn.Module):
         ngram_hidden = None
         ngram_mask = None
         if ngram_ids is not None:
+            # ngram side carries its own token-type table (reference:
+            # zen1/modeling.py:225-249 BertWordEmbeddings — ngram seg ids
+            # are 1 for second-sentence ngrams in pair tasks; 0 default)
             ngram_hidden = embed(cfg.ngram_vocab_size, "ngram_embeddings",
-                                 VocabParallelEmbed)(ngram_ids)
+                                 VocabParallelEmbed)(ngram_ids) + \
+                embed(cfg.type_vocab_size, "ngram_token_type_embeddings")(
+                    jnp.zeros_like(ngram_ids))
             ngram_hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
                                      name="ngram_ln")(ngram_hidden)
             ngram_mask = (ngram_ids != 0).astype(jnp.int32)
@@ -85,15 +90,17 @@ class ZenModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             hidden = BertLayer(cfg, name=f"layer_{i}")(
                 hidden, attention_mask, deterministic)
-            if ngram_hidden is not None and i < cfg.num_ngram_layers:
-                ngram_hidden = BertLayer(cfg, name=f"ngram_layer_{i}")(
-                    ngram_hidden, ngram_mask, deterministic)
-                # fuse: each char receives mean of covering grams' hiddens
-                pos = ngram_positions.astype(jnp.float32) * \
-                    ngram_mask[:, None, :].astype(jnp.float32)
-                cover = jnp.maximum(pos.sum(-1, keepdims=True), 1.0)
-                fused = jnp.einsum("bsm,bmh->bsh", pos / cover,
-                                   ngram_hidden.astype(jnp.float32))
+            if ngram_hidden is not None:
+                if i < cfg.num_ngram_layers:
+                    ngram_hidden = BertLayer(cfg, name=f"ngram_layer_{i}")(
+                        ngram_hidden, ngram_mask, deterministic)
+                # fusion runs on EVERY layer (reference zen1/modeling.py:
+                # 442 — the bmm sits OUTSIDE the word-layer gate, so
+                # deeper layers keep receiving the last ngram states);
+                # plain matmul: the raw 0/1 matrix sums covering grams
+                fused = jnp.einsum(
+                    "bsm,bmh->bsh", ngram_positions.astype(jnp.float32),
+                    ngram_hidden.astype(jnp.float32))
                 hidden = hidden + fused.astype(hidden.dtype)
 
         pooled = None

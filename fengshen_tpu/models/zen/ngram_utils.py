@@ -23,6 +23,7 @@ class ZenNgramDict:
         self.max_ngram_in_seq = max_ngram_in_seq
         self.max_ngram_len = max_ngram_len
         vocab: list[str] = ["[pad]"]
+        freqs: list[float] = [0.0]
         if ngram_freq_path and os.path.isdir(ngram_freq_path):
             # checkpoint dirs ship the dict as ngram.txt (reference:
             # ngram_utils.py NGRAM_DICT_NAME)
@@ -30,20 +31,32 @@ class ZenNgramDict:
         if ngram_freq_path and os.path.exists(ngram_freq_path):
             with open(ngram_freq_path) as f:
                 for line in f:
-                    token = line.strip().split("\t")[0].split(",")[0]
+                    fields = line.strip().replace("\t", ",").split(",")
+                    token = fields[0]
                     if token:
                         vocab.append(token)
+                        try:
+                            freqs.append(float(fields[1]))
+                        except (IndexError, ValueError):
+                            freqs.append(1.0)
         if ngrams:
             vocab.extend(ngrams)
+            freqs.extend([1.0] * len(ngrams))
         self.id_to_ngram_list = vocab
         self.ngram_to_id_dict = {g: i for i, g in enumerate(vocab)}
+        # dictionary frequency per id — zen2's fusion weights spans by
+        # freq before row-normalising (reference: examples/zen2_finetune/
+        # fengshen_sequence_level_ft_task.py:393-404)
+        self.id_to_freq = freqs
 
     def __len__(self) -> int:
         return len(self.id_to_ngram_list)
 
-    def match(self, chars: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    def match(self, chars: list[str], with_freqs: bool = False):
         """Return (ngram_ids [M], positions [S, M]) for a char sequence:
-        positions[i, j] = 1 iff char i is inside matched ngram j."""
+        positions[i, j] = 1 iff char i is inside matched ngram j. With
+        `with_freqs`, also return the dictionary frequency per match
+        (zen2's freq-weighted fusion)."""
         seq_len = len(chars)
         matches: list[tuple[int, int, int]] = []  # (ngram_id, start, length)
         for start in range(seq_len):
@@ -55,7 +68,12 @@ class ZenNgramDict:
         matches = matches[: self.max_ngram_in_seq]
         ngram_ids = np.zeros((self.max_ngram_in_seq,), np.int32)
         positions = np.zeros((seq_len, self.max_ngram_in_seq), np.int32)
+        freqs = np.zeros((self.max_ngram_in_seq,), np.float32)
         for j, (gid, start, ln) in enumerate(matches):
             ngram_ids[j] = gid
             positions[start:start + ln, j] = 1
+            freqs[j] = self.id_to_freq[gid] if gid < len(self.id_to_freq) \
+                else 1.0
+        if with_freqs:
+            return ngram_ids, positions, freqs
         return ngram_ids, positions

@@ -1,0 +1,87 @@
+"""torch → jax weights for ZEN2 (relative-attention n-gram BERT).
+
+Importer for released Erlangshen-ZEN2 checkpoints (reference:
+fengshen/models/zen2/modeling.py — char embeddings :293-315, ngram
+BertWordEmbeddings :317-340, relative BertSelfAttention with per-layer
+r_r_bias/r_w_bias :407-509, encoder `layer` + `word_layers` :609-645,
+ZenOnlyMLMHead :697-706).
+
+Bias-role note: the reference adds **r_r_bias** to the query for the
+content (AC) term and pairs **r_w_bias** with the positional basis in the
+BD term (modeling.py:451-457) — the OPPOSITE of the Transformer-XL paper
+naming our `Zen2SelfAttention` follows (r_w = content, r_r = position).
+The converter swaps them so the imported math is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.models.zen2.modeling_zen2 import Zen2Config
+from fengshen_tpu.utils.convert_common import (make_helpers,
+                                               unwrap_lightning)
+
+
+def _zen2_layer(sd, prefix: str) -> dict:
+    t, lin, ln = make_helpers(sd)
+    return {
+        "attention": {
+            "query": lin(f"{prefix}.attention.self.query"),
+            "key": lin(f"{prefix}.attention.self.key"),
+            "value": lin(f"{prefix}.attention.self.value"),
+            # swapped on purpose — see module docstring
+            "r_w_bias": t(f"{prefix}.attention.self.r_r_bias"),
+            "r_r_bias": t(f"{prefix}.attention.self.r_w_bias"),
+            "attention_output_dense": lin(f"{prefix}.attention.output"
+                                          ".dense"),
+        },
+        "attention_ln": ln(f"{prefix}.attention.output.LayerNorm"),
+        "intermediate_dense": lin(f"{prefix}.intermediate.dense"),
+        "output_dense": lin(f"{prefix}.output.dense"),
+        "output_ln": ln(f"{prefix}.output.LayerNorm"),
+    }
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config: Zen2Config,
+                    head: str = "none") -> dict:
+    """`head` ∈ {none, masked_lm, sequence_classification,
+    token_classification}. Returns the Zen2Model tower for "none", else
+    the head model's tree with the tower under "zen"."""
+    sd = unwrap_lightning(state_dict)
+    if not any(k.startswith("bert.") for k in sd):
+        sd = {f"bert.{k}": v for k, v in sd.items()}
+    t, lin, ln = make_helpers(sd)
+
+    tower: dict = {
+        "word_embeddings": {
+            "embedding": t("bert.embeddings.word_embeddings.weight")},
+        "token_type_embeddings": {
+            "embedding": t("bert.embeddings.token_type_embeddings.weight")},
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+        "ngram_embeddings": {
+            "embedding": t("bert.word_embeddings.word_embeddings.weight")},
+        "ngram_token_type_embeddings": {
+            "embedding": t(
+                "bert.word_embeddings.token_type_embeddings.weight")},
+        "ngram_ln": ln("bert.word_embeddings.LayerNorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        tower[f"layer_{i}"] = _zen2_layer(sd, f"bert.encoder.layer.{i}")
+    for i in range(config.num_hidden_word_layers):
+        tower[f"ngram_layer_{i}"] = _zen2_layer(
+            sd, f"bert.encoder.word_layers.{i}")
+    if "bert.pooler.dense.weight" in sd:
+        tower["pooler"] = lin("bert.pooler.dense")
+
+    if head == "none":
+        return tower
+    params: dict = {"zen": tower}
+    if head == "masked_lm":
+        params.update({
+            "transform_dense": lin("cls.predictions.transform.dense"),
+            "transform_ln": ln("cls.predictions.transform.LayerNorm"),
+            "bias": t("cls.predictions.bias"),
+        })
+    elif head in ("sequence_classification", "token_classification"):
+        params["classifier"] = lin("classifier")
+    return params

@@ -49,16 +49,21 @@ def _dt(cfg):
 
 
 def relative_sinusoidal_embedding(n_pos: int, dim: int) -> np.ndarray:
-    """Sinusoidal embeddings over relative offsets -(n_pos-1)..(n_pos-1)
-    (reference: modeling.py:343-405)."""
-    offsets = np.arange(-(n_pos - 1), n_pos, dtype=np.float32)
-    inv_freq = 1.0 / (10000 ** (np.arange(0, dim, 2,
-                                          dtype=np.float32) / dim))
+    """Sinusoidal embeddings over relative offsets -n_pos..n_pos-1 in the
+    tensor2tensor layout the reference uses — [sin | cos] concatenated
+    halves with freq_i = 10000^(-i/(dim/2-1)) — so imported r-bias
+    vectors act on the same basis (reference: modeling.py:367-384,
+    RelativeSinusoidalPositionalEmbedding.get_embedding)."""
+    half = dim // 2
+    scale = np.log(10000.0) / max(half - 1, 1)
+    inv_freq = np.exp(np.arange(half, dtype=np.float32) * -scale)
+    offsets = np.arange(-n_pos, n_pos, dtype=np.float32)
     angles = offsets[:, None] * inv_freq[None, :]
-    emb = np.zeros((len(offsets), dim), np.float32)
-    emb[:, 0::2] = np.sin(angles)
-    emb[:, 1::2] = np.cos(angles)
-    return emb
+    emb = np.concatenate([np.sin(angles), np.cos(angles)], axis=1)
+    if dim % 2 == 1:
+        emb = np.concatenate([emb, np.zeros((len(offsets), 1),
+                                            np.float32)], axis=1)
+    return emb  # [2*n_pos, dim]
 
 
 class Zen2SelfAttention(nn.Module):
@@ -92,9 +97,9 @@ class Zen2SelfAttention(nn.Module):
 
         # position term: (q + r_r) · R_{j-i}
         rel = jnp.asarray(relative_sinusoidal_embedding(seq, head_dim),
-                          q.dtype)  # [2S-1, d]
+                          q.dtype)  # [2S, d], row r ↔ offset r - S
         idx = (jnp.arange(seq)[None, :] - jnp.arange(seq)[:, None]
-               + seq - 1)  # [S, S] in 0..2S-2
+               + seq)  # [S, S] in 1..2S-1
         r_mat = rel[idx]  # [S, S, d]
         qr = q + r_r_bias[None, None].astype(q.dtype)
         bd = jnp.einsum("bqnd,qkd->bnqk", qr, r_mat,
@@ -165,8 +170,14 @@ class Zen2Model(nn.Module):
 
         ngram_hidden = ngram_mask = None
         if ngram_ids is not None:
+            # ngram side carries its own token-type table (reference:
+            # modeling.py:317-340 BertWordEmbeddings — word + token_type
+            # + LayerNorm); ngram token types are 0 in every published
+            # pipeline, so the zeros default matches
             ngram_hidden = embed(cfg.ngram_vocab_size,
-                                 "ngram_embeddings")(ngram_ids)
+                                 "ngram_embeddings")(ngram_ids) + \
+                embed(cfg.type_vocab_size, "ngram_token_type_embeddings")(
+                    jnp.zeros_like(ngram_ids))
             ngram_hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
                                      name="ngram_ln")(ngram_hidden)
             ngram_mask = (ngram_ids != 0).astype(jnp.int32)
@@ -174,15 +185,19 @@ class Zen2Model(nn.Module):
         for i in range(cfg.num_hidden_layers):
             hidden = Zen2Layer(cfg, name=f"layer_{i}")(
                 hidden, attention_mask, deterministic)
-            if ngram_hidden is not None and \
-                    i < cfg.num_hidden_word_layers:
-                ngram_hidden = Zen2Layer(cfg, name=f"ngram_layer_{i}")(
-                    ngram_hidden, ngram_mask, deterministic)
-                pos = ngram_positions.astype(jnp.float32) * \
-                    ngram_mask[:, None, :].astype(jnp.float32)
-                cover = jnp.maximum(pos.sum(-1, keepdims=True), 1.0)
-                fused = jnp.einsum("bsm,bmh->bsh", pos / cover,
-                                   ngram_hidden.astype(jnp.float32))
+            if ngram_hidden is not None:
+                if i < cfg.num_hidden_word_layers:
+                    ngram_hidden = Zen2Layer(
+                        cfg, name=f"ngram_layer_{i}")(
+                        ngram_hidden, ngram_mask, deterministic)
+                # fusion runs on EVERY layer — the reference bmm
+                # (modeling.py:636) sits OUTSIDE the word-layer gate, so
+                # layers past num_hidden_word_layers keep receiving the
+                # LAST ngram states; matrix arrives freq-normalised from
+                # data prep (examples/zen2_finetune/...:393-404)
+                fused = jnp.einsum(
+                    "bsm,bmh->bsh", ngram_positions.astype(jnp.float32),
+                    ngram_hidden.astype(jnp.float32))
                 hidden = hidden + fused.astype(hidden.dtype)
 
         pooled = None

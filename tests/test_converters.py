@@ -232,6 +232,8 @@ def test_zen_convert_structural_roundtrip():
         "bert.embeddings.LayerNorm.bias": (d,),
         "bert.word_embeddings.word_embeddings.weight": (
             cfg.ngram_vocab_size, d),
+        "bert.word_embeddings.token_type_embeddings.weight": (
+            cfg.type_vocab_size, d),
         "bert.word_embeddings.LayerNorm.weight": (d,),
         "bert.word_embeddings.LayerNorm.bias": (d,),
         "bert.pooler.dense.weight": (d, d),

@@ -69,12 +69,16 @@ def test_zen2_mlm_and_heads():
 
 
 def test_zen2_relative_embedding_values():
+    """t2t layout (reference: zen2/modeling.py:367-384): [2n, dim] with
+    [sin | cos] concatenated halves, offset 0 at row n."""
     from fengshen_tpu.models.zen2 import relative_sinusoidal_embedding
     emb = relative_sinusoidal_embedding(4, 8)
-    assert emb.shape == (7, 8)
-    # offset 0 row: sin(0)=0, cos(0)=1
-    np.testing.assert_allclose(emb[3, 0::2], 0.0, atol=1e-6)
-    np.testing.assert_allclose(emb[3, 1::2], 1.0, atol=1e-6)
+    assert emb.shape == (8, 8)
+    # offset 0 row: sin half = 0, cos half = 1
+    np.testing.assert_allclose(emb[4, :4], 0.0, atol=1e-6)
+    np.testing.assert_allclose(emb[4, 4:], 1.0, atol=1e-6)
+    # reference frequency: freq_i = 10000^(-i/(half-1))
+    np.testing.assert_allclose(emb[5, 3], np.sin(1e-4 ** 1.0), atol=1e-6)
 
 
 # -- transfo_xl variants ----------------------------------------------------
