@@ -5,6 +5,8 @@ backbone with segment-level recurrence for long text)."""
 from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl_denoise \
     import (TransfoXLDenoiseConfig, TransfoXLDenoiseModel,
             DenoiseCollator)
+from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl import (
+    TransfoXLConfig, TransfoXLModel)
 
 __all__ = ["TransfoXLDenoiseConfig", "TransfoXLDenoiseModel",
-           "DenoiseCollator"]
+           "DenoiseCollator", "TransfoXLConfig", "TransfoXLModel"]

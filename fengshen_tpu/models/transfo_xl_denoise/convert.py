@@ -1,0 +1,52 @@
+"""torch → jax weights for the Transformer-XL families.
+
+One importer for all three published checkpoint families — denoise
+("Bigan"), paraphrase, reasoning — which share the single
+TransfoXLDenoiseModel backbone (reference:
+fengshen/models/transfo_xl_paraphrase/__init__.py:1 and
+transfo_xl_reasoning/__init__.py:2 both re-export it).
+
+Reference state-dict naming (modeling_transfo_xl_denoise.py:681-704):
+`word_embeddings.weight` (tied output head), `transformer.r_w_bias` /
+`transformer.r_r_bias` (shared across layers),
+`transformer.layers.{i}.{input_layernorm, attention.query_key_value,
+attention.relative, attention.dense, post_attention_layernorm,
+mlp.dense_h_to_4h, mlp.dense_4h_to_h}`, `transformer.final_layernorm`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (make_helpers,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config) -> dict:
+    """Returns {"backbone": <TransfoXLModel params>} matching
+    `TransfoXLDenoiseModel(config with relative_encoding=True)`."""
+    sd = unwrap_lightning(state_dict)
+    t, lin, ln = make_helpers(sd)
+
+    n_layers = getattr(config, "num_layers", None) or config.n_layer
+    backbone: dict = {
+        "word_embeddings": {"embedding": t("word_embeddings.weight")},
+        "r_w_bias": t("transformer.r_w_bias"),
+        "r_r_bias": t("transformer.r_r_bias"),
+        "final_layernorm": ln("transformer.final_layernorm"),
+    }
+    for i in range(n_layers):
+        pre = f"transformer.layers.{i}"
+        backbone[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{pre}.input_layernorm"),
+            "attention": {
+                "query_key_value": lin(f"{pre}.attention.query_key_value"),
+                "relative": lin(f"{pre}.attention.relative"),
+                "dense": lin(f"{pre}.attention.dense"),
+            },
+            "post_attention_layernorm": ln(
+                f"{pre}.post_attention_layernorm"),
+            "dense_h_to_4h": lin(f"{pre}.mlp.dense_h_to_4h"),
+            "dense_4h_to_h": lin(f"{pre}.mlp.dense_4h_to_h"),
+        }
+    return {"backbone": backbone}

@@ -30,6 +30,12 @@ from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
 @dataclasses.dataclass
 class TransfoXLDenoiseConfig(GPT2Config):
     segment_length: int = 512  # per-segment window under recurrence
+    # The published checkpoints are trained with relative position
+    # encoding (reference: configuration_transfo_xl_denoise.py:103
+    # relative_encoding=True) — turning this on swaps the backbone to the
+    # faithful TransfoXLModel so imports are exact; False keeps the
+    # original absolute-position GPT2 backbone.
+    relative_encoding: bool = False
 
     @classmethod
     def small_test_config(cls, **overrides: Any):
@@ -45,14 +51,41 @@ class TransfoXLDenoiseModel(nn.Module):
     config: TransfoXLDenoiseConfig
 
     def setup(self):
-        self.backbone = GPT2Model(self.config, name="backbone")
-        self.lm_head = nn.Dense(self.config.vocab_size, use_bias=False,
-                                param_dtype=jnp.dtype(
-                                    self.config.param_dtype),
-                                name="lm_head")
+        if self.config.relative_encoding:
+            from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl \
+                import TransfoXLConfig, TransfoXLModel
+            cfg = self.config
+            self.backbone = TransfoXLModel(TransfoXLConfig(
+                vocab_size=cfg.vocab_size, hidden_size=cfg.n_embd,
+                num_layers=cfg.n_layer, num_attention_heads=cfg.n_head,
+                max_sequence_length=cfg.n_positions,
+                max_memory_length=cfg.segment_length,
+                embedding_dropout_prob=cfg.embd_pdrop,
+                attention_dropout_prob=cfg.attn_pdrop,
+                output_dropout_prob=cfg.resid_pdrop,
+                layernorm_epsilon=cfg.layer_norm_epsilon,
+                initializer_range=cfg.initializer_range,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype),
+                name="backbone")
+            self.lm_head = None
+        else:
+            self.backbone = GPT2Model(self.config, name="backbone")
+            self.lm_head = nn.Dense(self.config.vocab_size, use_bias=False,
+                                    param_dtype=jnp.dtype(
+                                        self.config.param_dtype),
+                                    name="lm_head")
 
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
-                 init_cache=False, deterministic=True):
+                 init_cache=False, deterministic=True, mems=None,
+                 return_mems=False):
+        """`mems`/`return_mems` drive the XL segment recurrence in
+        relative mode (ignored by the absolute-position backbone, whose
+        recurrence rides the KV cache via forward_segments)."""
+        if self.config.relative_encoding:
+            logits, new_mems = self.backbone(
+                input_ids, attention_mask=attention_mask, mems=mems,
+                deterministic=deterministic)
+            return (logits, new_mems) if return_mems else logits
         hidden = self.backbone(input_ids, attention_mask=attention_mask,
                                position_ids=position_ids,
                                init_cache=init_cache,
@@ -60,14 +93,23 @@ class TransfoXLDenoiseModel(nn.Module):
         return self.lm_head(hidden)
 
     def forward_segments(self, input_ids, deterministic=True):
-        """Long input processed as segments through the KV cache (the XL
-        recurrence); returns concatenated logits. Must be applied with
-        mutable=["cache"] and an initialised cache."""
+        """Long input processed as fixed-size segments — via the XL
+        memory in relative mode, via the preallocated KV cache otherwise
+        (apply with mutable=["cache"] and an initialised cache for the
+        latter). Returns concatenated logits."""
         cfg = self.config
         seg = cfg.segment_length
         batch, total = input_ids.shape
         n_seg = (total + seg - 1) // seg
         outs = []
+        if cfg.relative_encoding:
+            mems = None
+            for s in range(n_seg):
+                chunk = input_ids[:, s * seg:(s + 1) * seg]
+                logits, mems = self.backbone(
+                    chunk, mems=mems, deterministic=deterministic)
+                outs.append(logits)
+            return jnp.concatenate(outs, axis=1)
         for s in range(n_seg):
             chunk = input_ids[:, s * seg:(s + 1) * seg]
             pos = (s * seg + jnp.arange(chunk.shape[1]))[None]
@@ -78,6 +120,12 @@ class TransfoXLDenoiseModel(nn.Module):
         return jnp.concatenate(outs, axis=1)
 
     def partition_rules(self):
+        if self.config.relative_encoding:
+            # rules are re.search'd against full paths, so the XL rules
+            # match under the "backbone/" prefix unchanged
+            from fengshen_tpu.models.transfo_xl_denoise \
+                .modeling_transfo_xl import XL_PARTITION_RULES
+            return XL_PARTITION_RULES
         from fengshen_tpu.models.gpt2.modeling_gpt2 import PARTITION_RULES
         return PARTITION_RULES
 

@@ -1,0 +1,257 @@
+"""Transformer-XL importer parity (VERDICT r2 item 3).
+
+Synthetic state dict in the reference naming
+(fengshen/models/transfo_xl_denoise/modeling_transfo_xl_denoise.py) vs a
+numpy oracle restating the reference equations: fused-qkv relative
+attention (:278-340), the pad-reshape `_rel_shift` (:234-249), descending
+positional basis (:106-122, :588-591), pre-LN residuals with OpenAI tanh
+GELU (:156-162, :455-470), shared r-biases, tied output head (:758-763),
+and the XL memory recurrence (:600-660).
+"""
+
+import numpy as np
+import pytest
+
+H, NH, HD, NL, V = 16, 2, 8, 2, 40
+
+
+def _sd():
+    rng = np.random.RandomState(7)
+
+    def r(*s):
+        return rng.randn(*s).astype(np.float32) * 0.1
+
+    sd = {
+        "word_embeddings.weight": r(V, H),
+        "transformer.r_w_bias": r(NH, HD),
+        "transformer.r_r_bias": r(NH, HD),
+        "transformer.final_layernorm.weight": 1 + r(H),
+        "transformer.final_layernorm.bias": r(H),
+    }
+    for i in range(NL):
+        p = f"transformer.layers.{i}"
+        sd.update({
+            f"{p}.input_layernorm.weight": 1 + r(H),
+            f"{p}.input_layernorm.bias": r(H),
+            f"{p}.attention.query_key_value.weight": r(3 * H, H),
+            f"{p}.attention.query_key_value.bias": r(3 * H),
+            f"{p}.attention.relative.weight": r(H, H),
+            f"{p}.attention.relative.bias": r(H),
+            f"{p}.attention.dense.weight": r(H, H),
+            f"{p}.attention.dense.bias": r(H),
+            f"{p}.post_attention_layernorm.weight": 1 + r(H),
+            f"{p}.post_attention_layernorm.bias": r(H),
+            f"{p}.mlp.dense_h_to_4h.weight": r(4 * H, H),
+            f"{p}.mlp.dense_h_to_4h.bias": r(4 * H),
+            f"{p}.mlp.dense_4h_to_h.weight": r(H, 4 * H),
+            f"{p}.mlp.dense_4h_to_h.bias": r(H),
+        })
+    return sd
+
+
+def _ln(x, w, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps) * w + b
+
+
+def _gelu_tanh(x):
+    return 0.5 * x * (1.0 + np.tanh(
+        0.7978845608028654 * x * (1.0 + 0.044715 * x * x)))
+
+
+def _pos_emb(klen):
+    inv = 1.0 / (10000 ** (np.arange(0, H, 2, dtype=np.float32) / H))
+    seq = np.arange(klen - 1, -1, -1, dtype=np.float32)
+    ang = seq[:, None] * inv[None]
+    return np.concatenate([np.sin(ang), np.cos(ang)], -1)
+
+
+def _rel_shift(x):
+    b, n, q, k = x.shape
+    pad = np.zeros((b, n, q, 1), x.dtype)
+    xp = np.concatenate([pad, x], -1).reshape(b, n, k + 1, q)
+    return xp[:, :, 1:, :].reshape(b, n, q, k)
+
+
+def _layer(sd, i, x, ltor, pos, mem=None):
+    p = f"transformer.layers.{i}"
+    ln_x = _ln(x, sd[f"{p}.input_layernorm.weight"],
+               sd[f"{p}.input_layernorm.bias"])
+    cat = ln_x if mem is None else np.concatenate(
+        [_ln(mem, sd[f"{p}.input_layernorm.weight"],
+             sd[f"{p}.input_layernorm.bias"]), ln_x], 1)
+    B, qlen = x.shape[:2]
+    klen = cat.shape[1]
+    qkv = cat @ sd[f"{p}.attention.query_key_value.weight"].T + \
+        sd[f"{p}.attention.query_key_value.bias"]
+    q, k, v = np.split(qkv, 3, -1)
+    q = q[:, -qlen:]
+
+    def heads(t):
+        return t.reshape(B, t.shape[1], NH, HD).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    rel = pos @ sd[f"{p}.attention.relative.weight"].T + \
+        sd[f"{p}.attention.relative.bias"]
+    rel = rel.reshape(klen, NH, HD).transpose(1, 0, 2)
+    r_w = sd["transformer.r_w_bias"]
+    r_r = sd["transformer.r_r_bias"]
+    ac = np.einsum("bnqd,bnkd->bnqk", q + r_w[None, :, None], k)
+    bd = _rel_shift(np.einsum("bnqd,nkd->bnqk",
+                              q + r_r[None, :, None], rel))
+    scores = (ac + bd) / np.sqrt(HD)
+    scores = scores * ltor - 10000.0 * (1.0 - ltor)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ctx = np.einsum("bnqk,bnkd->bnqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, qlen, H)
+    attn = ctx @ sd[f"{p}.attention.dense.weight"].T + \
+        sd[f"{p}.attention.dense.bias"]
+    x = x + attn
+    y = _ln(x, sd[f"{p}.post_attention_layernorm.weight"],
+            sd[f"{p}.post_attention_layernorm.bias"])
+    mid = _gelu_tanh(y @ sd[f"{p}.mlp.dense_h_to_4h.weight"].T +
+                     sd[f"{p}.mlp.dense_h_to_4h.bias"])
+    return x + mid @ sd[f"{p}.mlp.dense_4h_to_h.weight"].T + \
+        sd[f"{p}.mlp.dense_4h_to_h.bias"]
+
+
+def _oracle(sd, ids, mems=None):
+    B, qlen = ids.shape
+    mem_len = mems[0].shape[1] if mems else 0
+    klen = qlen + mem_len
+    hidden = sd["word_embeddings.weight"][ids]
+    ltor = np.tril(np.ones((qlen, klen), np.float32),
+                   k=mem_len)[None, None]
+    pos = _pos_emb(klen)
+    new_mems = []
+    for i in range(NL):
+        prev = hidden if mems is None else np.concatenate(
+            [mems[i], hidden], 1)
+        new_mems.append(prev[:, -8:])
+        hidden = _layer(sd, i, hidden, ltor, pos,
+                        mems[i] if mems else None)
+    hidden = _ln(hidden, sd["transformer.final_layernorm.weight"],
+                 sd["transformer.final_layernorm.bias"])
+    return hidden @ sd["word_embeddings.weight"].T, new_mems
+
+
+@pytest.fixture
+def ids():
+    return np.random.RandomState(3).randint(0, V, (2, 6))
+
+
+def _config():
+    from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl \
+        import TransfoXLConfig
+    return TransfoXLConfig(vocab_size=V, hidden_size=H, num_layers=NL,
+                           num_attention_heads=NH,
+                           max_sequence_length=32, max_memory_length=8)
+
+
+def test_transfo_xl_convert_forward_parity(ids):
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.transfo_xl_denoise.convert import \
+        torch_to_params
+    from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl \
+        import TransfoXLModel
+
+    sd = _sd()
+    cfg = _config()
+    params = torch_to_params(sd, cfg)["backbone"]
+    model = TransfoXLModel(cfg)
+    logits, _ = model.apply({"params": params}, jnp.asarray(ids))
+    ref, _ = _oracle(sd, ids)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
+
+
+def test_transfo_xl_memory_recurrence_parity(ids):
+    """Segment 2 with XL memory from segment 1 must match the oracle's
+    per-layer memory semantics (reference update_mems :649-660)."""
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.transfo_xl_denoise.convert import \
+        torch_to_params
+    from fengshen_tpu.models.transfo_xl_denoise.modeling_transfo_xl \
+        import TransfoXLModel
+
+    sd = _sd()
+    cfg = _config()
+    params = torch_to_params(sd, cfg)["backbone"]
+    model = TransfoXLModel(cfg)
+    seg2 = np.random.RandomState(4).randint(0, V, (2, 5))
+
+    _, mems = model.apply({"params": params}, jnp.asarray(ids))
+    logits2, _ = model.apply({"params": params}, jnp.asarray(seg2),
+                             mems=mems)
+    _, ref_mems = _oracle(sd, ids)
+    for a, b in zip(mems, ref_mems):
+        np.testing.assert_allclose(np.asarray(a), b, atol=3e-4)
+    ref2, _ = _oracle(sd, seg2, mems=ref_mems)
+    np.testing.assert_allclose(np.asarray(logits2), ref2, atol=5e-4)
+
+
+def test_transfo_xl_denoise_model_relative_dispatch(ids):
+    """TransfoXLDenoiseModel(relative_encoding=True) routes through the
+    XL backbone and accepts converted params under 'backbone'."""
+    import jax
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.transfo_xl_denoise import (
+        TransfoXLDenoiseConfig, TransfoXLDenoiseModel)
+    from fengshen_tpu.models.transfo_xl_denoise.convert import \
+        torch_to_params
+
+    cfg = TransfoXLDenoiseConfig.small_test_config(
+        vocab_size=V, n_embd=H, n_layer=NL, n_head=NH,
+        relative_encoding=True, dtype="float32")
+    model = TransfoXLDenoiseModel(cfg)
+    sd = _sd()
+    params = torch_to_params(sd, cfg)
+    logits = model.apply({"params": params}, jnp.asarray(ids))
+    ref, _ = _oracle(sd, ids)
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
+    # init produces the same tree the converter fills
+    init = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    a = jax.tree_util.tree_map(lambda x: tuple(x.shape), init)
+    b = jax.tree_util.tree_map(lambda x: tuple(x.shape), params)
+    assert a == b
+
+
+def test_transfo_xl_denoise_forward_segments_relative(ids):
+    """forward_segments in relative mode rides the XL memory (review fix:
+    it used to call the cache path and a None lm_head)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.transfo_xl_denoise import (
+        TransfoXLDenoiseConfig, TransfoXLDenoiseModel)
+    from fengshen_tpu.parallel.partition import match_partition_rules
+
+    cfg = TransfoXLDenoiseConfig.small_test_config(
+        vocab_size=V, n_embd=H, n_layer=NL, n_head=NH,
+        relative_encoding=True, dtype="float32", segment_length=4)
+    model = TransfoXLDenoiseModel(cfg)
+    long_ids = np.random.RandomState(5).randint(0, V, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(long_ids[:, :4]))["params"]
+    out = model.apply({"params": params}, jnp.asarray(long_ids),
+                      method=TransfoXLDenoiseModel.forward_segments)
+    assert out.shape == (2, 8, V)
+    # segment 2 must see segment 1 through the memory: wrapper __call__
+    # with mems must agree with forward_segments' second half
+    logits1, mems = model.apply({"params": params},
+                                jnp.asarray(long_ids[:, :4]),
+                                return_mems=True)
+    logits2 = model.apply({"params": params}, jnp.asarray(long_ids[:, 4:]),
+                          mems=mems)
+    np.testing.assert_allclose(np.asarray(out[:, 4:]),
+                               np.asarray(logits2), atol=1e-5)
+    # XL partition rules reach every param through the backbone prefix
+    specs = match_partition_rules(model.partition_rules(), params)
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or hasattr(x, "index"))
+    assert any(s is not None and any(e for e in s) for s in flat
+               if s is not None)
