@@ -1,0 +1,58 @@
+"""torch → jax weights for DAVAE (adversarial text VAE).
+
+Reference state-dict naming (fengshen/models/DAVAE/DAVAEModel.py:35-140):
+everything lives under `vae_model.` (the EncDecAAE) —
+`vae_model.encoder.*` is a BertForLatentConnector (bert tower held
+directly: embeddings/encoder/pooler + bias-free `linear` → 2·latent,
+BertForLatentConnector.py:64-71), `vae_model.decoder.*` is a
+GPT2ModelForLatent (the GLM relative transformer + `transformer.
+linear_emb`, GPT2ModelForLatent.py:581-620), and `vae_model.Disc.{0,2}`
+is the AAE critic. Import target: DAVAEModel(relative_decoder=True).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (make_helpers, strip_prefix,
+                                               tensor, unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config) -> dict:
+    sd = unwrap_lightning(state_dict)
+    if any(k.startswith("vae_model.") for k in sd):
+        sd = strip_prefix(sd, "vae_model.")
+
+    # encoder tower: BertForLatentConnector holds embeddings/encoder/
+    # pooler at its top level, like a bare BertModel state dict
+    enc_sd = strip_prefix(sd, "encoder.")
+    from fengshen_tpu.models.bert.convert import model_to_params
+    encoder = model_to_params(
+        {k: v for k, v in enc_sd.items() if not k.startswith("linear.")},
+        config.encoder)
+
+    # decoder: reuse the transfo_xl importer (same GLM layer naming),
+    # then graft the latent projection that lives inside the transformer
+    from fengshen_tpu.models.transfo_xl_denoise.convert import \
+        torch_to_params as xl_convert
+    dec_sd = strip_prefix(sd, "decoder.")
+    decoder = xl_convert(dec_sd, config.decoder)["backbone"]
+    decoder["linear_emb"] = {
+        "kernel": tensor(dec_sd, "transformer.linear_emb.weight").T}
+
+    params: dict = {
+        "encoder": encoder,
+        "posterior": {"kernel": tensor(sd, "encoder.linear.weight").T},
+        "decoder": decoder,
+    }
+    return params
+
+
+def critic_to_params(state_dict: Mapping[str, Any]) -> dict:
+    """The AAE discriminator → LatentCritic (reference Disc indices 0/2
+    of the Sequential, DAVAEModel.py:131-132)."""
+    sd = unwrap_lightning(state_dict)
+    if any(k.startswith("vae_model.") for k in sd):
+        sd = strip_prefix(sd, "vae_model.")
+    _, lin, _ = make_helpers(sd)
+    return {"fc1": lin("Disc.0"), "out": lin("Disc.2")}

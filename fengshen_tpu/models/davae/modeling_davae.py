@@ -35,6 +35,13 @@ class DAVAEConfig:
     word_dropout: float = 0.2  # denoising corruption rate
     encoder: BertConfig = None
     decoder: GPT2Config = None
+    # The published DAVAE checkpoints decode with the GLM-style relative
+    # transformer (reference: DAVAEModel.py:44-50 — GPT2ModelForLatent on
+    # a TransfoXLConfig) and encode to the POOLED bert output through a
+    # bias-free linear (BertForLatentConnector.py:64-71). True switches
+    # both so imports are exact; False keeps the original
+    # absolute-position design.
+    relative_decoder: bool = False
 
     @classmethod
     def small_test_config(cls, **overrides: Any) -> "DAVAEConfig":
@@ -52,18 +59,46 @@ class DAVAEModel(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.encoder = BertModel(cfg.encoder, add_pooling_layer=False,
-                                 name="encoder")
-        self.decoder = GPT2Model(cfg.decoder, name="decoder")
-        self.posterior = nn.Dense(2 * cfg.latent_size, name="posterior")
-        self.latent_proj = nn.Dense(cfg.decoder.n_embd, name="latent_proj")
-        self.lm_head = nn.Dense(cfg.decoder.vocab_size, use_bias=False,
-                                name="lm_head")
+        if cfg.relative_decoder:
+            from fengshen_tpu.models.transfo_xl_denoise \
+                .modeling_transfo_xl import (TransfoXLConfig,
+                                             TransfoXLModel)
+            dec = cfg.decoder
+            self.encoder = BertModel(cfg.encoder, add_pooling_layer=True,
+                                     name="encoder")
+            # the reference decoder IS the GLM relative transformer with
+            # latent injection (GPT2ModelForLatent) — one shared module
+            self.decoder = TransfoXLModel(TransfoXLConfig(
+                vocab_size=dec.vocab_size, hidden_size=dec.n_embd,
+                num_layers=dec.n_layer, num_attention_heads=dec.n_head,
+                max_sequence_length=dec.n_positions,
+                embedding_dropout_prob=dec.embd_pdrop,
+                attention_dropout_prob=dec.attn_pdrop,
+                output_dropout_prob=dec.resid_pdrop,
+                layernorm_epsilon=dec.layer_norm_epsilon,
+                dtype=dec.dtype, param_dtype=dec.param_dtype),
+                latent_size=cfg.latent_size, name="decoder")
+            # reference encoder.linear is bias-free (:71)
+            self.posterior = nn.Dense(2 * cfg.latent_size, use_bias=False,
+                                      name="posterior")
+            self.latent_proj = None
+            self.lm_head = None
+        else:
+            self.encoder = BertModel(cfg.encoder, add_pooling_layer=False,
+                                     name="encoder")
+            self.decoder = GPT2Model(cfg.decoder, name="decoder")
+            self.posterior = nn.Dense(2 * cfg.latent_size,
+                                      name="posterior")
+            self.latent_proj = nn.Dense(cfg.decoder.n_embd,
+                                        name="latent_proj")
+            self.lm_head = nn.Dense(cfg.decoder.vocab_size, use_bias=False,
+                                    name="lm_head")
 
     def encode(self, input_ids, attention_mask=None, deterministic=True):
-        hidden, _ = self.encoder(input_ids, attention_mask,
-                                 deterministic=deterministic)
-        stats = self.posterior(hidden[:, 0])
+        hidden, pooled = self.encoder(input_ids, attention_mask,
+                                      deterministic=deterministic)
+        feat = pooled if self.config.relative_decoder else hidden[:, 0]
+        stats = self.posterior(feat)
         mean, logvar = jnp.split(stats, 2, axis=-1)
         return mean, logvar
 
@@ -72,6 +107,10 @@ class DAVAEModel(nn.Module):
         return mean + jnp.exp(0.5 * logvar) * eps * self.config.std_scale
 
     def decode_logits(self, latent, decoder_input_ids, deterministic=True):
+        if self.config.relative_decoder:
+            logits, _ = self.decoder(decoder_input_ids, latent=latent,
+                                     deterministic=deterministic)
+            return logits
         cond = self.latent_proj(latent)[:, None, :]
         hidden = self.decoder(decoder_input_ids,
                               deterministic=deterministic)
@@ -91,14 +130,16 @@ class DAVAEModel(nn.Module):
 
 
 class LatentCritic(nn.Module):
-    """Adversarial critic on the latent (the AAE discriminator)."""
+    """Adversarial critic on the latent — the AAE discriminator
+    (reference: DAVAEModel.py:131-132 `Disc = Sequential(Linear(L, 4L),
+    ReLU, Linear(4L, 1))`). `hidden` should be 4 × latent_size to match
+    imported checkpoints."""
 
     hidden: int = 128
 
     @nn.compact
     def __call__(self, z):
-        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc1")(z))
-        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc2")(h))
+        h = jax.nn.relu(nn.Dense(self.hidden, name="fc1")(z))
         return nn.Dense(1, name="out")(h)[..., 0]
 
 

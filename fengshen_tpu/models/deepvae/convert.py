@@ -1,0 +1,76 @@
+"""torch → jax weights for Della (deepVAE).
+
+Reference state-dict naming (fengshen/models/deepVAE/deep_vae.py:77-99 +
+latent_connector.py:40-62,310-314): `encoder.transformer.*` and
+`decoder.transformer.*` are HF-GPT2 towers (wte/wpe/h.N/ln_f, Conv1D
+kernels already [in, out]), the decoder adds per-layer bias-free
+`transformer.linear_emb_layers.N` and an untied `lm_head`;
+`latent_nets.N.{W_hh,W_ih}` (bias-free), `posterior_nets.N` /
+`prior_nets.N` (bias-free), `pooling.N.attention_weights`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import tensor, unwrap_lightning
+
+
+def _gpt2_block(sd, prefix: str) -> dict:
+    def t(name):
+        return tensor(sd, name)
+
+    def ln(p):
+        return {"scale": t(f"{p}.weight"), "bias": t(f"{p}.bias")}
+
+    def conv(p):  # HF Conv1D weight is already [in, out]
+        return {"kernel": t(f"{p}.weight"), "bias": t(f"{p}.bias")}
+
+    return {
+        "ln_1": ln(f"{prefix}.ln_1"),
+        "ln_2": ln(f"{prefix}.ln_2"),
+        "attn": {"c_attn": conv(f"{prefix}.attn.c_attn"),
+                 "c_proj": conv(f"{prefix}.attn.c_proj")},
+        "c_fc": conv(f"{prefix}.mlp.c_fc"),
+        "c_proj": conv(f"{prefix}.mlp.c_proj"),
+    }
+
+
+def torch_to_params(state_dict: Mapping[str, Any], config) -> dict:
+    sd = unwrap_lightning(state_dict)
+
+    def t(name):
+        return tensor(sd, name)
+
+    def ln(p):
+        return {"scale": t(f"{p}.weight"), "bias": t(f"{p}.bias")}
+
+    L = config.gpt2.n_layer
+    params: dict = {
+        "enc_wte": {"embedding": t("encoder.transformer.wte.weight")},
+        "enc_wpe": {"embedding": t("encoder.transformer.wpe.weight")},
+        "enc_ln_f": ln("encoder.transformer.ln_f"),
+        "dec_wte": {"embedding": t("decoder.transformer.wte.weight")},
+        "dec_wpe": {"embedding": t("decoder.transformer.wpe.weight")},
+        "ln_f": ln("decoder.transformer.ln_f"),
+    }
+    lm_key = "decoder.lm_head.weight"
+    lm = t(lm_key) if lm_key in sd else \
+        t("decoder.transformer.wte.weight")
+    params["lm_head"] = {"kernel": lm.T}
+    for i in range(L):
+        params[f"enc_h_{i}"] = _gpt2_block(sd, f"encoder.transformer.h.{i}")
+        params[f"dec_h_{i}"] = _gpt2_block(sd, f"decoder.transformer.h.{i}")
+        params[f"latent_proj_{i}"] = {"kernel": t(
+            f"decoder.transformer.linear_emb_layers.{i}.weight").T}
+        params[f"pool_{i}"] = {
+            "attention_weights": t(f"pooling.{i}.attention_weights")}
+        params[f"posterior_{i}"] = {"kernel": t(
+            f"posterior_nets.{i}.weight").T}
+        params[f"prior_{i}"] = {"kernel": t(f"prior_nets.{i}.weight").T}
+        if i < L - 1:
+            params[f"latent_net_{i}"] = {
+                "W_hh": {"kernel": t(f"latent_nets.{i}.W_hh.weight").T},
+                "W_ih": {"kernel": t(f"latent_nets.{i}.W_ih.weight").T},
+            }
+    return params

@@ -50,8 +50,10 @@ class AverageSelfAttention(nn.Module):
         query = self.param("attention_weights",
                            nn.initializers.normal(0.02),
                            (self.hidden_dim,))
-        scores = jnp.einsum("bsh,h->bs", jnp.tanh(hidden),
-                            query.astype(hidden.dtype))
+        # tanh over the SCORES, not the inputs (reference:
+        # deep_vae.py:66 `non_linearity(inputs.matmul(w))`)
+        scores = jnp.tanh(jnp.einsum("bsh,h->bs", hidden,
+                                     query.astype(hidden.dtype)))
         if attention_mask is not None:
             scores = jnp.where(attention_mask > 0, scores, -1e9)
         probs = jax.nn.softmax(scores, -1)
@@ -59,23 +61,24 @@ class AverageSelfAttention(nn.Module):
 
 
 class LatentLayer(nn.Module):
-    """Recursive latent combiner z_{<l+1} = g(z_{<l}, z_l)
-    (reference: deep_vae.py:44-54)."""
+    """Recursive latent combiner z_{<l+1} = tanh(W_hh z_{<l} + W_ih z_l)
+    (reference: deep_vae.py:44-54 — two bias-free Linears + tanh)."""
 
     latent_dim: int
 
     @nn.compact
     def __call__(self, z_prev, z_new):
-        gate = jax.nn.sigmoid(
-            nn.Dense(self.latent_dim, name="gate")(
-                jnp.concatenate([z_prev, z_new], -1)))
-        cand = jnp.tanh(nn.Dense(self.latent_dim, name="cand")(
-            jnp.concatenate([z_prev, z_new], -1)))
-        return gate * cand + (1 - gate) * z_prev
+        h = nn.Dense(self.latent_dim, use_bias=False, name="W_hh")(z_prev)
+        i = nn.Dense(self.latent_dim, use_bias=False, name="W_ih")(z_new)
+        return jnp.tanh(h + i)
 
 
 class DellaModel(nn.Module):
-    """Encoder/decoder GPT-2 stacks with per-layer recursive latents."""
+    """Separate encoder/decoder GPT-2 towers with per-layer recursive
+    latents (reference: deep_vae.py DeepVAE + latent_connector.py —
+    GPT2ForEncoderLatentConnector / GPT2ForDecoderLatentConnector each
+    carry their own wte/wpe/blocks/ln_f; the decoder adds a projected
+    latent BEFORE every block and an untied lm_head)."""
 
     config: DellaConfig
 
@@ -89,22 +92,34 @@ class DellaModel(nn.Module):
         batch, seq = input_ids.shape
         L, D = gcfg.n_layer, cfg.latent_dim
 
-        embed = nn.Embed(gcfg.vocab_size, gcfg.n_embd,
-                         embedding_init=nn.initializers.normal(
-                             gcfg.initializer_range), name="wte")
-        wpe = nn.Embed(gcfg.n_positions, gcfg.n_embd,
-                       embedding_init=nn.initializers.normal(
-                           gcfg.initializer_range), name="wpe")
-        pos = jnp.arange(seq)[None]
+        def tower_embed(prefix):
+            wte = nn.Embed(gcfg.vocab_size, gcfg.n_embd,
+                           embedding_init=nn.initializers.normal(
+                               gcfg.initializer_range),
+                           name=f"{prefix}_wte")
+            wpe = nn.Embed(gcfg.n_positions, gcfg.n_embd,
+                           embedding_init=nn.initializers.normal(
+                               gcfg.initializer_range),
+                           name=f"{prefix}_wpe")
+            return wte, wpe
 
-        # -- encoder: collect a pooled representation per layer ------------
-        hidden = embed(input_ids) + wpe(pos)
-        reps = []
+        # -- encoder: pooled representation per layer ----------------------
+        # HF hidden_states[1:] indexing (deep_vae.py:163-165): entries are
+        # block_0..block_{L-2} outputs, then ln_f(block_{L-1} output)
+        enc_wte, enc_wpe = tower_embed("enc")
+        pos = jnp.arange(seq)[None]
+        hidden = enc_wte(input_ids) + enc_wpe(pos)
+        layer_states = []
         for i in range(L):
             hidden = GPT2Block(gcfg, name=f"enc_h_{i}")(
                 hidden, attention_mask, pos, False, deterministic)
-            reps.append(AverageSelfAttention(
-                gcfg.n_embd, name=f"pool_{i}")(hidden, attention_mask))
+            layer_states.append(hidden)
+        layer_states[-1] = LayerNorm(epsilon=gcfg.layer_norm_epsilon,
+                                     name="enc_ln_f")(layer_states[-1])
+        # reference pools WITHOUT the padding mask (deep_vae.py:118-126,
+        # its own TODO) — kept identical so imported checkpoints match
+        reps = [AverageSelfAttention(gcfg.n_embd, name=f"pool_{i}")(
+            layer_states[i]) for i in range(L)]
 
         # -- recursive latent extraction (deep_vae.py:111-139) -------------
         z = jnp.zeros((batch, D), hidden.dtype)
@@ -128,9 +143,11 @@ class DellaModel(nn.Module):
             if i < L - 1:
                 z = LatentLayer(D, name=f"latent_net_{i}")(z, z_l)
 
-        # -- decoder: inject z_l into layer l (latent_connector) -----------
+        # -- decoder: inject z_l BEFORE block l (latent_connector.py:
+        # 172-179) over its own tower, untied lm_head ----------------------
+        dec_wte, dec_wpe = tower_embed("dec")
         dec_pos = jnp.arange(decoder_input_ids.shape[1])[None]
-        dec = embed(decoder_input_ids) + wpe(dec_pos)
+        dec = dec_wte(decoder_input_ids) + dec_wpe(dec_pos)
         for i in range(L):
             inject = nn.Dense(gcfg.n_embd, use_bias=False,
                               name=f"latent_proj_{i}")(zs[i])
@@ -138,7 +155,8 @@ class DellaModel(nn.Module):
             dec = GPT2Block(gcfg, name=f"dec_h_{i}")(
                 dec, None, dec_pos, False, deterministic)
         dec = LayerNorm(epsilon=gcfg.layer_norm_epsilon, name="ln_f")(dec)
-        logits = dec @ embed.embedding.T.astype(dec.dtype)
+        logits = nn.Dense(gcfg.vocab_size, use_bias=False,
+                          name="lm_head")(dec)
         return logits, posts, priors
 
 

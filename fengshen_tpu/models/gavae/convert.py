@@ -1,0 +1,39 @@
+"""torch → jax weights for GAVAE (GAN over DAVAE latents).
+
+The published GAVAE checkpoint is the DAVAE (`vae_model.*` — import via
+davae.convert); the GAN nets live in `gans_process` (plain attrs, not a
+registered submodule: fengshen/models/GAVAE/GAVAEModel.py:41 +
+gans_model.py:136-180), so when they are saved it is as standalone
+Gen_Net / CLS_Net state dicts — mapped here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (make_helpers,
+                                               unwrap_lightning)
+
+
+def gen_to_params(state_dict: Mapping[str, Any]) -> dict:
+    """Gen_Net (gans_model.py:99-133) → LatentGenerator."""
+    sd = unwrap_lightning(state_dict)
+    _, lin, _ = make_helpers(sd)
+    return {"x2_input": lin("x2_input"), "fc1": lin("fc1"),
+            "fc2": lin("fc2"), "fc3": lin("fc3"), "out": lin("out")}
+
+
+def cls_to_params(state_dict: Mapping[str, Any]) -> dict:
+    """CLS_Net (gans_model.py:35-93) → LatentDiscriminator. The torch
+    `out` maps onto the first cls_num rows of ours (we keep one extra
+    fake-class row, zero-initialised on import)."""
+    import numpy as np
+
+    sd = unwrap_lightning(state_dict)
+    _, lin, _ = make_helpers(sd)
+    out = lin("out")
+    k, b = out["kernel"], out["bias"]
+    out = {"kernel": np.concatenate(
+        [k, np.zeros((k.shape[0], 1), k.dtype)], 1),
+        "bias": np.concatenate([b, np.zeros((1,), b.dtype)])}
+    return {"fc1": lin("fc1"), "fc2": lin("fc2"), "out": out}

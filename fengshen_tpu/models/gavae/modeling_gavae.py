@@ -27,7 +27,6 @@ from fengshen_tpu.models.davae.modeling_davae import (
 class GAVAEConfig:
     latent_size: int = 128
     noise_size: int = 64
-    gan_hidden: int = 128
     cls_num: int = 2
     gan_lr: float = 1e-4
     vae: DAVAEConfig = None
@@ -36,40 +35,42 @@ class GAVAEConfig:
     def small_test_config(cls, **overrides: Any) -> "GAVAEConfig":
         vae = DAVAEConfig.small_test_config()
         base = dict(latent_size=vae.latent_size, noise_size=8,
-                    gan_hidden=16, vae=vae)
+                    vae=vae)
         base.update(overrides)
         return cls(**base)
 
 
 class LatentGenerator(nn.Module):
-    """noise (+ one-hot label) → latent (reference: gans_model.py:101-133
-    gen_model)."""
+    """noise (+ one-hot label) → latent. Reference Gen_Net structure
+    (gans_model.py:99-133): x2_input → 60, then 60→128→256→128→latent
+    with ReLU between the fc layers."""
 
     latent_size: int
-    hidden: int = 128
 
     @nn.compact
     def __call__(self, noise, labels_onehot=None):
         x = noise if labels_onehot is None else \
             jnp.concatenate([noise, labels_onehot], -1)
-        x = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc1")(x))
-        x = jax.nn.leaky_relu(nn.Dense(2 * self.hidden, name="fc2")(x))
-        x = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc3")(x))
+        x = nn.Dense(60, name="x2_input")(x)
+        x = jax.nn.relu(nn.Dense(128, name="fc1")(x))
+        x = jax.nn.relu(nn.Dense(256, name="fc2")(x))
+        x = jax.nn.relu(nn.Dense(128, name="fc3")(x))
         return nn.Dense(self.latent_size, name="out")(x)
 
 
 class LatentDiscriminator(nn.Module):
-    """latent → [real classes..., fake] logits (reference:
-    gans_model.py:37-99 cls_model — the discriminator doubles as the
-    class-conditional critic)."""
+    """latent → [real classes..., fake] logits. Reference CLS_Net
+    structure (gans_model.py:35-93): fc1 → 256, ReLU, fc2 → 64, dropout,
+    ReLU, out (we append one fake class for the adversarial target)."""
 
     cls_num: int = 2
-    hidden: int = 128
 
     @nn.compact
-    def __call__(self, z):
-        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc1")(z))
-        h = jax.nn.leaky_relu(nn.Dense(self.hidden, name="fc2")(h))
+    def __call__(self, z, deterministic=True):
+        h = jax.nn.relu(nn.Dense(256, name="fc1")(z))
+        h = nn.Dense(64, name="fc2")(h)
+        h = nn.Dropout(0.1)(h, deterministic=deterministic)
+        h = jax.nn.relu(h)
         return nn.Dense(self.cls_num + 1, name="out")(h)  # +1 = fake class
 
 
@@ -84,13 +85,18 @@ def gan_d_step(disc, d_params, gen, g_params, real_latents, real_labels,
     latents → the fake class."""
     batch = real_latents.shape[0]
     fake_cls = disc.cls_num
-    noise = jax.random.normal(rng, (batch, noise_size))
+    rng, nk, dk1, dk2 = jax.random.split(rng, 4)
+    noise = jax.random.normal(nk, (batch, noise_size))
     onehot = jax.nn.one_hot(real_labels, disc.cls_num)
     fake = gen.apply({"params": g_params}, noise, onehot)
 
     def loss_fn(p):
-        real_logits = disc.apply({"params": p}, real_latents)
-        fake_logits = disc.apply({"params": p}, fake)
+        real_logits = disc.apply({"params": p}, real_latents,
+                                 deterministic=False,
+                                 rngs={"dropout": dk1})
+        fake_logits = disc.apply({"params": p}, fake,
+                                 deterministic=False,
+                                 rngs={"dropout": dk2})
         return (_ce(real_logits, real_labels) +
                 _ce(fake_logits,
                     jnp.full((batch,), fake_cls, jnp.int32)))
@@ -103,12 +109,14 @@ def gan_g_step(disc, d_params, gen, g_params, labels, rng,
     """Generator update target: generated latents classified as their
     conditioning class (not fake)."""
     batch = labels.shape[0]
-    noise = jax.random.normal(rng, (batch, noise_size))
+    rng, nk, dk = jax.random.split(rng, 3)
+    noise = jax.random.normal(nk, (batch, noise_size))
     onehot = jax.nn.one_hot(labels, disc.cls_num)
 
     def loss_fn(p):
         fake = gen.apply({"params": p}, noise, onehot)
-        logits = disc.apply({"params": d_params}, fake)
+        logits = disc.apply({"params": d_params}, fake,
+                            deterministic=False, rngs={"dropout": dk})
         return _ce(logits, labels)
 
     return jax.value_and_grad(loss_fn)(g_params)
@@ -123,8 +131,8 @@ class GAVAEModel:
         self.config = config
         self.vae_model = vae_model or DAVAEModel(config.vae)
         self.vae_params = vae_params
-        self.gen = LatentGenerator(config.latent_size, config.gan_hidden)
-        self.disc = LatentDiscriminator(config.cls_num, config.gan_hidden)
+        self.gen = LatentGenerator(config.latent_size)
+        self.disc = LatentDiscriminator(config.cls_num)
         self.g_params = None
         self.d_params = None
 

@@ -1,0 +1,32 @@
+"""torch → jax weights for PPVAE (plug-in bottleneck VAE).
+
+Reference state-dict naming (fengshen/models/PPVAE/pluginVAE.py:86-92):
+`pluginvae.encoder.{fc1,fc2,mean,log_var}` +
+`pluginvae.decoder.{fc1,fc2,fc3}` over the frozen DAVAE
+(`vae_model.*`, imported separately via davae.convert).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from fengshen_tpu.utils.convert_common import (make_helpers, strip_prefix,
+                                               unwrap_lightning)
+
+
+def torch_to_params(state_dict: Mapping[str, Any]) -> dict:
+    """Returns the PluginVAE param tree (enc_fc1/enc_fc2/mean/log_var/
+    dec_fc1..3)."""
+    sd = unwrap_lightning(state_dict)
+    if any(k.startswith("pluginvae.") for k in sd):
+        sd = strip_prefix(sd, "pluginvae.")
+    _, lin, _ = make_helpers(sd)
+    return {
+        "enc_fc1": lin("encoder.fc1"),
+        "enc_fc2": lin("encoder.fc2"),
+        "mean": lin("encoder.mean"),
+        "log_var": lin("encoder.log_var"),
+        "dec_fc1": lin("decoder.fc1"),
+        "dec_fc2": lin("decoder.fc2"),
+        "dec_fc3": lin("decoder.fc3"),
+    }

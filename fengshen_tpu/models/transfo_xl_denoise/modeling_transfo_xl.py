@@ -189,13 +189,19 @@ class TransfoXLModel(nn.Module):
     """Word embeddings + relative transformer + tied output head
     (reference TransfoXLDenoiseModel :681-770). Returns (logits,
     new_mems); feed `mems` (list of [B, M, H], one per layer) for the XL
-    segment recurrence."""
+    segment recurrence.
+
+    With `latent_size > 0` the model is the reference's
+    GPT2ModelForLatent (DAVAE/GPT2ModelForLatent.py:500-575): `latent`
+    [B, latent_size] is projected by a bias-free `linear_emb` and added
+    after the embedding and after EVERY layer."""
 
     config: TransfoXLConfig
+    latent_size: int = 0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, mems=None,
-                 deterministic=True):
+                 latent=None, deterministic=True):
         cfg = self.config
         batch, qlen = input_ids.shape
         mem_len = mems[0].shape[1] if mems else 0
@@ -208,6 +214,12 @@ class TransfoXLModel(nn.Module):
                            cfg.initializer_range),
                        name="word_embeddings")
         hidden = wte(input_ids)
+        latent_emb = None
+        if self.latent_size > 0:
+            assert latent is not None, "latent_size>0 requires `latent`"
+            latent_emb = nn.Dense(cfg.hidden_size, use_bias=False,
+                                  name="linear_emb")(latent)[:, None, :]
+            hidden = hidden + latent_emb.astype(hidden.dtype)
 
         # causal mask over memory+current keys: query i attends keys
         # <= mem_len + i; multiplied by any padding mask
@@ -249,6 +261,8 @@ class TransfoXLModel(nn.Module):
             hidden = XLLayer(cfg, name=f"layer_{i}")(
                 hidden, ltor, pos_emb, r_w_bias, r_r_bias, mem_i,
                 deterministic)
+            if latent_emb is not None:
+                hidden = hidden + latent_emb.astype(hidden.dtype)
         hidden = nn.LayerNorm(epsilon=cfg.layernorm_epsilon,
                               dtype=jnp.dtype(cfg.dtype),
                               name="final_layernorm")(hidden)

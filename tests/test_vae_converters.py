@@ -1,0 +1,311 @@
+"""VAE-family importer tests (VERDICT r2 item 3): davae, ppvae, gavae,
+deepvae. Oracles: HF towers from transformers where the reference uses
+them, numpy/torch restatements of the reference head math elsewhere.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from test_transfo_xl_convert import (_layer as xl_layer,  # noqa: E402
+                                     _ln, _pos_emb, _sd as xl_sd,
+                                     H, NH, NL, V)
+
+
+# --------------------------------------------------------------- davae --
+
+def test_davae_convert_forward_parity():
+    """Reference DAVAE (DAVAEModel.py:35-140): bert pooled → bias-free
+    linear posterior; GLM relative decoder with latent injected after the
+    embedding and after every layer; tied logits."""
+    import jax.numpy as jnp
+    from transformers import BertConfig as HFBertConfig
+    from transformers import BertModel as HFBert
+
+    from fengshen_tpu.models.bert.modeling_bert import BertConfig
+    from fengshen_tpu.models.davae.convert import torch_to_params
+    from fengshen_tpu.models.davae.modeling_davae import (DAVAEConfig,
+                                                          DAVAEModel)
+    from fengshen_tpu.models.gpt2 import GPT2Config
+
+    LAT = 4
+    torch.manual_seed(0)
+    enc = HFBert(HFBertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2)).eval()
+    linear = torch.nn.Linear(32, 2 * LAT, bias=False)
+
+    dec_sd = xl_sd()  # reference-named GLM decoder weights
+    rng = np.random.RandomState(9)
+    linear_emb = rng.randn(H, LAT).astype(np.float32) * 0.1
+
+    sd = {f"vae_model.encoder.{k}": v for k, v in enc.state_dict().items()}
+    sd["vae_model.encoder.linear.weight"] = linear.weight
+    for k, v in dec_sd.items():
+        sd[f"vae_model.decoder.{k}"] = v
+    sd["vae_model.decoder.transformer.linear_emb.weight"] = linear_emb
+
+    cfg = DAVAEConfig(
+        latent_size=LAT, relative_decoder=True,
+        encoder=BertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32, type_vocab_size=2,
+            dtype="float32"),
+        decoder=GPT2Config(vocab_size=V, n_embd=H, n_layer=NL, n_head=NH,
+                           n_positions=32, dtype="float32"))
+    params = torch_to_params(sd, cfg)
+    model = DAVAEModel(cfg)
+
+    ids = np.random.RandomState(1).randint(0, 64, (2, 8))
+    dec_ids = np.random.RandomState(2).randint(0, V, (2, 6))
+    logits, mean, logvar, latent = model.apply(
+        {"params": params}, jnp.asarray(ids),
+        decoder_input_ids=jnp.asarray(dec_ids))
+
+    with torch.no_grad():
+        pooled = enc(torch.tensor(ids, dtype=torch.long)).pooler_output
+        stats = linear(pooled).numpy()
+    ref_mean, ref_logvar = stats[:, :LAT], stats[:, LAT:]
+    np.testing.assert_allclose(np.asarray(mean), ref_mean, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(logvar), ref_logvar, atol=2e-4)
+
+    # decoder oracle: xl layers + latent injection (GPT2ModelForLatent
+    # :500-575), tied logits
+    lat_emb = (ref_mean @ linear_emb.T)[:, None, :]
+    hidden = dec_sd["word_embeddings.weight"][dec_ids] + lat_emb
+    qlen = dec_ids.shape[1]
+    ltor = np.tril(np.ones((qlen, qlen), np.float32))[None, None]
+    pos = _pos_emb(qlen)
+    for i in range(NL):
+        hidden = xl_layer(dec_sd, i, hidden, ltor, pos) + lat_emb
+    hidden = _ln(hidden, dec_sd["transformer.final_layernorm.weight"],
+                 dec_sd["transformer.final_layernorm.bias"])
+    ref_logits = hidden @ dec_sd["word_embeddings.weight"].T
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=5e-4)
+
+
+def test_davae_critic_convert():
+    from fengshen_tpu.models.davae.convert import critic_to_params
+    from fengshen_tpu.models.davae.modeling_davae import LatentCritic
+    import jax.numpy as jnp
+
+    LAT = 4
+    rng = np.random.RandomState(3)
+    sd = {
+        "vae_model.Disc.0.weight": rng.randn(4 * LAT, LAT).astype(
+            np.float32),
+        "vae_model.Disc.0.bias": rng.randn(4 * LAT).astype(np.float32),
+        "vae_model.Disc.2.weight": rng.randn(1, 4 * LAT).astype(
+            np.float32),
+        "vae_model.Disc.2.bias": rng.randn(1).astype(np.float32),
+    }
+    params = critic_to_params(sd)
+    z = rng.randn(3, LAT).astype(np.float32)
+    out = LatentCritic(hidden=4 * LAT).apply({"params": params},
+                                             jnp.asarray(z))
+    h = np.maximum(z @ sd["vae_model.Disc.0.weight"].T +
+                   sd["vae_model.Disc.0.bias"], 0)
+    ref = h @ sd["vae_model.Disc.2.weight"].T + sd["vae_model.Disc.2.bias"]
+    np.testing.assert_allclose(np.asarray(out), ref[:, 0], atol=1e-5)
+
+
+# --------------------------------------------------------------- ppvae --
+
+def test_ppvae_convert_forward_parity():
+    """PluginVAE bottleneck (pluginVAE.py:13-78): leaky-relu enc/dec
+    MLPs; deterministic path uses the mean."""
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.ppvae.convert import torch_to_params
+    from fengshen_tpu.models.ppvae.modeling_ppvae import PluginVAE
+
+    LD, BD = 16, 4
+    rng = np.random.RandomState(5)
+
+    def lin(i, o):
+        return (rng.randn(o, i).astype(np.float32) * 0.3,
+                rng.randn(o).astype(np.float32) * 0.1)
+
+    names = {"encoder.fc1": lin(LD, LD // 2),
+             "encoder.fc2": lin(LD // 2, LD // 4),
+             "encoder.mean": lin(LD // 4, BD),
+             "encoder.log_var": lin(LD // 4, BD),
+             "decoder.fc1": lin(BD, LD // 4),
+             "decoder.fc2": lin(LD // 4, LD // 2),
+             "decoder.fc3": lin(LD // 2, LD)}
+    sd = {}
+    for n, (w, b) in names.items():
+        sd[f"pluginvae.{n}.weight"] = w
+        sd[f"pluginvae.{n}.bias"] = b
+
+    params = torch_to_params(sd)
+    z = rng.randn(3, LD).astype(np.float32)
+    out, kl = PluginVAE(latent_dim=LD, bottle_dim=BD).apply(
+        {"params": params}, jnp.asarray(z))
+
+    def leaky(x):
+        return np.where(x > 0, x, 0.01 * x)
+
+    def ln_np(x, name):
+        w, b = names[name]
+        return x @ w.T + b
+
+    h = leaky(ln_np(z, "encoder.fc1"))
+    h = leaky(ln_np(h, "encoder.fc2"))
+    mean = ln_np(h, "encoder.mean")
+    d = leaky(ln_np(mean, "decoder.fc1"))
+    d = leaky(ln_np(d, "decoder.fc2"))
+    ref = ln_np(d, "decoder.fc3")
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+# --------------------------------------------------------------- gavae --
+
+def test_gavae_net_converts():
+    """Gen_Net / CLS_Net (gans_model.py): relu chains with the reference
+    dims; discriminator gains a zero fake-class row."""
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.gavae.convert import (cls_to_params,
+                                                   gen_to_params)
+    from fengshen_tpu.models.gavae.modeling_gavae import (
+        LatentDiscriminator, LatentGenerator)
+
+    LAT, IN = 6, 10
+    rng = np.random.RandomState(6)
+
+    def lin(i, o):
+        return (rng.randn(o, i).astype(np.float32) * 0.2,
+                rng.randn(o).astype(np.float32) * 0.1)
+
+    gen_layers = {"x2_input": lin(IN, 60), "fc1": lin(60, 128),
+                  "fc2": lin(128, 256), "fc3": lin(256, 128),
+                  "out": lin(128, LAT)}
+    sd = {}
+    for n, (w, b) in gen_layers.items():
+        sd[f"{n}.weight"] = w
+        sd[f"{n}.bias"] = b
+    params = gen_to_params(sd)
+    x = rng.randn(3, IN).astype(np.float32)
+    out = LatentGenerator(LAT).apply({"params": params}, jnp.asarray(x))
+
+    def fwd(x):
+        h = x @ gen_layers["x2_input"][0].T + gen_layers["x2_input"][1]
+        for n in ("fc1", "fc2", "fc3"):
+            h = np.maximum(h @ gen_layers[n][0].T + gen_layers[n][1], 0)
+        return h @ gen_layers["out"][0].T + gen_layers["out"][1]
+
+    np.testing.assert_allclose(np.asarray(out), fwd(x), atol=1e-5)
+
+    cls_layers = {"fc1": lin(LAT, 256), "fc2": lin(256, 64),
+                  "out": lin(64, 2)}
+    sd = {}
+    for n, (w, b) in cls_layers.items():
+        sd[f"{n}.weight"] = w
+        sd[f"{n}.bias"] = b
+    params = cls_to_params(sd)
+    z = rng.randn(3, LAT).astype(np.float32)
+    logits = LatentDiscriminator(cls_num=2).apply({"params": params},
+                                                  jnp.asarray(z))
+    assert logits.shape == (3, 3)
+    h = np.maximum(z @ cls_layers["fc1"][0].T + cls_layers["fc1"][1], 0)
+    h = np.maximum(h @ cls_layers["fc2"][0].T + cls_layers["fc2"][1], 0)
+    ref = h @ cls_layers["out"][0].T + cls_layers["out"][1]
+    np.testing.assert_allclose(np.asarray(logits[:, :2]), ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits[:, 2]), 0.0, atol=1e-6)
+
+
+# ------------------------------------------------------------- deepvae --
+
+def test_della_convert_forward_parity():
+    """Della end-to-end vs a torch oracle built from HF GPT2 towers plus
+    the reference latent flow (deep_vae.py:111-139, latent_connector.py:
+    155-180): separate enc/dec towers, per-layer pooling on HF
+    hidden_states[1:], bias-free posterior/prior nets, tanh latent
+    combiner, injection before each decoder block, untied lm_head."""
+    import jax.numpy as jnp
+    from transformers import GPT2Config as HFGPT2Config
+    from transformers import GPT2Model as HFGPT2
+
+    from fengshen_tpu.models.deepvae.convert import torch_to_params
+    from fengshen_tpu.models.deepvae.modeling_deepvae import (DellaConfig,
+                                                              DellaModel)
+    from fengshen_tpu.models.gpt2 import GPT2Config
+
+    LAT, HID, L = 4, 24, 2
+    hf_cfg = HFGPT2Config(vocab_size=48, n_positions=32, n_embd=HID,
+                          n_layer=L, n_head=4)
+    torch.manual_seed(8)
+    enc = HFGPT2(hf_cfg).eval()
+    dec = HFGPT2(hf_cfg).eval()
+    lm_head = torch.nn.Linear(HID, 48, bias=False)
+    linear_embs = [torch.nn.Linear(LAT, HID, bias=False)
+                   for _ in range(L)]
+    post_nets = [torch.nn.Linear(HID + LAT, 2 * LAT, bias=False)
+                 for _ in range(L)]
+    prior_nets = [torch.nn.Linear(LAT, 2 * LAT, bias=False)
+                  for _ in range(L)]
+    w_hh = [torch.nn.Linear(LAT, LAT, bias=False) for _ in range(L - 1)]
+    w_ih = [torch.nn.Linear(LAT, LAT, bias=False) for _ in range(L - 1)]
+    pool_w = [torch.randn(HID) * 0.02 for _ in range(L)]
+
+    sd = {}
+    for k, v in enc.state_dict().items():
+        sd[f"encoder.transformer.{k}"] = v
+    for k, v in dec.state_dict().items():
+        sd[f"decoder.transformer.{k}"] = v
+    sd["decoder.lm_head.weight"] = lm_head.weight
+    for i in range(L):
+        sd[f"decoder.transformer.linear_emb_layers.{i}.weight"] = \
+            linear_embs[i].weight
+        sd[f"posterior_nets.{i}.weight"] = post_nets[i].weight
+        sd[f"prior_nets.{i}.weight"] = prior_nets[i].weight
+        sd[f"pooling.{i}.attention_weights"] = pool_w[i]
+    for i in range(L - 1):
+        sd[f"latent_nets.{i}.W_hh.weight"] = w_hh[i].weight
+        sd[f"latent_nets.{i}.W_ih.weight"] = w_ih[i].weight
+
+    cfg = DellaConfig(latent_dim=LAT,
+                      gpt2=GPT2Config(vocab_size=48, n_positions=32,
+                                      n_embd=HID, n_layer=L, n_head=4,
+                                      dtype="float32"))
+    params = torch_to_params(sd, cfg)
+    model = DellaModel(cfg)
+    ids = np.random.RandomState(10).randint(0, 48, (2, 7))
+    logits, posts, priors = model.apply({"params": params},
+                                        jnp.asarray(ids))
+
+    with torch.no_grad():
+        tids = torch.tensor(ids, dtype=torch.long)
+        enc_out = enc(tids, output_hidden_states=True)
+        layer_states = enc_out.hidden_states[1:]  # block outs, last ln_f'd
+        z = torch.zeros(2, LAT)
+        zs = []
+        ref_posts = []
+        for i in range(L):
+            scores = torch.softmax(
+                torch.tanh(layer_states[i] @ pool_w[i]), -1)
+            rep = (layer_states[i] * scores[..., None]).sum(1)
+            stats = post_nets[i](torch.cat([rep, z], -1))
+            mean = stats[:, :LAT]
+            zs.append(mean)
+            ref_posts.append(stats)
+            if i < L - 1:
+                z = torch.tanh(w_hh[i](z) + w_ih[i](mean))
+        # decoder with injection BEFORE each block
+        pos = torch.arange(ids.shape[1])[None]
+        hs = dec.wte(tids) + dec.wpe(pos)
+        for i in range(L):
+            hs = hs + linear_embs[i](zs[i])[:, None, :]
+            hs = dec.h[i](hs)[0]
+        hs = dec.ln_f(hs)
+        ref_logits = lm_head(hs).numpy()
+
+    for i in range(L):
+        got = np.concatenate([np.asarray(posts[i][0]),
+                              np.asarray(posts[i][1])], -1)
+        np.testing.assert_allclose(got, ref_posts[i].numpy(), atol=3e-4)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=2e-3)
