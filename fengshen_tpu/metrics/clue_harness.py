@@ -1,0 +1,175 @@
+"""End-to-end FewCLUE/ZeroCLUE quality harness for UniMC (VERDICT r2 #5).
+
+One command takes a LOCAL UniMC checkpoint directory in the reference's
+own format (config.json + pytorch_model.bin / Lightning .ckpt with the
+HF MegatronBert naming, plus tokenizer files), imports it with
+fengshen_tpu.models.unimc.convert, runs the CLUE task evals, and prints
+the comparison table against the published UniMC-MegatronBERT-1.3B
+numbers (reference: fengshen/examples/unimc/README.md:107-131 —
+few-shot avg 72.05, zero-shot avg 64.53).
+
+    python -m fengshen_tpu.metrics.clue_harness \
+        --checkpoint /path/to/Erlangshen-UniMC-MegatronBERT-1.3B-Chinese \
+        --data_dir /path/to/fewclue_unimc_json --split test_public
+
+`data_dir` holds one `<task>.json(l)` per task, each line in the UniMC
+data format (README.md:135-176): {texta, textb, question, choice,
+label}. The encoding below replicates the reference UniMCDataset exactly
+(modeling_unimc.py:140-231): '[MASK]'-joined options, block-diagonal
+option attention, option-wise position restarts, yes-token scoring at
+the option mask positions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+# published UniMC-MegatronBERT-1.3B rows (README.md:107-131)
+PUBLISHED = {
+    "few_shot": {
+        "eprstmt": 89.278, "csldcp": 60.9, "tnews": 57.46,
+        "iflytek": 52.89, "ocnli": 76.33, "bustm": 80.37, "chid": 90.33,
+        "csl": 61.73, "wsc": 79.15, "avg": 72.05},
+    "zero_shot": {
+        "eprstmt": 88.79, "csldcp": 42.06, "tnews": 55.21,
+        "iflytek": 33.93, "ocnli": 75.57, "bustm": 79.5, "chid": 89.4,
+        "csl": 50.25, "wsc": 66.67, "avg": 64.53},
+}
+
+
+from fengshen_tpu.models.unimc.modeling_unimc import (collate_unimc,
+                                                      encode_unimc)
+
+
+def load_unimc_checkpoint(ckpt_dir: str):
+    """Reference-format dir → (UniMCModel, params, tokenizer)."""
+    import torch
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.models.unimc.convert import torch_to_params
+    from fengshen_tpu.models.unimc.modeling_unimc import UniMCModel
+    from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                                   unwrap_lightning)
+
+    config = MegatronBertConfig.from_pretrained(ckpt_dir)
+    state: dict = {}
+    for name in ("pytorch_model.bin", "model.ckpt", "last.ckpt"):
+        path = os.path.join(ckpt_dir, name)
+        if os.path.exists(path):
+            state = torch.load(path, map_location="cpu",
+                               weights_only=False)
+            break
+    if not state:
+        raise FileNotFoundError(
+            f"no pytorch_model.bin / *.ckpt under {ckpt_dir}")
+    backbone_type = detect_bert_arch(unwrap_lightning(state))
+    params = torch_to_params(state, config, backbone_type=backbone_type)
+    tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)
+    yes_id = tokenizer.convert_tokens_to_ids("是")
+    if yes_id is None or yes_id == tokenizer.unk_token_id:
+        raise ValueError(
+            f"tokenizer in {ckpt_dir} has no '是' token — yes-token "
+            "scoring would silently read the [UNK] column")
+    model = UniMCModel(config, yes_token_id=yes_id,
+                       backbone_type=backbone_type)
+    return model, params, tokenizer
+
+
+def evaluate_task(model, params, items: list[dict], tokenizer,
+                  batch_size: int = 8, max_length: int = 512) -> float:
+    import jax.numpy as jnp
+
+    correct = total = 0
+    for i in range(0, len(items), batch_size):
+        chunk = [encode_unimc(it, tokenizer, max_length)
+                 for it in items[i:i + batch_size]]
+        batch = collate_unimc(chunk)
+        scores = model.apply(
+            {"params": params}, jnp.asarray(batch["input_ids"]),
+            attention_mask=jnp.asarray(batch["attention_mask"]),
+            token_type_ids=jnp.asarray(batch["token_type_ids"]),
+            option_positions=jnp.asarray(batch["option_positions"]),
+            position_ids=jnp.asarray(batch["position_ids"]))
+        scores = np.asarray(scores) + (batch["option_mask"] - 1) * 1e4
+        pred = scores.argmax(-1)
+        correct += int((pred == batch["labels"]).sum())
+        total += len(chunk)
+    return 100.0 * correct / max(total, 1)
+
+
+def load_task_file(data_dir: str, task: str, split: str) -> list[dict]:
+    for name in (f"{task}.jsonl", f"{task}.json",
+                 os.path.join(task, f"{split}.json"),
+                 os.path.join(task, f"{split}.jsonl")):
+        path = os.path.join(data_dir, name)
+        if os.path.exists(path):
+            with open(path) as f:
+                text = f.read().strip()
+            if text.startswith("["):
+                return json.loads(text)
+            return [json.loads(line) for line in text.splitlines()
+                    if line.strip()]
+    return []
+
+
+def run(checkpoint: str, data_dir: str, split: str = "test_public",
+        mode: str = "zero_shot", tasks: Optional[list[str]] = None,
+        batch_size: int = 8, max_length: int = 512,
+        model_params_tok: Optional[tuple] = None) -> dict:
+    """Returns {task: accuracy}; prints the comparison table."""
+    if model_params_tok is not None:
+        model, params, tokenizer = model_params_tok
+    else:
+        model, params, tokenizer = load_unimc_checkpoint(checkpoint)
+    published = PUBLISHED[mode]
+    tasks = tasks or [t for t in published if t != "avg"]
+    results: dict[str, Any] = {}
+    for task in tasks:
+        items = load_task_file(data_dir, task, split)
+        if not items:
+            print(f"[clue-harness] {task}: no data file, skipped")
+            continue
+        results[task] = evaluate_task(model, params, items, tokenizer,
+                                      batch_size, max_length)
+    if results:
+        results["avg"] = float(np.mean([results[t] for t in results]))
+
+    header = f"{'task':10s} {'ours':>8s} {'published':>10s} {'delta':>8s}"
+    print(header)
+    print("-" * len(header))
+    for task, acc in results.items():
+        pub = published.get(task)
+        delta = f"{acc - pub:+8.2f}" if pub is not None else "       -"
+        pub_s = f"{pub:10.2f}" if pub is not None else "         -"
+        print(f"{task:10s} {acc:8.2f} {pub_s} {delta}")
+    return results
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="UniMC FewCLUE/ZeroCLUE quality harness")
+    parser.add_argument("--checkpoint", required=True,
+                        help="reference-format UniMC checkpoint dir")
+    parser.add_argument("--data_dir", required=True,
+                        help="dir of <task>.json(l) files in UniMC format")
+    parser.add_argument("--split", default="test_public")
+    parser.add_argument("--mode", default="zero_shot",
+                        choices=["few_shot", "zero_shot"])
+    parser.add_argument("--tasks", default=None,
+                        help="comma-separated subset")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--max_length", type=int, default=512)
+    args = parser.parse_args(argv)
+    tasks = args.tasks.split(",") if args.tasks else None
+    run(args.checkpoint, args.data_dir, args.split, args.mode, tasks,
+        args.batch_size, args.max_length)
+
+
+if __name__ == "__main__":
+    main()

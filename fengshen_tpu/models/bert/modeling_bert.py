@@ -101,7 +101,11 @@ class BertLayer(nn.Module):
         v = v.reshape(batch, seq, n_head, head_dim)
         mask = None
         if attention_mask is not None:
-            mask = attention_mask[:, None, None, :].astype(bool)
+            if attention_mask.ndim == 3:
+                # per-sample [B, S, S] mask (UniMC block-diagonal options)
+                mask = attention_mask[:, None].astype(bool)
+            else:
+                mask = attention_mask[:, None, None, :].astype(bool)
         drop_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0:
             drop_rng = self.make_rng("dropout")
@@ -173,10 +177,11 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic=True, return_hidden=False):
+                 position_ids=None, deterministic=True,
+                 return_hidden=False):
         cfg = self.config
         hidden, _ = BertModel(cfg, add_pooling_layer=False, name="bert")(
-            input_ids, attention_mask, token_type_ids,
+            input_ids, attention_mask, token_type_ids, position_ids,
             deterministic=deterministic)
         h = _dense(cfg, cfg.hidden_size, "transform_dense")(hidden)
         h = get_activation(cfg.hidden_act)(h)

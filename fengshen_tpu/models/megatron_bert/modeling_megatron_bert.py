@@ -79,7 +79,13 @@ class MegatronBertSelfAttention(nn.Module):
         v = v.reshape(batch, seq, n_head, head_dim)
         mask = None
         if attention_mask is not None:
-            mask = attention_mask[:, None, None, :].astype(bool)
+            if attention_mask.ndim == 3:
+                # per-sample [B, S, S] mask (UniMC's block-diagonal option
+                # masking, reference: fengshen/models/unimc/
+                # modeling_unimc.py:92-113 get_att_mask)
+                mask = attention_mask[:, None].astype(bool)
+            else:
+                mask = attention_mask[:, None, None, :].astype(bool)
         drop_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
             drop_rng = self.make_rng("dropout")

@@ -37,13 +37,19 @@ class UniMCModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 option_positions=None, deterministic=True):
+                 option_positions=None, position_ids=None,
+                 deterministic=True):
         """option_positions: [B, n_options] indices of each option's mask
-        token. Returns per-option scores [B, n_options]."""
+        token. Returns per-option scores [B, n_options].
+
+        attention_mask may be [B, S] (padding) or [B, S, S] (the
+        reference's block-diagonal option masking); position_ids carry
+        the reference's option-wise position restarts (megatron backbone
+        only — reference: modeling_unimc.py:73-113)."""
         from fengshen_tpu.models.towers import mlm_tower
         logits = mlm_tower(self.config, self.backbone_type)(
             input_ids, attention_mask, token_type_ids,
-            deterministic=deterministic)
+            position_ids=position_ids, deterministic=deterministic)
         if option_positions is None:
             return logits
         # score of the yes-token at each option mask position
@@ -58,6 +64,96 @@ class UniMCModel(nn.Module):
         from fengshen_tpu.models.megatron_bert.modeling_megatron_bert \
             import PARTITION_RULES
         return PARTITION_RULES
+
+
+def encode_unimc(item: dict, tokenizer, max_length: int = 512) -> dict:
+    """THE UniMC encoding, shared by training, predict, and the CLUE
+    harness — a faithful restatement of the reference UniMCDataset.encode
+    (modeling_unimc.py:140-231, minus the MLM corruption): '[MASK]'-joined
+    options, block-diagonal option attention, option-wise position
+    restarts, yes-token scoring positions. Accepts the reference data
+    format ({texta, textb, question, choice, label}) and the legacy
+    `choices` key."""
+    choice = list(item.get("choice") or item.get("choices") or [])
+    while len(tokenizer.encode("[MASK]".join(choice))) > max_length - 32 \
+            and any(len(c) > 1 for c in choice):
+        choice = [c[: max(len(c) // 2, 1)] for c in choice]
+
+    texta = "[MASK]" + "[MASK]".join(choice)
+    if item.get("question"):
+        texta += "[SEP]" + item["question"]
+    texta += "[SEP]" + item.get("texta", "")
+    if item.get("textb"):
+        texta += "[SEP]" + item["textb"]
+
+    enc = tokenizer.encode_plus(texta, max_length=max_length,
+                                truncation="longest_first")
+    ids = enc["input_ids"]
+    n = len(ids)
+
+    question_len = 1
+    label_idx = [question_len]
+    for c in choice:
+        label_idx.append(label_idx[-1] + 1 + len(
+            tokenizer.encode(c, add_special_tokens=False)))
+
+    # block-diagonal option attention (reference get_att_mask :92-113):
+    # options cannot see each other; everything else attends fully
+    att = np.ones((n, n), np.int32)
+    lo, hi = question_len, min(label_idx[-1], n)
+    att[lo:hi, lo:hi] = 0
+    for i in range(len(label_idx) - 1):
+        a, b = min(label_idx[i], n), min(label_idx[i + 1], n)
+        att[a:b, a:b] = 1
+
+    # option-wise position restarts (reference get_position_ids :73-90)
+    pos = list(range(question_len))
+    for i in range(len(label_idx) - 1):
+        pos.extend(range(question_len,
+                         question_len + label_idx[i + 1] - label_idx[i]))
+    start = max(pos) + 1 if pos else 1
+    pos.extend(range(start, start + max(n - label_idx[-1], 0)))
+    pos = [min(p, 511) for p in (pos + [511] * n)[:n]]
+
+    token_type = [0] * question_len + [1] * (label_idx[-1] - label_idx[0]
+                                             + 1)
+    token_type = (token_type + [0] * n)[:n]
+
+    ids = np.asarray(ids)
+    opt_pos = [p for p in label_idx[:-1] if p < n]
+    ids[opt_pos] = tokenizer.mask_token_id
+    label = item.get("label")
+    return {"input_ids": ids, "attention_mask": att,
+            "token_type_ids": np.asarray(token_type),
+            "position_ids": np.asarray(pos),
+            "option_positions": opt_pos,
+            "label": int(label) if label is not None else -100}
+
+
+def collate_unimc(encoded: list[dict]) -> dict:
+    """Pad a list of encode_unimc outputs into one batch (2-D per-sample
+    attention masks, option_mask marking real options)."""
+    max_len = max(len(e["input_ids"]) for e in encoded)
+    n_opt = max(len(e["option_positions"]) for e in encoded)
+    batch = {k: [] for k in ("input_ids", "attention_mask",
+                             "token_type_ids", "position_ids",
+                             "option_positions", "option_mask", "labels")}
+    for e in encoded:
+        n = len(e["input_ids"])
+        p = max_len - n
+        batch["input_ids"].append(np.pad(e["input_ids"], (0, p)))
+        att = np.zeros((max_len, max_len), np.int32)
+        att[:n, :n] = e["attention_mask"]
+        batch["attention_mask"].append(att)
+        batch["token_type_ids"].append(np.pad(e["token_type_ids"],
+                                              (0, p)))
+        batch["position_ids"].append(np.pad(e["position_ids"], (0, p)))
+        opts = e["option_positions"]
+        batch["option_positions"].append(opts + [0] * (n_opt - len(opts)))
+        batch["option_mask"].append([1] * len(opts) +
+                                    [0] * (n_opt - len(opts)))
+        batch["labels"].append(e["label"])
+    return {k: np.asarray(v) for k, v in batch.items()}
 
 
 class UniMCPipelines:
@@ -102,42 +198,11 @@ class UniMCPipelines:
         self.params = params
 
     def _encode(self, sample: dict) -> dict:
-        """sample: {texta, choices: [...], label?}. Layout:
-        [CLS] [MASK] opt1 [SEP] [MASK] opt2 [SEP] ... text [SEP]"""
-        tok = self.tokenizer
-        ids = [tok.cls_token_id]
-        option_positions = []
-        for choice in sample["choices"]:
-            option_positions.append(len(ids))
-            ids.append(tok.mask_token_id)
-            ids.extend(tok.encode(choice, add_special_tokens=False))
-            ids.append(tok.sep_token_id)
-        ids.extend(tok.encode(sample.get("texta", ""),
-                              add_special_tokens=False))
-        ids.append(tok.sep_token_id)
         max_len = getattr(self.args, "max_length", 512) if self.args else 512
-        ids = ids[:max_len]
-        return {"input_ids": ids, "option_positions": option_positions,
-                "label": sample.get("label")}
+        return encode_unimc(sample, self.tokenizer, max_len)
 
     def _collate(self, samples: list[dict]) -> dict:
-        encoded = [self._encode(s) for s in samples]
-        max_len = max(len(e["input_ids"]) for e in encoded)
-        n_opt = max(len(e["option_positions"]) for e in encoded)
-        pad = self.tokenizer.pad_token_id or 0
-        batch = {"input_ids": [], "attention_mask": [],
-                 "option_positions": [], "labels": []}
-        for e in encoded:
-            p = max_len - len(e["input_ids"])
-            batch["input_ids"].append(e["input_ids"] + [pad] * p)
-            batch["attention_mask"].append([1] * len(e["input_ids"]) +
-                                           [0] * p)
-            opts = e["option_positions"] + [0] * (
-                n_opt - len(e["option_positions"]))
-            batch["option_positions"].append(opts)
-            batch["labels"].append(e["label"] if e["label"] is not None
-                                   else -100)
-        return {k: np.asarray(v) for k, v in batch.items()}
+        return collate_unimc([self._encode(s) for s in samples])
 
     def train(self, train_data: list[dict],
               dev_data: Optional[list[dict]] = None) -> None:
@@ -162,8 +227,11 @@ class UniMCPipelines:
                 scores = self.model.apply(
                     {"params": params}, batch["input_ids"],
                     attention_mask=batch["attention_mask"],
+                    token_type_ids=batch["token_type_ids"],
                     option_positions=batch["option_positions"],
+                    position_ids=batch["position_ids"],
                     deterministic=False, rngs={"dropout": rng})
+                scores = scores + (batch["option_mask"] - 1.0) * 1e4
                 loss, _ = stable_cross_entropy(scores[:, None, :],
                                                batch["labels"][:, None])
                 acc = (scores.argmax(-1) == batch["labels"]).mean()
@@ -205,6 +273,10 @@ class UniMCPipelines:
             {"params": self.params},
             jnp.asarray(batch["input_ids"], jnp.int32),
             attention_mask=jnp.asarray(batch["attention_mask"], jnp.int32),
+            token_type_ids=jnp.asarray(batch["token_type_ids"],
+                                       jnp.int32),
             option_positions=jnp.asarray(batch["option_positions"],
-                                         jnp.int32))
-        return [int(x) for x in np.asarray(scores.argmax(-1))]
+                                         jnp.int32),
+            position_ids=jnp.asarray(batch["position_ids"], jnp.int32))
+        scores = np.asarray(scores) + (batch["option_mask"] - 1.0) * 1e4
+        return [int(x) for x in scores.argmax(-1)]

@@ -1,0 +1,132 @@
+"""Dry-run of the FewCLUE/ZeroCLUE quality harness (VERDICT r2 #5):
+a randomly-initialized checkpoint WRITTEN IN THE REFERENCE'S OWN FORMAT
+(HF MegatronBertForMaskedLM state dict + config.json + tokenizer files)
+goes through load → convert → task eval → comparison table, end to end.
+The day a published checkpoint is reachable, parity is one command.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _make_reference_checkpoint(tmp_path):
+    """Reference-format UniMC checkpoint dir with a tiny random model."""
+    from transformers import BertTokenizer
+    from transformers import MegatronBertConfig as HFCfg
+    from transformers import MegatronBertForMaskedLM as HFMLM
+
+    chars = list("今天天气很好我们去公园吧然后回家机器学习模型训练数据中文"
+                 "测试句子北京是的首都问题答案好评差评体育军事财经科技否")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + \
+        sorted(set(chars))
+    ckpt = tmp_path / "unimc_ckpt"
+    ckpt.mkdir()
+    (ckpt / "vocab.txt").write_text("\n".join(vocab))
+    BertTokenizer(str(ckpt / "vocab.txt")).save_pretrained(str(ckpt))
+
+    hf_cfg = HFCfg(vocab_size=len(vocab), hidden_size=32,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   intermediate_size=64, max_position_embeddings=64,
+                   type_vocab_size=2)
+    torch.manual_seed(0)
+    model = HFMLM(hf_cfg)
+    # the reference UniMCModel holds the MLM tower under attr `bert`
+    sd = {f"bert.{k}": v for k, v in model.state_dict().items()}
+    torch.save(sd, ckpt / "pytorch_model.bin")
+    (ckpt / "config.json").write_text(json.dumps({
+        "vocab_size": len(vocab), "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 64,
+        "type_vocab_size": 2, "dtype": "float32",
+        "model_type": "megatron-bert"}))
+    return ckpt
+
+
+def _make_task_files(tmp_path):
+    data = tmp_path / "clue_data"
+    data.mkdir()
+    rows = [
+        {"texta": "今天天气很好", "textb": "", "question": "",
+         "choice": ["这是一条好评", "这是一条差评"], "label": 0},
+        {"texta": "机器学习模型", "textb": "", "question": "",
+         "choice": ["这是一条好评", "这是一条差评"], "label": 1},
+        {"texta": "北京是中国的首都", "textb": "",
+         "question": "下面句子的类别是",
+         "choice": ["体育", "军事", "财经"], "label": 2},
+    ]
+    for task in ("eprstmt", "tnews"):
+        with open(data / f"{task}.jsonl", "w") as f:
+            for r in rows:
+                f.write(json.dumps(r, ensure_ascii=False) + "\n")
+    return data
+
+
+def test_clue_harness_end_to_end(tmp_path, capsys):
+    from fengshen_tpu.metrics.clue_harness import run
+
+    ckpt = _make_reference_checkpoint(tmp_path)
+    data = _make_task_files(tmp_path)
+    results = run(str(ckpt), str(data), mode="zero_shot",
+                  tasks=["eprstmt", "tnews"], batch_size=2,
+                  max_length=64)
+    assert set(results) == {"eprstmt", "tnews", "avg"}
+    for v in results.values():
+        assert 0.0 <= v <= 100.0
+    out = capsys.readouterr().out
+    assert "published" in out and "eprstmt" in out
+    # the table compares against the published zero-shot row
+    assert "88.79" in out
+
+
+def test_unimc_reference_scoring_matches_torch(tmp_path):
+    """The harness encoding (block-diagonal mask + position restarts +
+    yes-token scoring) must reproduce the reference UniMCModel.forward
+    (modeling_unimc.py:297-345) on the converted weights."""
+    from fengshen_tpu.metrics.clue_harness import (collate_unimc,
+                                                   encode_unimc,
+                                                   load_unimc_checkpoint)
+
+    ckpt = _make_reference_checkpoint(tmp_path)
+    model, params, tokenizer = load_unimc_checkpoint(str(ckpt))
+
+    item = {"texta": "今天天气很好", "textb": "", "question": "",
+            "choice": ["好评", "差评"], "label": 0}
+    enc = encode_unimc(item, tokenizer, max_length=64)
+    batch = collate_unimc([enc])
+
+    import jax.numpy as jnp
+    scores = model.apply(
+        {"params": params}, jnp.asarray(batch["input_ids"]),
+        attention_mask=jnp.asarray(batch["attention_mask"]),
+        token_type_ids=jnp.asarray(batch["token_type_ids"]),
+        option_positions=jnp.asarray(batch["option_positions"]),
+        position_ids=jnp.asarray(batch["position_ids"]))
+
+    # torch oracle: reference forward = MLM logits at option mask
+    # positions, yes-token column
+    from transformers import MegatronBertForMaskedLM as HFMLM
+    from transformers import MegatronBertConfig as HFCfg
+    sd = torch.load(ckpt / "pytorch_model.bin", weights_only=False)
+    hf_cfg = HFCfg(vocab_size=model.config.vocab_size, hidden_size=32,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   intermediate_size=64, max_position_embeddings=64,
+                   type_vocab_size=2)
+    tm = HFMLM(hf_cfg).eval()
+    tm.load_state_dict({k[len("bert."):]: v for k, v in sd.items()})
+    yes_id = tokenizer.convert_tokens_to_ids("是")
+    with torch.no_grad():
+        # HF MegatronBert expands a [B, S, S] mask to additive form
+        logits = tm(
+            torch.tensor(batch["input_ids"], dtype=torch.long),
+            attention_mask=torch.tensor(batch["attention_mask"],
+                                        dtype=torch.float),
+            token_type_ids=torch.tensor(batch["token_type_ids"],
+                                        dtype=torch.long),
+            position_ids=torch.tensor(batch["position_ids"],
+                                      dtype=torch.long)).logits
+    ref = logits[0, batch["option_positions"][0], yes_id].numpy()
+    np.testing.assert_allclose(np.asarray(scores)[0], ref, atol=3e-4)
