@@ -59,6 +59,11 @@ class T5QAModule(TrainModule):
         parser.add_argument("--max_target_length", type=int, default=64)
         parser.add_argument("--num_beams", type=int, default=4)
         parser.add_argument("--length_penalty", type=float, default=1.0)
+        parser.add_argument("--repetition_penalty", type=float,
+                            default=1.0)
+        parser.add_argument("--no_repeat_ngram_size", type=int,
+                            default=0)
+        parser.add_argument("--min_length", type=int, default=0)
         return parent_parser
 
     jit_predict = True

@@ -142,6 +142,11 @@ def main(argv=None):
     group.add_argument("--max_tgt_length", default=128, type=int)
     group.add_argument("--num_beams", default=1, type=int)
     group.add_argument("--length_penalty", default=1.0, type=float)
+    group.add_argument("--repetition_penalty", default=1.0,
+                       type=float)
+    group.add_argument("--no_repeat_ngram_size", default=0,
+                       type=int)
+    group.add_argument("--min_length", default=0, type=int)
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)

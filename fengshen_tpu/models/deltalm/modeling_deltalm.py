@@ -51,6 +51,7 @@ class DeltaLMConfig:
     activation_function: str = "gelu"
     dropout: float = 0.1
     max_position_embeddings: int = 512
+    decode_cache_length: int = 512  # KV-cache capacity for generation
     init_std: float = 0.02
     scale_embedding: bool = False
     pad_token_id: int = 1

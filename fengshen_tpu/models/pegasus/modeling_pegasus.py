@@ -47,6 +47,7 @@ class PegasusConfig:
     activation_function: str = "gelu"
     dropout: float = 0.1
     max_position_embeddings: int = 1024
+    decode_cache_length: int = 512  # KV-cache capacity for generation
     init_std: float = 0.02
     scale_embedding: bool = True
     pad_token_id: int = 0

@@ -49,6 +49,88 @@ def top_p_logits(logits: jax.Array, p: float,
     return jnp.where(logits < threshold, filter_value, logits)
 
 
+def apply_logits_controls(logits, history, cur_index, *,
+                          repetition_penalty: float = 1.0,
+                          no_repeat_ngram_size: int = 0,
+                          min_length: int = 0,
+                          eos_token_id: Optional[int] = None,
+                          history_mask=None):
+    """HF-`generate`-compatible logits processors, fully jittable
+    (reference: fengshen/utils/transfo_xl_utils.py penalized sampling;
+    the examples pass the HF kwargs — mt5_summary, qa_t5, ziya).
+
+    logits [N, V]; history [N, L] tokens generated so far (prompt
+    included for decoder-only); cur_index: traced count of valid history
+    tokens (== the position the next token will take); history_mask
+    [N, L] marks real tokens (left-padded prompts).
+    """
+    n_rows, vocab = logits.shape
+    length = history.shape[1]
+    logits = logits.astype(jnp.float32)
+    valid = jnp.arange(length)[None, :] < cur_index
+    if history_mask is not None:
+        valid = valid & history_mask.astype(bool)
+
+    if repetition_penalty != 1.0:
+        seen = jnp.zeros((n_rows, vocab), jnp.int32).at[
+            jnp.arange(n_rows)[:, None], history].max(
+            valid.astype(jnp.int32)).astype(bool)
+        penalized = jnp.where(logits > 0, logits / repetition_penalty,
+                              logits * repetition_penalty)
+        logits = jnp.where(seen, penalized, logits)
+
+    if no_repeat_ngram_size == 1:
+        # HF semantics at size 1: ban every previously generated token
+        banned = jnp.zeros((n_rows, vocab), jnp.int32).at[
+            jnp.arange(n_rows)[:, None], history].max(
+            valid.astype(jnp.int32)).astype(bool)
+        logits = jnp.where(banned, jnp.float32(-1e9), logits)
+    elif no_repeat_ngram_size > 1:
+        n = no_repeat_ngram_size
+        # previous complete n-grams: windows [s, s+n) inside the valid
+        # prefix; the candidate v is banned when the last (n-1)-gram plus
+        # v matches one of them (HF NoRepeatNGramLogitsProcessor)
+        n_win = length - n + 1
+        if n_win > 0:
+            idx = jnp.arange(n_win)[:, None] + jnp.arange(n - 1)[None, :]
+            wins = history[:, idx]                     # [N, W, n-1]
+            nxt = history[:, jnp.arange(n - 1, length)]  # [N, W]
+            win_ok = valid[:, idx].all(-1) & \
+                valid[:, jnp.arange(n - 1, length)]
+            last = jax.lax.dynamic_slice_in_dim(
+                history, cur_index - (n - 1), n - 1, axis=1)
+            match = (wins == last[:, None, :]).all(-1) & win_ok
+            match = match & (cur_index >= n - 1)
+            banned = jnp.zeros((n_rows, vocab), jnp.int32).at[
+                jnp.arange(n_rows)[:, None], nxt].max(
+                match.astype(jnp.int32)).astype(bool)
+            logits = jnp.where(banned, jnp.float32(-1e9), logits)
+
+    if min_length > 0 and eos_token_id is not None:
+        eos_col = jnp.arange(vocab) == eos_token_id
+        logits = jnp.where(eos_col[None] & (cur_index < min_length),
+                           jnp.float32(-1e9), logits)
+    return logits
+
+
+def _controls_active(repetition_penalty, no_repeat_ngram_size,
+                     min_length) -> bool:
+    return (repetition_penalty != 1.0 or no_repeat_ngram_size > 0 or
+            min_length > 0)
+
+
+def _make_control(control_kw: dict, history_mask=None):
+    """`control(logits, history, cur_index)` — identity when no control
+    is active, else apply_logits_controls bound to these settings. The
+    ONE place every decode path gets its processor from."""
+    if not _controls_active(control_kw["repetition_penalty"],
+                            control_kw["no_repeat_ngram_size"],
+                            control_kw["min_length"]):
+        return lambda logits, history, cur: logits
+    return partial(apply_logits_controls, history_mask=history_mask,
+                   **control_kw)
+
+
 def _select_token(logits, rng, do_sample, temperature, top_k, top_p):
     logits = logits.astype(jnp.float32)
     if not do_sample:
@@ -65,18 +147,32 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
              top_k: int = 0, top_p: float = 0.0,
              eos_token_id: Optional[int] = None,
              pad_token_id: int = 0,
+             repetition_penalty: float = 1.0,
+             no_repeat_ngram_size: int = 0,
+             min_length: int = 0,
              rng: Optional[jax.Array] = None) -> jax.Array:
     """Batched decode with a preallocated KV cache.
 
     `input_ids` is LEFT-padded [B, S] (the reference pads left for batched
     generation, reference: llama_generate.py:17-40); `attention_mask` marks
     real tokens. Returns [B, S + max_new_tokens] with pad after eos.
+    `min_length` counts the FULL sequence (prompt + generated), matching
+    HF `generate(min_length=...)` for decoder-only models.
     """
     batch, prompt_len = input_ids.shape
     if attention_mask is None:
         attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    total_len = prompt_len + max_new_tokens
+    hist_mask = jnp.concatenate(
+        [attention_mask.astype(jnp.int32),
+         jnp.ones((batch, max_new_tokens), jnp.int32)], axis=1)
+    control = _make_control(
+        dict(repetition_penalty=repetition_penalty,
+             no_repeat_ngram_size=no_repeat_ngram_size,
+             min_length=min_length, eos_token_id=eos_token_id),
+        history_mask=hist_mask)
 
     # position_ids from mask cumsum (left-pad aware,
     # reference: modeling_llama.py:353-375)
@@ -98,34 +194,42 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
         init_cache=True, mutable=["cache"])
     cache = mutated["cache"]
 
+    buf = jnp.concatenate(
+        [input_ids.astype(jnp.int32),
+         jnp.full((batch, max_new_tokens), pad_token_id, jnp.int32)],
+        axis=1)
     rng, step_rng = jax.random.split(rng)
-    next_token = _select_token(logits[:, -1], step_rng, do_sample,
+    step_logits = control(logits[:, -1], buf, jnp.int32(prompt_len))
+    next_token = _select_token(step_logits, step_rng, do_sample,
                                temperature, top_k, top_p)
+    buf = buf.at[:, prompt_len].set(next_token.astype(jnp.int32))
     finished = jnp.zeros((batch,), bool)
     if eos_token_id is not None:
         finished = finished | (next_token == eos_token_id)
 
-    def step(carry, step_rng):
-        cache, token, pos, finished = carry
+    def step(carry, inp):
+        cache, buf, token, pos, finished = carry
+        t, step_rng = inp
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, token[:, None],
             attention_mask=attention_mask,
             position_ids=pos[:, None], init_cache=True, mutable=["cache"])
-        nxt = _select_token(logits[:, -1], step_rng, do_sample,
+        step_logits = control(logits[:, -1], buf, t)
+        nxt = _select_token(step_logits, step_rng, do_sample,
                             temperature, top_k, top_p)
-        nxt = jnp.where(finished, pad_token_id, nxt)
+        nxt = jnp.where(finished, pad_token_id, nxt).astype(jnp.int32)
         if eos_token_id is not None:
             finished = finished | (nxt == eos_token_id)
-        return (mutated["cache"], nxt, pos + 1, finished), nxt
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, nxt[:, None], t, axis=1)
+        return (mutated["cache"], buf, nxt, pos + 1, finished), None
 
     pos0 = position_ids[:, -1] + 1
     step_rngs = jax.random.split(rng, max(max_new_tokens - 1, 0))
-    (_, _, _, _), tokens = jax.lax.scan(
-        step, (cache, next_token, pos0, finished), step_rngs)
-
-    out = jnp.concatenate(
-        [input_ids, next_token[:, None], tokens.T], axis=1)
-    return out
+    ts = jnp.arange(prompt_len + 1, total_len)
+    (_, buf, _, _, _), _ = jax.lax.scan(
+        step, (cache, buf, next_token, pos0, finished), (ts, step_rngs))
+    return buf
 
 
 def _make_seq2seq_logits_fn(model, params, input_ids, attention_mask,
@@ -200,6 +304,9 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
                      do_sample: bool = False, temperature: float = 1.0,
                      top_k: int = 0, top_p: float = 0.0,
                      num_beams: int = 1, length_penalty: float = 1.0,
+                     repetition_penalty: float = 1.0,
+                     no_repeat_ngram_size: int = 0,
+                     min_length: int = 0,
                      rng: Optional[jax.Array] = None) -> jax.Array:
     """Encoder-decoder decode (HF `generate` surface for the seq2seq
     examples — reference: fengshen/examples/mt5_summary, qa_t5,
@@ -207,7 +314,10 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
 
     Greedy / sampling when `num_beams == 1`, otherwise beam search.
     Returns [B, 1 + max_new_tokens] decoder ids starting with
-    `decoder_start_token_id`, padded after eos.
+    `decoder_start_token_id`, padded after eos. `min_length` counts
+    decoder tokens (start token included), matching HF seq2seq
+    `generate(min_length=...)`; `repetition_penalty` and
+    `no_repeat_ngram_size` act over the decoder sequence.
     """
     if num_beams > 1:
         if do_sample:
@@ -219,7 +329,10 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
             max_new_tokens=max_new_tokens,
             decoder_start_token_id=decoder_start_token_id,
             eos_token_id=eos_token_id, pad_token_id=pad_token_id,
-            num_beams=num_beams, length_penalty=length_penalty)
+            num_beams=num_beams, length_penalty=length_penalty,
+            repetition_penalty=repetition_penalty,
+            no_repeat_ngram_size=no_repeat_ngram_size,
+            min_length=min_length)
 
     batch = input_ids.shape[0]
     if max_new_tokens == 0:
@@ -227,6 +340,9 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
     length = max_new_tokens + 1
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    control_kw = dict(repetition_penalty=repetition_penalty,
+                      no_repeat_ngram_size=no_repeat_ngram_size,
+                      min_length=min_length, eos_token_id=eos_token_id)
     if _seq2seq_supports_cache(model) and \
             max_new_tokens < _cache_capacity(model):
         return _cached_seq2seq_sample(
@@ -235,18 +351,20 @@ def seq2seq_generate(model, params, input_ids: jax.Array,
             decoder_start_token_id=decoder_start_token_id,
             eos_token_id=eos_token_id, pad_token_id=pad_token_id,
             do_sample=do_sample, temperature=temperature, top_k=top_k,
-            top_p=top_p, rng=rng)
+            top_p=top_p, control_kw=control_kw, rng=rng)
     logits_fn = _make_seq2seq_logits_fn(model, params, input_ids,
                                         attention_mask, expand=1)
     buf = jnp.full((batch, length), pad_token_id, jnp.int32)
     buf = buf.at[:, 0].set(decoder_start_token_id)
     finished = jnp.zeros((batch,), bool)
+    control = _make_control(control_kw)
 
     def step(carry, inp):
         buf, finished = carry
         t, step_rng = inp
         logits = jax.lax.dynamic_index_in_dim(
             logits_fn(buf), t - 1, axis=1, keepdims=False)
+        logits = control(logits, buf, t)
         nxt = _select_token(logits, step_rng, do_sample, temperature,
                             top_k, top_p)
         nxt = jnp.where(finished, pad_token_id, nxt).astype(jnp.int32)
@@ -284,12 +402,13 @@ def _takes_position_offset(model) -> bool:
 def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
                            max_new_tokens, decoder_start_token_id,
                            eos_token_id, pad_token_id, do_sample,
-                           temperature, top_k, top_p, rng):
+                           temperature, top_k, top_p, control_kw, rng):
     """Greedy/sampling decode through the model's KV cache: the encoder
     runs once, cross-attention K/V are projected once on the priming
     call, and each scan step runs the decoder on ONE token (O(L)
     attention per step instead of the O(L²) full-prefix re-run)."""
     batch = input_ids.shape[0]
+    control = _make_control(control_kw)
     enc = model.apply({"params": params}, input_ids, attention_mask,
                       method=model.encode)
     cache = _init_seq2seq_cache(model, input_ids,
@@ -306,32 +425,40 @@ def _cached_seq2seq_sample(model, params, input_ids, attention_mask, *,
             method=model.decode_logits, **kw)
         return mutated["cache"], logits[:, -1]
 
+    length = max_new_tokens + 1
+    buf = jnp.full((batch, length), pad_token_id, jnp.int32)
+    buf = buf.at[:, 0].set(decoder_start_token_id)
     start = jnp.full((batch,), decoder_start_token_id, jnp.int32)
     # same key stream as the buffer path (split(rng, max_new)): the two
     # implementations must sample identically for a given seed
     keys = jax.random.split(rng, max_new_tokens)
     # prime: projects cross K/V, decodes the start token at position 0
     cache, logits = decode(cache, start, {}, jnp.int32(0))
-    tok = _select_token(logits, keys[0], do_sample, temperature,
-                        top_k, top_p).astype(jnp.int32)
+    tok = _select_token(control(logits, buf, jnp.int32(1)), keys[0],
+                        do_sample, temperature, top_k, top_p
+                        ).astype(jnp.int32)
+    buf = buf.at[:, 1].set(tok)
     finished = jnp.zeros((batch,), bool)
     if eos_token_id is not None:
         finished = finished | (tok == eos_token_id)
 
     def step(carry, inp):
-        cache, tok, finished = carry
+        cache, buf, tok, finished = carry
         t, step_rng = inp
         cache, logits = decode(cache, tok, cross_kw, t)
-        nxt = _select_token(logits, step_rng, do_sample,
-                            temperature, top_k, top_p)
+        nxt = _select_token(control(logits, buf, t + 1), step_rng,
+                            do_sample, temperature, top_k, top_p)
         nxt = jnp.where(finished, pad_token_id, nxt).astype(jnp.int32)
         if eos_token_id is not None:
             finished = finished | (nxt == eos_token_id)
-        return (cache, nxt, finished), nxt
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, nxt[:, None], t + 1, axis=1)
+        return (cache, buf, nxt, finished), None
 
     ts = jnp.arange(1, max_new_tokens)  # token t sits at position t
-    _, toks = jax.lax.scan(step, (cache, tok, finished), (ts, keys[1:]))
-    return jnp.concatenate([start[:, None], tok[:, None], toks.T], axis=1)
+    (_, buf, _, _), _ = jax.lax.scan(
+        step, (cache, buf, tok, finished), (ts, keys[1:]))
+    return buf
 
 
 _BEAM_NEG = jnp.float32(-1e9)
@@ -395,7 +522,7 @@ def _beam_finish(alive_buf, alive_scores, fin_buf, fin_scores,
 def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
                          max_new_tokens, decoder_start_token_id,
                          eos_token_id, pad_token_id, num_beams,
-                         length_penalty):
+                         length_penalty, control_kw):
     """Beam search through the KV cache: one-token decoder steps with the
     cache rows gathered along the beam dimension on every reorder."""
     batch = input_ids.shape[0]
@@ -417,6 +544,14 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
     last_tok = jnp.full((batch, K), decoder_start_token_id, jnp.int32)
     cross_kw = _cross_cache_kwargs(model)
     has_pos = _takes_position_offset(model)
+    row_control = _make_control(control_kw)
+
+    def control(log_probs, alive_buf, cur):
+        # HF beam search runs the processors on the log-softmaxed scores
+        vocab = log_probs.shape[-1]
+        out = row_control(log_probs.reshape(batch * K, vocab),
+                          alive_buf.reshape(batch * K, -1), cur)
+        return out.reshape(batch, K, vocab)
 
     def decode(cache, last_tok, kw, offset):
         if has_pos:
@@ -444,6 +579,7 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
 
     # priming step (t=1): projects the cross-attention K/V into the cache
     cache, log_probs = decode(cache, last_tok, {}, jnp.int32(0))
+    log_probs = control(log_probs, alive_buf, jnp.int32(1))
     (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
      last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
                               fin_scores, log_probs, jnp.int32(1), K,
@@ -455,6 +591,7 @@ def _cached_seq2seq_beam(model, params, input_ids, attention_mask, *,
          last_tok) = carry
         # last_tok was selected at step t-1 and sits at position t-1
         cache, log_probs = decode(cache, last_tok, cross_kw, t - 1)
+        log_probs = control(log_probs, alive_buf, t)
         (alive_buf, alive_scores, fin_buf, fin_scores, src_beam,
          last_tok) = _beam_select(alive_buf, alive_scores, fin_buf,
                                   fin_scores, log_probs, t, K,
@@ -482,7 +619,10 @@ def seq2seq_predict_step(model, config, args, params, batch, *,
         eos_token_id=getattr(config, "eos_token_id", None),
         pad_token_id=getattr(config, "pad_token_id", 0) or 0,
         num_beams=getattr(args, "num_beams", 1),
-        length_penalty=getattr(args, "length_penalty", 1.0))
+        length_penalty=getattr(args, "length_penalty", 1.0),
+        repetition_penalty=getattr(args, "repetition_penalty", 1.0),
+        no_repeat_ngram_size=getattr(args, "no_repeat_ngram_size", 0),
+        min_length=getattr(args, "min_length", 0))
 
 
 def seq2seq_beam_search(model, params, input_ids: jax.Array,
@@ -491,7 +631,10 @@ def seq2seq_beam_search(model, params, input_ids: jax.Array,
                         decoder_start_token_id: int = 0,
                         eos_token_id: Optional[int] = None,
                         pad_token_id: int = 0, num_beams: int = 4,
-                        length_penalty: float = 1.0) -> jax.Array:
+                        length_penalty: float = 1.0,
+                        repetition_penalty: float = 1.0,
+                        no_repeat_ngram_size: int = 0,
+                        min_length: int = 0) -> jax.Array:
     """Beam search over an encoder-decoder model, fully inside `lax.scan`
     (static shapes; TPU-friendly — no per-token host sync).
 
@@ -504,6 +647,10 @@ def seq2seq_beam_search(model, params, input_ids: jax.Array,
     batch = input_ids.shape[0]
     if max_new_tokens == 0:
         return jnp.full((batch, 1), decoder_start_token_id, jnp.int32)
+    control_kw = dict(repetition_penalty=repetition_penalty,
+                      no_repeat_ngram_size=no_repeat_ngram_size,
+                      min_length=min_length, eos_token_id=eos_token_id)
+    row_control = _make_control(control_kw)
     if _seq2seq_supports_cache(model) and \
             max_new_tokens < _cache_capacity(model):
         return _cached_seq2seq_beam(
@@ -511,7 +658,8 @@ def seq2seq_beam_search(model, params, input_ids: jax.Array,
             max_new_tokens=max_new_tokens,
             decoder_start_token_id=decoder_start_token_id,
             eos_token_id=eos_token_id, pad_token_id=pad_token_id,
-            num_beams=num_beams, length_penalty=length_penalty)
+            num_beams=num_beams, length_penalty=length_penalty,
+            control_kw=control_kw)
     K = num_beams
     length = max_new_tokens + 1
 
@@ -526,7 +674,10 @@ def seq2seq_beam_search(model, params, input_ids: jax.Array,
             logits_fn(alive_buf.reshape(batch * K, length)),
             t - 1, axis=1, keepdims=False)
         log_probs = jax.nn.log_softmax(
-            logits.astype(jnp.float32), axis=-1).reshape(batch, K, -1)
+            logits.astype(jnp.float32), axis=-1)
+        log_probs = row_control(
+            log_probs, alive_buf.reshape(batch * K, length),
+            t).reshape(batch, K, -1)
         (alive_buf, alive_scores, fin_buf, fin_scores, _, _) = \
             _beam_select(alive_buf, alive_scores, fin_buf, fin_scores,
                          log_probs, t, K, eos_token_id, length_penalty)

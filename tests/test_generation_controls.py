@@ -1,0 +1,196 @@
+"""Generation control kwargs vs HF torch `generate` (VERDICT r2 item 7):
+repetition_penalty, no_repeat_ngram_size, min_length on gpt2
+(decoder-only path) and bart (seq2seq cached + beam paths).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from fengshen_tpu.models.bart import (BartConfig,  # noqa: E402
+                                      BartForConditionalGeneration)
+from fengshen_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel  # noqa
+
+
+@pytest.fixture(scope="module")
+def gpt2_pair():
+    from fengshen_tpu.models.gpt2.convert import torch_to_params
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dtype="float32")
+    return torch_to_params(tm.state_dict(), cfg), tm, cfg
+
+
+@pytest.fixture(scope="module")
+def bart_pair():
+    from fengshen_tpu.models.bart.convert import torch_to_params
+    hf_cfg = transformers.BartConfig(
+        vocab_size=128, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, attn_implementation="eager",
+        decoder_start_token_id=2, eos_token_id=2, pad_token_id=1,
+        bos_token_id=0, forced_bos_token_id=None, forced_eos_token_id=None)
+    torch.manual_seed(1)
+    tm = transformers.BartForConditionalGeneration(hf_cfg).eval()
+    cfg = BartConfig(vocab_size=128, d_model=32, encoder_layers=2,
+                     decoder_layers=2, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64,
+                     dtype="float32")
+    return torch_to_params(tm.state_dict(), cfg), tm, cfg
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"repetition_penalty": 1.5},
+    {"no_repeat_ngram_size": 2},
+    {"min_length": 12},
+    {"repetition_penalty": 1.3, "no_repeat_ngram_size": 3,
+     "min_length": 10},
+])
+def test_gpt2_greedy_controls_match_hf(gpt2_pair, kwargs):
+    from fengshen_tpu.utils.generate import generate
+    params, tm, cfg = gpt2_pair
+    prompt = np.array([[5, 11, 42, 7]], dtype=np.int64)
+    hf_kwargs = dict(kwargs)
+    if "min_length" not in hf_kwargs:
+        # HF's GenerationConfig default min_length=0
+        hf_kwargs["min_length"] = 0
+    with torch.no_grad():
+        ref = tm.generate(torch.tensor(prompt), max_new_tokens=10,
+                          do_sample=False, pad_token_id=0,
+                          eos_token_id=99, **hf_kwargs).numpy()
+    out = generate(GPT2LMHeadModel(cfg), params,
+                   jnp.asarray(prompt, jnp.int32), max_new_tokens=10,
+                   eos_token_id=99, pad_token_id=0, **kwargs)
+    np.testing.assert_array_equal(np.asarray(out)[0, :ref.shape[1]],
+                                  ref[0])
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"repetition_penalty": 1.5},
+    {"no_repeat_ngram_size": 2},
+    {"min_length": 10},
+])
+def test_bart_greedy_controls_match_hf(bart_pair, kwargs):
+    from fengshen_tpu.utils.generate import seq2seq_generate
+    params, tm, cfg = bart_pair
+    enc_ids = np.array([[0, 17, 9, 42, 33, 2]], dtype=np.int64)
+    hf_kwargs = {"min_length": 0} | kwargs
+    with torch.no_grad():
+        ref = tm.generate(torch.tensor(enc_ids), max_new_tokens=12,
+                          do_sample=False, num_beams=1,
+                          **hf_kwargs).numpy()
+    out = seq2seq_generate(
+        BartForConditionalGeneration(cfg), params,
+        jnp.asarray(enc_ids, jnp.int32), max_new_tokens=12,
+        decoder_start_token_id=2, eos_token_id=2, pad_token_id=1,
+        **kwargs)
+    n = min(ref.shape[1], np.asarray(out).shape[1])
+    np.testing.assert_array_equal(np.asarray(out)[0, :n], ref[0, :n])
+
+
+def test_bart_beam_controls_match_hf(bart_pair):
+    from fengshen_tpu.utils.generate import seq2seq_generate
+    params, tm, cfg = bart_pair
+    enc_ids = np.array([[0, 9, 17, 42, 2]], dtype=np.int64)
+    kwargs = dict(no_repeat_ngram_size=2, repetition_penalty=1.2,
+                  min_length=8)
+    with torch.no_grad():
+        ref = tm.generate(torch.tensor(enc_ids), max_new_tokens=10,
+                          num_beams=3, length_penalty=1.0,
+                          early_stopping=True, **kwargs).numpy()
+    out = seq2seq_generate(
+        BartForConditionalGeneration(cfg), params,
+        jnp.asarray(enc_ids, jnp.int32), max_new_tokens=10,
+        decoder_start_token_id=2, eos_token_id=2, pad_token_id=1,
+        num_beams=3, length_penalty=1.0, **kwargs)
+    n = min(ref.shape[1], np.asarray(out).shape[1])
+    np.testing.assert_array_equal(np.asarray(out)[0, :n], ref[0, :n])
+
+
+def test_controls_leftpad_history_mask(gpt2_pair):
+    """Left padding must not leak pad tokens into the repetition
+    penalty's seen-set: a left-padded prompt and the same prompt unpadded
+    generate the same continuation."""
+    from fengshen_tpu.utils.generate import generate
+    params, _, cfg = gpt2_pair
+    model = GPT2LMHeadModel(cfg)
+    prompt = np.array([[5, 11, 42, 7]], dtype=np.int32)
+    padded = np.array([[0, 0, 5, 11, 42, 7]], dtype=np.int32)
+    mask = np.array([[0, 0, 1, 1, 1, 1]], dtype=np.int32)
+    kwargs = dict(max_new_tokens=8, repetition_penalty=2.0,
+                  no_repeat_ngram_size=2, pad_token_id=1)
+    out_a = np.asarray(generate(model, params, jnp.asarray(prompt),
+                                **kwargs))[0, 4:]
+    out_b = np.asarray(generate(model, params, jnp.asarray(padded),
+                                attention_mask=jnp.asarray(mask),
+                                **kwargs))[0, 6:]
+    np.testing.assert_array_equal(out_a, out_b)
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"repetition_penalty": 1.5},
+    {"no_repeat_ngram_size": 2},
+    {"min_length": 10},
+])
+def test_bart_buffer_path_controls_match_cached(bart_pair, kwargs,
+                                                monkeypatch):
+    """The non-cached buffer fallback (models without KV-cache support or
+    overflowing decode_cache_length) must produce the same controlled
+    greedy output as the cached path."""
+    import importlib
+    G = importlib.import_module("fengshen_tpu.utils.generate")
+    params, _, cfg = bart_pair
+    model = BartForConditionalGeneration(cfg)
+    enc_ids = np.array([[0, 17, 9, 42, 33, 2]], dtype=np.int32)
+    common = dict(max_new_tokens=12, decoder_start_token_id=2,
+                  eos_token_id=2, pad_token_id=1, **kwargs)
+    cached = np.asarray(G.seq2seq_generate(
+        model, params, jnp.asarray(enc_ids), **common))
+    monkeypatch.setattr(G, "_seq2seq_supports_cache", lambda m: False)
+    buffered = np.asarray(G.seq2seq_generate(
+        model, params, jnp.asarray(enc_ids), **common))
+    np.testing.assert_array_equal(cached, buffered)
+
+
+def test_bart_beam_buffer_path_controls_match_cached(bart_pair,
+                                                     monkeypatch):
+    import importlib
+    G = importlib.import_module("fengshen_tpu.utils.generate")
+    params, _, cfg = bart_pair
+    model = BartForConditionalGeneration(cfg)
+    enc_ids = np.array([[0, 9, 17, 42, 2]], dtype=np.int32)
+    common = dict(max_new_tokens=10, decoder_start_token_id=2,
+                  eos_token_id=2, pad_token_id=1, num_beams=3,
+                  no_repeat_ngram_size=2, repetition_penalty=1.2,
+                  min_length=8)
+    cached = np.asarray(G.seq2seq_generate(
+        model, params, jnp.asarray(enc_ids), **common))
+    monkeypatch.setattr(G, "_seq2seq_supports_cache", lambda m: False)
+    buffered = np.asarray(G.seq2seq_generate(
+        model, params, jnp.asarray(enc_ids), **common))
+    np.testing.assert_array_equal(cached, buffered)
+
+
+def test_ngram_size_one_bans_all_seen_tokens(gpt2_pair):
+    """HF semantics at no_repeat_ngram_size=1: no token may ever repeat."""
+    from fengshen_tpu.utils.generate import generate
+    params, tm, cfg = gpt2_pair
+    prompt = np.array([[5, 11, 42, 7]], dtype=np.int64)
+    with torch.no_grad():
+        ref = tm.generate(torch.tensor(prompt), max_new_tokens=10,
+                          do_sample=False, pad_token_id=0,
+                          no_repeat_ngram_size=1, min_length=0).numpy()
+    out = generate(GPT2LMHeadModel(cfg), params,
+                   jnp.asarray(prompt, jnp.int32), max_new_tokens=10,
+                   pad_token_id=0, no_repeat_ngram_size=1)
+    np.testing.assert_array_equal(np.asarray(out)[0], ref[0])
+    assert len(set(np.asarray(out)[0].tolist())) == out.shape[1]
