@@ -1,0 +1,83 @@
+"""CLUE1.1 extraction-style recipe via UBERT.
+
+Reference: fengshen/examples/clue1.1/run_clue_ubert.sh — span-extraction
+tasks (cmrc-style reading comprehension) driven through the UBERT
+instruction format: {task_type, text, choices: [{entity_type}]}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def load_rows(path: str) -> list[dict]:
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def to_ubert(rows: list[dict]) -> list[dict]:
+    out = []
+    for r in rows:
+        question = r.get("question", r.get("query", "答案"))
+        item = {
+            "task_type": "抽取任务",
+            "subtask_type": "抽取式阅读理解",
+            "text": r.get("context", r.get("text", "")),
+            "choices": [{"entity_type": question,
+                         "entity_list": [
+                             {"entity_name": a.get("text", ""),
+                              "entity_idx": [[a.get("answer_start", 0),
+                                              a.get("answer_start", 0) +
+                                              max(len(a.get("text", "")) -
+                                                  1, 0)]]}
+                             for a in r.get("answers", [])]}],
+        }
+        out.append(item)
+    return out
+
+
+def main(argv=None):
+    from fengshen_tpu.models.ubert.modeling_ubert import UbertPipelines
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--task", default="cmrc")
+    parser.add_argument("--data_dir", required=True)
+    parser.add_argument("--output_path", default="predict.json")
+    parser.add_argument("--train_data", default="train.json")
+    parser.add_argument("--valid_data", default="dev.json")
+    parser.add_argument("--test_data", default="test.json")
+    parser = UbertPipelines.pipelines_args(parser)
+    args = parser.parse_args(argv)
+
+    train = to_ubert(load_rows(
+        os.path.join(args.data_dir, args.train_data)))
+    dev = to_ubert(load_rows(os.path.join(args.data_dir, args.valid_data)))
+    test_rows = load_rows(os.path.join(args.data_dir, args.test_data))
+    test = to_ubert(test_rows)
+
+    pipe = UbertPipelines(args, model=args.model_path)
+    if train:
+        pipe.fit(train, dev or None)
+    preds = pipe.predict(test) if test else []
+    with open(args.output_path, "w") as f:
+        for row, p in zip(test_rows, preds):
+            answers = [e["entity_name"]
+                       for ch in p.get("choices", [])
+                       for e in ch.get("entity_list", [])]
+            f.write(json.dumps(
+                {"id": row.get("id"), "answer": answers[0] if answers
+                 else ""}, ensure_ascii=False) + "\n")
+    print(f"[clue1.1:{args.task}] wrote {len(preds)} predictions "
+          f"to {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()

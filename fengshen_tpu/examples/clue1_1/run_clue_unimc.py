@@ -1,0 +1,134 @@
+"""CLUE1.1 leaderboard recipe via UniMC.
+
+Reference: fengshen/examples/clue1.1/run_clue_unimc.sh + solution/ —
+every CLUE classification task reformulated as unified multiple choice
+(the recipe behind the UniMC-DeBERTa CLUE1.1 rank-8 entry,
+reference: fengshen/examples/clue1.1/README.md:3). Reads the CLUE json
+files, maps each task's label ids onto option texts, trains through
+UniMCPipelines, and writes leaderboard-format predictions (original
+label-id strings, not option indices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+# task → (ordered CLUE label ids, option texts). The label id at
+# position i corresponds to choice i; predictions are written back as
+# the original id string.
+TASK_LABELS = {
+    "tnews": (["100", "101", "102", "103", "104", "106", "107", "108",
+               "109", "110", "112", "113", "114", "115", "116"],
+              ["故事", "文化", "娱乐", "体育", "财经", "房产", "汽车",
+               "教育", "科技", "军事", "旅游", "国际", "股票", "农业",
+               "电竞"]),
+    "afqmc": (["0", "1"], ["不同", "相似"]),
+    "ocnli": (["entailment", "neutral", "contradiction"],
+              ["蕴含", "中立", "矛盾"]),
+    "csl": (["0", "1"], ["错误", "正确"]),
+    "wsc": (["false", "true"], ["错误", "正确"]),
+    "iflytek": (None, None),  # built from the data's label/label_des
+}
+
+
+def load_rows(path: str) -> list[dict]:
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def iflytek_labels(rows: list[dict]) -> tuple[list[str], list[str]]:
+    """label id → label_des vocabulary from the labelled splits."""
+    seen: dict[str, str] = {}
+    for r in rows:
+        label = r.get("label")
+        if label is not None:
+            seen[str(label)] = r.get("label_des", str(label))
+    ids = sorted(seen, key=lambda x: int(x) if x.isdigit() else 0)
+    return ids, [seen[i] for i in ids]
+
+
+def _text(task: str, r: dict) -> str:
+    if task == "afqmc":
+        return f"{r.get('sentence1', '')}[SEP]{r.get('sentence2', '')}"
+    if task == "ocnli":
+        return f"{r.get('sentence1', '')}[SEP]{r.get('sentence2', '')}"
+    if task == "csl":
+        return f"{r.get('abst', '')}[SEP]{','.join(r.get('keyword', []))}"
+    if task == "wsc":
+        t = r.get("target", {})
+        return (f"{r.get('text', '')}[SEP]{t.get('span1_text', '')}"
+                f"指代{t.get('span2_text', '')}")
+    return r.get("sentence", r.get("text", ""))
+
+
+def to_unimc(task: str, rows: list[dict], label_ids: list[str],
+             choices: list[str]) -> list[dict]:
+    index = {lid: i for i, lid in enumerate(label_ids)}
+    out = []
+    for r in rows:
+        item = {"texta": _text(task, r), "textb": "", "question": "",
+                "choice": choices}
+        label = r.get("label")
+        if label is not None:
+            item["label"] = index.get(str(label), 0)
+        out.append(item)
+    return out
+
+
+def main(argv=None):
+    from fengshen_tpu.models.unimc.modeling_unimc import UniMCPipelines
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--task", default="tnews",
+                        choices=list(TASK_LABELS))
+    parser.add_argument("--data_dir", required=True)
+    parser.add_argument("--output_path", default="predict.json")
+    parser.add_argument("--train_data", default="train.json")
+    parser.add_argument("--valid_data", default="dev.json")
+    parser.add_argument("--test_data", default="test.json")
+    parser.add_argument("--predict_batchsize", type=int, default=16)
+    parser = UniMCPipelines.add_pipeline_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    train_rows = load_rows(os.path.join(args.data_dir, args.train_data))
+    dev_rows = load_rows(os.path.join(args.data_dir, args.valid_data))
+    test_rows = load_rows(os.path.join(args.data_dir, args.test_data))
+
+    label_ids, choices = TASK_LABELS[args.task]
+    if label_ids is None:
+        label_ids, choices = iflytek_labels(train_rows + dev_rows)
+        if not label_ids:
+            raise ValueError(
+                "iflytek needs labelled train/dev rows to build the "
+                "label→description vocabulary")
+
+    train = to_unimc(args.task, train_rows, label_ids, choices)
+    dev = to_unimc(args.task, dev_rows, label_ids, choices)
+    test = to_unimc(args.task, test_rows, label_ids, choices)
+
+    pipe = UniMCPipelines(args, model=args.model_path)
+    if train:
+        pipe.train(train, dev or None)
+    preds: list[int] = []
+    bs = max(args.predict_batchsize, 1)
+    for i in range(0, len(test), bs):
+        preds.extend(pipe.predict(test[i:i + bs]))
+    with open(args.output_path, "w") as f:
+        for row, p in zip(test_rows, preds):
+            f.write(json.dumps(
+                {"id": row.get("id"), "label": label_ids[p]},
+                ensure_ascii=False) + "\n")
+    print(f"[clue1.1:{args.task}] wrote {len(preds)} predictions "
+          f"to {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()

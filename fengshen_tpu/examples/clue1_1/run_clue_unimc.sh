@@ -1,0 +1,25 @@
+#!/bin/bash
+# CLUE1.1 leaderboard recipe via UniMC (reference:
+# fengshen/examples/clue1.1/run_clue_unimc.sh — tnews/afqmc/iflytek/
+# wsc/ocnli/csl/chid/c3 as unified multiple choice)
+set -euo pipefail
+
+TASK=${TASK:-tnews}
+DATA_DIR=${DATA_DIR:-./data/$TASK}
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-UniMC-RoBERTa-110M-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/clue11_unimc_$TASK}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.clue1_1.run_clue_unimc \
+    --task $TASK \
+    --data_dir $DATA_DIR \
+    --model_path $MODEL_PATH \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 16 \
+    --max_length 512 \
+    --learning_rate 2e-5 \
+    --max_epochs 7 \
+    --precision bf16 \
+    --output_path $ROOT_DIR/${TASK}_predict.json

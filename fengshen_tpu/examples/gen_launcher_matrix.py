@@ -1,0 +1,270 @@
+"""Generate the reproducibility launcher matrix (VERDICT r2 item 6).
+
+The reference ships its hyperparameters as per-task shell scripts — they
+are the reproducibility artifact (reference: fengshen/examples/
+zen2_finetune/*.sh 22 configs, zen1_finetune/*.sh, pretrain_t5/*.sh
+57M→10B, clue1.1/). This generator re-emits that matrix against OUR
+drivers and flags, with the task hyperparameters (labels, batch sizes,
+sequence lengths, learning rates) carried over from the reference
+shells verbatim. Run `python -m fengshen_tpu.examples.gen_launcher_matrix`
+to regenerate; tests/test_launcher_matrix.py smoke-parses every emitted
+flag against the target driver's argparse.
+"""
+
+from __future__ import annotations
+
+import os
+
+HERE = os.path.dirname(__file__)
+
+HEADER = """#!/bin/bash
+# {title}
+# hparams carried from reference: fengshen/examples/{ref}
+# TPU: single host by default; scale via the mesh flags
+# (--tensor_model_parallel_size / --fsdp_parallel_size) and
+# launchers/slurm_multihost.sh or launchers/gke_tpu_job.yaml.
+set -euo pipefail
+
+MODEL_PATH=${{MODEL_PATH:-{model}}}
+DATA_DIR=${{DATA_DIR:-./data/{task}}}
+ROOT_DIR=${{ROOT_DIR:-./workdir/$(basename $0 .sh)}}
+mkdir -p $ROOT_DIR
+"""
+
+# ---------------------------------------------------------------- zen --
+
+# (task, num_labels, batch_base, batch_large, max_seq, lr)
+ZEN2_SEQ_TASKS = [
+    ("afqmc", 2, 32, 32, 128, "2e-5"),
+    ("cmnli", 3, 64, 32, 128, "2e-5"),
+    ("iflytek", 119, 32, 32, 128, "2e-5"),
+    ("ocnli", 3, 32, 32, 128, "2e-5"),
+    ("tnews", 15, 32, 32, 128, "2e-5"),
+]
+# (task, batch, max_seq, lr)
+ZEN2_NER_TASKS = [
+    ("cluener", 32, 256, "3e-5"),
+    ("cmeee", 16, 512, "3e-5"),
+    ("msra", 32, 256, "3e-5"),
+    ("ontonotes4", 32, 256, "3e-5"),
+    ("resume", 32, 256, "3e-5"),
+    ("weibo", 32, 256, "3e-5"),
+]
+ZEN2_MODELS = {"base": "IDEA-CCNL/Erlangshen-ZEN2-345M-Chinese",
+               "large": "IDEA-CCNL/Erlangshen-ZEN2-668M-Chinese"}
+
+
+def _zen2_seq_shell(size, task, labels, batch, seq, lr):
+    body = HEADER.format(
+        title=f"ZEN2-{size} {task} classification finetune",
+        ref=f"zen2_finetune/fs_zen2_{size}_{task}.sh",
+        model=ZEN2_MODELS[size], task=task)
+    body += f"""
+python -m fengshen_tpu.examples.zen2_finetune.fengshen_sequence_level_ft_task \\
+    --model_path $MODEL_PATH \\
+    --train_file $DATA_DIR/train.json \\
+    --val_file $DATA_DIR/dev.json \\
+    --test_file $DATA_DIR/test1.1.json \\
+    --default_root_dir $ROOT_DIR \\
+    --save_ckpt_path $ROOT_DIR/ckpt \\
+    --load_ckpt_path $ROOT_DIR/ckpt \\
+    --monitor val_acc --mode max --save_top_k 3 \\
+    --train_batchsize {batch} \\
+    --val_batchsize 16 \\
+    --max_seq_length {seq} \\
+    --num_labels {labels} \\
+    --learning_rate {lr} \\
+    --weight_decay 0.01 \\
+    --warmup_ratio 0.01 \\
+    --max_epochs 7 \\
+    --precision bf16 \\
+    --seed 1234
+"""
+    return body
+
+
+def _zen2_ner_shell(size, task, batch, seq, lr):
+    body = HEADER.format(
+        title=f"ZEN2-{size} {task} NER finetune",
+        ref=f"zen2_finetune/ner_zen2_{size}_{task}.sh",
+        model=ZEN2_MODELS[size], task=task)
+    body += f"""
+python -m fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \\
+    --model_path $MODEL_PATH \\
+    --data_dir $DATA_DIR \\
+    --default_root_dir $ROOT_DIR \\
+    --save_ckpt_path $ROOT_DIR/ckpt \\
+    --load_ckpt_path $ROOT_DIR/ckpt \\
+    --monitor val_f1 --mode max --save_top_k 3 \\
+    --train_batchsize {batch} \\
+    --val_batchsize 16 \\
+    --max_seq_length {seq} \\
+    --learning_rate {lr} \\
+    --weight_decay 0.01 \\
+    --warmup_ratio 0.01 \\
+    --max_epochs 5 \\
+    --precision bf16 \\
+    --seed 1234
+"""
+    return body
+
+
+# ----------------------------------------------------------------- t5 --
+
+# size -> (d_model, d_ff, num_layers, num_heads, micro_batch, tp, fsdp)
+# dims follow the public Randeng-T5-Char family scale points; batch and
+# lr/warmup come from the reference shells (MICRO_BATCH_SIZE, deepspeed
+# scheduler warmup_max_lr 1e-4 over 10k steps)
+T5_SCALES = {
+    "57M": (512, 1024, 8, 6, 64, 1, 1),
+    "700M": (1024, 2816, 24, 16, 8, 1, 8),
+    "large": (1024, 2816, 24, 16, 8, 1, 8),
+    "10B": (4096, 10240, 24, 64, 1, 8, 4),
+}
+
+
+def _t5_shell(size):
+    d_model, d_ff, layers, heads, micro, tp, fsdp = T5_SCALES[size]
+    name = ("pretrain_randeng_t5_large" if size == "large" else
+            f"pretrain_randeng_t5_char_{size}")
+    body = HEADER.format(
+        title=f"Randeng-T5 {size} span-corruption pretrain",
+        ref=f"pretrain_t5/{name}.sh",
+        model=f"./randeng_t5_char_{size}", task="wudao_180g")
+    body += f"""
+# model config for this scale point (written once into the workdir)
+if [ ! -f $MODEL_PATH/config.json ]; then
+  mkdir -p $MODEL_PATH
+  cat > $MODEL_PATH/config.json << EOF
+{{"vocab_size": 32596, "d_model": {d_model}, "d_ff": {d_ff},
+ "num_layers": {layers}, "num_decoder_layers": {layers},
+ "num_heads": {heads}, "dropout_rate": 0.1, "model_type": "t5"}}
+EOF
+fi
+
+python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \\
+    --model_path $MODEL_PATH \\
+    --train_file $DATA_DIR/train.json \\
+    --default_root_dir $ROOT_DIR \\
+    --save_ckpt_path $ROOT_DIR/ckpt \\
+    --load_ckpt_path $ROOT_DIR/ckpt \\
+    --train_batchsize {micro} \\
+    --max_seq_length 512 \\
+    --learning_rate 1e-4 \\
+    --min_learning_rate 1e-5 \\
+    --warmup_steps 10000 \\
+    --max_steps 100000 \\
+    --every_n_train_steps 5000 \\
+    --tensor_model_parallel_size {tp} \\
+    --fsdp_parallel_size {fsdp} \\
+    --precision bf16 \\
+    --seed 1234
+"""
+    return body
+
+
+# ------------------------------------------------------------- clue1.1 --
+
+def _clue_unimc_shell():
+    return """#!/bin/bash
+# CLUE1.1 leaderboard recipe via UniMC (reference:
+# fengshen/examples/clue1.1/run_clue_unimc.sh — tnews/afqmc/iflytek/
+# wsc/ocnli/csl/chid/c3 as unified multiple choice)
+set -euo pipefail
+
+TASK=${TASK:-tnews}
+DATA_DIR=${DATA_DIR:-./data/$TASK}
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-UniMC-RoBERTa-110M-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/clue11_unimc_$TASK}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.clue1_1.run_clue_unimc \\
+    --task $TASK \\
+    --data_dir $DATA_DIR \\
+    --model_path $MODEL_PATH \\
+    --default_root_dir $ROOT_DIR \\
+    --save_ckpt_path $ROOT_DIR/ckpt \\
+    --load_ckpt_path $ROOT_DIR/ckpt \\
+    --train_batchsize 16 \\
+    --max_length 512 \\
+    --learning_rate 2e-5 \\
+    --max_epochs 7 \\
+    --precision bf16 \\
+    --output_path $ROOT_DIR/${TASK}_predict.json
+"""
+
+
+def _clue_ubert_shell():
+    return """#!/bin/bash
+# CLUE1.1 extraction-style recipe via UBERT (reference:
+# fengshen/examples/clue1.1/run_clue_ubert.sh)
+set -euo pipefail
+
+TASK=${TASK:-cmrc}
+DATA_DIR=${DATA_DIR:-./data/$TASK}
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-Ubert-110M-Chinese}
+ROOT_DIR=${ROOT_DIR:-./workdir/clue11_ubert_$TASK}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.clue1_1.run_clue_ubert \\
+    --task $TASK \\
+    --data_dir $DATA_DIR \\
+    --model_path $MODEL_PATH \\
+    --default_root_dir $ROOT_DIR \\
+    --save_ckpt_path $ROOT_DIR/ckpt \\
+    --load_ckpt_path $ROOT_DIR/ckpt \\
+    --train_batchsize 8 \\
+    --max_length 512 \\
+    --learning_rate 2e-5 \\
+    --max_epochs 5 \\
+    --precision bf16 \\
+    --output_path $ROOT_DIR/${TASK}_predict.json
+"""
+
+
+def main():
+    written = []
+
+    def emit(reldir, name, content):
+        path = os.path.join(HERE, reldir, name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+        os.chmod(path, 0o755)
+        written.append(os.path.relpath(path, HERE))
+
+    for size in ("base", "large"):
+        for task, labels, b_base, b_large, seq, lr in ZEN2_SEQ_TASKS:
+            batch = b_base if size == "base" else b_large
+            emit("zen2_finetune", f"fs_zen2_{size}_{task}.sh",
+                 _zen2_seq_shell(size, task, labels, batch, seq, lr))
+        for task, batch, seq, lr in ZEN2_NER_TASKS:
+            emit("zen2_finetune", f"ner_zen2_{size}_{task}.sh",
+                 _zen2_ner_shell(size, task, batch, seq, lr))
+
+    # zen1: the reference ships one classification + one NER shell
+    # (fs_zen1_tnews.sh already exists); NER hparams from the reference
+    # ner_zen1_ontonotes4.sh: batch 64, max_seq 128, lr 3e-5
+    zen1_ner = _zen2_ner_shell("base", "ontonotes4", 64, 128, "3e-5")
+    zen1_ner = zen1_ner.replace(
+        "ZEN2-base ontonotes4 NER finetune", "ZEN1 ontonotes4 NER finetune"
+    ).replace(
+        "zen2_finetune/ner_zen2_base_ontonotes4.sh",
+        "zen1_finetune/ner_zen1_ontonotes4.sh"
+    ).replace("IDEA-CCNL/Erlangshen-ZEN2-345M-Chinese",
+              "IDEA-CCNL/Erlangshen-ZEN1-224M-Chinese")
+    emit("zen1_finetune", "ner_zen1_ontonotes4.sh", zen1_ner)
+
+    for size in T5_SCALES:
+        name = ("pretrain_randeng_t5_large.sh" if size == "large" else
+                f"pretrain_randeng_t5_char_{size}.sh")
+        emit("pretrain_t5", name, _t5_shell(size))
+
+    emit("clue1_1", "run_clue_unimc.sh", _clue_unimc_shell())
+    emit("clue1_1", "run_clue_ubert.sh", _clue_ubert_shell())
+    print(f"wrote {len(written)} launchers")
+    return written
+
+
+if __name__ == "__main__":
+    main()

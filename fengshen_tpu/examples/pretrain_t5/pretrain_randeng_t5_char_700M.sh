@@ -1,0 +1,40 @@
+#!/bin/bash
+# Randeng-T5 700M span-corruption pretrain
+# hparams carried from reference: fengshen/examples/pretrain_t5/pretrain_randeng_t5_char_700M.sh
+# TPU: single host by default; scale via the mesh flags
+# (--tensor_model_parallel_size / --fsdp_parallel_size) and
+# launchers/slurm_multihost.sh or launchers/gke_tpu_job.yaml.
+set -euo pipefail
+
+MODEL_PATH=${MODEL_PATH:-./randeng_t5_char_700M}
+DATA_DIR=${DATA_DIR:-./data/wudao_180g}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+
+# model config for this scale point (written once into the workdir)
+if [ ! -f $MODEL_PATH/config.json ]; then
+  mkdir -p $MODEL_PATH
+  cat > $MODEL_PATH/config.json << EOF
+{"vocab_size": 32596, "d_model": 1024, "d_ff": 2816,
+ "num_layers": 24, "num_decoder_layers": 24,
+ "num_heads": 16, "dropout_rate": 0.1, "model_type": "t5"}
+EOF
+fi
+
+python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \
+    --model_path $MODEL_PATH \
+    --train_file $DATA_DIR/train.json \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 8 \
+    --max_seq_length 512 \
+    --learning_rate 1e-4 \
+    --min_learning_rate 1e-5 \
+    --warmup_steps 10000 \
+    --max_steps 100000 \
+    --every_n_train_steps 5000 \
+    --tensor_model_parallel_size 1 \
+    --fsdp_parallel_size 8 \
+    --precision bf16 \
+    --seed 1234

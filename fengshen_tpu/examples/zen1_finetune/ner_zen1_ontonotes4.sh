@@ -1,0 +1,29 @@
+#!/bin/bash
+# ZEN1 ontonotes4 NER finetune
+# hparams carried from reference: fengshen/examples/zen1_finetune/ner_zen1_ontonotes4.sh
+# TPU: single host by default; scale via the mesh flags
+# (--tensor_model_parallel_size / --fsdp_parallel_size) and
+# launchers/slurm_multihost.sh or launchers/gke_tpu_job.yaml.
+set -euo pipefail
+
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-ZEN1-224M-Chinese}
+DATA_DIR=${DATA_DIR:-./data/ontonotes4}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \
+    --model_path $MODEL_PATH \
+    --data_dir $DATA_DIR \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor val_f1 --mode max --save_top_k 3 \
+    --train_batchsize 64 \
+    --val_batchsize 16 \
+    --max_seq_length 128 \
+    --learning_rate 3e-5 \
+    --weight_decay 0.01 \
+    --warmup_ratio 0.01 \
+    --max_epochs 5 \
+    --precision bf16 \
+    --seed 1234

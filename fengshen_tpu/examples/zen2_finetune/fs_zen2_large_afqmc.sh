@@ -1,0 +1,32 @@
+#!/bin/bash
+# ZEN2-large afqmc classification finetune
+# hparams carried from reference: fengshen/examples/zen2_finetune/fs_zen2_large_afqmc.sh
+# TPU: single host by default; scale via the mesh flags
+# (--tensor_model_parallel_size / --fsdp_parallel_size) and
+# launchers/slurm_multihost.sh or launchers/gke_tpu_job.yaml.
+set -euo pipefail
+
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-ZEN2-668M-Chinese}
+DATA_DIR=${DATA_DIR:-./data/afqmc}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.zen2_finetune.fengshen_sequence_level_ft_task \
+    --model_path $MODEL_PATH \
+    --train_file $DATA_DIR/train.json \
+    --val_file $DATA_DIR/dev.json \
+    --test_file $DATA_DIR/test1.1.json \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor val_acc --mode max --save_top_k 3 \
+    --train_batchsize 32 \
+    --val_batchsize 16 \
+    --max_seq_length 128 \
+    --num_labels 2 \
+    --learning_rate 2e-5 \
+    --weight_decay 0.01 \
+    --warmup_ratio 0.01 \
+    --max_epochs 7 \
+    --precision bf16 \
+    --seed 1234

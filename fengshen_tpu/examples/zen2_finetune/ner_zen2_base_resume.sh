@@ -1,0 +1,29 @@
+#!/bin/bash
+# ZEN2-base resume NER finetune
+# hparams carried from reference: fengshen/examples/zen2_finetune/ner_zen2_base_resume.sh
+# TPU: single host by default; scale via the mesh flags
+# (--tensor_model_parallel_size / --fsdp_parallel_size) and
+# launchers/slurm_multihost.sh or launchers/gke_tpu_job.yaml.
+set -euo pipefail
+
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-ZEN2-345M-Chinese}
+DATA_DIR=${DATA_DIR:-./data/resume}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \
+    --model_path $MODEL_PATH \
+    --data_dir $DATA_DIR \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor val_f1 --mode max --save_top_k 3 \
+    --train_batchsize 32 \
+    --val_batchsize 16 \
+    --max_seq_length 256 \
+    --learning_rate 3e-5 \
+    --weight_decay 0.01 \
+    --warmup_ratio 0.01 \
+    --max_epochs 5 \
+    --precision bf16 \
+    --seed 1234

@@ -48,26 +48,17 @@ from fengshen_tpu.models.unimc.modeling_unimc import (collate_unimc,
 
 def load_unimc_checkpoint(ckpt_dir: str):
     """Reference-format dir → (UniMCModel, params, tokenizer)."""
-    import torch
     from transformers import AutoTokenizer
 
     from fengshen_tpu.models.megatron_bert import MegatronBertConfig
     from fengshen_tpu.models.unimc.convert import torch_to_params
     from fengshen_tpu.models.unimc.modeling_unimc import UniMCModel
     from fengshen_tpu.utils.convert_common import (detect_bert_arch,
+                                                   load_torch_checkpoint,
                                                    unwrap_lightning)
 
     config = MegatronBertConfig.from_pretrained(ckpt_dir)
-    state: dict = {}
-    for name in ("pytorch_model.bin", "model.ckpt", "last.ckpt"):
-        path = os.path.join(ckpt_dir, name)
-        if os.path.exists(path):
-            state = torch.load(path, map_location="cpu",
-                               weights_only=False)
-            break
-    if not state:
-        raise FileNotFoundError(
-            f"no pytorch_model.bin / *.ckpt under {ckpt_dir}")
+    state = load_torch_checkpoint(ckpt_dir)
     backbone_type = detect_bert_arch(unwrap_lightning(state))
     params = torch_to_params(state, config, backbone_type=backbone_type)
     tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)

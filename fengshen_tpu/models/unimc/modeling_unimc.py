@@ -195,6 +195,18 @@ class UniMCPipelines:
                 yes_id = ids[0]
         self.model = UniMCModel(config, yes_token_id=yes_id,
                                 backbone_type=backbone_type)
+        if params is None and model is not None:
+            # import reference-format torch weights when the dir has them
+            from fengshen_tpu.models.unimc.convert import torch_to_params
+            from fengshen_tpu.utils.convert_common import \
+                load_torch_checkpoint
+            try:
+                state = load_torch_checkpoint(model)
+            except FileNotFoundError:
+                state = None
+            if state is not None:
+                params = torch_to_params(state, config,
+                                         backbone_type=backbone_type)
         self.params = params
 
     def _encode(self, sample: dict) -> dict:

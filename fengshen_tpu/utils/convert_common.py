@@ -125,3 +125,20 @@ def lstm_cell_params(state_dict: Mapping[str, Any], prefix: str,
         cell[f"h{g}"] = {"kernel": w_hh[gi * h:(gi + 1) * h].T,
                          "bias": b[gi * h:(gi + 1) * h]}
     return cell
+
+
+def load_torch_checkpoint(ckpt_dir: str) -> Mapping[str, Any]:
+    """State dict from a reference-format checkpoint dir, trying the
+    file names the reference publishes under (HF pytorch_model.bin,
+    Lightning model.ckpt / last.ckpt)."""
+    import os
+
+    import torch
+
+    for name in ("pytorch_model.bin", "model.ckpt", "last.ckpt"):
+        path = os.path.join(ckpt_dir, name)
+        if os.path.exists(path):
+            return torch.load(path, map_location="cpu",
+                              weights_only=False)
+    raise FileNotFoundError(
+        f"no pytorch_model.bin / model.ckpt / last.ckpt under {ckpt_dir}")
