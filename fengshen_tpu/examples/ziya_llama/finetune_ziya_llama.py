@@ -115,6 +115,19 @@ class LlamaSFTPackedCollator:
         if cur["ids"]:
             rows.append(cur)
         if self.fixed_rows is not None:
+            if len(rows) > self.fixed_rows:
+                # silent truncation is training-data loss — count it so a
+                # mis-sized --packed_rows is visible in the logs
+                prev = getattr(self, "dropped_rows", 0)
+                self.dropped_rows = prev + len(rows) - self.fixed_rows
+                # warn on the first drop and every 100-row threshold
+                if prev == 0 or prev // 100 != self.dropped_rows // 100:
+                    import logging
+                    logging.getLogger("fengshen_tpu").warning(
+                        "[packed] dropped %d overflow row(s) so far — "
+                        "batches pack into more than --packed_rows=%d "
+                        "rows; raise it to keep all data",
+                        self.dropped_rows, self.fixed_rows)
             rows = rows[: self.fixed_rows]
             empty = {"ids": [], "labels": [], "segs": [], "pos": []}
             rows += [empty] * (self.fixed_rows - len(rows))

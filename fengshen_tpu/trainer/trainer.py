@@ -428,12 +428,21 @@ class Trainer:
             step = jax.jit(functools.partial(module.predict_step, **kwargs))
             kwargs = {}
         outputs = []
+        warned_fallback = False
         for batch in dataloader:
             if getattr(self, "_batch_sh", None) is not None:
                 try:
                     batch = jax.device_put(batch, self._batch_sh)
-                except (ValueError, TypeError):
-                    pass  # batch structure differs from training
+                except (ValueError, TypeError) as e:
+                    # batch structure differs from training — running
+                    # un-sharded is correct but quietly gathers onto one
+                    # device on a pod, so say so ONCE (same contract as
+                    # _run_validation's val_shard_fallback)
+                    if not warned_fallback:
+                        warned_fallback = True
+                        self._log({"event": "predict_shard_fallback",
+                                   "step": self.global_step,
+                                   "error": str(e)[:200]})
             outputs.append(step(params, batch, **kwargs))
         return outputs
 

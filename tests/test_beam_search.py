@@ -17,6 +17,8 @@ import pytest
 from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
 from fengshen_tpu.utils.generate import seq2seq_generate
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 VOCAB = 6
 EOS = 1

@@ -9,6 +9,8 @@ from fengshen_tpu.models.bert import BertConfig, BertModel
 from fengshen_tpu.models.clip import (CLIPVisionConfig, TaiyiCLIPModel,
                                       clip_contrastive_loss)
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 def test_bert_forward_parity():
     torch = pytest.importorskip("torch")

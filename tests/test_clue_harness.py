@@ -10,6 +10,8 @@ import json
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 torch = pytest.importorskip("torch")
 
 

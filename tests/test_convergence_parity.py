@@ -20,8 +20,13 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
+
+
 torch = pytest.importorskip("torch")
 import transformers  # noqa: E402
+
 
 LR, WD, BETAS, EPS = 1e-3, 0.01, (0.9, 0.999), 1e-8
 N_STEPS = 25

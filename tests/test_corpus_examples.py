@@ -2,8 +2,13 @@
 
 import json
 
+
+
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 def test_shard_and_preprocess(tmp_path):

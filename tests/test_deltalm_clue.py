@@ -1,10 +1,13 @@
 """DeltaLM + CLUE harness tests."""
 
+import pytest
 import json
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
 
 
 def test_deltalm_forward_and_causality():

@@ -5,8 +5,13 @@ mirroring tests/test_examples.py (SURVEY.md §4)."""
 import json
 import os
 
+
+
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 def _bert_tokenizer_dir(tmp_path):

@@ -3,8 +3,13 @@ translation, and ZEN1 finetune — tiny data, 8-device CPU mesh."""
 
 import json
 
+
+
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 def _bert_tokenizer_dir(tmp_path):

@@ -4,8 +4,13 @@ taiyi-clip pretrain, taiyi-SD finetune, dreambooth — tiny data, CPU mesh."""
 import json
 import wave
 
+
+
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 # ---------------------------------------------------------------------------

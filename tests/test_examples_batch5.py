@@ -4,8 +4,13 @@ pretrain, DAVAE generate demo, tcbert demo."""
 
 import json
 
+
+
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 def _bert_tokenizer_dir(tmp_path):

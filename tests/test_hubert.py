@@ -1,8 +1,11 @@
 """Hubert audio pretraining tests."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
 
 
 def test_mask_indices():

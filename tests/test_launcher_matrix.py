@@ -11,6 +11,8 @@ import re
 
 import pytest
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "fengshen_tpu",
                         "examples")
 

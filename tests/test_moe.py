@@ -13,6 +13,8 @@ import pytest
 
 from fengshen_tpu.ops import SwitchMoE, load_balancing_loss
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 @pytest.fixture
 def mesh_exp2():

@@ -13,6 +13,8 @@ from fengshen_tpu.examples.ziya_llama.finetune_ziya_llama import (
 from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 class CharTok:
     """Minimal char tokenizer with the HF encode() surface."""

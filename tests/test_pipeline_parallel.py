@@ -4,8 +4,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
+import pytest
+
+
 
 from fengshen_tpu.parallel.pipeline import pipeline_apply
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 
 def _mesh_pipe4():

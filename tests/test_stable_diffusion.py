@@ -1,8 +1,11 @@
 """Stable Diffusion component + training-step tests."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
 
 
 def test_scheduler_add_noise_and_velocity():

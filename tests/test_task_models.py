@@ -9,6 +9,8 @@ import pytest
 
 from fengshen_tpu.models.megatron_bert import MegatronBertConfig
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 def _bert_tokenizer(tmp_path):
     from transformers import BertTokenizer

@@ -4,6 +4,8 @@ import argparse
 import json
 import os
 
+
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -11,6 +13,8 @@ import pytest
 
 from fengshen_tpu.data import (PretrainingSampler, PretrainingRandomSampler,
                                UniversalDataModule, DataLoader)
+
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
 
 
 def _parse(argv, extra=None):
@@ -370,6 +374,7 @@ import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
 from fengshen_tpu.parallel import distributed_initialize
+
 distributed_initialize("127.0.0.1:29876", num_processes=2,
                        process_id=int(sys.argv[1]))
 print("DEVICES", jax.device_count(), "PROC", jax.process_count())

@@ -15,6 +15,8 @@ from fengshen_tpu.ops import dot_product_attention, causal_mask
 from fengshen_tpu.ops.ulysses_attention import (
     ulysses_attention_sharded, sequence_parallel_attention)
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
 
 def _rand_qkv(rng, batch, seq, heads, dim):
     return (jnp.asarray(rng.randn(batch, seq, heads, dim), jnp.float32),

@@ -7,6 +7,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-fit/e2e lane: run with -m slow or no -m filter
+
+
+
+
 
 # -- zen2 -------------------------------------------------------------------
 
