@@ -76,6 +76,9 @@ MODEL_REGISTRY: dict[str, tuple[str, str, dict[str, str]]] = {
               {"base": "PPVAEModel"}),
     "della": ("fengshen_tpu.models.deepvae", "DellaConfig",
               {"base": "DellaModel"}),
+    "transfo-xl-denoise": ("fengshen_tpu.models.transfo_xl_denoise",
+                           "TransfoXLDenoiseConfig",
+                           {"base": "TransfoXLDenoiseModel"}),
     "transfo-xl-paraphrase": ("fengshen_tpu.models.transfo_xl_paraphrase",
                               "TransfoXLParaphraseConfig",
                               {"base": "TransfoXLParaphraseModel"}),
@@ -148,8 +151,35 @@ class AutoModel:
         params = None
         try:
             convert = importlib.import_module(module.__name__ + ".convert")
+        except ModuleNotFoundError:
+            return model, params
+        try:
             if hasattr(convert, "load_hf_pretrained"):
                 _, params = convert.load_hf_pretrained(path, config)
-        except (ModuleNotFoundError, FileNotFoundError):
-            pass
+            elif hasattr(convert, "torch_to_params"):
+                # generic path: reference-format torch weights in the dir
+                # → the family converter (passing the requested head when
+                # the converter dispatches on it)
+                import inspect
+
+                from fengshen_tpu.utils.convert_common import \
+                    load_torch_checkpoint
+                state = load_torch_checkpoint(path)
+                kwargs = {}
+                if "head" in inspect.signature(
+                        convert.torch_to_params).parameters:
+                    kwargs["head"] = head
+                elif head != "base":
+                    import logging
+                    logging.getLogger("fengshen_tpu").warning(
+                        "%s.convert.torch_to_params does not dispatch on "
+                        "heads; the tree returned for head=%r may miss "
+                        "head weights — flax will error at apply if so. "
+                        "Use the family converter directly for full "
+                        "control.", module.__name__, head)
+                params = convert.torch_to_params(state, config, **kwargs)
+        except FileNotFoundError:
+            pass  # config-only dir: return a randomly initialisable model
+        except ModuleNotFoundError:
+            pass  # torch-less install: model with params=None, as before
         return model, params

@@ -129,8 +129,9 @@ def lstm_cell_params(state_dict: Mapping[str, Any], prefix: str,
 
 def load_torch_checkpoint(ckpt_dir: str) -> Mapping[str, Any]:
     """State dict from a reference-format checkpoint dir, trying the
-    file names the reference publishes under (HF pytorch_model.bin,
-    Lightning model.ckpt / last.ckpt)."""
+    file names the reference publishes under (HF pytorch_model.bin or
+    sharded *.safetensors, Lightning model.ckpt / last.ckpt)."""
+    import glob
     import os
 
     import torch
@@ -140,5 +141,15 @@ def load_torch_checkpoint(ckpt_dir: str) -> Mapping[str, Any]:
         if os.path.exists(path):
             return torch.load(path, map_location="cpu",
                               weights_only=False)
+    st_files = sorted(glob.glob(os.path.join(ckpt_dir, "*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+        state: dict = {}
+        for f in st_files:
+            with safe_open(f, framework="pt") as sf:
+                for key in sf.keys():
+                    state[key] = sf.get_tensor(key)
+        return state
     raise FileNotFoundError(
-        f"no pytorch_model.bin / model.ckpt / last.ckpt under {ckpt_dir}")
+        f"no pytorch_model.bin / *.safetensors / model.ckpt / last.ckpt "
+        f"under {ckpt_dir}")

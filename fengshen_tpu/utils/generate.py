@@ -160,6 +160,8 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
     HF `generate(min_length=...)` for decoder-only models.
     """
     batch, prompt_len = input_ids.shape
+    if max_new_tokens <= 0:
+        return input_ids
     if attention_mask is None:
         attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
     if rng is None:
@@ -701,7 +703,9 @@ def sample_sequence_batch(model, params, context: jax.Array,
     (reference: fengshen/utils/transfo_xl_utils.py sample_sequence_batch).
     `attention_mask` marks real tokens of a LEFT-padded context — required
     whenever prompts in the batch have different lengths."""
-    max_new = max_out_seq - context.shape[1]
+    # a context already at/over max_out_seq generates nothing (the
+    # reference loop simply doesn't iterate)
+    max_new = max(max_out_seq - context.shape[1], 0)
     return generate(model, params, context,
                     attention_mask=attention_mask, max_new_tokens=max_new,
                     do_sample=True, temperature=temperature, top_k=top_k,

@@ -36,3 +36,41 @@ def test_register_model():
                                intermediate_size=32, num_hidden_layers=1,
                                num_attention_heads=2)
     assert cfg.vocab_size == 8
+
+
+def test_auto_from_pretrained_generic_torch_converter(tmp_path):
+    """AutoModel.from_pretrained loads reference-format torch weights
+    through the family's torch_to_params when no HF loader exists."""
+    import json
+
+    import numpy as np
+    import pytest
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as HFBertConfig
+    from transformers import BertForMaskedLM as HFMLM
+
+    from fengshen_tpu.models.auto import AutoModel
+
+    hf_cfg = HFBertConfig(vocab_size=64, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=64,
+                          max_position_embeddings=32, type_vocab_size=2)
+    torch.manual_seed(0)
+    tm = HFMLM(hf_cfg).eval()
+    ckpt = tmp_path / "bert_ckpt"
+    ckpt.mkdir()
+    torch.save(tm.state_dict(), ckpt / "pytorch_model.bin")
+    (ckpt / "config.json").write_text(json.dumps({
+        "model_type": "bert", "vocab_size": 64, "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 32,
+        "type_vocab_size": 2, "dtype": "float32"}))
+
+    model, params = AutoModel.from_pretrained(str(ckpt), head="masked_lm")
+    assert params is not None
+    import jax.numpy as jnp
+    ids = np.array([[3, 9, 17, 4]], dtype=np.int32)
+    logits = model.apply({"params": params}, jnp.asarray(ids))
+    with torch.no_grad():
+        ref = tm(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4)
