@@ -89,7 +89,7 @@ def _zen2_ner_shell(size, task, batch, seq, lr):
         ref=f"zen2_finetune/ner_zen2_{size}_{task}.sh",
         model=ZEN2_MODELS[size], task=task)
     body += f"""
-python -m fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \\
+python -m fengshen_tpu.examples.zen2_finetune.fengshen_token_level_ft_task \\
     --model_path $MODEL_PATH \\
     --data_dir $DATA_DIR \\
     --default_root_dir $ROOT_DIR \\
@@ -252,7 +252,9 @@ def main():
         "zen2_finetune/ner_zen2_base_ontonotes4.sh",
         "zen1_finetune/ner_zen1_ontonotes4.sh"
     ).replace("IDEA-CCNL/Erlangshen-ZEN2-345M-Chinese",
-              "IDEA-CCNL/Erlangshen-ZEN1-224M-Chinese")
+              "IDEA-CCNL/Erlangshen-ZEN1-224M-Chinese"
+    ).replace("zen2_finetune.fengshen_token_level_ft_task",
+              "zen1_finetune.fengshen_token_level_ft_task")
     emit("zen1_finetune", "ner_zen1_ontonotes4.sh", zen1_ner)
 
     for size in T5_SCALES:

@@ -1,0 +1,111 @@
+"""ZEN2 token-level (NER) finetune.
+
+Port of the reference workload (reference:
+fengshen/examples/zen2_finetune/fengshen_token_level_ft_task.py + the 12
+ner_zen2_* shell configs): the zen1 CoNLL pipeline and collator on the
+relative-attention ZEN2 encoder with freq-weighted ngram fusion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+from fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \
+    import ConllDataset, ZenTaggingCollator, build_label_maps
+from fengshen_tpu.models.zen import ZenNgramDict
+from fengshen_tpu.models.zen2 import Zen2Config, Zen2ForTokenClassification
+from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+
+class Zen2TaggingModule(TrainModule):
+    def __init__(self, args, config: Optional[Zen2Config] = None,
+                 num_labels: int = 9):
+        super().__init__(args)
+        if config is None and getattr(args, "model_path", None):
+            config = Zen2Config.from_pretrained(args.model_path)
+        self.config = config
+        self.model = Zen2ForTokenClassification(config,
+                                                num_labels=num_labels)
+
+    @staticmethod
+    def add_module_specific_args(parent_parser):
+        parser = parent_parser.add_argument_group("zen2 ner")
+        parser.add_argument("--max_seq_length", type=int, default=128)
+        parser.add_argument("--ngram_dict_path", type=str, default=None)
+        parser.add_argument("--data_dir", type=str, default=None)
+        return parent_parser
+
+    def init_params(self, rng):
+        seq = min(self.args.max_seq_length, 32)
+        ids = jnp.zeros((1, seq), jnp.int32)
+        ngram_ids = jnp.zeros((1, 8), jnp.int32)
+        ngram_pos = jnp.zeros((1, seq, 8), jnp.int32)
+        return self.model.init(rng, ids, ngram_ids=ngram_ids,
+                               ngram_positions=ngram_pos)["params"]
+
+    def training_loss(self, params, batch, rng):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            ngram_ids=batch["ngram_ids"],
+            ngram_positions=batch["ngram_positions"],
+            attention_mask=batch["attention_mask"],
+            deterministic=False, rngs={"dropout": rng})
+        loss, _ = stable_cross_entropy(logits, batch["labels"])
+        valid = batch["labels"] != -100
+        acc = ((logits.argmax(-1) == batch["labels"]) * valid).sum() / \
+            jnp.maximum(valid.sum(), 1)
+        return loss, {"token_acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from transformers import AutoTokenizer
+
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser()
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = Zen2TaggingModule.add_module_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    if not args.data_dir:
+        parser.error("--data_dir with train.char.bio is required")
+    tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    ngram_dict = ZenNgramDict(args.ngram_dict_path or args.model_path)
+    datasets = {}
+    for split, fname in (("train", "train.char.bio"),
+                         ("validation", "dev.char.bio")):
+        path = os.path.join(args.data_dir, fname)
+        if os.path.exists(path):
+            datasets[split] = ConllDataset(path)
+    if "train" not in datasets:
+        parser.error(f"no train.char.bio under {args.data_dir}")
+    label2id, _ = build_label_maps(list(datasets.values()))
+    # zen2 weights ngram spans by dictionary frequency in data prep
+    collator = ZenTaggingCollator(tokenizer, ngram_dict, label2id,
+                                  max_seq_length=args.max_seq_length,
+                                  freq_weighted=True)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets)
+    module = Zen2TaggingModule(args, num_labels=len(label2id))
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

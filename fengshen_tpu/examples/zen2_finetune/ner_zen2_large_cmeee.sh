@@ -11,7 +11,7 @@ DATA_DIR=${DATA_DIR:-./data/cmeee}
 ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
 mkdir -p $ROOT_DIR
 
-python -m fengshen_tpu.examples.zen1_finetune.fengshen_token_level_ft_task \
+python -m fengshen_tpu.examples.zen2_finetune.fengshen_token_level_ft_task \
     --model_path $MODEL_PATH \
     --data_dir $DATA_DIR \
     --default_root_dir $ROOT_DIR \

@@ -258,3 +258,30 @@ def test_zen1_token_level_e2e(tmp_path, mesh8):
         ["--max_seq_length", "32", "--data_dir", str(data_dir)]))
     losses = _losses(tmp_path)
     assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+def test_zen2_token_level_e2e(tmp_path, mesh8):
+    """ner_zen2_* shells drive THIS module — zen2 tower (relative
+    attention) + freq-weighted ngram matrix on the CoNLL pipeline."""
+    import dataclasses
+    import json as _json
+    import os
+
+    from fengshen_tpu.examples.zen2_finetune import (
+        fengshen_token_level_ft_task as task)
+    from fengshen_tpu.models.zen2 import Zen2Config
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    cfg = Zen2Config.small_test_config(vocab_size=len(tok))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(dataclasses.asdict(cfg), f)
+    (model_dir / "ngram.txt").write_text("中文,5\n测试,3\n")
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    conll = "\n".join(["中 B-LOC", "文 I-LOC", "测 O", "试 O", "",
+                       "句 B-LOC", "子 I-LOC", "很 O", "好 O", ""])
+    (data_dir / "train.char.bio").write_text(conll * 4)
+    task.main(_run_args(
+        tmp_path, model_dir, tmp_path / "unused.json",
+        ["--max_seq_length", "32", "--data_dir", str(data_dir)]))
+    losses = _losses(tmp_path)
+    assert len(losses) == 2 and all(np.isfinite(losses))
