@@ -240,3 +240,127 @@ def test_t5_seq2seq_loss_curve_matches_torch():
           f"jax[-1]={jax_losses[-1]:.4f} max|d|={diffs.max():.5f}")
     assert diffs.max() < 5e-3, (torch_losses, jax_losses)
     assert torch_losses[-1] < torch_losses[0] - 0.1
+
+
+def test_unimc_finetune_loss_curve_matches_torch():
+    """Task-head training dynamics (round 3): the full UniMC path —
+    imported MegatronBert tower + reference encoding (block-diagonal
+    option masks, position restarts) + yes-token option scoring + CE —
+    must track a torch program computing the identical loss, step for
+    step under AdamW."""
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.models.unimc.convert import torch_to_params
+    from fengshen_tpu.models.unimc.modeling_unimc import (UniMCModel,
+                                                          collate_unimc)
+
+    hf_cfg = transformers.MegatronBertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(3)
+    tm = transformers.MegatronBertForMaskedLM(hf_cfg).train()
+
+    cfg = MegatronBertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2, dtype="float32",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    yes_id = 7
+    # the unimc converter accepts a raw ForMaskedLM state dict directly
+    params = torch_to_params(tm.state_dict(), cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.asarray(x), jnp.float32), params)
+    model = UniMCModel(cfg, yes_token_id=yes_id)
+
+    # synthetic pre-encoded batches in the shared encoding's format:
+    # two options at fixed positions, block-diagonal mask, restarts.
+    # (Hand-built so no tokenizer is needed; the REAL encode_unimc output
+    # is parity-checked against the torch oracle in
+    # test_clue_harness.py::test_unimc_reference_scoring_matches_torch —
+    # this test adds the training-dynamics dimension.)
+    rng = np.random.RandomState(1)
+    S, n_opt = 16, 2
+    batches = []
+    for _ in range(4):
+        enc_rows = []
+        for _ in range(4):
+            ids = rng.randint(8, 96, S)
+            label_idx = [1, 4, 7]  # [CLS] [M] o o [M] o o text...
+            att = np.ones((S, S), np.int32)
+            att[1:7, 1:7] = 0
+            att[1:4, 1:4] = 1
+            att[4:7, 4:7] = 1
+            pos = [0, 1, 2, 3, 1, 2, 3] + list(range(4, 4 + S - 7))
+            tt = [0] + [1] * 7 + [0] * (S - 8)
+            ids[label_idx[:-1]] = 5  # mask token id
+            label = int(rng.randint(0, n_opt))
+            # learnable signal: a text token announces the gold option,
+            # so the anti-vacuousness check below has something to learn
+            ids[8] = 8 + label
+            enc_rows.append({
+                "input_ids": ids, "attention_mask": att,
+                "token_type_ids": np.asarray(tt),
+                "position_ids": np.asarray(pos),
+                "option_positions": label_idx[:-1],
+                "label": label})
+        batches.append(collate_unimc(enc_rows))
+
+    tx = _optax_adamw()
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o, batch):
+        def loss_fn(p):
+            scores = model.apply(
+                {"params": p}, batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                option_positions=batch["option_positions"],
+                position_ids=batch["position_ids"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                scores, batch["labels"]).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    opt = _torch_adamw(tm)
+    ce = torch.nn.CrossEntropyLoss()
+    torch_losses, jax_losses = [], []
+    # this head learns through the tied MLM logits, which moves slowly
+    # at the shared LR — run longer so the anti-vacuousness check has
+    # teeth; strict parity is asserted over the first N_STEPS, past
+    # which the collapsed loss amplifies fp-order noise chaotically
+    for i in range(3 * N_STEPS):
+        b = batches[i % 4]
+        logits = tm(
+            torch.tensor(b["input_ids"], dtype=torch.long),
+            attention_mask=torch.tensor(b["attention_mask"],
+                                        dtype=torch.float),
+            token_type_ids=torch.tensor(b["token_type_ids"],
+                                        dtype=torch.long),
+            position_ids=torch.tensor(b["position_ids"],
+                                      dtype=torch.long)).logits
+        opt_pos = torch.tensor(b["option_positions"], dtype=torch.long)
+        scores = torch.gather(
+            logits[..., yes_id], 1, opt_pos)
+        t_loss = ce(scores, torch.tensor(b["labels"], dtype=torch.long))
+        opt.zero_grad()
+        t_loss.backward()
+        opt.step()
+        torch_losses.append(float(t_loss.detach()))
+
+        jb = {k: jnp.asarray(v) for k, v in b.items()}
+        params, opt_state, loss = step(params, opt_state, jb)
+        jax_losses.append(float(loss))
+
+    diffs = np.abs(np.array(torch_losses[:N_STEPS]) -
+                   np.array(jax_losses[:N_STEPS]))
+    print(f"\nUniMC loss parity: torch[0]={torch_losses[0]:.4f} "
+          f"jax[0]={jax_losses[0]:.4f} torch[-1]={torch_losses[-1]:.4f} "
+          f"jax[-1]={jax_losses[-1]:.4f} "
+          f"max|d|[:{N_STEPS}]={diffs.max():.5f}")
+    assert diffs.max() < 5e-3, (torch_losses, jax_losses)
+    # the full run must actually learn the planted signal
+    assert torch_losses[-1] < torch_losses[0] - 0.1
+    assert jax_losses[-1] < jax_losses[0] - 0.1
