@@ -1,0 +1,262 @@
+"""Raw CLUE1.1 json → the UniMC data format, per task.
+
+Faithful restatement of the reference's per-task converters
+(reference: fengshen/examples/clue1.1/data_preprocessing/
+{tnews,afqmc,ocnli,csl,wsc,iflytek,c3,chid}_preprocessing.py and
+cluedata2unidata.sh): the exact question strings, option texts, and
+text augmentations those scripts produce are part of the published
+recipe — the zero/few-shot numbers depend on them.
+
+    python -m fengshen_tpu.examples.clue1_1.cluedata2unidata \
+        --task tnews --input_dir ./CLUE/tnews --output_dir ./data/tnews
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+TNEWS_LABEL2DESC = {
+    "news_story": "故事", "news_culture": "文化",
+    "news_entertainment": "娱乐", "news_sports": "体育",
+    "news_finance": "财经", "news_house": "房产", "news_car": "汽车",
+    "news_edu": "教育", "news_tech": "科技", "news_military": "军事",
+    "news_travel": "旅游", "news_world": "国际", "news_stock": "股票",
+    "news_agriculture": "农业", "news_game": "电竞"}
+
+
+def _rows(path):
+    with open(path, encoding="utf8") as f:
+        for line in f:
+            if line.strip():
+                yield json.loads(line)
+
+
+_SKIP = object()  # row has a label the task cannot map (e.g. ocnli '-')
+
+
+def _with_label(item: dict, data: dict, answer: str,
+                choice: list) -> dict | object:
+    """Attach label/answer only when resolvable. A PRESENT but unmapped
+    label (OCNLI's no-consensus '-') signals the row must be DROPPED —
+    emitting it as class 0 would train garbage; an ABSENT label (test
+    split) emits the item without a label key."""
+    if "label" not in data and "label_desc" not in data:
+        item["answer"] = ""
+        return item
+    if not answer:
+        return _SKIP
+    item["answer"] = answer
+    item["label"] = choice.index(answer)
+    return item
+
+
+def convert_tnews(data: dict) -> dict:
+    choice = list(TNEWS_LABEL2DESC.values())
+    answer = TNEWS_LABEL2DESC.get(data.get("label_desc", ""), "")
+    item = {"texta": data["sentence"], "textb": "",
+            "question": "下面新闻属于哪一个类别？", "choice": choice,
+            "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_afqmc(data: dict) -> dict:
+    label2desc = {"0": "不相似", "1": "相似"}
+    choice = list(label2desc.values())
+    answer = label2desc.get(str(data.get("label", "")), "")
+    item = {"texta": data["sentence1"], "textb": data["sentence2"],
+            "question": "", "choice": choice,
+            "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_ocnli(data: dict) -> dict:
+    label2desc = {"contradiction": "矛盾", "neutral": "自然",
+                  "entailment": "蕴含"}
+    choice = list(label2desc.values())
+    answer = label2desc.get(data.get("label", ""), "")
+    item = {"texta": data["sentence1"], "textb": data["sentence2"],
+            "question": "", "choice": choice,
+            "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_csl(data: dict) -> dict:
+    """jieba top-15 keywords prefixed to the abstract; options phrase the
+    keyword list (reference: csl_preprocessing.py:16-47)."""
+    import jieba.analyse
+
+    label2desc = {"1": "可以", "0": "不能"}
+    rs = jieba.analyse.extract_tags(data["abst"], topK=15)
+    texta = "、".join(rs) + "。" + data["abst"]
+    keyword = "、".join(data["keyword"])
+    choice = [f"{v}使用{keyword}概括摘要" for v in label2desc.values()]
+    answer = label2desc.get(str(data.get("label", "")), "")
+    answer = f"{answer}使用{keyword}概括摘要" if answer else ""
+    item = {"texta": texta, "textb": "", "question": "",
+            "choice": choice, "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_wsc(data: dict) -> dict:
+    """Bracket span1 as [..] and span2 as _.._ in the text; options are
+    '<span2>是/不是<span1>' (reference: wsc_preprocessing.py:10-45)."""
+    label2desc = {"true": "是", "false": "不是"}
+    target = data["target"]
+    text = list(data["text"])
+    s1, s2 = target["span1_index"], target["span2_index"]
+    l1, l2 = len(target["span1_text"]), len(target["span2_text"])
+    if s2 < s1:
+        text.insert(s2, "_")
+        text.insert(s2 + l2 + 1, "_")
+        text.insert(s1 + 2, "[")
+        text.insert(s1 + 2 + l1 + 1, "]")
+    else:
+        text.insert(s1, "[")
+        text.insert(s1 + l1 + 1, "]")
+        text.insert(s2 + 2, "_")
+        text.insert(s2 + 2 + l2 + 1, "_")
+    span1, span2 = target["span1_text"], target["span2_text"]
+    choice = [f"{span2}{v}{span1}" for v in label2desc.values()]
+    answer = label2desc.get(str(data.get("label", "")).lower(), "")
+    answer = f"{span2}{answer}{span1}" if answer else ""
+    item = {"texta": "".join(text), "textb": "", "question": "",
+            "choice": choice, "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_iflytek(data: dict, label_vocab: dict) -> dict:
+    """Choices are the task's full label_des vocabulary, built from the
+    labelled splits (the reference hardcodes the same list)."""
+    choice = list(label_vocab.values())
+    answer = label_vocab.get(str(data.get("label", "")), "")
+    item = {"texta": data["sentence"], "textb": "",
+            "question": "下面句子描述的应用属于哪一个类别？",
+            "choice": choice, "id": data.get("id", 0)}
+    return _with_label(item, data, answer, choice)
+
+
+def convert_c3(data: list) -> list:
+    """c3 rows are [passage_sentences, [qa...], id]; one UniMC item per
+    question (reference: c3_preprocessing.py)."""
+    texta = "\n".join(data[0])
+    out = []
+    for qa in data[1]:
+        answer = qa.get("answer", "")
+        item = {"texta": texta, "textb": "",
+                "question": qa["question"], "choice": qa["choice"],
+                "answer": answer,
+                "id": data[2] if len(data) > 2 else 0}
+        if answer:
+            item["label"] = qa["choice"].index(answer)
+        out.append(item)
+    return out
+
+
+def convert_chid(data: dict, answers: dict) -> list:
+    """One UniMC item per idiom blank: the blank's sentence with #idiom#
+    replaced by [MASK]s, candidates as options
+    (reference: chid_preprocessing.py — simplified to whole-sentence
+    context instead of its windowed re-segmentation)."""
+    import re
+
+    out = []
+    for sent in data["content"]:
+        for m in re.findall(r"#idiom\d+#", sent):
+            # the scored blank becomes ____; OTHER blanks in the same
+            # sentence are stripped so no raw #idiomN# junk remains
+            text = re.sub(r"#idiom\d+#", "",
+                          sent.replace(m, "____"))
+            label = answers.get(m)
+            item = {"texta": text, "textb": "", "question": "",
+                    "choice": data["candidates"], "id": m}
+            if label is not None:
+                item["answer"] = data["candidates"][label]
+                item["label"] = label
+            else:
+                item["answer"] = ""
+            out.append(item)
+    return out
+
+
+def convert_file(task: str, in_path: str, out_path: str,
+                 label_vocab: dict | None = None,
+                 answers: dict | None = None) -> int:
+    simple = {"tnews": convert_tnews, "afqmc": convert_afqmc,
+              "ocnli": convert_ocnli, "csl": convert_csl,
+              "wsc": convert_wsc}
+    n = 0
+    with open(out_path, "w", encoding="utf8") as out:
+        for data in _rows(in_path):
+            if task in simple:
+                items = [simple[task](data)]
+            elif task == "iflytek":
+                items = [convert_iflytek(data, label_vocab or {})]
+            elif task == "c3":
+                items = convert_c3(data)
+            elif task == "chid":
+                items = convert_chid(data, answers or {})
+            else:
+                raise ValueError(f"unknown task {task}")
+            for item in items:
+                if item is _SKIP:
+                    continue
+                out.write(json.dumps(item, ensure_ascii=False) + "\n")
+                n += 1
+    return n
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="raw CLUE1.1 → UniMC-format jsonl")
+    parser.add_argument("--task", required=True,
+                        choices=["tnews", "afqmc", "ocnli", "csl", "wsc",
+                                 "iflytek", "c3", "chid"])
+    parser.add_argument("--input_dir", required=True)
+    parser.add_argument("--output_dir", required=True)
+    args = parser.parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    label_vocab = None
+    if args.task == "iflytek":
+        label_vocab = {}
+        for split in ("train.json", "dev.json"):
+            path = os.path.join(args.input_dir, split)
+            if os.path.exists(path):
+                for r in _rows(path):
+                    if "label" in r:
+                        label_vocab[str(r["label"])] = r.get(
+                            "label_des", str(r["label"]))
+        label_vocab = dict(sorted(
+            label_vocab.items(),
+            key=lambda kv: int(kv[0]) if kv[0].isdigit() else 0))
+    answers = None
+    if args.task == "chid":
+        answers = {}
+        for name in ("train_answer.json", "dev_answer.json"):
+            path = os.path.join(args.input_dir, name)
+            if os.path.exists(path):
+                with open(path, encoding="utf8") as f:
+                    answers.update(json.load(f))
+
+    if args.task == "iflytek" and label_vocab:
+        # the original CLUE label id per option index — run_clue_unimc
+        # reads this to write leaderboard-format predictions
+        with open(os.path.join(args.output_dir, "label_map.json"), "w",
+                  encoding="utf8") as f:
+            json.dump(label_vocab, f, ensure_ascii=False, indent=1)
+
+    for split in ("train.json", "dev.json", "test.json",
+                  "test1.1.json", "test_public.json"):
+        in_path = os.path.join(args.input_dir, split)
+        if not os.path.exists(in_path):
+            continue
+        out_path = os.path.join(args.output_dir, split)
+        n = convert_file(args.task, in_path, out_path, label_vocab,
+                         answers)
+        print(f"[{args.task}] {split}: {n} items → {out_path}")
+
+
+if __name__ == "__main__":
+    main()

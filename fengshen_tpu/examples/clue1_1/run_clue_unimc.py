@@ -17,19 +17,21 @@ import os
 
 # task → (ordered CLUE label ids, option texts). The label id at
 # position i corresponds to choice i; predictions are written back as
-# the original id string.
+# the original id string. ORDERING IS SHARED with the cluedata2unidata
+# converters (their label2desc dict orders) so converted rows and these
+# inline fallbacks agree on what option index i means.
 TASK_LABELS = {
     "tnews": (["100", "101", "102", "103", "104", "106", "107", "108",
                "109", "110", "112", "113", "114", "115", "116"],
               ["故事", "文化", "娱乐", "体育", "财经", "房产", "汽车",
                "教育", "科技", "军事", "旅游", "国际", "股票", "农业",
                "电竞"]),
-    "afqmc": (["0", "1"], ["不同", "相似"]),
-    "ocnli": (["entailment", "neutral", "contradiction"],
-              ["蕴含", "中立", "矛盾"]),
-    "csl": (["0", "1"], ["错误", "正确"]),
-    "wsc": (["false", "true"], ["错误", "正确"]),
-    "iflytek": (None, None),  # built from the data's label/label_des
+    "afqmc": (["0", "1"], ["不相似", "相似"]),
+    "ocnli": (["contradiction", "neutral", "entailment"],
+              ["矛盾", "自然", "蕴含"]),
+    "csl": (["1", "0"], ["可以概括摘要", "不能概括摘要"]),
+    "wsc": (["true", "false"], ["是", "不是"]),
+    "iflytek": (None, None),  # built from the data / label_map.json
 }
 
 
@@ -71,6 +73,10 @@ def _text(task: str, r: dict) -> str:
 
 def to_unimc(task: str, rows: list[dict], label_ids: list[str],
              choices: list[str]) -> list[dict]:
+    if rows and "choice" in rows[0]:
+        # already in the UniMC format (produced by cluedata2unidata's
+        # reference-faithful per-task converters) — pass through
+        return rows
     index = {lid: i for i, lid in enumerate(label_ids)}
     out = []
     for r in rows:
@@ -104,11 +110,20 @@ def main(argv=None):
 
     label_ids, choices = TASK_LABELS[args.task]
     if label_ids is None:
-        label_ids, choices = iflytek_labels(train_rows + dev_rows)
+        label_map_path = os.path.join(args.data_dir, "label_map.json")
+        if os.path.exists(label_map_path):
+            # written by cluedata2unidata next to converted rows: the
+            # original CLUE label id per option index
+            with open(label_map_path, encoding="utf8") as f:
+                label_map = json.load(f)
+            label_ids = list(label_map)
+            choices = list(label_map.values())
+        else:
+            label_ids, choices = iflytek_labels(train_rows + dev_rows)
         if not label_ids:
             raise ValueError(
-                "iflytek needs labelled train/dev rows to build the "
-                "label→description vocabulary")
+                "iflytek needs label_map.json or labelled train/dev rows "
+                "to build the label→description vocabulary")
 
     train = to_unimc(args.task, train_rows, label_ids, choices)
     dev = to_unimc(args.task, dev_rows, label_ids, choices)

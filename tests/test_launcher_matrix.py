@@ -167,3 +167,101 @@ def test_run_clue_unimc_e2e(tmp_path, monkeypatch):
                  "108", "109", "110", "112", "113", "114", "115",
                  "116"}
     assert all(p["label"] in tnews_ids for p in preds)
+
+
+def test_cluedata2unidata_converters(tmp_path):
+    """Raw CLUE rows → the reference's exact UniMC items (question
+    strings, option phrasing, text augmentations) per task."""
+    import json
+
+    from fengshen_tpu.examples.clue1_1 import cluedata2unidata as c2u
+
+    t = c2u.convert_tnews({"sentence": "股市大涨", "label": "114",
+                           "label_desc": "news_stock", "id": 7})
+    assert t["question"] == "下面新闻属于哪一个类别？"
+    assert t["choice"][t["label"]] == "股票" and t["answer"] == "股票"
+
+    a = c2u.convert_afqmc({"sentence1": "花呗如何还款",
+                           "sentence2": "花呗怎么还钱", "label": "1"})
+    assert a["choice"] == ["不相似", "相似"] and a["label"] == 1
+
+    o = c2u.convert_ocnli({"sentence1": "他在北京", "sentence2": "他在中国",
+                           "label": "entailment"})
+    assert o["choice"][o["label"]] == "蕴含"
+
+    w = c2u.convert_wsc({
+        "text": "小明告诉小红他很高兴",
+        "target": {"span1_index": 0, "span1_text": "小明",
+                   "span2_index": 6, "span2_text": "他"},
+        "label": "true"})
+    assert "[小明]" in w["texta"] and "_他_" in w["texta"]
+    assert w["choice"][w["label"]] == "他是小明"
+
+    s = c2u.convert_csl({"abst": "本文研究了深度学习模型的压缩方法",
+                         "keyword": ["深度学习", "压缩"], "label": "1"})
+    assert s["choice"][s["label"]].startswith("可以使用深度学习、压缩")
+    assert s["texta"].endswith("本文研究了深度学习模型的压缩方法")
+
+    c3 = c2u.convert_c3([["第一句。", "第二句。"],
+                         [{"question": "问题？",
+                           "choice": ["甲", "乙"], "answer": "乙"}],
+                         "c3-id"])
+    assert len(c3) == 1 and c3[0]["label"] == 1
+
+    ch = c2u.convert_chid(
+        {"content": ["这件事#idiom000001#，大家都明白。"],
+         "candidates": ["一目了然", "一知半解"]},
+        {"#idiom000001#": 0})
+    assert len(ch) == 1 and ch[0]["label"] == 0
+    assert "____" in ch[0]["texta"]
+
+    # end-to-end file conversion + the driver's pass-through
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    with open(raw / "train.json", "w") as f:
+        f.write(json.dumps({"sentence": "股市大涨", "label": "114",
+                            "label_desc": "news_stock", "id": 1},
+                           ensure_ascii=False) + "\n")
+    out_dir = tmp_path / "uni"
+    c2u.main(["--task", "tnews", "--input_dir", str(raw),
+              "--output_dir", str(out_dir)])
+    rows = [json.loads(l) for l in open(out_dir / "train.json")]
+    assert rows and rows[0]["choice"][rows[0]["label"]] == "股票"
+
+    from fengshen_tpu.examples.clue1_1.run_clue_unimc import to_unimc
+    passed = to_unimc("tnews", rows, [], [])
+    assert passed is rows  # converted rows pass through unchanged
+
+
+def test_cluedata2unidata_label_hygiene():
+    """Unmapped labels (OCNLI '-') drop the row; absent labels (test
+    split) emit no label key; converter option order agrees with
+    run_clue_unimc's TASK_LABELS so written prediction ids are right."""
+    from fengshen_tpu.examples.clue1_1 import cluedata2unidata as c2u
+    from fengshen_tpu.examples.clue1_1.run_clue_unimc import TASK_LABELS
+
+    # '-' (no consensus) must be dropped, not trained as class 0
+    assert c2u.convert_ocnli({"sentence1": "a", "sentence2": "b",
+                              "label": "-"}) is c2u._SKIP
+    # test rows carry no label key at all
+    t = c2u.convert_tnews({"sentence": "x", "id": 1})
+    assert "label" not in t
+    # order agreement: option index i ↔ TASK_LABELS id i
+    for task, conv, probe in (
+            ("ocnli", c2u.convert_ocnli,
+             lambda lid: {"sentence1": "a", "sentence2": "b",
+                          "label": lid}),
+            ("wsc", c2u.convert_wsc,
+             lambda lid: {"text": "小明说他好",
+                          "target": {"span1_index": 0,
+                                     "span1_text": "小明",
+                                     "span2_index": 3,
+                                     "span2_text": "他"},
+                          "label": lid}),
+            ("csl", c2u.convert_csl,
+             lambda lid: {"abst": "研究", "keyword": ["研"],
+                          "label": lid})):
+        label_ids, _ = TASK_LABELS[task]
+        for i, lid in enumerate(label_ids):
+            item = conv(probe(lid))
+            assert item["label"] == i, (task, lid, item)
