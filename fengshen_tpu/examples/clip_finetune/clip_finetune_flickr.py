@@ -2,18 +2,58 @@
 
 Port of the reference workload
 (reference: fengshen/examples/clip_finetune/clip_finetune_flickr.py):
-the same contrastive module as pretrain_taiyi_clip with both towers
-trainable and a finetune-scale LR — the reference splits pretrain/finetune
-into separate dirs; here the finetune driver reuses the pretrain module.
+the same contrastive module as pretrain_taiyi_clip with BOTH towers
+trainable and the reference's finetune hyperparameters as defaults —
+the per-vision-tower LR preset table (:184-196), AdamW betas
+(0.9, 0.98) / eps 1e-6 for ViT, weight decay 0.2 (:198-206), and a
+cosine schedule in place of its CosineAnnealingWarmRestarts (:210-213).
+Any explicitly passed flag overrides the preset.
 """
 
 from __future__ import annotations
 
+import argparse
+
+# reference :184-196 — LR by vision tower; Taiyi-CLIP ships ViT-B/32
+CLIP_LR_PRESETS = {
+    "RN50": 5e-4, "RN101": 5e-4, "RN50x4": 5e-4, "RN50x16": 4e-4,
+    "RN50x64": 3.6e-4, "ViT-B/32": 5e-4, "ViT-B/16": 5e-4,
+    "ViT-L/14": 4e-4, "ViT-L/14-336px": 2e-5,
+}
+
+def _finetune_defaults(clip_model: str) -> dict:
+    is_vit = clip_model.startswith("ViT")
+    return {
+        "--weight_decay": "0.2",
+        # reference :198-206: betas (0.9, 0.98) + eps 1e-6 for ViT
+        # towers, (0.9, 0.999) + eps 1e-8 for the ResNet towers
+        "--adam_beta2": "0.98" if is_vit else "0.999",
+        "--adam_epsilon": "1e-6" if is_vit else "1e-8",
+        "--scheduler_type": "cosine",
+        "--learning_rate": str(CLIP_LR_PRESETS[clip_model]),
+    }
+
 
 def main(argv=None):
+    import sys
+
     from fengshen_tpu.examples.pretrain_taiyi_clip.pretrain import main \
         as pretrain_main
-    # finetune = same driver, both towers trainable (no --freeze_image_tower)
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    peek = argparse.ArgumentParser(add_help=False)
+    peek.add_argument("--clip_model", default="ViT-B/32",
+                      choices=sorted(CLIP_LR_PRESETS))
+    preset_args, argv = peek.parse_known_args(argv)
+
+    # finetune = same driver, both towers trainable (no
+    # --freeze_image_tower) with the reference finetune defaults; every
+    # user-passed flag wins over a preset (both `--flag value` and
+    # `--flag=value` forms count as passed)
+    passed = {a.split("=", 1)[0] for a in argv if a.startswith("--")}
+    for flag, value in _finetune_defaults(preset_args.clip_model).items():
+        if flag not in passed:
+            argv += [flag, value]
     pretrain_main(argv)
 
 

@@ -229,3 +229,42 @@ def test_dreambooth_dataset_pairs(tmp_path):
     assert len(ds) == 3
     s = ds[1]
     assert s["instance_prompt"] == "sks 狗" and "class_image" in s
+
+
+def test_clip_finetune_flickr_e2e(tmp_path, mesh8, monkeypatch):
+    """The finetune driver injects the reference presets (LR table, ViT
+    AdamW betas/eps, wd 0.2, cosine) and trains BOTH towers."""
+    from fengshen_tpu.examples.clip_finetune import clip_finetune_flickr
+    from fengshen_tpu.examples.pretrain_taiyi_clip import pretrain
+    from fengshen_tpu.models.clip import CLIPVisionConfig
+    _, csv_path = _image_dataset(tmp_path)
+    tok, model_dir = _bert_dir(tmp_path)
+    small_vision = CLIPVisionConfig.small_test_config(image_size=32)
+    monkeypatch.setattr(pretrain, "CLIPVisionConfig", lambda: small_vision)
+
+    seen = {}
+    orig = pretrain.main
+
+    def spy(argv):
+        seen["argv"] = list(argv)
+        return orig(argv)
+
+    monkeypatch.setattr(pretrain, "main", spy)
+    clip_finetune_flickr.main([
+        "--model_path", str(model_dir), "--train_csv", str(csv_path),
+        "--train_batchsize", "2", "--max_steps", "2",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--image_size", "32", "--max_length", "16", "--seed", "1",
+        "--learning_rate", "1e-4"])  # explicit flag beats the preset
+    argv = seen["argv"]
+    assert "--freeze_image_tower" not in argv
+    assert argv[argv.index("--learning_rate") + 1] == "1e-4"
+    assert argv[argv.index("--weight_decay") + 1] == "0.2"
+    assert argv[argv.index("--scheduler_type") + 1] == "cosine"
+    lines = [json.loads(l)
+             for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2 and all(np.isfinite(losses))
