@@ -147,7 +147,9 @@ def convert_c3(data: list) -> list:
         item = {"texta": texta, "textb": "",
                 "question": qa["question"], "choice": qa["choice"],
                 "answer": answer,
-                "id": data[2] if len(data) > 2 else 0}
+                # per-QUESTION id (reference c3_preprocessing.py:20) —
+                # the submission aligns predictions by it
+                "id": qa.get("id", data[2] if len(data) > 2 else 0)}
         if answer:
             item["label"] = qa["choice"].index(answer)
         out.append(item)

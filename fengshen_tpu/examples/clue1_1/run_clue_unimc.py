@@ -5,8 +5,10 @@ every CLUE classification task reformulated as unified multiple choice
 (the recipe behind the UniMC-DeBERTa CLUE1.1 rank-8 entry,
 reference: fengshen/examples/clue1.1/README.md:3). Reads the CLUE json
 files, maps each task's label ids onto option texts, trains through
-UniMCPipelines, and writes leaderboard-format predictions (original
-label-id strings, not option indices).
+UniMCPipelines, and writes leaderboard-format predictions — original
+label-id strings for the fixed-label tasks, the reference
+predict2submit formats for c3 (option indices) and chid (one
+{tag: index} object).
 """
 
 from __future__ import annotations
@@ -32,6 +34,11 @@ TASK_LABELS = {
     "csl": (["1", "0"], ["可以概括摘要", "不能概括摘要"]),
     "wsc": (["true", "false"], ["是", "不是"]),
     "iflytek": (None, None),  # built from the data / label_map.json
+    # c3 and chid carry per-row choice lists (cluedata2unidata output
+    # required); predictions are option indices with task-specific
+    # submission formats (reference: predict2submit/{c3,chid}_submit.py)
+    "c3": ([], []),
+    "chid": ([], []),
 }
 
 
@@ -109,6 +116,19 @@ def main(argv=None):
     test_rows = load_rows(os.path.join(args.data_dir, args.test_data))
 
     label_ids, choices = TASK_LABELS[args.task]
+    if args.task in ("c3", "chid"):
+        # EVERY split must be pre-converted (per-row choice lists) —
+        # raw c3/chid rows have no 'choice' and would silently train on
+        # empty-option garbage through the generic fallback
+        for name, rows in (("train", train_rows), ("dev", dev_rows),
+                           ("test", test_rows)):
+            if rows and "choice" not in rows[0]:
+                raise ValueError(
+                    f"{args.task} {name} split is not in the UniMC "
+                    "format — run cluedata2unidata first")
+        if not any((train_rows, dev_rows, test_rows)):
+            raise ValueError(f"no data found for {args.task} in "
+                             f"{args.data_dir}")
     if label_ids is None:
         label_map_path = os.path.join(args.data_dir, "label_map.json")
         if os.path.exists(label_map_path):
@@ -137,10 +157,25 @@ def main(argv=None):
     for i in range(0, len(test), bs):
         preds.extend(pipe.predict(test[i:i + bs]))
     with open(args.output_path, "w") as f:
-        for row, p in zip(test_rows, preds):
+        if args.task == "chid":
+            # submission is ONE json object {"#idiomN#": option_index}
+            # (reference: predict2submit/chid_submit.py)
             f.write(json.dumps(
-                {"id": row.get("id"), "label": label_ids[p]},
+                {row.get("id"): int(p)
+                 for row, p in zip(test_rows, preds)},
                 ensure_ascii=False) + "\n")
+        elif args.task == "c3":
+            # c3 submits the option index directly
+            # (reference: predict2submit/c3_submit.py)
+            for row, p in zip(test_rows, preds):
+                f.write(json.dumps(
+                    {"id": row.get("id"), "label": int(p)},
+                    ensure_ascii=False) + "\n")
+        else:
+            for row, p in zip(test_rows, preds):
+                f.write(json.dumps(
+                    {"id": row.get("id"), "label": label_ids[p]},
+                    ensure_ascii=False) + "\n")
     print(f"[clue1.1:{args.task}] wrote {len(preds)} predictions "
           f"to {args.output_path}")
 

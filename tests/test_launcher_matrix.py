@@ -203,10 +203,11 @@ def test_cluedata2unidata_converters(tmp_path):
     assert s["texta"].endswith("本文研究了深度学习模型的压缩方法")
 
     c3 = c2u.convert_c3([["第一句。", "第二句。"],
-                         [{"question": "问题？",
+                         [{"question": "问题？", "id": "q-77",
                            "choice": ["甲", "乙"], "answer": "乙"}],
                          "c3-id"])
     assert len(c3) == 1 and c3[0]["label"] == 1
+    assert c3[0]["id"] == "q-77"  # per-question, not the doc id
 
     ch = c2u.convert_chid(
         {"content": ["这件事#idiom000001#，大家都明白。"],
@@ -265,3 +266,58 @@ def test_cluedata2unidata_label_hygiene():
         for i, lid in enumerate(label_ids):
             item = conv(probe(lid))
             assert item["label"] == i, (task, lid, item)
+
+
+def test_run_clue_unimc_chid_c3_submission_formats(tmp_path, monkeypatch):
+    """chid submits ONE dict {tag: index}; c3 submits option indices —
+    the reference predict2submit formats."""
+    import json
+
+    from fengshen_tpu.examples.clue1_1 import run_clue_unimc as drv
+
+    from fengshen_tpu.models.unimc.modeling_unimc import UniMCPipelines
+
+    class FakePipe:
+        add_pipeline_specific_args = staticmethod(
+            UniMCPipelines.add_pipeline_specific_args)
+
+        def __init__(self, args=None, model=None):
+            pass
+
+        def train(self, *a, **k):
+            raise AssertionError("no train data given")
+
+        def predict(self, rows):
+            return [1] * len(rows)
+
+    monkeypatch.setattr(
+        "fengshen_tpu.models.unimc.modeling_unimc.UniMCPipelines",
+        FakePipe)
+
+    data = tmp_path / "chid"
+    data.mkdir()
+    rows = [{"texta": "这件事____。", "textb": "", "question": "",
+             "choice": ["一目了然", "一知半解"], "answer": "",
+             "id": f"#idiom00000{i}#"} for i in range(3)]
+    with open(data / "test.json", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n")
+    out = tmp_path / "chid_pred.json"
+    drv.main(["--task", "chid", "--data_dir", str(data),
+              "--output_path", str(out)])
+    sub = json.loads(open(out).read())
+    assert sub == {f"#idiom00000{i}#": 1 for i in range(3)}
+
+    data2 = tmp_path / "c3"
+    data2.mkdir()
+    rows = [{"texta": "文。", "textb": "", "question": "问？",
+             "choice": ["甲", "乙", "丙"], "answer": "", "id": i}
+            for i in range(2)]
+    with open(data2 / "test.json", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n")
+    out2 = tmp_path / "c3_pred.json"
+    drv.main(["--task", "c3", "--data_dir", str(data2),
+              "--output_path", str(out2)])
+    preds = [json.loads(l) for l in open(out2)]
+    assert preds == [{"id": 0, "label": 1}, {"id": 1, "label": 1}]
