@@ -62,6 +62,10 @@ class T5PretrainModule(TrainModule):
                  "pretrain_t5.py:29-49 continues from mT5 with a reduced "
                  "zh/en sentencepiece model)")
         parser.add_argument("--max_seq_length", type=int, default=512)
+        parser.add_argument(
+            "--do_eval_only", action="store_true",
+            help="restore the checkpoint and run one validation sweep "
+                 "only (reference: pretrain_mt5_small_predict.sh)")
         parser.add_argument("--noise_density", type=float, default=0.15)
         parser.add_argument("--mean_noise_span_length", type=float,
                             default=3.0)
@@ -137,7 +141,10 @@ def main(argv=None):
     module = T5PretrainModule(args)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
-    trainer.fit(module, datamodule)
+    if args.do_eval_only:
+        trainer.validate(module, datamodule)
+    else:
+        trainer.fit(module, datamodule)
 
 
 if __name__ == "__main__":

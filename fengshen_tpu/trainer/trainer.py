@@ -254,6 +254,69 @@ class Trainer:
 
         return step_fn
 
+    # -- shared state building -------------------------------------------
+    def _make_init_fn(self, module: TrainModule, rng, total_steps: int,
+                      eval_only: bool = False):
+        """The TrainState factory fit() and validate() share. Eval-only
+        states carry a zero-size optimizer (no adam moments — a model
+        that only fits with --offload_optimizer must still be
+        evaluable), and restore falls back to weights-only through the
+        checkpoint callback's existing opt_state-mismatch path."""
+        import optax
+
+        def init_fn():
+            params = module.init_params(rng)
+            if eval_only:
+                tx = optax.set_to_zero()
+            else:
+                tx, _ = module.configure_optimizers(total_steps, params)
+            return TrainState.create(
+                apply_fn=getattr(module, "model", None) and
+                module.model.apply or (lambda *a, **k: None),
+                params=params, tx=tx)
+
+        return init_fn
+
+    def _restore_callback(self):
+        return next((c for c in self.callbacks
+                     if hasattr(c, "maybe_restore")), None)
+
+    # -- validate --------------------------------------------------------
+    def validate(self, module: TrainModule, datamodule) -> TrainState:
+        """Eval-only entry (the reference's `--do_eval_only` path,
+        reference: fengshen/examples/pretrain_t5/
+        pretrain_mt5_small_predict.sh): build/restore the state, run ONE
+        validation sweep over the val loader, no training."""
+        args = self.args
+        module.setup("validate")
+        datamodule.trainer = self
+        loader = getattr(datamodule, "val_dataloader", lambda: None)()
+        if loader is None:
+            # mid-fit a missing val loader is skippable; here it IS the
+            # whole job
+            raise ValueError(
+                "validate() has no validation data — pass --val_file / "
+                "a 'validation' split (val_datasets_field="
+                f"{getattr(args, 'val_datasets_field', 'validation')!r})")
+        rng = jax.random.PRNGKey(getattr(args, "seed", 42))
+        rules = module.partition_rules()
+        state, _ = create_sharded_state(
+            self._make_init_fn(module, rng, 1, eval_only=True),
+            rules, self.mesh)
+        ckpt_cb = self._restore_callback()
+        prev_step = self.global_step
+        if ckpt_cb is not None:
+            state = ckpt_cb.maybe_restore(state, self, weights_only=True)
+        if self.global_step == prev_step:
+            # restore silently skipped (no checkpoint found): the sweep
+            # below runs on init_params — legitimate for HF-imported
+            # weights, surprising otherwise, so SAY it
+            self._log({"event": "validate_no_checkpoint_restored"})
+        self._log({"event": "validate_start",
+                   "step": self.global_step})
+        self._run_validation(module, datamodule, state, rng)
+        return state
+
     # -- fit -------------------------------------------------------------
     def fit(self, module: TrainModule, datamodule) -> TrainState:
         args = self.args
@@ -275,24 +338,16 @@ class Trainer:
             else next(iter(meta_loader))
         rules = module.partition_rules()
 
-        def init_fn():
-            params = module.init_params(rng)
-            tx, _ = module.configure_optimizers(total_steps, params)
-            return TrainState.create(
-                apply_fn=getattr(module, "model", None) and
-                module.model.apply or (lambda *a, **k: None),
-                params=params, tx=tx)
-
         state, state_sh = create_sharded_state(
-            init_fn, rules, self.mesh,
+            self._make_init_fn(module, rng, total_steps), rules,
+            self.mesh,
             offload_optimizer=bool(getattr(args, "offload_optimizer",
                                            False)))
         _, self._schedule = module.configure_optimizers(total_steps,
                                                         state.params)
 
         # restore (updates self.global_step / self.consumed_samples)
-        ckpt_cb = next((c for c in self.callbacks
-                        if hasattr(c, "maybe_restore")), None)
+        ckpt_cb = self._restore_callback()
         if ckpt_cb is not None:
             state = ckpt_cb.maybe_restore(state, self)
         # (re)create the train loader AFTER restore so the resumable

@@ -79,9 +79,12 @@ class UniversalCheckpoint:
         self._get_manager().wait_until_finished()
 
     # -- restore -------------------------------------------------------------
-    def maybe_restore(self, state: Any, trainer: Any) -> Any:
+    def maybe_restore(self, state: Any, trainer: Any,
+                      weights_only: bool = False) -> Any:
         """Silently skip a missing load path, exactly like the reference
-        (reference: universal_checkpoint.py:38-41)."""
+        (reference: universal_checkpoint.py:38-41). `weights_only` skips
+        the optimizer moments entirely — the eval-only entry restores
+        into a zero-size optimizer state."""
         path = self.load_path
         if not path or not os.path.isdir(path):
             return state
@@ -108,14 +111,29 @@ class UniversalCheckpoint:
         # a full run must silently fall back to the freshly initialized
         # optimizer state, and vice versa — matching the reference's
         # silent-skip semantics (reference: universal_checkpoint.py:38-41).
-        try:
-            restored = _restore(with_opt=True)
-        except ValueError as e:
-            if "opt_state" not in str(e):
-                # a genuine mismatch elsewhere (param shapes/tree) must
-                # surface, not silently reset the optimizer
-                raise
-            restored = _restore(with_opt=False)
+        if weights_only:
+            # The eval path carries a zero-size optimizer, so the
+            # payload cannot describe the on-disk opt_state; restore the
+            # params SUBTREE only (no adam-moment deserialisation)
+            abstract = {"params": jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None)),
+                state.params)}
+            restored = mgr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(item=abstract,
+                                                 partial_restore=True),
+                    meta=ocp.args.JsonRestore()))
+        else:
+            try:
+                restored = _restore(with_opt=True)
+            except ValueError as e:
+                if "opt_state" not in str(e):
+                    # a genuine mismatch elsewhere (param shapes/tree)
+                    # must surface, not silently reset the optimizer
+                    raise
+                restored = _restore(with_opt=False)
         meta = restored["meta"]
         # restore loop counters the way the reference's on_load_checkpoint
         # does (reference: examples/pretrain_erlangshen_bert/

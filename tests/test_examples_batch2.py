@@ -64,6 +64,23 @@ def test_pretrain_t5_e2e(tmp_path, mesh8):
         tmp_path, model_dir, train, ["--max_seq_length", "32"]))
     _assert_losses(tmp_path)
 
+    # --do_eval_only: restore the just-saved checkpoint and run one
+    # validation sweep, no training (reference:
+    # pretrain_mt5_small_predict.sh)
+    val = tmp_path / "val.json"
+    _write_jsonl(val, [{"text": "机器学习模型训练数据"}] * 4)
+    pretrain_t5.main(_common_args(
+        tmp_path, model_dir, train,
+        ["--max_seq_length", "32", "--do_eval_only",
+         "--val_file", str(val), "--val_batchsize", "2"]))
+    lines = [json.loads(l)
+             for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    assert any("val_loss" in l for l in lines)
+    assert any(l.get("event") == "validate_start" for l in lines)
+    # no NEW training steps were taken
+    losses = [l["loss"] for l in lines if "loss" in l]
+    assert len(losses) == 2
+
 
 def test_pretrain_t5_trim_vocab():
     import jax
