@@ -1,0 +1,20 @@
+#!/bin/bash
+# Continue pretraining from the last checkpoint (reference:
+# fengshen/examples/pretrain_t5/pretrain_mt5_small_continue.sh) — same
+# run dir, the resumable sampler restarts from consumed_samples.
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-77M}
+ROOT_DIR=${ROOT_DIR:-./workdir/pretrain_t5.pretrain_t5}
+
+python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize ${BATCH:-32} \
+    --max_steps ${MAX_STEPS:-200000} \
+    --learning_rate ${LR:-1e-4} \
+    --warmup_steps 1000 \
+    --every_n_train_steps 5000 \
+    --precision bf16 \
+    --max_seq_length 512 --noise_density 0.15

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Eval-only sweep over the validation file with the last checkpoint
+# (reference: fengshen/examples/pretrain_t5/pretrain_mt5_small_predict.sh
+# --do_eval_only).
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-77M}
+ROOT_DIR=${ROOT_DIR:-./workdir/pretrain_t5.pretrain_t5}
+
+python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --val_file ${VAL_FILE:-val.json} \
+    --do_eval_only \
+    --default_root_dir $ROOT_DIR \
+    --load_ckpt_path $ROOT_DIR/ckpt \
+    --val_batchsize ${BATCH:-32} \
+    --precision bf16 \
+    --max_seq_length 512 --noise_density 0.15
