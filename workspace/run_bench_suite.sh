@@ -1,0 +1,36 @@
+#!/bin/bash
+# The round-3 pending hardware rows, in one pass. Run ONLY after the
+# 256x256 probe succeeds (see .claude/skills/verify/SKILL.md). No
+# `timeout` wrappers anywhere — killed in-flight TPU work wedges the
+# relay; bench.py's internal watchdog is the only safe abort.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  python - << 'EOF'
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+print("probe ok:", float((x @ x).block_until_ready()[0, 0]))
+EOF
+}
+
+echo "== probe"; probe || exit 1
+
+echo "== default bench (regression guard: expect ~1.9 vs_baseline)"
+python bench.py | tee /tmp/bench_default.json
+
+echo "== sharded-step bench"
+BENCH_CONFIG=sharded python bench.py | tee /tmp/bench_sharded.json
+
+echo "== probe"; probe || exit 1
+
+echo "== 13B-shape bench (GQA + offload ladder; first compile is long)"
+BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
+
+echo "== probe"; probe || exit 1
+
+echo "== block-sparse vs dense flash timing (S=4096/8192)"
+python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
+
+echo "== probe"; probe || exit 1
+echo "ALL DONE — paste the rows into docs/performance.md"
