@@ -1,0 +1,33 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/classification/finetune_classification_bert-3.9B_tnews.sh
+# TPU-native translation: DeepSpeed ZeRO stages -> mesh flags
+# (--fsdp_parallel_size = ZeRO-3 analog), fp16 -> bf16,
+# Lightning val_check_interval 1.0 (once per epoch) -> 0 (per-epoch).
+set -euo pipefail
+
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-MegatronBert-3.9B}
+DATA_DIR=${DATA_DIR:-./data/tnews_public}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+
+python -m fengshen_tpu.examples.classification.finetune_classification \
+    --pretrained_model_path $MODEL_PATH \
+    --model_type huggingface-megatron_bert \
+    --output_save_path $ROOT_DIR/predict.json \
+    --data_dir $DATA_DIR \
+    --train_data train.json --valid_data dev.json --test_data test.json \
+    --train_batchsize 16 --valid_batchsize 56 \
+    --max_length 128 \
+    --texta_name sentence \
+    --label_name label --id_name id \
+    --learning_rate 0.00001 --weight_decay 0.01 --warmup 0.001 \
+    --num_labels 15 \
+    --monitor val_acc --mode max --save_top_k 3 \
+    --every_n_train_steps 0 --save_weights_only True \
+    --dirpath $ROOT_DIR/ckpt \
+    --filename model-{epoch:02d}-{val_acc:.4f} \
+    --max_epochs 7 --gradient_clip_val 0.1 \
+    --val_check_interval 100 \
+    --precision bf16 \
+    --default_root_dir $ROOT_DIR \
+    --fsdp_parallel_size 4

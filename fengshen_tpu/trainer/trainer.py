@@ -281,6 +281,26 @@ class Trainer:
         return next((c for c in self.callbacks
                      if hasattr(c, "maybe_restore")), None)
 
+    # -- predict state ---------------------------------------------------
+    def restore_for_predict(self, module: TrainModule) -> TrainState:
+        """Build + restore an eval-only TrainState WITHOUT running a
+        validation sweep — the cheap entry for predict-only drivers
+        (e.g. classification --do_predict_only), which need weights but
+        no dev-set pass."""
+        module.setup("predict")
+        rng = jax.random.PRNGKey(getattr(self.args, "seed", 42))
+        state, state_sh = create_sharded_state(
+            self._make_init_fn(module, rng, 1, eval_only=True),
+            module.partition_rules(), self.mesh)
+        self._state_sh = state_sh
+        ckpt_cb = self._restore_callback()
+        prev_step = self.global_step
+        if ckpt_cb is not None:
+            state = ckpt_cb.maybe_restore(state, self, weights_only=True)
+        if self.global_step == prev_step:
+            self._log({"event": "predict_no_checkpoint_restored"})
+        return state
+
     # -- validate --------------------------------------------------------
     def validate(self, module: TrainModule, datamodule) -> TrainState:
         """Eval-only entry (the reference's `--do_eval_only` path,

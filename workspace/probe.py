@@ -1,0 +1,34 @@
+"""TPU relay probe — 256x256 bf16 matmul with an in-process watchdog.
+
+Per the wedge protocol (NOTES.md): never timeout-kill TPU work from
+outside; an in-process abort (os._exit) is the one safe exit.  A daemon
+thread is used rather than SIGALRM because the axon plugin import can
+reset signal handlers and a main thread blocked in C never re-enters the
+interpreter to run a Python signal handler.  Exit 0 = alive, 3 = wedged.
+"""
+import os
+import sys
+import threading
+import time
+
+DEADLINE = float(os.environ.get("PROBE_DEADLINE", "120"))
+_done = threading.Event()
+
+
+def _watch():
+    if not _done.wait(DEADLINE):
+        sys.stderr.write(f"probe: relay WEDGED (no response in {DEADLINE:.0f}s)\n")
+        sys.stderr.flush()
+        os._exit(3)
+
+
+threading.Thread(target=_watch, daemon=True).start()
+
+t0 = time.time()
+import jax
+import jax.numpy as jnp
+
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float((x @ x).block_until_ready()[0, 0])
+_done.set()
+print(f"probe ok: backend={jax.default_backend()} val={v} dt={time.time()-t0:.1f}s")
