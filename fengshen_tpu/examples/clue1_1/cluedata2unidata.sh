@@ -1,0 +1,18 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/clue1.1/cluedata2unidata.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+CLUEDATA_PATH=${CLUEDATA_PATH:-./CLUE_DATA}
+UNIDATA_PATH=${UNIDATA_PATH:-./data}
+for task in afqmc c3 chid csl iflytek ocnli tnews wsc; do
+  case $task in
+    wsc) in_dir=$CLUEDATA_PATH/cluewsc2020_public;;
+    *)   in_dir=$CLUEDATA_PATH/${task}_public;;
+  esac
+  python -m fengshen_tpu.examples.clue1_1.cluedata2unidata \
+      --task $task --input_dir $in_dir --output_dir $UNIDATA_PATH/$task
+done
+# cmrc2018 is extractive QA: served by the ubert recipe
+# (run_clue_ubert.sh), not the UniMC converter.

@@ -1,0 +1,198 @@
+"""CLUE1.1 predictions → leaderboard submission files.
+
+Port of the reference's per-task submit scripts
+(reference: fengshen/examples/clue1.1/predict2submit/{afqmc,tnews,
+iflytek,ocnli,csl,wsc,c3,chid,cmrc2018}_submit.py — one small script per
+task, unified here behind ``--task``). Input rows are prediction jsonl in
+the reference format: ``{id, choice, label, score{choice: p}}`` (+
+``line_id`` for chid groups; ubert entity lists for cmrc2018).
+
+Note: `run_clue_unimc.py` already writes leaderboard-format predictions
+directly; this driver exists for reference-format predict files and for
+the tasks whose submissions need cross-row re-grouping (csl voting,
+chid exclusive assignment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any
+
+import numpy as np
+
+from fengshen_tpu.examples.clue1_1.cluedata2unidata import TNEWS_LABEL2DESC
+
+#: CLUE tnews submission codes per label name
+#: (reference: predict2submit/tnews_submit.py:8-23 id2label)
+TNEWS_CODES = {
+    "news_story": "100", "news_culture": "101",
+    "news_entertainment": "102", "news_sports": "103",
+    "news_finance": "104", "news_house": "106", "news_car": "107",
+    "news_edu": "108", "news_tech": "109", "news_military": "110",
+    "news_travel": "112", "news_world": "113", "news_stock": "114",
+    "news_agriculture": "115", "news_game": "116"}
+#: option desc → submission code (composed through the shared forward
+#: table so the two stay consistent)
+TNEWS_DESC2CODE = {desc: TNEWS_CODES[name]
+                   for name, desc in TNEWS_LABEL2DESC.items()}
+
+
+def _rows(path: str) -> list[dict]:
+    with open(path, encoding="utf8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _write_jsonl(rows: list[dict], path: str) -> None:
+    with open(path, "w", encoding="utf8") as f:
+        for row in rows:
+            f.write(json.dumps(row, ensure_ascii=False) + "\n")
+
+
+def _write_json(data: Any, path: str) -> None:
+    with open(path, "w", encoding="utf8") as f:
+        f.write(json.dumps(data, ensure_ascii=False) + "\n")
+
+
+def exclusive_assign(group: list[dict]) -> list[dict]:
+    """Greedy one-option-per-row assignment by descending score — the
+    reference's `recls` (chid candidates are used exactly once per
+    group; reference: chid_submit.py:20-33)."""
+    mat = np.asarray([[v for v in row["score"].values()]
+                      for row in group], np.float64)
+    n_rows, n_labels = mat.shape
+    for _ in range(n_rows):
+        i, j = np.unravel_index(np.argmax(mat), mat.shape)
+        group[i]["label"] = int(j)
+        mat[i, :] = 0.0
+        mat[:, j] = 0.0
+    return group
+
+
+def submit_afqmc(rows: list[dict]) -> list[dict]:
+    id2label = {0: "0", 1: "1"}
+    return [{"id": r["id"], "label": id2label[int(r["label"])]}
+            for r in rows]
+
+
+def submit_tnews(rows: list[dict]) -> list[dict]:
+    return [{"id": r["id"],
+             "label": TNEWS_DESC2CODE[r["choice"][int(r["label"])]]}
+            for r in rows]
+
+
+def submit_iflytek(rows: list[dict], label_map: dict) -> list[dict]:
+    """label_map (cluedata2unidata's label_map.json): original CLUE
+    label id → option desc; inverted here (reference hardcodes the same
+    two tables, iflytek_submit.py:6-130)."""
+    desc2id = {desc: lid for lid, desc in label_map.items()}
+    return [{"id": r["id"],
+             "label": desc2id[r["choice"][int(r["label"])]]}
+            for r in rows]
+
+
+def submit_ocnli(rows: list[dict]) -> list[dict]:
+    id2label = {0: "contradiction", 1: "neutral", 2: "entailment"}
+    return [{"id": r["id"], "label": id2label[int(r["label"])]}
+            for r in rows]
+
+
+def submit_wsc(rows: list[dict]) -> list[dict]:
+    """Option order decides the true/false mapping
+    (reference: wsc_submit.py:8-21)."""
+    out = []
+    for r in rows:
+        if "不是" in r["choice"][0] and "是" in r["choice"][1]:
+            label = "false" if int(r["label"]) == 1 else "true"
+        else:
+            label = "true" if int(r["label"]) == 0 else "false"
+        out.append({"id": r["id"], "label": label})
+    return out
+
+
+def submit_c3(rows: list[dict]) -> list[dict]:
+    return [{"id": r["id"], "label": int(r["label"])} for r in rows]
+
+
+def submit_csl(rows: list[dict]) -> list[dict]:
+    """Abstract-level vote: within each texta group, the higher-scored
+    half of the keyword rows is class 0 ('可以'), the rest class 1,
+    then 1↦'0'/0↦'1' for the leaderboard
+    (reference: csl_submit.py:40-72 csl_scorted + submit)."""
+    groups: dict[str, dict] = {}
+    for r in rows:
+        groups.setdefault(r["texta"], {})[r["id"]] = \
+            r["score"][r["choice"][0]]
+    id2label = {}
+    for scores in groups.values():
+        ranked = sorted(scores.items(), key=lambda kv: kv[1],
+                        reverse=True)
+        for i, (row_id, _) in enumerate(ranked):
+            id2label[row_id] = 0 if i < len(ranked) / 2 else 1
+    flip = {1: "0", 0: "1"}
+    return [{"id": r["id"], "label": flip[id2label[r["id"]]]}
+            for r in rows]
+
+
+def submit_chid(rows: list[dict]) -> dict:
+    """Group rows by line_id, exclusively assign candidates within each
+    group, emit {blank_tag: option_index}
+    (reference: chid_submit.py:41-57)."""
+    groups: dict[Any, list] = {}
+    for r in rows:
+        groups.setdefault(r.get("line_id", r["id"]), []).append(r)
+    result = {}
+    for group in groups.values():
+        for r in exclusive_assign(group):
+            result[r["id"]] = int(r["label"])
+    return result
+
+
+def submit_cmrc2018(rows: list[dict]) -> dict:
+    """ubert entity predictions → best span per question id
+    (reference: cmrc2018_submit.py:7-27)."""
+    id2spans: dict[Any, list] = {}
+    for row in rows:
+        for choice in row["choices"]:
+            id2spans.setdefault(choice["id"], []).extend(
+                choice.get("entity_list", []))
+    return {qid: (sorted(spans, key=lambda s: s["score"],
+                         reverse=True)[0]["entity_name"] if spans else "")
+            for qid, spans in id2spans.items()}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="CLUE predictions → submission format")
+    parser.add_argument("--task", required=True,
+                        choices=["afqmc", "tnews", "iflytek", "ocnli",
+                                 "csl", "wsc", "c3", "chid", "cmrc2018"])
+    parser.add_argument("--data_path", required=True, type=str)
+    parser.add_argument("--save_path", required=True, type=str)
+    parser.add_argument("--label_map", default=None, type=str,
+                        help="iflytek: cluedata2unidata's label_map.json")
+    args = parser.parse_args(argv)
+
+    rows = _rows(args.data_path)
+    if args.task == "iflytek":
+        if not args.label_map:
+            parser.error("--task iflytek requires --label_map")
+        with open(args.label_map, encoding="utf8") as f:
+            result = submit_iflytek(rows, json.load(f))
+    elif args.task in ("chid", "cmrc2018"):
+        result = {"chid": submit_chid,
+                  "cmrc2018": submit_cmrc2018}[args.task](rows)
+    else:
+        result = {"afqmc": submit_afqmc, "tnews": submit_tnews,
+                  "ocnli": submit_ocnli, "csl": submit_csl,
+                  "wsc": submit_wsc, "c3": submit_c3}[args.task](rows)
+
+    if isinstance(result, dict):
+        _write_json(result, args.save_path)
+    else:
+        _write_jsonl(result, args.save_path)
+    print(f"[{args.task}] {len(rows)} predictions → {args.save_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/clue1.1/predict2submit.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+PRED_DATA_PATH=${PRED_DATA_PATH:-./predict}
+SUBMIT_DATA_PATH=${SUBMIT_DATA_PATH:-./submit}
+mkdir -p $SUBMIT_DATA_PATH
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task afqmc \
+    --data_path $PRED_DATA_PATH/afqmc_predict.json \
+    --save_path $SUBMIT_DATA_PATH/afqmc_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task c3 \
+    --data_path $PRED_DATA_PATH/c3_predict.json \
+    --save_path $SUBMIT_DATA_PATH/c311_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task chid \
+    --data_path $PRED_DATA_PATH/chid_predict.json \
+    --save_path $SUBMIT_DATA_PATH/chid11_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task csl \
+    --data_path $PRED_DATA_PATH/csl_predict.json \
+    --save_path $SUBMIT_DATA_PATH/csl_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task iflytek \
+    --data_path $PRED_DATA_PATH/iflytek_predict.json \
+    --label_map $PRED_DATA_PATH/iflytek_label_map.json \
+    --save_path $SUBMIT_DATA_PATH/iflytek_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task ocnli \
+    --data_path $PRED_DATA_PATH/ocnli_predict.json \
+    --save_path $SUBMIT_DATA_PATH/ocnli_50k_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task tnews \
+    --data_path $PRED_DATA_PATH/tnews_predict.json \
+    --save_path $SUBMIT_DATA_PATH/tnews11_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task wsc \
+    --data_path $PRED_DATA_PATH/wsc_predict.json \
+    --save_path $SUBMIT_DATA_PATH/cluewsc11_predict.json
+python -m fengshen_tpu.examples.clue1_1.predict2submit --task cmrc2018 \
+    --data_path $PRED_DATA_PATH/cmrc2018_predict.json \
+    --save_path $SUBMIT_DATA_PATH/cmrc2018_predict.json

@@ -1,0 +1,13 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/finetune_taiyi_stable_diffusion/evaluate.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-Stable-Diffusion-1B-Chinese-v0.1}
+python -m fengshen_tpu.examples.finetune_taiyi_stable_diffusion.evaluate \
+    --model_path $MODEL_PATH \
+    --clip_path ${CLIP_PATH:-} \
+    --prompt_file ${PROMPT_FILE:-} \
+    --image_size 512 --num_steps 50 \
+    --out $ROOT_DIR/eval_scores.json

@@ -1,0 +1,17 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/mt5_summary/pretrain_mt5_summary.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-google/mt5-large}
+python -m fengshen_tpu.examples.mt5_summary.mt5_summary \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor train_loss --mode min \
+    --train_batchsize 16 --val_batchsize 16 \
+    --learning_rate 1e-4 --weight_decay 0.1 --warmup_ratio 0.01 \
+    --max_epochs 2 \
+    --precision bf16

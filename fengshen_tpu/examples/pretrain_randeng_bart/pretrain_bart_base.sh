@@ -1,0 +1,16 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/pretrain_randeng_bart/pretrain_bart_base.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-BART-139M}
+python -m fengshen_tpu.examples.pretrain_randeng_bart.pretrain_bart \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 32 \
+    --learning_rate 1e-4 --weight_decay 1e-1 --warmup_ratio 0.01 \
+    --max_epochs 10 --log_every_n_steps 1 \
+    --precision bf16

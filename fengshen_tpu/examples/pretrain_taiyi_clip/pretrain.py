@@ -86,7 +86,9 @@ def main(argv=None):
     parser = add_trainer_args(parser)
     parser = UniversalDataModule.add_data_specific_args(parser)
     parser = UniversalCheckpoint.add_argparse_args(parser)
-    parser = TaiyiCLIPModule.add_module_specific_args(parser)
+    # reference: pretrain_taiyi_clip/test.sh — eval-only retrieval pass
+    parser.add_argument("--test_only", action="store_true", default=False)
+    parser.add_argument("--val_csv", type=str, default=None)
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
@@ -94,6 +96,9 @@ def main(argv=None):
     if args.train_csv:
         datasets["train"] = ImageTextCSVDataset(args.train_csv,
                                                 image_root=args.image_root)
+    if args.val_csv:
+        datasets["validation"] = ImageTextCSVDataset(
+            args.val_csv, image_root=args.image_root)
     collator = CLIPCollator(tokenizer, image_size=args.image_size,
                             max_length=args.max_length)
     datamodule = UniversalDataModule(tokenizer=tokenizer,
@@ -102,7 +107,10 @@ def main(argv=None):
     module = TaiyiCLIPModule(args)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
-    trainer.fit(module, datamodule)
+    if args.test_only:
+        trainer.validate(module, datamodule)
+    else:
+        trainer.fit(module, datamodule)
 
 
 if __name__ == "__main__":

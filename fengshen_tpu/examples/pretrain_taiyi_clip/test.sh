@@ -1,0 +1,16 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/pretrain_taiyi_clip/test.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-CLIP-RoBERTa-102M-ViT-L-Chinese}
+python -m fengshen_tpu.examples.pretrain_taiyi_clip.pretrain \
+    --model_path $MODEL_PATH \
+    --test_only \
+    --val_csv ${VAL_CSV:-flickr30k_cna_val.csv} \
+    --image_root ${IMAGE_ROOT:-./images} \
+    --default_root_dir $ROOT_DIR \
+    --test_batchsize 64 \
+    --log_every_n_steps 1 \
+    --precision fp32

@@ -108,7 +108,18 @@ def main(argv=None):
     parser = UniversalDataModule.add_data_specific_args(parser)
     parser = UniversalCheckpoint.add_argparse_args(parser)
     parser = T5QAModule.add_module_specific_args(parser)
+    # reference: qa_t5/run_predict.sh — eval-only decode of the test
+    # split into a text file
+    group = parser.add_argument_group("qa predict")
+    group.add_argument("--do_eval_only", action="store_true",
+                       default=False)
+    group.add_argument("--pretrained_model_path", default=None, type=str,
+                       help="alias of --model_path (reference flag name)")
+    group.add_argument("--prediction_res_path",
+                       default="./predictions.txt", type=str)
     args = parser.parse_args(argv)
+    if args.pretrained_model_path:
+        args.model_path = args.pretrained_model_path
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     module = T5QAModule(args)
@@ -121,7 +132,20 @@ def main(argv=None):
                                      collate_fn=collator, args=args)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
-    trainer.fit(module, datamodule)
+    if args.do_eval_only:
+        import numpy as np
+        state = trainer.restore_for_predict(module)
+        loader = datamodule.test_dataloader() or \
+            datamodule.val_dataloader()
+        outputs = trainer.predict(module, loader, state=state)
+        with open(args.prediction_res_path, "w", encoding="utf-8") as f:
+            for out in outputs:
+                for text in tokenizer.batch_decode(
+                        np.asarray(out), skip_special_tokens=True):
+                    f.write(text + "\n")
+        print("predictions saved to", args.prediction_res_path)
+    else:
+        trainer.fit(module, datamodule)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,20 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/qa_t5/run_finetune.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-784M-QA-Chinese}
+python -m fengshen_tpu.examples.qa_t5.finetune_t5_cmrc \
+    --pretrained_model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --val_file ${VAL_FILE:-dev.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --every_n_train_steps 100 \
+    --train_batchsize 8 --val_batchsize 8 \
+    --max_seq_length 512 \
+    --learning_rate 1e-4 --weight_decay 1e-2 --warmup_ratio 0.1 \
+    --min_learning_rate 1e-5 \
+    --max_epochs 10 \
+    --precision bf16

@@ -1,0 +1,17 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/qa_t5/run_predict.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-784M-QA-Chinese}
+python -m fengshen_tpu.examples.qa_t5.finetune_t5_cmrc \
+    --pretrained_model_path $MODEL_PATH \
+    --test_file ${TEST_FILE:-test.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --do_eval_only \
+    --prediction_res_path $ROOT_DIR/predictions_sampling.txt \
+    --val_batchsize 8 --test_batchsize 8 \
+    --max_seq_length 512 \
+    --precision bf16

@@ -1,5 +1,5 @@
 #!/bin/bash
-# Launcher for sequence_tagging.finetune_sequence_tagging (reference pattern: fengshen/examples/sequence_tagging/*.sh)
+# Launcher for sequence_tagging.finetune_sequence_tagging (reference: fengshen/examples/sequence_tagging/finetune_sequence_tagging.sh (bert + linear decode head; DECODE_TYPE=crf/span/biaffine for the other heads))
 # Multi-host TPU: run this script on every host with JAX_COORDINATOR_ADDRESS
 # set (see docs/multihost.md); single host needs no extra flags.
 MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-MegatronBert-1.3B}
@@ -17,4 +17,4 @@ python -m fengshen_tpu.examples.sequence_tagging.finetune_sequence_tagging \
     --warmup_steps 1000 \
     --every_n_train_steps 5000 \
     --precision bf16 \
-    --model_type bert-crf --data_dir $DATA_DIR
+    --model_type bert-${DECODE_TYPE:-linear} --data_dir $DATA_DIR

@@ -111,6 +111,13 @@ class DreamBoothModule(TaiyiSDModule):
         group.add_argument("--with_prior_preservation", action="store_true",
                            default=False)
         group.add_argument("--prior_loss_weight", type=float, default=1.0)
+        group.add_argument(
+            "--num_class_images", type=int, default=0,
+            help="pre-generate class images with the frozen model until "
+                 "class_data_dir holds this many (reference: "
+                 "train_with_prior.sh --num_class_images=200)")
+        group.add_argument("--class_gen_steps", type=int, default=50,
+                           help="denoise steps for class-image pre-gen")
         return parser
 
     def training_loss(self, params, batch, rng):
@@ -144,6 +151,39 @@ class DreamBoothModule(TaiyiSDModule):
         return loss, {}
 
 
+def ensure_class_images(args, tokenizer, module) -> int:
+    """Top up class_data_dir to --num_class_images by sampling the frozen
+    model on --class_prompt (reference: stable_diffusion_dreambooth/
+    train.py pre-generation loop before training with prior
+    preservation). Returns how many images were generated."""
+    import glob
+    import os
+
+    import jax
+
+    from fengshen_tpu.models.stable_diffusion.sampling import text_to_image
+
+    os.makedirs(args.class_data_dir, exist_ok=True)
+    have = len([p for ext in ("*.png", "*.jpg", "*.jpeg") for p in
+                glob.glob(os.path.join(args.class_data_dir, ext))])
+    need = max(int(args.num_class_images) - have, 0)
+    if need == 0:
+        return 0
+    params = module.init_params(jax.random.PRNGKey(args.seed))
+    ids = jnp.asarray([tokenizer.encode(args.class_prompt)], jnp.int32)
+    for i in range(need):
+        img = text_to_image(module.model, params, ids,
+                            image_size=args.image_size,
+                            num_steps=args.class_gen_steps,
+                            guidance_scale=1.0,
+                            rng=jax.random.PRNGKey(args.seed + 1 + i))
+        arr = (np.asarray(img[0]).clip(0, 1) * 255).astype(np.uint8)
+        from PIL import Image
+        Image.fromarray(arr).save(os.path.join(
+            args.class_data_dir, f"class_gen_{have + i:05d}.png"))
+    return need
+
+
 def main(argv=None):
     from transformers import AutoTokenizer
 
@@ -161,6 +201,13 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+    module = DreamBoothModule(args)
+    if args.with_prior_preservation and args.num_class_images > 0 and \
+            args.class_data_dir and args.class_prompt:
+        n = ensure_class_images(args, tokenizer, module)
+        if n:
+            print(f"generated {n} class images into "
+                  f"{args.class_data_dir}")
     dataset = DreamBoothDataset(
         args.instance_data_dir, args.instance_prompt,
         class_data_dir=args.class_data_dir if
@@ -171,7 +218,6 @@ def main(argv=None):
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args,
                                      datasets={"train": dataset})
-    module = DreamBoothModule(args)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
     trainer.fit(module, datamodule)

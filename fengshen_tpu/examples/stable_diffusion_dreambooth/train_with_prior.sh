@@ -1,0 +1,22 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/stable_diffusion_dreambooth/train_with_prior.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Taiyi-Stable-Diffusion-1B-Chinese-v0.1}
+INSTANCE_DIR=${INSTANCE_DIR:-./instance_images}
+CLASS_DIR=${CLASS_DIR:-./class_images_duck}
+python -m fengshen_tpu.examples.stable_diffusion_dreambooth.train \
+    --model_path $MODEL_PATH \
+    --instance_data_dir $INSTANCE_DIR \
+    --instance_prompt "一只鸭子" \
+    --class_data_dir $CLASS_DIR \
+    --class_prompt "鸭子" \
+    --with_prior_preservation --prior_loss_weight 1.0 \
+    --num_class_images 200 \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 2 \
+    --learning_rate 1e-6 \
+    --precision bf16

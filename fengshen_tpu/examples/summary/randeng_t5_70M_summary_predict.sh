@@ -1,0 +1,23 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/summary/randeng_t5_70M_summary_predict.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-77M-Summary}
+python -m fengshen_tpu.examples.summary.seq2seq_summary \
+    --model_type t5 \
+    --pretrained_model_path $MODEL_PATH \
+    --output_save_path $ROOT_DIR/predict.json \
+    --datasets_name lcsts \
+    --val_datasets_field val \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor val_loss --mode min --save_top_k 3 --save_last \
+    --train_batchsize 64 --val_batchsize 64 --test_batchsize 64 \
+    --max_enc_length 128 --max_dec_length 64 \
+    --prompt "" \
+    --learning_rate 1e-4 --weight_decay 1e-2 \
+    --max_epochs 1 \
+    --precision bf16 \
+    --do_eval_only

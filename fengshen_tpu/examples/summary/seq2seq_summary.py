@@ -32,9 +32,12 @@ class Seq2SeqCollator:
     decoder_start_token_id: int = 0
     text_key: str = "text"
     summary_key: str = "summary"
+    #: task prefix prepended to the source (reference: seq2seq_summary.py
+    #: :158 `--prompt`, default "summarize:")
+    prompt: str = ""
 
     def source_text(self, sample: dict) -> str:
-        return sample[self.text_key]
+        return self.prompt + sample[self.text_key]
 
     def target_text(self, sample: dict) -> str:
         return sample[self.summary_key]
@@ -147,20 +150,81 @@ def main(argv=None):
     group.add_argument("--no_repeat_ngram_size", default=0,
                        type=int)
     group.add_argument("--min_length", default=0, type=int)
+    # the reference driver's eval surface (reference: fengshen/examples/
+    # summary/seq2seq_summary.py:144-158)
+    group.add_argument("--do_eval_only", action="store_true",
+                       default=False)
+    group.add_argument("--pretrained_model_path", default=None, type=str,
+                       help="alias of --model_path (reference flag name)")
+    group.add_argument("--output_save_path", default="./predict.json",
+                       type=str)
+    group.add_argument("--prompt", default="summarize:", type=str)
+    group.add_argument("--rouge_keys", default="rougeL,rouge1,rouge2",
+                       type=str)
+    group.add_argument("--max_enc_length", default=None, type=int,
+                       help="alias of --max_src_length (reference name)")
+    group.add_argument("--max_dec_length", default=None, type=int,
+                       help="alias of --max_tgt_length (reference name)")
     args = parser.parse_args(argv)
+    if args.pretrained_model_path:
+        args.model_path = args.pretrained_model_path
+    if args.max_enc_length:
+        args.max_src_length = args.max_enc_length
+    if args.max_dec_length:
+        args.max_tgt_length = args.max_dec_length
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     model, config = build_model(args.model_type, args.model_path)
     collator = Seq2SeqCollator(
         tokenizer, max_src_length=args.max_src_length,
         max_tgt_length=args.max_tgt_length,
-        decoder_start_token_id=getattr(config, "decoder_start_token_id", 0))
+        decoder_start_token_id=getattr(config, "decoder_start_token_id", 0),
+        prompt=args.prompt)
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args)
     module = Seq2SeqModule(args, model, config)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
-    trainer.fit(module, datamodule)
+    if args.do_eval_only:
+        state = trainer.restore_for_predict(module)
+    else:
+        state = trainer.fit(module, datamodule)
+    test_loader = datamodule.test_dataloader() \
+        if hasattr(datamodule, "test_dataloader") else None
+    if test_loader is not None:
+        evaluate_and_save(trainer, module, tokenizer, test_loader, args,
+                          state)
+
+
+def evaluate_and_save(trainer, module, tokenizer, loader, args,
+                      state) -> dict:
+    """Decode the test split, write prediction jsonl, print char-level
+    ROUGE (reference: seq2seq_summary.py:82-120
+    validation_epoch_end + save_prediction_to_file)."""
+    import json
+
+    from fengshen_tpu.metrics.rouge import rouge_scores
+
+    outputs = trainer.predict(module, loader, state=state)
+    preds, refs = [], []
+    with open(args.output_save_path, "w", encoding="utf-8") as f:
+        for out in outputs:
+            tokens = np.asarray(out["tokens"] if isinstance(out, dict)
+                                else out)
+            texts = tokenizer.batch_decode(tokens,
+                                           skip_special_tokens=True)
+            preds.extend(texts)
+            for t in texts:
+                f.write(json.dumps({"pred": t}, ensure_ascii=False) + "\n")
+    # labels for rouge come from a second pass over the raw loader
+    for batch in loader:
+        labels = np.where(batch["labels"] < 0, 0, batch["labels"])
+        refs.extend(tokenizer.batch_decode(labels,
+                                           skip_special_tokens=True))
+    keys = tuple(k.strip() for k in args.rouge_keys.split(","))
+    scores = rouge_scores(preds, refs[:len(preds)], keys=keys)
+    print("rouge:", json.dumps(scores, ensure_ascii=False))
+    return scores
 
 
 if __name__ == "__main__":

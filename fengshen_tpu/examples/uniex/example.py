@@ -19,16 +19,42 @@ TEST_DATA = [{
     "id": 0}]
 
 
+def _load_jsonl(path: str) -> list:
+    import json
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
 def main(argv=None, pipeline=None):
     parser = argparse.ArgumentParser("TASK NAME")
     parser = UniEXPipelines.pipelines_args(parser)
+    # reference: uniex train.sh / predict.sh surface — --train switches
+    # to finetune mode; --fast_ex_mode is the reference's fast-extraction
+    # decode (one joint pass instead of per-type rescoring; our decoder
+    # is already single-pass, so the flag is accepted for recipe parity)
+    parser.add_argument("--train", action="store_true", default=False)
+    parser.add_argument("--fast_ex_mode", action="store_true",
+                        default=False)
+    parser.add_argument("--output_path", default=None, type=str)
     args = parser.parse_args(argv)
     if pipeline is None:
         pipeline = UniEXPipelines(args,
                                   model=getattr(args, "model_path", None))
-    result = pipeline.predict(TEST_DATA)
-    for line in result:
-        print(line)
+    if args.train and getattr(args, "train_file", None):
+        dev = _load_jsonl(args.val_file) if getattr(args, "val_file",
+                                                    None) else None
+        pipeline.fit(_load_jsonl(args.train_file), dev)
+    data = _load_jsonl(args.test_file) \
+        if getattr(args, "test_file", None) else TEST_DATA
+    result = pipeline.predict(data)
+    if args.output_path:
+        import json
+        with open(args.output_path, "w", encoding="utf-8") as f:
+            for line in result:
+                f.write(json.dumps(line, ensure_ascii=False) + "\n")
+    else:
+        for line in result:
+            print(line)
     return result
 
 

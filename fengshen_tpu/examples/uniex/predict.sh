@@ -1,0 +1,15 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/uniex/predict.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-UniEX-RoBERTa-110M-Chinese}
+DATA_DIR=${DATA_DIR:-./data/cluener}
+python -m fengshen_tpu.examples.uniex.example \
+    --model_path $MODEL_PATH \
+    --fast_ex_mode \
+    --test_file $DATA_DIR/dev.json \
+    --output_path $ROOT_DIR/predict.json \
+    --max_length 512 \
+    --max_entity_types 16

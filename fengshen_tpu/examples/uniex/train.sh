@@ -1,0 +1,23 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/uniex/train.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Erlangshen-UniEX-RoBERTa-110M-Chinese}
+DATA_DIR=${DATA_DIR:-./data/cluener}
+python -m fengshen_tpu.examples.uniex.example \
+    --model_path $MODEL_PATH \
+    --train \
+    --train_file $DATA_DIR/train.json \
+    --val_file $DATA_DIR/dev.json \
+    --test_file $DATA_DIR/dev.json \
+    --output_path $ROOT_DIR/predict.json \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor val_loss --save_top_k 3 --every_n_train_steps 40 \
+    --train_batchsize 16 --val_batchsize 16 \
+    --max_length 512 \
+    --learning_rate 1e-5 --weight_decay 0.1 --warmup_ratio 0.1 \
+    --max_epochs 47 --gradient_clip_val 0.25 --val_check_interval 40 \
+    --precision bf16

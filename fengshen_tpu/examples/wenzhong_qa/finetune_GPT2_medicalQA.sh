@@ -1,0 +1,20 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/wenzhong_qa/finetune_GPT2_medicalQA.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Wenzhong-GPT2-3.5B}
+DATA_DIR=${DATA_DIR:-./data/medicalQA}
+python -m fengshen_tpu.examples.wenzhong_qa.finetune_wenzhong \
+    --model_path $MODEL_PATH \
+    --train_file $DATA_DIR/train.json \
+    --val_file $DATA_DIR/dev.json \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 1 \
+    --max_seq_length 512 \
+    --learning_rate 1e-5 --weight_decay 1e-2 \
+    --adam_beta2 0.95 \
+    --gradient_clip_val 1.0 \
+    --precision bf16

@@ -1,0 +1,19 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/wenzhong_qa/finetune_wenzhong.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+# ZeRO-3 + offload recipe -> --offload_optimizer (host-resident moments)
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Wenzhong-GPT2-3.5B}
+python -m fengshen_tpu.examples.wenzhong_qa.finetune_wenzhong \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-train.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --train_batchsize 1 \
+    --max_seq_length 512 \
+    --learning_rate 1e-5 --weight_decay 0.01 \
+    --offload_optimizer \
+    --gradient_clip_val 1.0 \
+    --precision bf16

@@ -1,0 +1,10 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/ziya_llama/convert_llama13b_tp8.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+python -m fengshen_tpu.models.llama.convert \
+    --input_path ${INPUT_DIR:-llama13b_hf} \
+    --output_path ${OUTPUT_DIR:-llama13b_fs_tp8} \
+    --model_parallel_size 8

@@ -1,0 +1,22 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/ziya_llama/finetune_no_tp.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-./llama13b_fs}
+python -m fengshen_tpu.examples.ziya_llama.finetune_ziya_llama \
+    --model_path $MODEL_PATH \
+    --train_file ${TRAIN_FILE:-./data/small_train.json} \
+    --val_file ${VAL_FILE:-./data/small_valid.json} \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt_no_tp --save_last \
+    --every_n_train_steps 100 \
+    --train_batchsize 2 --val_batchsize 2 \
+    --max_seq_length 256 \
+    --learning_rate 1e-4 --min_learning_rate 1e-5 \
+    --weight_decay 0.1 --warmup_ratio 0.05 \
+    --adam_beta1 0.9 --adam_beta2 0.95 \
+    --fsdp_parallel_size 8 \
+    --max_epochs 4 --log_every_n_steps 1 \
+    --precision bf16

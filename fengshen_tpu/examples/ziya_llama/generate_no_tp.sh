@@ -1,0 +1,12 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/ziya_llama/generate_no_tp.sh
+# TPU-native translation: DeepSpeed ZeRO -> mesh flags, fp16 -> bf16.
+set -euo pipefail
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+MODEL_PATH=${MODEL_PATH:-./llama13b_fs}
+python -m fengshen_tpu.examples.ziya_inference.generate_ziya \
+    --model_path $MODEL_PATH \
+    --query "${QUERY:-帮我写一份去西安的旅游计划}" \
+    --max_new_tokens 128 \
+    --temperature 0.8 --top_p 0.85

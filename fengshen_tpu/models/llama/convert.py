@@ -123,3 +123,66 @@ def load_hf_pretrained(path: str, config: LlamaConfig | None = None):
             state.update(torch.load(f, map_location="cpu",
                                     weights_only=True))
     return config, torch_to_params(state, config)
+
+
+def save_converted(output_path: str, config: LlamaConfig,
+                   params: dict, model_parallel_size: int = 1) -> None:
+    """Write the ONE logical fengshen-tpu checkpoint: config.json +
+    orbax params. `model_parallel_size` is validated against the config
+    and recorded as intent — actual TP sharding happens at load time
+    from the partition rules, so there are no per-rank `part_{i}` dirs
+    (the reference's convert_fs_llama_tp.py:15-31 layout is obsolete
+    by design here)."""
+    import json
+    import os
+
+    import orbax.checkpoint as ocp
+
+    if model_parallel_size > 1:
+        for dim, name in ((config.num_attention_heads,
+                           "num_attention_heads"),
+                          (getattr(config, "num_key_value_heads",
+                                   config.num_attention_heads),
+                           "num_key_value_heads"),
+                          (config.intermediate_size,
+                           "intermediate_size")):
+            if dim % model_parallel_size:
+                raise ValueError(
+                    f"{name}={dim} not divisible by "
+                    f"model_parallel_size={model_parallel_size}")
+    os.makedirs(output_path, exist_ok=True)
+    config.save_pretrained(output_path)
+    with open(os.path.join(output_path, "parallel_meta.json"), "w") as f:
+        json.dump({"intended_model_parallel_size": model_parallel_size,
+                   "layout": "logical (shard at load via partition "
+                             "rules)"}, f)
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.abspath(os.path.join(output_path, "params")),
+              params, force=True)
+    ckpt.wait_until_finished()
+
+
+def main(argv=None) -> None:
+    """CLI for the ziya convert shells (reference:
+    ziya_llama/convert_llama13b_to_fs.sh, convert_llama13b_tp{4,8}.sh)."""
+    import argparse
+
+    parser = argparse.ArgumentParser("llama HF -> fengshen-tpu convert")
+    parser.add_argument("--input_path", required=True, type=str,
+                        help="HF llama checkpoint dir")
+    parser.add_argument("--output_path", required=True, type=str)
+    parser.add_argument("--input_dir", default=None, type=str,
+                        help="alias of --input_path (tp-reshard shells)")
+    parser.add_argument("--output_dir", default=None, type=str,
+                        help="alias of --output_path")
+    parser.add_argument("--model_parallel_size", default=1, type=int)
+    args = parser.parse_args(argv)
+    config, params = load_hf_pretrained(args.input_dir or args.input_path)
+    save_converted(args.output_dir or args.output_path, config, params,
+                   model_parallel_size=args.model_parallel_size)
+    print(f"converted -> {args.output_dir or args.output_path} "
+          f"(model_parallel_size={args.model_parallel_size})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""Driver surface added for the round-4 launcher matrix (VERDICT r3
+missing #1/#3): rouge metric, clue predict2submit, summary eval path,
+llama convert CLI."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+# -- rouge ----------------------------------------------------------------
+
+def test_rouge_hand_computed():
+    from fengshen_tpu.metrics.rouge import rouge_l, rouge_n
+
+    pred, ref = "a b c", "a c d"
+    # unigrams: match {a, c} = 2, P=2/3, R=2/3 → F=2/3
+    assert abs(rouge_n(pred, ref, 1) - 2 / 3) < 1e-9
+    # bigrams: {ab, bc} vs {ac, cd} → 0
+    assert rouge_n(pred, ref, 2) == 0.0
+    # LCS "a c" = 2 → F=2/3
+    assert abs(rouge_l(pred, ref) - 2 / 3) < 1e-9
+    assert rouge_l("x", "") == 0.0
+
+
+def test_rouge_chinese_char_level():
+    from fengshen_tpu.metrics.rouge import rouge_scores
+
+    scores = rouge_scores(["今天天气好"], ["今天天气好"], char_level=True)
+    assert scores["rouge1_fmeasure"] == 1.0
+    assert scores["rougeL_fmeasure"] == 1.0
+    partial = rouge_scores(["今天很好"], ["今天天气好"], char_level=True)
+    assert 0.0 < partial["rouge1_fmeasure"] < 1.0
+
+
+# -- predict2submit -------------------------------------------------------
+
+from fengshen_tpu.examples.clue1_1 import predict2submit as p2s
+
+
+def test_submit_afqmc_and_ocnli():
+    rows = [{"id": 1, "label": 0}, {"id": 2, "label": 1}]
+    assert p2s.submit_afqmc(rows) == [{"id": 1, "label": "0"},
+                                      {"id": 2, "label": "1"}]
+    rows3 = [{"id": 5, "label": 2}]
+    assert p2s.submit_ocnli(rows3) == [{"id": 5, "label": "entailment"}]
+
+
+def test_submit_tnews_desc_to_code():
+    rows = [{"id": 0, "choice": ["故事", "文化"], "label": 1}]
+    assert p2s.submit_tnews(rows) == [{"id": 0, "label": "101"}]
+
+
+def test_submit_wsc_option_order():
+    # reference: wsc_submit.py:8-21 — mapping flips with option order
+    rows = [{"id": 0, "choice": ["他不是指小明", "他是指小明"], "label": 1},
+            {"id": 1, "choice": ["他是指小明", "他不是指小明"], "label": 1}]
+    out = p2s.submit_wsc(rows)
+    assert out[0]["label"] == "false"
+    assert out[1]["label"] == "false"
+    rows2 = [{"id": 2, "choice": ["他不是指小明", "他是指小明"], "label": 0}]
+    assert p2s.submit_wsc(rows2)[0]["label"] == "true"
+
+
+def test_submit_csl_groups_higher_half():
+    # one abstract, two keyword rows: higher-scored row → class 0 → '1'
+    rows = [{"id": 10, "texta": "T", "choice": ["可以"],
+             "score": {"可以": 0.9}},
+            {"id": 11, "texta": "T", "choice": ["可以"],
+             "score": {"可以": 0.1}}]
+    out = {r["id"]: r["label"] for r in p2s.submit_csl(rows)}
+    assert out == {10: "1", 11: "0"}
+
+
+def test_submit_chid_exclusive_assignment():
+    # two blanks in one group, same favourite option: the lower-scored
+    # row must take its second choice (reference recls semantics)
+    rows = [{"id": "#idiom1#", "line_id": 7,
+             "score": {"a": 0.9, "b": 0.5}},
+            {"id": "#idiom2#", "line_id": 7,
+             "score": {"a": 0.8, "b": 0.1}}]
+    out = p2s.submit_chid(rows)
+    assert out["#idiom1#"] == 0 and out["#idiom2#"] == 1
+
+
+def test_submit_cmrc2018_best_span():
+    rows = [{"choices": [
+        {"id": "q1", "entity_list": [
+            {"entity_name": "北京", "score": 0.4},
+            {"entity_name": "上海", "score": 0.9}]},
+        {"id": "q2", "entity_list": []}]}]
+    out = p2s.submit_cmrc2018(rows)
+    assert out == {"q1": "上海", "q2": ""}
+
+
+def test_submit_iflytek_label_map(tmp_path):
+    rows = [{"id": 3, "choice": ["打车", "地图"], "label": 1}]
+    label_map = {"0": "打车", "1": "地图"}
+    out = p2s.submit_iflytek(rows, label_map)
+    assert out == [{"id": 3, "label": "1"}]
+
+
+def test_predict2submit_cli(tmp_path):
+    pred = tmp_path / "afqmc_predict.json"
+    with open(pred, "w") as f:
+        f.write(json.dumps({"id": 1, "label": 1}) + "\n")
+    out = tmp_path / "submit.json"
+    p2s.main(["--task", "afqmc", "--data_path", str(pred),
+              "--save_path", str(out)])
+    assert json.loads(out.read_text())["label"] == "1"
+
+
+# -- llama convert CLI ----------------------------------------------------
+
+@pytest.mark.slow
+def test_llama_convert_cli(tmp_path):
+    import torch
+
+    from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=1, num_attention_heads=4,
+                      num_key_value_heads=4)
+    src = tmp_path / "hf"
+    src.mkdir()
+    cfg.save_pretrained(str(src))
+    hd = cfg.hidden_size
+    state = {"model.embed_tokens.weight": torch.randn(32, hd),
+             "model.norm.weight": torch.ones(hd),
+             "lm_head.weight": torch.randn(32, hd)}
+    pre = "model.layers.0"
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        state[f"{pre}.self_attn.{proj}.weight"] = torch.randn(hd, hd)
+    for proj, shape in (("gate_proj", (32, hd)), ("up_proj", (32, hd)),
+                        ("down_proj", (hd, 32))):
+        state[f"{pre}.mlp.{proj}.weight"] = torch.randn(*shape)
+    state[f"{pre}.input_layernorm.weight"] = torch.ones(hd)
+    state[f"{pre}.post_attention_layernorm.weight"] = torch.ones(hd)
+    torch.save(state, str(src / "pytorch_model.bin"))
+
+    from fengshen_tpu.models.llama import convert as llama_convert
+    out = tmp_path / "fs"
+    llama_convert.main(["--input_path", str(src),
+                        "--output_path", str(out),
+                        "--model_parallel_size", "4"])
+    assert (out / "config.json").exists()
+    assert (out / "params").exists()
+    meta = json.loads((out / "parallel_meta.json").read_text())
+    assert meta["intended_model_parallel_size"] == 4
+    # non-divisible TP must fail loudly
+    with pytest.raises(ValueError):
+        llama_convert.save_converted(
+            str(tmp_path / "bad"), cfg, {}, model_parallel_size=3)
+
+
+# -- summary eval path ----------------------------------------------------
+
+@pytest.mark.slow
+def test_summary_do_eval_only(tmp_path, mesh8, monkeypatch):
+    """--do_eval_only: restore-free predict + rouge report + predictions
+    file (the randeng_t5_70M_summary_predict.sh path)."""
+    monkeypatch.chdir(tmp_path)
+    from transformers import BertTokenizer
+
+    chars = list("今天天气很好糟糕新闻摘要内容标题经济体育")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + \
+        sorted(set(chars))
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab))
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    BertTokenizer(str(tmp_path / "vocab.txt")).save_pretrained(
+        str(model_dir))
+    with open(model_dir / "config.json", "w") as f:
+        json.dump({"model_type": "t5", "vocab_size": len(vocab),
+                   "d_model": 32, "d_kv": 8, "d_ff": 64, "num_layers": 2,
+                   "num_heads": 4, "dtype": "float32"}, f)
+    rng = np.random.RandomState(0)
+    for name in ("train.json", "test.json"):
+        with open(tmp_path / name, "w") as f:
+            for i in range(4):
+                f.write(json.dumps(
+                    {"text": "".join(rng.choice(chars, 10)),
+                     "summary": "".join(rng.choice(chars, 4))},
+                    ensure_ascii=False) + "\n")
+
+    from fengshen_tpu.examples.summary import seq2seq_summary
+    out = tmp_path / "predict.json"
+    seq2seq_summary.main([
+        "--model_type", "t5",
+        "--model_path", str(model_dir),
+        "--do_eval_only",
+        "--output_save_path", str(out),
+        "--train_file", str(tmp_path / "train.json"),
+        "--test_file", str(tmp_path / "test.json"),
+        "--test_batchsize", "2",
+        "--max_enc_length", "16", "--max_dec_length", "8",
+        "--prompt", "摘要:",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--precision", "fp32",
+    ])
+    lines = [json.loads(x) for x in open(out, encoding="utf-8")]
+    assert len(lines) == 4 and all("pred" in r for r in lines)
