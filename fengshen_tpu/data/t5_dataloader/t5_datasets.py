@@ -90,8 +90,16 @@ class T5SpanCorruptionCollator:
             compute_input_and_target_lengths(
                 self.max_seq_length, self.noise_density,
                 self.mean_noise_span_length)
-        # sentinel ids: <extra_id_0> is the LAST vocab entries in T5
-        self.sentinel0 = len(self.tokenizer) - 1
+        # sentinel ids: sentencepiece T5 puts <extra_id_0> LAST and
+        # descends; the char-level T5Tokenizer wrapper APPENDS
+        # <extra_id_0..117> so its ids ascend — it publishes them as
+        # `sentinel_token_ids` (models/t5/tokenization_megatron_t5.py)
+        sentinels = getattr(self.tokenizer, "sentinel_token_ids", None)
+        if sentinels:
+            self.sentinels = list(sentinels)
+        else:
+            self.sentinels = [len(self.tokenizer) - 1 - i
+                              for i in range(100)]
         self.eos = self.tokenizer.eos_token_id or 1
         self.pad = self.tokenizer.pad_token_id or 0
 
@@ -103,14 +111,16 @@ class T5SpanCorruptionCollator:
                                        self.mean_noise_span_length,
                                        self.np_rng)
         inp, tgt = [], []
-        sentinel = self.sentinel0
+        span_i = 0
         prev_noise = False
         for tok, is_noise in zip(ids, mask):
             if is_noise:
                 if not prev_noise:
+                    sentinel = self.sentinels[
+                        min(span_i, len(self.sentinels) - 1)]
                     inp.append(sentinel)
                     tgt.append(sentinel)
-                    sentinel -= 1
+                    span_i += 1
                 tgt.append(tok)
             else:
                 inp.append(tok)

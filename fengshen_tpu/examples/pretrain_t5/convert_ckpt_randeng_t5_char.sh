@@ -1,0 +1,10 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/pretrain_t5/convert_ckpt_randeng_t5_char.sh
+# DeepSpeed mp_rank .pt -> bare pytorch_model.bin (strip module.model.)
+set -euo pipefail
+BIN_DIR=${BIN_DIR:-./randeng_t5_char_57M}
+mkdir -p $BIN_DIR
+python -m fengshen_tpu.examples.pretrain_t5.convert_ckpt_to_bin \
+    --ckpt_path ${CKPT_PATH:-./ckpt/last.ckpt/checkpoint/mp_rank_00_model_states.pt} \
+    --bin_path $BIN_DIR/pytorch_model.bin \
+    --rm_prefix module.model.

@@ -1,0 +1,41 @@
+"""DeepSpeed/Lightning .ckpt → bare pytorch_model.bin.
+
+Port of reference: fengshen/examples/pretrain_t5/convert_ckpt_to_bin.py
+:13-34 (driven by convert_ckpt_randeng_t5_char.sh): load the wrapped
+state dict (``['module']`` for DeepSpeed mp_rank files, ``['state_dict']``
+for plain Lightning, else the file itself), strip ``--rm_prefix`` from key
+names, and save a bin the family converters / HF loaders can read.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def strip_prefix(state_dict: dict, prefix: str | None) -> dict:
+    if not prefix:
+        return dict(state_dict)
+    n = len(prefix)
+    return {(k[n:] if k.startswith(prefix) else k): v
+            for k, v in state_dict.items()}
+
+
+def main(argv=None):
+    import torch
+
+    parser = argparse.ArgumentParser("Pretrain Unsupervise.")
+    parser.add_argument("--ckpt_path", default=None, type=str)
+    parser.add_argument("--bin_path", default=None, type=str)
+    parser.add_argument("--rm_prefix", default=None, type=str)
+    args = parser.parse_args(argv)
+
+    raw = torch.load(args.ckpt_path, map_location="cpu",
+                     weights_only=False)
+    state_dict = raw.get("module", raw.get("state_dict", raw)) \
+        if isinstance(raw, dict) else raw
+    torch.save(strip_prefix(state_dict, args.rm_prefix), args.bin_path)
+    print(f"saved {len(state_dict)} tensors -> {args.bin_path}")
+
+
+if __name__ == "__main__":
+    main()

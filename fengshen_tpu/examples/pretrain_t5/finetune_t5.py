@@ -1,0 +1,216 @@
+"""UniMC-format multiple-choice finetune over T5 (Randeng-T5-Char 57M).
+
+Port of reference: fengshen/examples/pretrain_t5/finetune_t5.py +
+data/t5_dataloader/t5_datasets.py:438-505 TaskT5Dataset (driven by
+finetune_unimc_randeng_t5_char_57M.sh): each UniMC row
+``{texta, textb, question, choice, answer}`` becomes
+``question + '，'.join(choice) + '。' + texta [+ textb]`` → the answer
+text, trained with seq2seq CE.
+
+TPU-native evaluation: the reference's validation runs HF
+``generate(force_words_ids=answer_tokens, num_beams=2)`` — a dynamic
+constrained beam that does not map to static-shape XLA. The equivalent
+choice-restricted decision here scores each option's token sequence by
+teacher-forced log-likelihood in ONE jitted batched pass and takes the
+argmax; same decision rule over the same candidate set, no dynamic
+control flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.trainer.module import TrainModule
+
+MAX_ANSWER_LEN = 16  # reference: t5_datasets.py:470 decode max_length=16
+
+
+class TaskT5Dataset:
+    """reference: t5_datasets.py:438-460."""
+
+    def __init__(self, data_path: str, args):
+        self.max_length = args.max_seq_length
+        with open(data_path, encoding="utf8") as f:
+            self.data = [json.loads(line) for line in f if line.strip()]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, index):
+        return self.data[index]
+
+
+def encode_text(item: dict) -> str:
+    """reference: t5_datasets.py:462-466."""
+    if item.get("textb"):
+        return (item["question"] + "，".join(item["choice"]) + "。" +
+                str(item["texta"]) + str(item["textb"]))
+    return (str(item["question"]) + "，".join(item["choice"]) + "。" +
+            str(item["texta"]))
+
+
+@dataclass
+class TaskT5Collator:
+    tokenizer: Any
+    max_seq_length: int = 512
+    decoder_start_token_id: int = 0
+    max_choices: int = 4
+
+    def _encode_answer(self, text: str) -> list[int]:
+        ids = self.tokenizer.encode(text, add_special_tokens=False)
+        eos = self.tokenizer.eos_token_id
+        if eos is not None:
+            ids = ids[: MAX_ANSWER_LEN - 1] + [eos]
+        return ids[:MAX_ANSWER_LEN]
+
+    def __call__(self, samples: list[dict]) -> dict:
+        pad = self.tokenizer.pad_token_id or 0
+        batch = {"input_ids": [], "attention_mask": [],
+                 "decoder_input_ids": [], "labels": [],
+                 "choice_ids": [], "choice_mask": [], "label_idx": []}
+        for item in samples:
+            enc = self.tokenizer(
+                encode_text(item), max_length=self.max_seq_length,
+                padding="max_length", truncation=True)
+            batch["input_ids"].append(enc["input_ids"])
+            batch["attention_mask"].append(enc["attention_mask"])
+            tgt = self._encode_answer(item.get("answer", ""))
+            dec_in = [self.decoder_start_token_id] + tgt[:-1]
+            pad_t = MAX_ANSWER_LEN - len(tgt)
+            batch["decoder_input_ids"].append(dec_in + [pad] * pad_t)
+            batch["labels"].append(tgt + [-100] * pad_t)
+            # all options, for the choice-restricted eval
+            cids = np.full((self.max_choices, MAX_ANSWER_LEN), -100,
+                           np.int32)
+            cmask = np.zeros((self.max_choices,), np.int32)
+            for c, choice in enumerate(item["choice"][: self.max_choices]):
+                ids = self._encode_answer(choice)
+                cids[c, : len(ids)] = ids
+                cmask[c] = 1
+            batch["choice_ids"].append(cids)
+            batch["choice_mask"].append(cmask)
+            batch["label_idx"].append(int(item.get("label", 0)))
+        return {k: np.asarray(v) for k, v in batch.items()}
+
+
+class MT5FinetuneModule(TrainModule):
+    """reference: finetune_t5.py:14-103 MT5FinetuneModel."""
+
+    def __init__(self, args, model=None, config=None):
+        super().__init__(args)
+        from fengshen_tpu.models.t5 import (T5Config,
+                                            T5ForConditionalGeneration)
+        if config is None:
+            config = T5Config.from_pretrained(args.pretrained_model_path)
+        self.config = config
+        self.model = model or T5ForConditionalGeneration(config)
+
+    @staticmethod
+    def add_model_specific_args(parent_args):
+        parser = parent_args.add_argument_group("BaseModel")
+        parser.add_argument("--keep_tokens_path", default=None, type=str)
+        parser.add_argument("--max_seq_length", default=512, type=int)
+        parser.add_argument(
+            "--tokenizer_type", default="t5_tokenizer", type=str,
+            choices=["t5_tokenizer", "bert_tokenizer"])
+        parser.add_argument("--pretrained_model_path", default=None,
+                            type=str)
+        parser.add_argument("--train_data_path", default=None, type=str)
+        parser.add_argument("--valid_data_path", default=None, type=str)
+        return parent_args
+
+    def init_params(self, rng):
+        ids = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, ids, ids)["params"]
+
+    def _loss(self, params, batch, rng=None):
+        logits = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["decoder_input_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=rng is None,
+            rngs={"dropout": rng} if rng is not None else None)
+        return vocab_parallel_cross_entropy(logits, batch["labels"])
+
+    def training_loss(self, params, batch, rng):
+        loss, n = self._loss(params, batch, rng)
+        return loss, {"n_tokens": n}
+
+    def validation_loss(self, params, batch, rng):
+        loss, _ = self._loss(params, batch)
+        # choice-restricted accuracy: teacher-forced log-likelihood per
+        # option (the static-shape counterpart of the reference's
+        # force_words_ids beam)
+        B, C, L = batch["choice_ids"].shape
+        rep = lambda x: jnp.repeat(x, C, axis=0)  # noqa: E731
+        choice = batch["choice_ids"].reshape(B * C, L)
+        pad = 0
+        dec_in = jnp.concatenate(
+            [jnp.zeros((B * C, 1), choice.dtype),
+             jnp.where(choice[:, :-1] < 0, pad, choice[:, :-1])], axis=1)
+        logits = self.model.apply(
+            {"params": params}, rep(batch["input_ids"]), dec_in,
+            attention_mask=rep(batch["attention_mask"]),
+            deterministic=True)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok_lp = jnp.take_along_axis(
+            logp, jnp.where(choice < 0, 0, choice)[..., None],
+            axis=-1)[..., 0]
+        valid = (choice >= 0).astype(jnp.float32)
+        scores = (tok_lp * valid).sum(-1) / jnp.maximum(valid.sum(-1), 1)
+        scores = scores.reshape(B, C)
+        scores = jnp.where(batch["choice_mask"] > 0, scores, -1e9)
+        acc = (scores.argmax(-1) == batch["label_idx"]).mean()
+        return loss, {"cond_acc": acc}
+
+    def partition_rules(self):
+        return self.model.partition_rules()
+
+
+def main(argv=None):
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import Trainer, add_trainer_args
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    parser = argparse.ArgumentParser("Finetune T5 (UniMC format)")
+    parser = add_module_args(parser)
+    parser = add_trainer_args(parser)
+    parser = UniversalDataModule.add_data_specific_args(parser)
+    parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = MT5FinetuneModule.add_model_specific_args(parser)
+    args = parser.parse_args(argv)
+
+    if args.tokenizer_type == "bert_tokenizer":
+        from fengshen_tpu.models.t5 import T5Tokenizer
+        tokenizer = T5Tokenizer.from_pretrained(args.pretrained_model_path)
+    else:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(
+            args.pretrained_model_path)
+
+    module = MT5FinetuneModule(args)
+    collator = TaskT5Collator(
+        tokenizer, max_seq_length=args.max_seq_length,
+        decoder_start_token_id=module.config.decoder_start_token_id)
+    datasets = {"train": TaskT5Dataset(args.train_data_path, args)}
+    if args.valid_data_path:
+        datasets["validation"] = TaskT5Dataset(args.valid_data_path, args)
+    datamodule = UniversalDataModule(tokenizer=tokenizer,
+                                     collate_fn=collator, args=args,
+                                     datasets=datasets)
+    trainer = Trainer(args)
+    trainer.callbacks.append(UniversalCheckpoint(args))
+    trainer.fit(module, datamodule)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/pretrain_t5/finetune_unimc_randeng_t5_char_57M.sh
+# UniMC-format multiple-choice finetune of the char-level Randeng-T5 57M
+set -euo pipefail
+MODEL_PATH=${MODEL_PATH:-IDEA-CCNL/Randeng-T5-Char-57M-Chinese}
+TRAIN_DATA_DIR=${TRAIN_DATA_DIR:-./data/unimc}
+ROOT_DIR=${ROOT_DIR:-./workdir/$(basename $0 .sh)}
+mkdir -p $ROOT_DIR
+python -m fengshen_tpu.examples.pretrain_t5.finetune_t5 \
+    --pretrained_model_path $MODEL_PATH \
+    --tokenizer_type bert_tokenizer \
+    --train_data_path $TRAIN_DATA_DIR/train.json \
+    --valid_data_path $TRAIN_DATA_DIR/dev.json \
+    --default_root_dir $ROOT_DIR \
+    --save_ckpt_path $ROOT_DIR/ckpt --load_ckpt_path $ROOT_DIR/ckpt \
+    --monitor train_loss --mode min --save_top_k 3 --save_last \
+    --every_n_train_steps 100000 \
+    --train_batchsize 8 --val_batchsize 8 \
+    --max_seq_length 512 \
+    --learning_rate 1e-4 --weight_decay 1e-2 --warmup_ratio 0.01 \
+    --max_epochs 1 \
+    --precision bf16

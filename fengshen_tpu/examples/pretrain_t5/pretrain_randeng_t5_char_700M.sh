@@ -22,6 +22,7 @@ EOF
 fi
 
 python -m fengshen_tpu.examples.pretrain_t5.pretrain_t5 \
+    --tokenizer_type bert_tokenizer \
     --model_path $MODEL_PATH \
     --train_file $DATA_DIR/train.json \
     --default_root_dir $ROOT_DIR \

@@ -69,6 +69,12 @@ class T5PretrainModule(TrainModule):
         parser.add_argument("--noise_density", type=float, default=0.15)
         parser.add_argument("--mean_noise_span_length", type=float,
                             default=3.0)
+        parser.add_argument(
+            "--tokenizer_type", default="t5_tokenizer", type=str,
+            choices=["t5_tokenizer", "bert_tokenizer"],
+            help="bert_tokenizer = char-level Randeng vocab behind the "
+                 "T5Tokenizer wrapper (reference: pretrain_t5.py:27 + "
+                 "models/megatron_t5/tokenization_megatron_t5.py)")
         return parent_parser
 
     def init_params(self, rng):
@@ -130,8 +136,13 @@ def main(argv=None):
     parser = T5PretrainModule.add_module_specific_args(parser)
     args = parser.parse_args(argv)
 
-    tokenizer = AutoTokenizer.from_pretrained(
-        args.new_vocab_path or args.model_path)
+    if args.tokenizer_type == "bert_tokenizer":
+        from fengshen_tpu.models.t5 import T5Tokenizer
+        tokenizer = T5Tokenizer.from_pretrained(
+            args.new_vocab_path or args.model_path)
+    else:
+        tokenizer = AutoTokenizer.from_pretrained(
+            args.new_vocab_path or args.model_path)
     collator = T5SpanCorruptionCollator(
         tokenizer, max_seq_length=args.max_seq_length,
         noise_density=args.noise_density,

@@ -1,0 +1,107 @@
+"""Offline corpus tokenization for T5 pretraining.
+
+Port of reference: fengshen/examples/pretrain_t5/process_data.py
+(driven by process_data_bert_tokenizer.sh): tokenize a text corpus once,
+split train/test by ``--train_split_size``, and save sharded tokenized
+data so the pretrain run streams pre-encoded ids instead of re-running
+the tokenizer per epoch.
+
+TPU-native: shards are written as ``.npy`` object arrays of int32 id
+lists (mmap-friendly), not HF `datasets.save_to_disk` arrow dirs; the
+reference flag surface is preserved.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def iter_texts(path: str, text_column: str):
+    """Rows from a jsonl file, a directory of jsonl files, or a plain
+    text file (one doc per line)."""
+    paths = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith((".json", ".jsonl", ".txt")):
+                paths.append(os.path.join(path, name))
+    else:
+        paths = [path]
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith("{"):
+                    try:
+                        yield json.loads(line).get(text_column, "")
+                        continue
+                    except json.JSONDecodeError:
+                        pass
+                yield line
+
+
+def save_shards(rows: list, out_dir: str, n_shards: int) -> int:
+    os.makedirs(out_dir, exist_ok=True)
+    n_shards = max(1, min(n_shards, len(rows) or 1))
+    for i in range(n_shards):
+        shard = rows[i::n_shards]
+        arr = np.empty(len(shard), dtype=object)
+        for j, ids in enumerate(shard):
+            arr[j] = np.asarray(ids, np.int32)
+        np.save(os.path.join(out_dir, f"shard_{i:05d}.npy"), arr,
+                allow_pickle=True)
+    return n_shards
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("Pretrain Unsupervise.")
+    parser.add_argument("--train_data_path", default=None, type=str)
+    parser.add_argument("--preprocessing_num_workers", default=30,
+                        type=int)
+    parser.add_argument("--saved_data_shards", default=800, type=int)
+    parser.add_argument("--saved_train_data_path", default=None, type=str)
+    parser.add_argument("--saved_test_data_path", default=None, type=str)
+    parser.add_argument("--max_seq_length", default=512, type=int)
+    parser.add_argument("--train_split_size", default=0.999, type=float)
+    parser.add_argument("--pretrained_model_path", default=None, type=str)
+    parser.add_argument("--tokenizer_type", default="t5_tokenizer",
+                        choices=["t5_tokenizer", "bert_tokenizer"])
+    parser.add_argument("--text_column_name", default="text")
+    parser.add_argument("--remove_columns", nargs="+", default=[])
+    args = parser.parse_args(argv)
+
+    if args.tokenizer_type == "bert_tokenizer":
+        from fengshen_tpu.models.t5 import T5Tokenizer
+        tokenizer = T5Tokenizer.from_pretrained(args.pretrained_model_path)
+    else:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(
+            args.pretrained_model_path)
+
+    rows = []
+    for text in iter_texts(args.train_data_path, args.text_column_name):
+        ids = tokenizer.encode(text, add_special_tokens=False,
+                               truncation=True,
+                               max_length=args.max_seq_length)
+        if ids:
+            rows.append(ids)
+
+    split = int(len(rows) * args.train_split_size)
+    train, test = rows[:split], rows[split:]
+    n_train = save_shards(train, args.saved_train_data_path,
+                          args.saved_data_shards)
+    n_test = save_shards(test, args.saved_test_data_path,
+                         max(1, args.saved_data_shards // 100))
+    print(f"train: {len(train)} docs / {n_train} shards -> "
+          f"{args.saved_train_data_path}")
+    print(f"test:  {len(test)} docs / {n_test} shards -> "
+          f"{args.saved_test_data_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/bin/bash
+# hparams carried from reference: fengshen/examples/pretrain_t5/process_data_bert_tokenizer.sh
+# one-off corpus tokenization with the char-level Randeng vocab
+set -euo pipefail
+python -m fengshen_tpu.examples.pretrain_t5.process_data \
+    --tokenizer_type bert_tokenizer \
+    --train_data_path ${TRAIN_DATA_PATH:-wudao_180g} \
+    --train_split_size 0.999 \
+    --max_seq_length 512 \
+    --preprocessing_num_workers 100 \
+    --saved_data_shards 800 \
+    --saved_train_data_path ${SAVED_TRAIN:-./tokenized/train} \
+    --saved_test_data_path ${SAVED_TEST:-./tokenized/test} \
+    --pretrained_model_path ${MODEL_PATH:-IDEA-CCNL/Randeng-T5-Char-57M-Chinese}

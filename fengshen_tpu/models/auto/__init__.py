@@ -7,6 +7,7 @@ Resolution order: model_type from config.json → registry entry → class.
 """
 
 from fengshen_tpu.models.auto.auto_factory import (AutoConfig, AutoModel,
+                                                   AutoTokenizer,
                                                    register_model)
 
-__all__ = ["AutoConfig", "AutoModel", "register_model"]
+__all__ = ["AutoConfig", "AutoModel", "AutoTokenizer", "register_model"]

@@ -183,3 +183,38 @@ class AutoModel:
         except ModuleNotFoundError:
             pass  # torch-less install: model with params=None, as before
         return model, params
+
+
+#: model_type → (module, factory attr) for tokenizers that HF
+#: AutoTokenizer cannot resolve (reference:
+#: fengshen/models/auto/tokenization_auto.py TOKENIZER_MAPPING)
+TOKENIZER_REGISTRY: dict[str, tuple[str, str]] = {
+    # char-level Randeng T5: BERT vocab behind a T5 surface
+    "megatron_t5": ("fengshen_tpu.models.t5", "T5Tokenizer"),
+    "t5_char": ("fengshen_tpu.models.t5", "T5Tokenizer"),
+}
+
+
+class AutoTokenizer:
+    """Resolve fengshen-specific tokenizers by the checkpoint's
+    config.json (``tokenizer_class``/``fengshen_model_type``/
+    ``model_type``), falling through to HF AutoTokenizer."""
+
+    @staticmethod
+    def from_pretrained(path: str, **kwargs) -> Any:
+        keys = []
+        cfg_file = os.path.join(path, "config.json") \
+            if os.path.isdir(path) else None
+        if cfg_file and os.path.exists(cfg_file):
+            with open(cfg_file) as f:
+                raw = json.load(f)
+            keys = [raw.get("tokenizer_class", ""),
+                    raw.get("fengshen_model_type", ""),
+                    raw.get("model_type", "")]
+        for key in keys:
+            if key in TOKENIZER_REGISTRY:
+                module_name, attr = TOKENIZER_REGISTRY[key]
+                cls = getattr(importlib.import_module(module_name), attr)
+                return cls.from_pretrained(path, **kwargs)
+        import transformers
+        return transformers.AutoTokenizer.from_pretrained(path, **kwargs)

@@ -6,6 +6,7 @@ from fengshen_tpu.models.t5.configuration_t5 import T5Config
 from fengshen_tpu.models.t5.modeling_t5 import (T5Model,
                                                 T5ForConditionalGeneration,
                                                 T5EncoderModel)
+from fengshen_tpu.models.t5.tokenization_megatron_t5 import T5Tokenizer
 
 __all__ = ["T5Config", "T5Model", "T5ForConditionalGeneration",
-           "T5EncoderModel"]
+           "T5EncoderModel", "T5Tokenizer"]

@@ -1,0 +1,49 @@
+"""Randeng-T5-Char tokenizer: BertTokenizer-backed T5Tokenizer.
+
+Port of reference: fengshen/models/megatron_t5/tokenization_megatron_t5.py
+:20-32 — the char-level Randeng checkpoints (57M → 10B) ship a BERT
+vocab.txt rather than a sentencepiece model; ``T5Tokenizer.from_pretrained``
+returns a BertTokenizer carrying the T5 special surface: ``[BOS]``/``[EOS]``
+plus 118 ``<extra_id_i>`` span-corruption sentinels as additional special
+tokens.
+
+Beyond the reference: bos/eos_token attributes are bound when the markers
+exist in the vocab (the reference leaves them unset, which breaks
+`tokenizer.eos_token_id`-driven collators), and `sentinel_token_ids`
+exposes the extra-id range the span-corruption collator needs.
+"""
+
+from __future__ import annotations
+
+from transformers import BertTokenizer
+
+DEFAULT_EXTRA_ID_NUM = 118
+
+
+class T5Tokenizer:
+    """Factory matching the reference class shape: use
+    ``T5Tokenizer.from_pretrained(vocab_path)``."""
+
+    def __init__(self, extra_id_num: int = DEFAULT_EXTRA_ID_NUM):
+        self.extra_id_num = extra_id_num
+
+    @classmethod
+    def from_pretrained(cls, vocab_path: str,
+                        extra_id_num: int = DEFAULT_EXTRA_ID_NUM
+                        ) -> BertTokenizer:
+        special_tokens = ["[BOS]", "[EOS]"] + \
+            [f"<extra_id_{i}>" for i in range(extra_id_num)]
+        tokenizer = BertTokenizer.from_pretrained(
+            vocab_path, additional_special_tokens=special_tokens)
+        # bind the T5 special surface when the markers resolve (added
+        # specials always resolve; [BOS]/[EOS] may also live in vocab.txt)
+        unk = tokenizer.unk_token_id
+        if tokenizer.convert_tokens_to_ids("[EOS]") != unk:
+            tokenizer.eos_token = "[EOS]"
+        if tokenizer.convert_tokens_to_ids("[BOS]") != unk:
+            tokenizer.bos_token = "[BOS]"
+        tokenizer.extra_id_num = extra_id_num
+        tokenizer.sentinel_token_ids = [
+            tokenizer.convert_tokens_to_ids(f"<extra_id_{i}>")
+            for i in range(extra_id_num)]
+        return tokenizer

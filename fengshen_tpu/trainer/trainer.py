@@ -541,11 +541,21 @@ class Trainer:
                 self._val_fn = jax.jit(module.validation_loss)
             self._val_fn_module = module
         val_fn = self._val_fn
+        metric_sums: dict = {}
+
+        def _accumulate(metrics):
+            for k, v in (metrics or {}).items():
+                try:
+                    metric_sums[k] = metric_sums.get(k, 0.0) + float(v)
+                except (TypeError, ValueError):
+                    pass  # non-scalar diagnostic; skip
+
         for i, batch in enumerate(loader):
             if limit and i >= limit:
                 break
             try:
-                loss, _ = val_fn(state.params, batch, rng)
+                loss, metrics = val_fn(state.params, batch, rng)
+                _accumulate(metrics)
             except (TypeError, ValueError) as e:
                 # this batch doesn't fit the train batch spec — run IT on a
                 # separately cached inferred-sharding jit, but keep the
@@ -555,11 +565,17 @@ class Trainer:
                     self._log({"event": "val_shard_fallback",
                                "step": self.global_step,
                                "error": str(e)[:200]})
-                loss, _ = self._val_fn_plain(state.params, batch, rng)
+                loss, metrics = self._val_fn_plain(state.params, batch,
+                                                   rng)
+                _accumulate(metrics)
             losses.append(float(loss))
         if losses:
-            self._log({"step": self.global_step,
-                       "val_loss": float(np.mean(losses))})
+            entry = {"step": self.global_step,
+                     "val_loss": float(np.mean(losses))}
+            for k, total in metric_sums.items():
+                key = k if k.startswith("val_") else f"val_{k}"
+                entry[key] = total / len(losses)
+            self._log(entry)
 
     # -- logging ---------------------------------------------------------
     def _log(self, entry: dict) -> None:

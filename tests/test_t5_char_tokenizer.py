@@ -1,0 +1,165 @@
+"""Randeng-T5-Char tokenizer + char-tokenizer recipes (VERDICT r3
+missing #3 / next-round item 4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+CHARS = list("今天天气很好糟糕新闻标题体育财经科技故事内容问题答案是否")
+
+
+def _char_model_dir(tmp_path, with_markers=True, config_extra=None):
+    specials = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    if with_markers:
+        specials += ["[BOS]", "[EOS]"]
+    vocab = specials + sorted(set(CHARS))
+    model_dir = tmp_path / "model"
+    model_dir.mkdir(exist_ok=True)
+    (model_dir / "vocab.txt").write_text("\n".join(vocab))
+    cfg = {"model_type": "t5", "tokenizer_class": "megatron_t5",
+           "vocab_size": len(vocab) + 120, "d_model": 32, "d_kv": 8,
+           "d_ff": 64, "num_layers": 2, "num_heads": 4,
+           "dtype": "float32"}
+    cfg.update(config_extra or {})
+    with open(model_dir / "config.json", "w") as f:
+        json.dump(cfg, f)
+    return model_dir
+
+
+def test_round_trip_with_extra_ids(tmp_path):
+    from fengshen_tpu.models.t5 import T5Tokenizer
+
+    tok = T5Tokenizer.from_pretrained(str(_char_model_dir(tmp_path)))
+    # char-level: each Chinese char is one token
+    ids = tok.encode("今天天气", add_special_tokens=False)
+    assert len(ids) == 4
+    assert tok.decode(ids, skip_special_tokens=True).replace(" ", "") == \
+        "今天天气"
+    # 118 sentinels, round-trippable as single tokens
+    assert len(tok.sentinel_token_ids) == 118
+    s17 = tok.convert_tokens_to_ids("<extra_id_17>")
+    assert s17 == tok.sentinel_token_ids[17]
+    assert tok.convert_ids_to_tokens(s17) == "<extra_id_17>"
+    # [BOS]/[EOS] bound as bos/eos
+    assert tok.eos_token == "[EOS]" and tok.bos_token == "[BOS]"
+    assert tok.eos_token_id == tok.convert_tokens_to_ids("[EOS]")
+
+
+def test_span_corruption_uses_wrapper_sentinels(tmp_path):
+    from fengshen_tpu.data.t5_dataloader.t5_datasets import (
+        T5SpanCorruptionCollator)
+    from fengshen_tpu.models.t5 import T5Tokenizer
+
+    tok = T5Tokenizer.from_pretrained(str(_char_model_dir(tmp_path)))
+    collator = T5SpanCorruptionCollator(tok, max_seq_length=32,
+                                        noise_density=0.3)
+    batch = collator([{"text": "".join(np.random.RandomState(0)
+                                       .choice(CHARS, 24))}])
+    sent = set(tok.sentinel_token_ids)
+    used = [t for t in batch["input_ids"][0].tolist() if t in sent]
+    assert used, "no sentinel tokens appeared in the corrupted input"
+    # first span must use <extra_id_0>, second <extra_id_1>, ... (the
+    # wrapper's ASCENDING ids, not len(vocab)-1-i)
+    assert used[0] == tok.sentinel_token_ids[0]
+    assert used == tok.sentinel_token_ids[: len(used)]
+
+
+def test_auto_tokenizer_resolves_char_t5(tmp_path):
+    from fengshen_tpu.models.auto import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(str(_char_model_dir(tmp_path)))
+    assert hasattr(tok, "sentinel_token_ids")
+    # plain dirs still fall through to HF
+    from transformers import BertTokenizer
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    (plain / "vocab.txt").write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] +
+        sorted(set(CHARS))))
+    BertTokenizer(str(plain / "vocab.txt")).save_pretrained(str(plain))
+    hf = AutoTokenizer.from_pretrained(str(plain))
+    assert not hasattr(hf, "sentinel_token_ids")
+
+
+def test_process_data_and_convert_ckpt(tmp_path):
+    import torch
+
+    from fengshen_tpu.examples.pretrain_t5 import (convert_ckpt_to_bin,
+                                                   process_data)
+
+    model_dir = _char_model_dir(tmp_path)
+    corpus = tmp_path / "corpus.jsonl"
+    rng = np.random.RandomState(0)
+    with open(corpus, "w") as f:
+        for _ in range(10):
+            f.write(json.dumps(
+                {"text": "".join(rng.choice(CHARS, 20))},
+                ensure_ascii=False) + "\n")
+    process_data.main([
+        "--tokenizer_type", "bert_tokenizer",
+        "--train_data_path", str(corpus),
+        "--train_split_size", "0.8",
+        "--max_seq_length", "32",
+        "--saved_data_shards", "2",
+        "--saved_train_data_path", str(tmp_path / "train_shards"),
+        "--saved_test_data_path", str(tmp_path / "test_shards"),
+        "--pretrained_model_path", str(model_dir)])
+    shards = sorted(os.listdir(tmp_path / "train_shards"))
+    assert len(shards) == 2
+    arr = np.load(str(tmp_path / "train_shards" / shards[0]),
+                  allow_pickle=True)
+    assert all(a.dtype == np.int32 for a in arr)
+    total = sum(len(np.load(str(tmp_path / "train_shards" / s),
+                            allow_pickle=True)) for s in shards)
+    assert total == 8  # 0.8 split of 10
+
+    # convert_ckpt_to_bin strips the DeepSpeed module.model. prefix
+    ckpt = {"module": {"module.model.shared.weight": torch.ones(3),
+                       "other.weight": torch.zeros(2)}}
+    src = tmp_path / "mp_rank_00_model_states.pt"
+    torch.save(ckpt, str(src))
+    out = tmp_path / "pytorch_model.bin"
+    convert_ckpt_to_bin.main(["--ckpt_path", str(src),
+                              "--bin_path", str(out),
+                              "--rm_prefix", "module.model."])
+    state = torch.load(str(out), weights_only=True)
+    assert set(state) == {"shared.weight", "other.weight"}
+
+
+@pytest.mark.slow
+def test_finetune_unimc_t5_char_e2e(tmp_path, mesh8, monkeypatch):
+    """The char-57M launcher recipe end-to-end on a synthetic vocab:
+    UniMC rows → fit 2 steps → choice-restricted val acc logged."""
+    monkeypatch.chdir(tmp_path)
+    model_dir = _char_model_dir(tmp_path)
+    rng = np.random.RandomState(0)
+    data_dir = tmp_path / "unimc"
+    data_dir.mkdir()
+    for name in ("train.json", "dev.json"):
+        with open(data_dir / name, "w") as f:
+            for i in range(8):
+                f.write(json.dumps(
+                    {"texta": "".join(rng.choice(CHARS, 10)),
+                     "textb": "",
+                     "question": "是否？", "choice": ["是", "否"],
+                     "answer": ["是", "否"][i % 2], "label": i % 2,
+                     "id": i}, ensure_ascii=False) + "\n")
+
+    from fengshen_tpu.examples.pretrain_t5 import finetune_t5
+    finetune_t5.main([
+        "--pretrained_model_path", str(model_dir),
+        "--tokenizer_type", "bert_tokenizer",
+        "--train_data_path", str(data_dir / "train.json"),
+        "--valid_data_path", str(data_dir / "dev.json"),
+        "--train_batchsize", "4", "--val_batchsize", "4",
+        "--max_seq_length", "32",
+        "--max_steps", "2", "--max_epochs", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--precision", "fp32",
+    ])
+    log = (tmp_path / "runs" / "metrics.jsonl").read_text()
+    assert "cond_acc" in log
