@@ -1,7 +1,11 @@
 """BERT corpus pipeline (reference: fengshen/data/bert_dataloader/ —
 corpus sharding + sentence-level preprocessing + BertDataModule)."""
 
-from fengshen_tpu.data.bert_dataloader.load import (shard_corpus,
-                                                    preprocess_corpus)
+from fengshen_tpu.data.bert_dataloader.load import (
+    auto_split, cut_sent_file, mark_sentence_boundaries,
+    generate_cache_arrow, preprocess_corpus, repack_segments,
+    shard_corpus, split_train_test_validation_index)
 
-__all__ = ["shard_corpus", "preprocess_corpus"]
+__all__ = ["shard_corpus", "preprocess_corpus", "cut_sent_file",
+           "mark_sentence_boundaries", "repack_segments", "auto_split",
+           "generate_cache_arrow", "split_train_test_validation_index"]

@@ -59,3 +59,155 @@ def preprocess_corpus(input_path: str, output_path: str,
                                       ensure_ascii=False) + "\n")
                 n += 1
     return n
+
+
+# -- the reference's exact wudao cleaning semantics -----------------------
+
+import re
+
+_BOUNDARY = "#####"
+#: The five published sentence-boundary rules of the wudao cleaning
+#: pipeline (reference: fengshen/data/bert_dataloader/
+#: preprocessing.py:27-37 cut_sent). The regex patterns ARE the cleaning
+#: spec — quoted-sentence handling depends on applying them verbatim and
+#: in this order: (1) break after terminal-punct runs, (2) break after
+#: ellipses, (3)/(4) break after punct+closing-quote, (5) re-attach a
+#: closing quote that rule 1 separated from its sentence.
+_BOUNDARY_RULES = (
+    ("([？。！\\?\\!…]+)([^”’]|[”’])", r"\1" + _BOUNDARY + r"\2"),
+    ("([\\.]{3,})([^”’])", r"\1" + _BOUNDARY + r"\2"),
+    ("([。！？\\?\\!…][”’])([^，。！？\\?\\!]|\\s)",
+     r"\1" + _BOUNDARY + r"\2"),
+    ("([\\.]{3,}[”’])([^，。！？\\?\\!]|\\s)", r"\1" + _BOUNDARY + r"\2"),
+    ("([#]{5})([”’])([^，。！？\\?\\!])", r"\2" + _BOUNDARY + r"\3"),
+)
+
+
+def mark_sentence_boundaries(text: str) -> list[str]:
+    """Split one document into sentences by the reference's rule
+    cascade. The trailing space matches the reference (rule 1 needs a
+    lookahead character to fire on a document-final sentence)."""
+    marked = text + " "
+    for pattern, repl in _BOUNDARY_RULES:
+        marked = re.sub(pattern, repl, marked)
+    return marked.strip().split(_BOUNDARY)
+
+
+def repack_segments(sentences: Iterator[str],
+                    max_chars: int = 512) -> list[str]:
+    """Greedy re-packing of sentences into ~max_chars segments —
+    reference: preprocessing.py:39-50 ("一个512里面多个样本"), including
+    its two deliberate quirks: a segment may exceed max_chars by the
+    final appended sentence (the bound is checked BEFORE appending), and
+    an empty sentence flushes the current segment."""
+    segments: list[str] = []
+    current = ""
+    for sentence in sentences:
+        sentence = sentence.strip()
+        if len(current) < max_chars and len(sentence) > 0:
+            current += sentence
+        else:
+            segments.append(current)
+            current = sentence
+    segments.append(current)
+    return segments
+
+
+def cut_sent_file(input_path: str, output_path: str,
+                  content_key: str = "text",
+                  max_chars: int = 512) -> int:
+    """jsonl of documents → jsonl of ≈max_chars cleaned text segments
+    (the per-file body of reference preprocessing.py:11-50)."""
+    n = 0
+    with open(input_path, encoding="utf-8") as fin, \
+            open(output_path, "w", encoding="utf-8") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            sentences = mark_sentence_boundaries(row.get(content_key, ""))
+            for segment in repack_segments(iter(sentences), max_chars):
+                fout.write(json.dumps({"text": segment},
+                                      ensure_ascii=False) + "\n")
+                n += 1
+    return n
+
+
+def auto_split(data_dir: str, threshold_mb: int = 1024,
+               chunk_mb: int = 300, suffix: str = ".json") -> list[str]:
+    """Line-safe re-sharding of oversized corpus files — the semantics
+    of reference auto_split.sh: files over `threshold_mb` are split into
+    ≈`chunk_mb` chunks named `<stem>-aa.json`, `<stem>-ab.json`, … and
+    the original is removed. `split -C` never breaks a line; neither
+    does this."""
+    import itertools
+    import string
+
+    new_paths: list[str] = []
+    for name in sorted(os.listdir(data_dir)):
+        path = os.path.join(data_dir, name)
+        if not os.path.isfile(path) or \
+                os.path.getsize(path) <= threshold_mb * 1024 * 1024:
+            continue
+        stem = name[: -len(suffix)] if name.endswith(suffix) else name
+        suffixes = ("".join(p) for p in
+                    itertools.product(string.ascii_lowercase, repeat=2))
+        limit = chunk_mb * 1024 * 1024
+        out, written = None, 0
+        with open(path, encoding="utf-8") as fin:
+            for line in fin:
+                size = len(line.encode())
+                if out is None or written + size > limit:
+                    if out is not None:
+                        out.close()
+                    chunk = os.path.join(
+                        data_dir, f"{stem}-{next(suffixes)}{suffix}")
+                    new_paths.append(chunk)
+                    out = open(chunk, "w", encoding="utf-8")
+                    written = 0
+                out.write(line)
+                written += size
+        if out is not None:
+            out.close()
+        os.remove(path)
+    return new_paths
+
+
+def split_train_test_validation_index(train_test_validation: str) -> dict:
+    """'950,49,1' → the two nested split rates the reference derives
+    (reference: load.py:60-66)."""
+    parts = [int(i) for i in train_test_validation.split(",")]
+    return {"train_rate": parts[0] / sum(parts),
+            "test_rate": parts[1] / sum(parts[1:])}
+
+
+def generate_cache_arrow(data_dir: str, save_path: str,
+                         train_test_validation: str = "950,49,1",
+                         seed: int = 42) -> list[str]:
+    """Per-shard 3-way split + HF-datasets arrow cache — the
+    reference's BertDataGenerate.generate_cache_arrow
+    (reference: load.py:27-103), with a fixed seed so regenerated
+    caches are reproducible (the reference's splits are not)."""
+    import datasets as hf_datasets
+
+    idx = split_train_test_validation_index(train_test_validation)
+    os.makedirs(save_path, exist_ok=True)
+    saved = []
+    for name in sorted(os.listdir(data_dir)):
+        path = os.path.join(data_dir, name)
+        if not os.path.isfile(path):
+            continue
+        ds = hf_datasets.load_dataset("json", data_files=path)
+        split1 = ds["train"].train_test_split(
+            train_size=idx["train_rate"], seed=seed)
+        split2 = split1["test"].train_test_split(
+            train_size=idx["test_rate"], seed=seed)
+        out = hf_datasets.DatasetDict({
+            "train": split1["train"],
+            "test": split2["train"],
+            "validation": split2["test"]})
+        target = os.path.join(save_path, name)
+        out.save_to_disk(target)
+        saved.append(target)
+    return saved

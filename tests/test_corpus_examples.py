@@ -72,3 +72,106 @@ def test_seq2seq_collator_and_fit(tmp_path, mesh8):
     trainer = Trainer(args)
     state = trainer.fit(module, dm)
     assert int(state.step) == 2
+
+
+def test_wudao_cleaning_rules_hand_computed(tmp_path):
+    """The five boundary rules + 512-repacking against literal expected
+    outputs (reference: bert_dataloader/preprocessing.py:11-50)."""
+    import json
+
+    from fengshen_tpu.data.bert_dataloader import (cut_sent_file,
+                                                   mark_sentence_boundaries,
+                                                   repack_segments)
+
+    # rule 1: terminal punctuation runs; doc-final sentence also splits
+    assert mark_sentence_boundaries("天气好。明天呢？？好！") == \
+        ["天气好。", "明天呢？？", "好！", ""]
+    # rules 3/5: closing quote stays attached to its sentence
+    assert mark_sentence_boundaries("他说：“不行！”然后走了。") == \
+        ["他说：“不行！”", "然后走了。", ""]
+    # rule 2: ascii ellipsis of >=3 dots
+    assert mark_sentence_boundaries("省略...继续。") == \
+        ["省略...", "继续。", ""]
+    # unicode ellipsis
+    assert mark_sentence_boundaries("等等……然后。") == \
+        ["等等……", "然后。", ""]
+
+    # repacking quirks: bound checked BEFORE append (may overflow), and
+    # empty sentences flush
+    assert repack_segments(iter(["abc", "de", "", "fg"]),
+                           max_chars=4) == ["abcde", "fg"]
+    assert repack_segments(iter(["123456", "78"]),
+                           max_chars=4) == ["123456", "78"]
+
+    # file level: one doc → cleaned ~8-char segments
+    src = tmp_path / "docs.jsonl"
+    with open(src, "w") as f:
+        f.write(json.dumps({"text": "一二三。四五六！七八九？十。"},
+                           ensure_ascii=False) + "\n")
+    out = tmp_path / "clean.jsonl"
+    n = cut_sent_file(str(src), str(out), max_chars=8)
+    rows = [json.loads(x)["text"] for x in open(out, encoding="utf-8")]
+    # sentences: 一二三。|四五六！|七八九？|十。|'' → pack at 8 chars:
+    # "一二三。四五六！" (8, stop) → "七八九？十。" flushed by the empty
+    # sentence; the final empty accumulator is emitted too (the
+    # reference's unconditional last write, preprocessing.py:49-50)
+    assert rows == ["一二三。四五六！", "七八九？十。", ""]
+    assert n == 3
+
+
+def test_auto_split_line_safe(tmp_path):
+    """auto_split.sh semantics: oversized files split into -aa/-ab
+    chunks on line boundaries, original removed."""
+    import json
+    import os
+
+    from fengshen_tpu.data.bert_dataloader import auto_split
+
+    big = tmp_path / "corpus.json"
+    line = json.dumps({"text": "x" * 100}) + "\n"
+    with open(big, "w") as f:
+        for _ in range(100):
+            f.write(line)
+    # threshold 0MB (everything splits), chunks of ~1/3 the data
+    chunks = auto_split(str(tmp_path), threshold_mb=0,
+                        chunk_mb=4 * len(line) // (1024 * 1024) or 0.004)
+    assert not big.exists()
+    names = sorted(os.path.basename(c) for c in chunks)
+    assert names[0] == "corpus-aa.json"
+    # every chunk holds whole lines and the union is the original
+    total = 0
+    for c in chunks:
+        content = open(c).read()
+        assert content.endswith("\n")
+        assert all(x == line.strip() for x in
+                   content.strip().split("\n") if x)
+        total += content.count("\n")
+    assert total == 100
+
+
+def test_generate_cache_arrow_split(tmp_path):
+    """Per-shard 950/49/1-style split into an arrow cache
+    (reference: load.py:27-103 BertDataGenerate)."""
+    import json
+
+    import datasets as hf_datasets
+
+    from fengshen_tpu.data.bert_dataloader import (
+        generate_cache_arrow, split_train_test_validation_index)
+
+    idx = split_train_test_validation_index("950,49,1")
+    assert abs(idx["train_rate"] - 0.95) < 1e-9
+    assert abs(idx["test_rate"] - 0.98) < 1e-9
+
+    shards = tmp_path / "shards"
+    shards.mkdir()
+    with open(shards / "s0.json", "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"text": f"doc {i}"}) + "\n")
+    saved = generate_cache_arrow(str(shards), str(tmp_path / "cache"),
+                                 train_test_validation="80,10,10")
+    assert len(saved) == 1
+    dd = hf_datasets.load_from_disk(saved[0])
+    assert set(dd) == {"train", "test", "validation"}
+    assert len(dd["train"]) == 80
+    assert len(dd["test"]) + len(dd["validation"]) == 20
