@@ -199,17 +199,27 @@ def _run_sharded() -> None:
     fsdp = int(os.environ.get("BENCH_FSDP", str(n_dev)))
     tp = int(os.environ.get("BENCH_TP", "1"))
     config = LlamaConfig(
-        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-        num_hidden_layers=16, num_attention_heads=8,
+        vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
+        hidden_size=int(os.environ.get("BENCH_HIDDEN", "1024")),
+        intermediate_size=int(os.environ.get("BENCH_INTER", "2816")),
+        num_hidden_layers=int(os.environ.get("BENCH_LAYERS", "16")),
+        num_attention_heads=int(os.environ.get("BENCH_HEADS", "8")),
         max_position_embeddings=seq, dtype="bfloat16",
-        attention_impl="flash", scan_layers=True,
-        gradient_checkpointing=True,
+        attention_impl=os.environ.get("BENCH_ATTN", "flash"),
+        scan_layers=True, gradient_checkpointing=True,
         remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"))
+    extra = ["--fsdp_parallel_size", str(fsdp),
+             "--tensor_model_parallel_size", str(tp)]
+    name = "llama300m_sharded_step_tokens_per_sec_per_chip"
+    if bool(int(os.environ.get("BENCH_OFFLOAD", "0"))):
+        # headroom lever row (docs/performance.md): host-resident adam
+        # moments between steps — measures the offloaded-update cost on
+        # the 300M shape
+        extra.append("--offload_optimizer")
+        name = "llama300m_offload_update_tokens_per_sec_per_chip"
     if not _trainer_bench(
-            config, "llama300m_sharded_step_tokens_per_sec_per_chip",
-            per_chip, seq, flops_attn_term=12.0 * 16 * 1024 * seq,
-            extra_args=["--fsdp_parallel_size", str(fsdp),
-                        "--tensor_model_parallel_size", str(tp)]):
+            config, name, per_chip, seq,
+            flops_attn_term=12.0 * 16 * 1024 * seq, extra_args=extra):
         raise RuntimeError("bench-sharded: OOM")
 
 
@@ -241,7 +251,9 @@ def _run(per_chip_batch: int) -> None:
         max_position_embeddings=seq, dtype="bfloat16",
         attention_impl=os.environ.get("BENCH_ATTN", "flash"),
         scan_layers=True, gradient_checkpointing=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"))
+        remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"),
+        # headroom lever rows (docs/performance.md): BENCH_INT8_LMHEAD=1
+        int8_lm_head=bool(int(os.environ.get("BENCH_INT8_LMHEAD", "0"))))
     model = LlamaForCausalLM(config)
     batch = per_chip_batch * n_dev
 

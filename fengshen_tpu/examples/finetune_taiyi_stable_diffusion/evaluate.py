@@ -57,17 +57,20 @@ def main(argv=None):
         prompts = DEMO_PROMPTS
 
     # generation path reuses the chinese demo's model/params bootstrap
+    import tempfile
+
     from fengshen_tpu.examples.stable_diffusion_chinese.demo import (
         main as demo_main)
     images = []
-    for prompt in prompts:
-        arr = demo_main(["--model_path", args.model_path or "",
-                         "--prompt", prompt,
-                         "--image_size", str(args.image_size),
-                         "--num_steps", str(args.num_steps),
-                         "--guidance_scale", str(args.guidance_scale),
-                         "--out", "/dev/null"])
-        images.append(np.asarray(arr))
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, prompt in enumerate(prompts):
+            arr = demo_main(["--model_path", args.model_path or "",
+                             "--prompt", prompt,
+                             "--image_size", str(args.image_size),
+                             "--num_steps", str(args.num_steps),
+                             "--guidance_scale", str(args.guidance_scale),
+                             "--out", f"{tmp}/gen_{i}.png"])
+            images.append(np.asarray(arr)[0])
 
     # scoring towers (text config from the CLIP checkpoint when given;
     # demo-scale otherwise)

@@ -1,9 +1,31 @@
-"""Taiyi Stable Diffusion bilingual (zh/EN) txt2img demo — the _EN variant
-of stable_diffusion_chinese (reference:
-fengshen/examples/stable_diffusion_chinese_EN/), identical pipeline with a
-bilingual text-encoder checkpoint."""
+"""Taiyi Stable Diffusion bilingual (zh/EN) txt2img demo.
 
-from fengshen_tpu.examples.stable_diffusion_chinese.demo import main
+The _EN variant of stable_diffusion_chinese (reference:
+fengshen/examples/stable_diffusion_chinese_EN/): the SAME sampling
+pipeline driven by the bilingual text-encoder checkpoint
+(Taiyi-Stable-Diffusion-1B-Chinese-EN-v0.1), so English prompts work
+alongside Chinese ones.
+"""
+
+from __future__ import annotations
+
+DEFAULT_BILINGUAL_CHECKPOINT = \
+    "IDEA-CCNL/Taiyi-Stable-Diffusion-1B-Chinese-EN-v0.1"
+
+
+def main(argv=None, **kwargs):
+    from fengshen_tpu.examples.stable_diffusion_chinese.demo import (
+        main as zh_main)
+
+    argv = list(argv) if argv is not None else []
+    if "--model_path" not in argv:
+        argv = ["--model_path", DEFAULT_BILINGUAL_CHECKPOINT] + argv
+    if "--prompt" not in argv:
+        # the reference _EN demo's headline English prompt
+        argv = argv + ["--prompt", "a colorful painting of a castle, "
+                                   "fantasy, detailed"]
+    return zh_main(argv, **kwargs)
+
 
 if __name__ == "__main__":
     main()

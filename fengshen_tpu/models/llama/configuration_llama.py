@@ -39,6 +39,9 @@ class LlamaConfig:
     # dots_with_no_batch_dims_saveable); "checkpoint_dots" saves all dots
     remat_policy: str = "nothing"      # nothing | dots_no_batch | checkpoint_dots
     attention_impl: str = "dense"      # dense | flash | ring | ulysses | sequence
+    # dynamic int8x int8 LM-head matmul (2x MXU rate on v5e; see
+    # ops/int8_matmul.py). Training-time perf lever, off by default.
+    int8_lm_head: bool = False
     # lax.scan over layers: one compiled layer body regardless of depth —
     # keeps compile time/program size O(1) in num_hidden_layers and is the
     # standard TPU pattern for deep stacks. Params gain a leading [L] dim.

@@ -321,6 +321,24 @@ class LlamaModel(nn.Module):
         return RMSNorm(epsilon=cfg.rms_norm_eps, name="norm")(hidden)
 
 
+class _Int8LMHead(nn.Module):
+    """Dense-compatible LM head routed through the dynamic int8 matmul
+    (ops/int8_matmul.py): same `kernel` param shape/path as nn.Dense so
+    partition rules and checkpoint converters are unaffected."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        from fengshen_tpu.ops.int8_matmul import int8_matmul
+        cfg = self.config
+        kernel = self.param("kernel",
+                            nn.initializers.normal(cfg.initializer_range),
+                            (cfg.hidden_size, cfg.vocab_size),
+                            jnp.dtype(cfg.param_dtype))
+        return int8_matmul(hidden, kernel.astype(_dt(cfg)))
+
+
 class LlamaForCausalLM(nn.Module):
     """LM head on the stack (reference: modeling_llama.py:239-405)."""
 
@@ -336,7 +354,16 @@ class LlamaForCausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             embedding = self.variables["params"]["model"]["embed_tokens"][
                 "embedding"]
-            logits = hidden @ embedding.T.astype(hidden.dtype)
+            if cfg.int8_lm_head:
+                from fengshen_tpu.ops.int8_matmul import int8_matmul
+                logits = int8_matmul(hidden,
+                                     embedding.T.astype(hidden.dtype))
+            else:
+                logits = hidden @ embedding.T.astype(hidden.dtype)
+        elif cfg.int8_lm_head:
+            # same lm_head/kernel param path as the Dense branch, so
+            # partition rules and converters apply unchanged
+            logits = _Int8LMHead(cfg, name="lm_head")(hidden)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False,
                               dtype=_dt(cfg),

@@ -285,3 +285,45 @@ def test_zen2_token_level_e2e(tmp_path, mesh8):
         ["--max_seq_length", "32", "--data_dir", str(data_dir)]))
     losses = _losses(tmp_path)
     assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+@pytest.mark.slow
+def test_stable_diffusion_EN_demo_passes_bilingual_checkpoint(tmp_path,
+                                                              monkeypatch):
+    """The _EN demo must inject the bilingual checkpoint path and an
+    English default prompt (it was a bare alias of the zh main before
+    round 4)."""
+    monkeypatch.chdir(tmp_path)
+    from fengshen_tpu.examples.stable_diffusion_chinese_EN import demo
+
+    captured = {}
+
+    def fake_zh_main(argv=None, **kwargs):
+        captured["argv"] = list(argv)
+        return None
+
+    monkeypatch.setattr(
+        "fengshen_tpu.examples.stable_diffusion_chinese.demo.main",
+        fake_zh_main)
+    demo.main([])
+    argv = captured["argv"]
+    i = argv.index("--model_path")
+    assert "Chinese-EN" in argv[i + 1]
+    assert "--prompt" in argv
+    # explicit flags win over the injected defaults
+    demo.main(["--model_path", "/my/ckpt", "--prompt", "hi"])
+    assert captured["argv"].count("--model_path") == 1
+    assert "/my/ckpt" in captured["argv"]
+
+
+@pytest.mark.slow
+def test_stable_diffusion_EN_demo_runs_small(tmp_path):
+    """End-to-end sampling at demo scale through the EN wrapper."""
+    import numpy as np
+
+    from fengshen_tpu.examples.stable_diffusion_chinese_EN import demo
+
+    imgs = demo.main(["--model_path", "", "--image_size", "32",
+                      "--num_steps", "2",
+                      "--out", str(tmp_path / "out.png")])
+    assert np.asarray(imgs).shape[-1] == 3

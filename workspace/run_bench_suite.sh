@@ -29,6 +29,14 @@ BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
 
 echo "== probe"; probe || exit 1
 
+echo "== headroom lever: int8 LM-head on the default 300M shape"
+BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
+
+echo "== headroom lever: offloaded optimizer update (300M via Trainer)"
+BENCH_CONFIG=sharded BENCH_OFFLOAD=1 python bench.py | tee /tmp/bench_offload.json
+
+echo "== probe"; probe || exit 1
+
 echo "== block-sparse vs dense flash timing (S=4096/8192)"
 python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
 
