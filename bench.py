@@ -253,7 +253,10 @@ def _run(per_chip_batch: int) -> None:
         scan_layers=True, gradient_checkpointing=True,
         remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"),
         # headroom lever rows (docs/performance.md): BENCH_INT8_LMHEAD=1
-        int8_lm_head=bool(int(os.environ.get("BENCH_INT8_LMHEAD", "0"))))
+        int8_lm_head=bool(int(os.environ.get("BENCH_INT8_LMHEAD", "0"))),
+        # BENCH_FUSED_CE=<chunks>: chunked fused LM-head+CE frees the
+        # ~3.7GB fp32 logits tensor → try larger BENCH_BATCH with it
+        fused_ce_chunks=int(os.environ.get("BENCH_FUSED_CE", "0")))
     model = LlamaForCausalLM(config)
     batch = per_chip_batch * n_dev
 
@@ -266,10 +269,20 @@ def _run(per_chip_batch: int) -> None:
     ids = jnp.asarray(np.random.RandomState(0).randint(
         0, config.vocab_size - 1, (batch, seq)), jnp.int32)
 
-    def loss_fn(p, ids):
-        logits = model.apply({"params": p}, ids)
-        loss, _ = stable_cross_entropy(logits[:, :-1], ids[:, 1:])
-        return loss
+    if config.fused_ce_chunks:
+        from fengshen_tpu.ops.fused_ce import causal_fused_loss
+
+        def loss_fn(p, ids):
+            hidden = model.apply({"params": p}, ids, return_hidden=True)
+            kernel = p["lm_head"]["kernel"].astype(hidden.dtype)
+            loss, _, _ = causal_fused_loss(
+                hidden, kernel, ids, num_chunks=config.fused_ce_chunks)
+            return loss
+    else:
+        def loss_fn(p, ids):
+            logits = model.apply({"params": p}, ids)
+            loss, _ = stable_cross_entropy(logits[:, :-1], ids[:, 1:])
+            return loss
 
     @jax.jit
     def step(p, o, ids):

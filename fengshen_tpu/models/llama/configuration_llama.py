@@ -42,6 +42,11 @@ class LlamaConfig:
     # dynamic int8x int8 LM-head matmul (2x MXU rate on v5e; see
     # ops/int8_matmul.py). Training-time perf lever, off by default.
     int8_lm_head: bool = False
+    # >0: chunked fused LM-head+CE (ops/fused_ce.py) — logits are
+    # computed per sequence chunk and recomputed in backward, cutting
+    # peak HBM by ~the chunk factor on the [B,S,V] tensor. Replicated
+    # head only (TP uses vocab-parallel CE instead).
+    fused_ce_chunks: int = 0
     # lax.scan over layers: one compiled layer body regardless of depth —
     # keeps compile time/program size O(1) in num_hidden_layers and is the
     # standard TPU pattern for deep stacks. Params gain a leading [L] dim.

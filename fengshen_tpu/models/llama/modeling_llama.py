@@ -346,11 +346,17 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
-                 init_cache=False, deterministic=True):
+                 init_cache=False, deterministic=True,
+                 return_hidden=False):
         cfg = self.config
         hidden = LlamaModel(cfg, name="model")(
             input_ids, attention_mask, position_ids, init_cache,
             deterministic)
+        if return_hidden:
+            # the fused chunked LM-head+CE path (ops/fused_ce.py)
+            # applies the head itself from the param tree (init always
+            # runs the normal path, so lm_head params exist either way)
+            return hidden
         if cfg.tie_word_embeddings:
             embedding = self.variables["params"]["model"]["embed_tokens"][
                 "embedding"]

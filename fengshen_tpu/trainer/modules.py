@@ -32,11 +32,49 @@ class CausalLMModule(TrainModule):
         ids = jnp.zeros((1, seq), jnp.int32)
         return self.model.init(rng, ids)["params"]
 
+    def _fused_ce_active(self) -> bool:
+        """Chunked fused head+CE is a replicated-head lever; under
+        tensor parallelism vocab-parallel CE already avoids the full
+        logits tensor, so the fused path stays off there."""
+        from fengshen_tpu.parallel.mesh import get_mesh
+        chunks = getattr(self.config, "fused_ce_chunks", 0)
+        if not chunks:
+            return False
+        mesh = get_mesh()
+        return mesh is None or mesh.shape.get("tensor", 1) == 1
+
+    def _lm_head_kernel(self, params):
+        """[H, V] head weight for the fused path (tied or untied)."""
+        if getattr(self.config, "tie_word_embeddings", False):
+            return params["model"]["embed_tokens"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
     def training_loss(self, params, batch, rng):
         labels = batch.get("labels", batch["input_ids"])
         extra = {}
         if "position_ids" in batch:  # packed rows restart positions
             extra["position_ids"] = batch["position_ids"]
+        if self._fused_ce_active():
+            from fengshen_tpu.ops.fused_ce import causal_fused_loss
+            hidden, mutated = self.model.apply(
+                {"params": params}, batch["input_ids"],
+                attention_mask=batch.get("attention_mask"),
+                deterministic=False, mutable=["losses"],
+                return_hidden=True, **extra)
+            kernel = self._lm_head_kernel(params).astype(hidden.dtype)
+            loss, n_tokens, n_correct = causal_fused_loss(
+                hidden, kernel, labels,
+                num_chunks=self.config.fused_ce_chunks)
+            metrics = {"acc": n_correct / jnp.maximum(n_tokens, 1),
+                       "n_tokens": n_tokens}
+            aux_leaves = jax.tree_util.tree_leaves(
+                mutated.get("losses", {}))
+            if aux_leaves:
+                aux = sum(jnp.sum(leaf) for leaf in aux_leaves)
+                loss = loss + getattr(self.config, "moe_aux_weight",
+                                      0.01) * aux
+                metrics["aux_loss"] = aux
+            return loss, metrics
         logits, mutated = self.model.apply(
             {"params": params}, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),

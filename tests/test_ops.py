@@ -338,3 +338,101 @@ def test_pallas_flash_gqa_interpret_matches_dense():
     np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=1e-3)
     np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=1e-3)
     np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=1e-3)
+
+
+def test_fused_lm_head_ce_matches_unfused():
+    """Chunked fused head+CE (ops/fused_ce.py): identical loss and
+    gradients to the materialized-logits path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fengshen_tpu.ops.fused_ce import causal_fused_loss, fused_lm_head_ce
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    rng = np.random.RandomState(0)
+    B, S, H, V = 2, 12, 16, 32
+    hidden = jnp.asarray(rng.randn(B, S, H), jnp.float32)
+    kernel = jnp.asarray(rng.randn(H, V) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+    labels = labels.at[0, :3].set(-100)  # ignore_index masking
+
+    def unfused(h, k):
+        return stable_cross_entropy(h @ k, labels)[0]
+
+    def fused(h, k):
+        return fused_lm_head_ce(h, k, labels, num_chunks=4)[0]
+
+    l_u, (gh_u, gk_u) = jax.value_and_grad(unfused, argnums=(0, 1))(
+        hidden, kernel)
+    l_f, (gh_f, gk_f) = jax.value_and_grad(fused, argnums=(0, 1))(
+        hidden, kernel)
+    assert abs(float(l_u - l_f)) < 1e-5
+    np.testing.assert_allclose(gh_u, gh_f, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gk_u, gk_f, rtol=1e-4, atol=1e-6)
+
+    # accuracy numerator matches a direct argmax
+    loss, n, correct = fused_lm_head_ce(hidden, kernel, labels,
+                                        num_chunks=4)
+    logits = hidden @ kernel
+    valid = labels != -100
+    assert int(n) == int(valid.sum())
+    assert int(correct) == int(((logits.argmax(-1) == labels) *
+                                valid).sum())
+
+    # odd seq lens degrade the chunk count instead of failing
+    loss13, n13, _ = fused_lm_head_ce(hidden[:, :11], kernel,
+                                      labels[:, :11], num_chunks=4)
+    assert jnp.isfinite(loss13)
+
+    # causal variant == shift-by-one of the plain one
+    lc, _, _ = causal_fused_loss(hidden, kernel, labels, num_chunks=4)
+    ls, _ = stable_cross_entropy(hidden[:, :-1] @ kernel, labels[:, 1:])
+    assert abs(float(lc - ls)) < 1e-5
+
+
+def test_causal_lm_module_fused_ce_path(mesh8):
+    """CausalLMModule with fused_ce_chunks: same loss as the plain path
+    (tensor axis is 2 on mesh8, so the gate must keep it OFF there; on a
+    tensor=1 mesh it engages)."""
+    import argparse
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    base = LlamaConfig(vocab_size=64, hidden_size=32,
+                       intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=4,
+                       max_position_embeddings=32, dtype="float32")
+    args = argparse.Namespace(max_seq_length=16)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 63, (2, 16)),
+                      jnp.int32)
+    batch = {"input_ids": ids}
+    rng = jax.random.PRNGKey(0)
+
+    plain = CausalLMModule(args, LlamaForCausalLM(base), base)
+    params = plain.init_params(rng)
+    cfg_f = dataclasses.replace(base, fused_ce_chunks=4)
+    fused = CausalLMModule(args, LlamaForCausalLM(cfg_f), cfg_f)
+
+    # tensor=2 mesh: gate keeps the fused path off
+    assert not fused._fused_ce_active()
+
+    set_mesh(None)
+    try:
+        mesh1 = make_mesh(MeshConfig(data=8, fsdp=1, sequence=1,
+                                     tensor=1))
+        set_mesh(mesh1)
+        assert fused._fused_ce_active()
+        l_p, m_p = plain.training_loss(params, batch, rng)
+        l_f, m_f = fused.training_loss(params, batch, rng)
+        assert abs(float(l_p - l_f)) < 1e-5
+        assert abs(float(m_p["acc"] - m_f["acc"])) < 1e-6
+    finally:
+        set_mesh(None)

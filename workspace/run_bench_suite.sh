@@ -32,6 +32,12 @@ echo "== probe"; probe || exit 1
 echo "== headroom lever: int8 LM-head on the default 300M shape"
 BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
 
+echo "== headroom lever: chunked fused LM-head+CE (frees ~3.7GB logits)"
+BENCH_FUSED_CE=8 python bench.py | tee /tmp/bench_fused_ce.json
+echo "== fused CE + bigger batch (the point of the lever)"
+BENCH_FUSED_CE=8 BENCH_BATCH=40 python bench.py | tee /tmp/bench_fused_ce_b40.json || true
+BENCH_FUSED_CE=8 BENCH_BATCH=32 python bench.py | tee /tmp/bench_fused_ce_b32.json || true
+
 echo "== headroom lever: offloaded optimizer update (300M via Trainer)"
 BENCH_CONFIG=sharded BENCH_OFFLOAD=1 python bench.py | tee /tmp/bench_offload.json
 
