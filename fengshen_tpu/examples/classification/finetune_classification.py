@@ -425,21 +425,25 @@ class ClassificationModule(TrainModule):
                         path, e)
             return None
         # converters for the *ForX classes nest the encoder under its
-        # module name; accept either the bare encoder tree or that
-        for key in ("bert_encoder", "bert", "encoder", "megatron_bert",
-                    "roformer", "longformer", "zen", "model"):
-            if isinstance(imported, dict) and set(imported) == {key}:
-                imported = imported[key]
-        if init_encoder is not None:
-            want = jax.tree_util.tree_structure(init_encoder)
-            got = jax.tree_util.tree_structure(imported)
-            if want != got:
-                logger.warning(
-                    "imported tree from %s does not match the %s encoder "
-                    "structure; keeping random init", path,
-                    self.model_type)
-                return None
-        return imported
+        # module name (often alongside head entries): pick the first
+        # candidate subtree whose structure matches the encoder we built
+        candidates = [imported]
+        if isinstance(imported, dict):
+            for key in ("bert_encoder", "bert", "encoder",
+                        "megatron_bert", "roformer", "longformer",
+                        "zen", "model"):
+                if key in imported:
+                    candidates.insert(0, imported[key])
+        if init_encoder is None:
+            return candidates[0]
+        want = jax.tree_util.tree_structure(init_encoder)
+        for cand in candidates:
+            if jax.tree_util.tree_structure(cand) == want:
+                return cand
+        logger.warning(
+            "imported tree from %s does not match the %s encoder "
+            "structure; keeping random init", path, self.model_type)
+        return None
 
     def _apply(self, params, batch, deterministic, rng=None):
         kwargs = {"attention_mask": batch.get("attention_mask"),
