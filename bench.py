@@ -173,7 +173,8 @@ def _run_large() -> None:
             max_position_embeddings=seq, dtype="bfloat16",
             param_dtype="bfloat16", attention_impl="flash",
             scan_layers=True, gradient_checkpointing=True,
-            remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"))
+            remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"),
+            fused_ce_chunks=int(os.environ.get("BENCH_FUSED_CE", "0")))
         if _trainer_bench(
                 config, f"llama13bshape_l{layers}_train_tokens_per_sec"
                 "_per_chip", per_chip, seq,
