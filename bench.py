@@ -166,10 +166,16 @@ def _run_large() -> None:
               batch_env else [(8, 4), (8, 2), (6, 2), (4, 1)])
     for layers, per_chip in ladder:
         _watchdog()
+        # env dim overrides exist ONLY for CPU smoking (a 5120-dim
+        # compile exceeds the watchdog on the CPU backend); hardware
+        # runs use the 13B defaults
         config = LlamaConfig(
-            vocab_size=32000, hidden_size=5120,
-            intermediate_size=13824, num_hidden_layers=layers,
-            num_attention_heads=40, num_key_value_heads=8,
+            vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", "5120")),
+            intermediate_size=int(os.environ.get("BENCH_INTER", "13824")),
+            num_hidden_layers=layers,
+            num_attention_heads=int(os.environ.get("BENCH_HEADS", "40")),
+            num_key_value_heads=int(os.environ.get("BENCH_KV", "8")),
             max_position_embeddings=seq, dtype="bfloat16",
             param_dtype="bfloat16", attention_impl="flash",
             scan_layers=True, gradient_checkpointing=True,

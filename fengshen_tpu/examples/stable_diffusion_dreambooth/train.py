@@ -163,13 +163,31 @@ def ensure_class_images(args, tokenizer, module) -> int:
 
     from fengshen_tpu.models.stable_diffusion.sampling import text_to_image
 
+    from fengshen_tpu.models.stable_diffusion.sampling import (
+        init_sampling_params)
+
     os.makedirs(args.class_data_dir, exist_ok=True)
     have = len([p for ext in ("*.png", "*.jpg", "*.jpeg") for p in
                 glob.glob(os.path.join(args.class_data_dir, ext))])
     need = max(int(args.num_class_images) - have, 0)
     if need == 0:
         return 0
-    params = module.init_params(jax.random.PRNGKey(args.seed))
+    # the training init covers only the training submodules (VAE encode
+    # + unet); sampling also needs the VAE decoder — init the full
+    # sampling tree, then overlay the module's (possibly checkpoint-
+    # imported) weights where paths coincide
+    key = jax.random.PRNGKey(args.seed)
+    params = init_sampling_params(module.model, key, args.image_size)
+
+    def overlay(base, update):
+        if not (isinstance(base, dict) and isinstance(update, dict)):
+            return update
+        out = dict(base)
+        for k, v in update.items():
+            out[k] = overlay(base[k], v) if k in base else v
+        return out
+
+    params = overlay(params, module.init_params(key))
     ids = jnp.asarray([tokenizer.encode(args.class_prompt)], jnp.int32)
     for i in range(need):
         img = text_to_image(module.model, params, ids,

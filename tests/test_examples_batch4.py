@@ -268,3 +268,78 @@ def test_clip_finetune_flickr_e2e(tmp_path, mesh8, monkeypatch):
              for l in open(tmp_path / "runs" / "metrics.jsonl")]
     losses = [l["loss"] for l in lines if "loss" in l]
     assert len(losses) == 2 and all(np.isfinite(losses))
+
+
+@pytest.mark.slow
+def test_dreambooth_class_image_pregeneration(tmp_path, mesh8,
+                                              monkeypatch):
+    """--num_class_images tops up class_data_dir by sampling the frozen
+    model before training (reference train_with_prior.sh recipe)."""
+    import glob
+
+    from fengshen_tpu.examples.stable_diffusion_dreambooth import train
+    from fengshen_tpu.examples.finetune_taiyi_stable_diffusion import (
+        finetune)
+    _small_sd_patches(monkeypatch, finetune)
+    pytest.importorskip("PIL")
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    (tmp_path / "instance").mkdir()
+    for i in range(2):
+        arr = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / "instance" / f"{i}.png")
+    (tmp_path / "cls").mkdir()  # EMPTY: everything must be generated
+    tok, model_dir = _bert_dir(tmp_path)
+    train.main([
+        "--model_path", str(model_dir),
+        "--instance_data_dir", str(tmp_path / "instance"),
+        "--instance_prompt", "一张照片的狗",
+        "--class_data_dir", str(tmp_path / "cls"),
+        "--class_prompt", "一张照片", "--with_prior_preservation",
+        "--num_class_images", "2", "--class_gen_steps", "2",
+        "--train_batchsize", "2", "--max_steps", "1",
+        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--image_size", "32", "--max_length", "16", "--seed", "1"])
+    generated = glob.glob(str(tmp_path / "cls" / "class_gen_*.png"))
+    assert len(generated) == 2
+    lines = [json.loads(l)
+             for l in open(tmp_path / "runs" / "metrics.jsonl")]
+    assert any("loss" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_uniex_train_mode_e2e(tmp_path, mesh8):
+    """uniex example --train: finetune on spandata jsonl then predict to
+    --output_path (the uniex train.sh/predict.sh surface)."""
+    from fengshen_tpu.examples.uniex import example as uniex_example
+
+    rows = [{"task_type": "实体识别",
+             "text": "小明在北京工作",
+             "choices": [{"entity_type": "人物姓名",
+                          "entity_list": [{"entity_name": "小明"}]},
+                         {"entity_type": "地址",
+                          "entity_list": [{"entity_name": "北京"}]}],
+             "id": i} for i in range(4)]
+    train_file = tmp_path / "train.json"
+    with open(train_file, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, ensure_ascii=False) + "\n")
+    _, model_dir = _bert_dir(tmp_path)
+    out = tmp_path / "predict.json"
+    result = uniex_example.main([
+        "--model_path", str(model_dir),
+        "--train", "--train_file", str(train_file),
+        "--test_file", str(train_file),
+        "--output_path", str(out),
+        "--max_length", "64", "--max_entity_types", "4",
+        "--train_batchsize", "2", "--max_steps", "2", "--max_epochs", "1",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--precision", "fp32"])
+    assert len(result) == 4
+    lines = [json.loads(x) for x in open(out, encoding="utf-8")]
+    assert len(lines) == 4
