@@ -138,7 +138,10 @@ class UniversalDataModule:
             self.datasets = load_dataset(
                 args.datasets_name,
                 num_proc=getattr(args, "num_workers", 1))
-        elif getattr(args, "train_file", None) is not None:
+        elif any(getattr(args, attr, None) for attr in
+                 ("train_file", "val_file", "test_file")):
+            # any split file triggers file loading — predict-only runs
+            # pass just --test_file (e.g. qa_t5 run_predict.sh)
             import datasets as hf_datasets
             file_type = getattr(args, "raw_file_type", "json")
             data_files = {}

@@ -231,3 +231,31 @@ def test_sequence_tagging_e2e(tmp_path, mesh8, model_type):
         ["--max_seq_length", "32", "--model_type", model_type,
          "--data_dir", str(data_dir)]))
     _assert_losses(tmp_path)
+
+
+def test_qa_t5_predict_only(tmp_path, mesh8):
+    """run_predict.sh path: --do_eval_only decodes the test split into
+    --prediction_res_path without training."""
+    from fengshen_tpu.examples.qa_t5 import finetune_t5_cmrc
+    from fengshen_tpu.models.t5 import T5Config
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    T5Config.small_test_config(vocab_size=len(tok)).save_pretrained(
+        str(model_dir))
+    test = tmp_path / "test.json"
+    _write_jsonl(test, [{"question": "北京是什么",
+                         "context": "北京是中国的首都",
+                         "answer": ["首都"]}] * 4)
+    res = tmp_path / "predictions.txt"
+    finetune_t5_cmrc.main([
+        "--model_path", str(model_dir),
+        "--test_file", str(test),
+        "--do_eval_only",
+        "--prediction_res_path", str(res),
+        "--test_batchsize", "2",
+        "--max_seq_length", "32", "--max_target_length", "8",
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--precision", "fp32"])
+    lines = res.read_text().splitlines()
+    assert len(lines) == 4
