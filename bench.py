@@ -16,11 +16,23 @@ import numpy as np
 import optax
 
 
+_WATCHDOG_DEADLINE = [None]
+
+
 def _watchdog(seconds: int = 540) -> None:
     """Fail fast (exit 1) instead of hanging forever if the accelerator or
-    its compile service is wedged."""
+    its compile service is wedged.
+
+    Thread-based (plus SIGALRM as a second line): a Python SIGALRM
+    handler cannot run while the main thread is blocked inside a C call
+    — exactly the state a wedged relay leaves us in (the round-4 probe
+    proved this; os._exit from a daemon thread still works)."""
     import os
     import signal
+    import threading
+    import time
+
+    _WATCHDOG_DEADLINE[0] = time.time() + seconds
 
     def on_alarm(signum, frame):
         import sys
@@ -34,10 +46,40 @@ def _watchdog(seconds: int = 540) -> None:
     except (ValueError, OSError):
         pass
 
+    if getattr(_watchdog, "_thread_started", False):
+        return
+    _watchdog._thread_started = True
+
+    def watch():
+        import sys
+        while True:
+            time.sleep(5)
+            deadline = _WATCHDOG_DEADLINE[0]
+            if deadline is not None and time.time() > deadline:
+                print("bench watchdog (thread): accelerator unresponsive,"
+                      " aborting", file=sys.stderr, flush=True)
+                os._exit(1)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+def _probe_accelerator(seconds: int = 150) -> None:
+    """256x256 matmul with its own short deadline BEFORE any heavy work:
+    a wedged relay then yields a fast, unambiguous diagnostic instead of
+    a slow watchdog abort mid-compile."""
+    _watchdog(seconds)
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    val = float((x @ x).block_until_ready()[0, 0])
+    print(f"bench probe ok: backend={jax.default_backend()} val={val}",
+          file=__import__("sys").stderr, flush=True)
+
 
 def main() -> None:
-    _watchdog()
     import os
+
+    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
+        _probe_accelerator()
+    _watchdog()
 
     mode = os.environ.get("BENCH_CONFIG", "default")
     if mode == "large":
