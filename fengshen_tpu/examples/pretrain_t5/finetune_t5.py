@@ -62,7 +62,9 @@ class TaskT5Collator:
     tokenizer: Any
     max_seq_length: int = 512
     decoder_start_token_id: int = 0
-    max_choices: int = 4
+    #: static option count per batch (CLUE tnews has 15, iflytek 119 —
+    #: size it to the task; one fixed shape keeps the jit cache at 1)
+    max_choices: int = 16
 
     def _encode_answer(self, text: str) -> list[int]:
         ids = self.tokenizer.encode(text, add_special_tokens=False)
@@ -125,6 +127,7 @@ class MT5FinetuneModule(TrainModule):
                             type=str)
         parser.add_argument("--train_data_path", default=None, type=str)
         parser.add_argument("--valid_data_path", default=None, type=str)
+        parser.add_argument("--max_choices", default=16, type=int)
         return parent_args
 
     def init_params(self, rng):
@@ -200,7 +203,8 @@ def main(argv=None):
     module = MT5FinetuneModule(args)
     collator = TaskT5Collator(
         tokenizer, max_seq_length=args.max_seq_length,
-        decoder_start_token_id=module.config.decoder_start_token_id)
+        decoder_start_token_id=module.config.decoder_start_token_id,
+        max_choices=args.max_choices)
     datasets = {"train": TaskT5Dataset(args.train_data_path, args)}
     if args.valid_data_path:
         datasets["validation"] = TaskT5Dataset(args.valid_data_path, args)
