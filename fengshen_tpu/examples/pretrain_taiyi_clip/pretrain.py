@@ -86,6 +86,7 @@ def main(argv=None):
     parser = add_trainer_args(parser)
     parser = UniversalDataModule.add_data_specific_args(parser)
     parser = UniversalCheckpoint.add_argparse_args(parser)
+    parser = TaiyiCLIPModule.add_module_specific_args(parser)
     # reference: pretrain_taiyi_clip/test.sh — eval-only retrieval pass
     parser.add_argument("--test_only", action="store_true", default=False)
     parser.add_argument("--val_csv", type=str, default=None)
