@@ -75,13 +75,78 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None):
     return app
 
 
+def _resolve_pipeline(pipeline_cfg: PipelineConfig):
+    module = importlib.import_module(
+        f"fengshen_tpu.pipelines.{pipeline_cfg.task}")
+    return module.Pipeline(args=None, model=pipeline_cfg.model,
+                           **pipeline_cfg.pipeline_args)
+
+
+def build_stdlib_server(server_cfg: ServerConfig,
+                        pipeline_cfg: PipelineConfig, pipeline=None):
+    """Dependency-free fallback server (http.server) exposing the SAME
+    surface as the FastAPI app: `POST /api/<task>` with
+    `{"input_text": ...}` and `GET /healthz`. FastAPI/uvicorn stay the
+    production path; this keeps the REST surface runnable (and
+    testable) where they are not installed."""
+    import http.server
+
+    if pipeline is None:
+        pipeline = _resolve_pipeline(pipeline_cfg)
+    route = f"/api/{pipeline_cfg.task}"
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload, ensure_ascii=False).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok",
+                                 "task": pipeline_cfg.task})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != route:
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+                result = pipeline(req["input_text"])
+                self._send(200, {"result": result})
+            except KeyError:
+                self._send(422, {"error": "input_text required"})
+            except Exception as e:  # noqa: BLE001 — surface, don't die
+                self._send(500, {"error": str(e)[:500]})
+
+    return http.server.ThreadingHTTPServer(
+        (server_cfg.host, server_cfg.port), Handler)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", required=True, type=str)
     args = parser.parse_args(argv)
     server_cfg, pipeline_cfg = load_config(args.config)
-    app = build_app(pipeline_cfg)
-    import uvicorn
+    try:
+        app = build_app(pipeline_cfg)
+        import uvicorn
+    except ModuleNotFoundError:
+        server = build_stdlib_server(server_cfg, pipeline_cfg)
+        print(f"fastapi/uvicorn not installed — stdlib server on "
+              f"{server_cfg.host}:{server_cfg.port}", flush=True)
+        server.serve_forever()
+        return
     uvicorn.run(app, host=server_cfg.host, port=server_cfg.port,
                 log_level=server_cfg.log_level)
 

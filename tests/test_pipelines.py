@@ -219,3 +219,55 @@ def test_api_build_app(tmp_path):
     assert r.status_code == 200
     assert r.json()["result"]["label"] == 1
     assert client.get("/healthz").json()["status"] == "ok"
+
+
+def test_api_stdlib_server_roundtrip():
+    """The dependency-free REST fallback serves the same surface as the
+    FastAPI app: POST /api/<task> + GET /healthz (fastapi is not in
+    this image, so this path IS the runnable serving surface here)."""
+    import json as json_mod
+    import threading
+    import urllib.request
+
+    from fengshen_tpu.api.main import (PipelineConfig, ServerConfig,
+                                       build_stdlib_server)
+
+    calls = []
+
+    def fake_pipeline(text):
+        calls.append(text)
+        return [{"label": "1", "score": 0.9}]
+
+    server = build_stdlib_server(
+        ServerConfig(host="127.0.0.1", port=0),
+        PipelineConfig(task="text_classification"),
+        pipeline=fake_pipeline)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            health = json_mod.loads(r.read())
+        assert health == {"status": "ok", "task": "text_classification"}
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/text_classification",
+            data=json_mod.dumps({"input_text": "天气很好"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json_mod.loads(r.read())
+        assert out["result"][0]["label"] == "1"
+        assert calls == ["天气很好"]
+
+        # missing field → 422, wrong path → 404
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/text_classification",
+            data=b"{}", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad, timeout=10)
+            assert False, "expected 422"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+    finally:
+        server.shutdown()
