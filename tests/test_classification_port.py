@@ -282,3 +282,98 @@ def test_finetune_classification_t5_backbone(tmp_path, mesh8, monkeypatch):
         "--precision", "fp32",
     ])
     assert os.path.exists(str(out) + ".0")
+
+
+@pytest.mark.slow
+def test_offload_demo_fits_7gb_at_1p3b_shape(tmp_path):
+    """The README-headline claim behind
+    demo_classification_afqmc_erlangshen_offload.sh: with
+    --offload_optimizer (adam moments host-resident), the 1.3B-shape
+    classification grad step fits a ~7-8 GB accelerator. Verified
+    analytically via AOT compile + XLA memory analysis on a 1-device
+    mesh (no real buffers), mirroring the demo's batch 1 x seq 128."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    from fengshen_tpu.parallel.partition import make_shardings
+
+    # Erlangshen-MegatronBert-1.3B dims (hidden 2048, 24 layers,
+    # vocab 21128), bf16 params as the fp16 demo runs
+    config = MegatronBertConfig(
+        vocab_size=21248, hidden_size=2048, num_hidden_layers=24,
+        num_attention_heads=32, intermediate_size=8192,
+        max_position_embeddings=512, type_vocab_size=2,
+        dtype="bfloat16", param_dtype="bfloat16", scan_layers=True)
+    model = fc.TaskModel(config, "huggingface-megatron_bert",
+                         num_labels=2)
+
+    set_mesh(None)
+    # params fully replicated: per-device footprint equals the demo's
+    # single-accelerator footprint regardless of the data-axis width
+    mesh = make_mesh(MeshConfig(data=8, fsdp=1, sequence=1, tensor=1))
+    set_mesh(mesh)
+    try:
+        rng = jax.random.PRNGKey(0)
+        ids_small = jnp.zeros((1, 16), jnp.int32)
+        params_struct = jax.eval_shape(
+            lambda r: model.init(r, ids_small)["params"], rng)
+        n_params = sum(np.prod(l.shape) for l in
+                       jax.tree_util.tree_leaves(params_struct))
+        assert 1.2e9 < n_params < 1.5e9, f"{n_params:.2e}"
+
+        batch_struct = {
+            "input_ids": jax.ShapeDtypeStruct((1, 128), jnp.int32),
+            "attention_mask": jax.ShapeDtypeStruct((1, 128), jnp.int32),
+            "token_type_ids": jax.ShapeDtypeStruct((1, 128), jnp.int32),
+            "labels": jax.ShapeDtypeStruct((1,), jnp.int32)}
+
+        def loss_fn(params, batch):
+            from fengshen_tpu.parallel.cross_entropy import (
+                stable_cross_entropy)
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                attention_mask=batch["attention_mask"],
+                token_type_ids=batch["token_type_ids"],
+                deterministic=True)
+            loss, _ = stable_cross_entropy(logits[:, None, :],
+                                           batch["labels"][:, None])
+            return loss
+
+        param_sh = make_shardings(
+            [(".*", jax.sharding.PartitionSpec(None))], params_struct,
+            mesh)
+        grad_step = jax.jit(jax.grad(loss_fn),
+                            in_shardings=(param_sh, None))
+        compiled = grad_step.lower(params_struct, batch_struct).compile()
+        mem = compiled.memory_analysis()
+        args_gb = mem.argument_size_in_bytes / 2**30
+        out_gb = mem.output_size_in_bytes / 2**30
+        temp_gb = mem.temp_size_in_bytes / 2**30
+        print(f"\n1.3B offload-demo grad step: args {args_gb:.2f} + "
+              f"grads {out_gb:.2f} + temp(cpu) {temp_gb:.2f} GiB")
+        # Device-resident steady state under --offload_optimizer =
+        # bf16 params + bf16 grads (moments live on HOST between
+        # steps); at batch 1 x seq 128 activations are tens of MB.
+        # That is the 7 GB claim: ~4.7 GiB + activations < 7.
+        assert args_gb + out_gb < 5.5, "params+grads exceed the claim"
+        # The CPU backend upcasts bf16 matmul operands to fp32 (no
+        # native bf16 CPU matmul), which shows up as a ~fp32-params
+        # temp; bound temp by that artifact + 1 GiB of activations so
+        # a real activation blow-up still fails the test. On TPU the
+        # bf16 MXU consumes the weights directly and this temp term
+        # does not exist.
+        fp32_params_gb = n_params * 4 / 2**30
+        assert temp_gb < fp32_params_gb + 1.0, (
+            f"temp {temp_gb:.2f} GiB exceeds the CPU-upcast artifact "
+            f"bound {fp32_params_gb + 1.0:.2f}")
+        # and WITHOUT offload the fp32 adam moments alone add ~9.4 GiB
+        # device-resident — the demo could not fit; the offload is
+        # what makes the 7 GB recipe real
+        moments_gb = 2 * n_params * 4 / 2**30
+        assert args_gb + out_gb + moments_gb > 12.0
+    finally:
+        set_mesh(None)
