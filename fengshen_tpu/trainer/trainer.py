@@ -438,9 +438,14 @@ class Trainer:
                         cb.on_train_step_end(self, state)
                 if self._preempted:
                     # preemption-aware autosave (SURVEY.md §5.3: TPU pods
-                    # preempt; the reference only had SLURM re-queue)
+                    # preempt; the reference only had SLURM re-queue).
+                    # MUST flush: an async save lost to process exit is
+                    # no save at all
                     if ckpt_cb is not None:
-                        ckpt_cb.save(state, self)
+                        try:
+                            ckpt_cb.save(state, self, sync=True)
+                        except TypeError:  # custom cb without sync kwarg
+                            ckpt_cb.save(state, self)
                     self._log({"event": "preempted_saved",
                                "step": self.global_step})
                     return state

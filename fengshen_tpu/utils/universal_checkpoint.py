@@ -41,6 +41,12 @@ class UniversalCheckpoint:
         parser.add_argument("--every_n_epochs", default=None, type=int)
         parser.add_argument("--save_on_train_epoch_end", action="store_true",
                             default=None)
+        parser.add_argument(
+            "--async_save", action="store_true", default=False,
+            help="orbax async checkpointing: serialization overlaps the "
+                 "following train steps instead of blocking (flushed at "
+                 "fit end and on preemption). No reference equivalent — "
+                 "the reference's Lightning saves block training.")
         return parent_parser
 
     def __init__(self, args):
@@ -58,13 +64,17 @@ class UniversalCheckpoint:
             top_k = getattr(self.args, "save_top_k", 3)
             options = ocp.CheckpointManagerOptions(
                 max_to_keep=None if top_k in (-1, None) else max(top_k, 1),
-                enable_async_checkpointing=False)
+                enable_async_checkpointing=bool(
+                    getattr(self.args, "async_save", False)))
             self._manager = ocp.CheckpointManager(self.save_path,
                                                   options=options)
         return self._manager
 
     # -- save ---------------------------------------------------------------
-    def save(self, state: Any, trainer: Any) -> None:
+    def save(self, state: Any, trainer: Any, sync: bool = False) -> None:
+        """`sync=True` forces a flush (preemption / fit end must not
+        lose the in-flight save); with --async_save, periodic saves
+        return immediately and serialization overlaps training."""
         step = int(trainer.global_step)
         payload = {"params": state.params}
         if not getattr(self.args, "save_weights_only", False):
@@ -76,7 +86,13 @@ class UniversalCheckpoint:
             step, args=ocp.args.Composite(
                 state=ocp.args.StandardSave(payload),
                 meta=ocp.args.JsonSave(meta)))
-        self._get_manager().wait_until_finished()
+        if sync or not getattr(self.args, "async_save", False):
+            self._get_manager().wait_until_finished()
+
+    def wait(self) -> None:
+        """Flush any in-flight async save."""
+        if self._manager is not None:
+            self._manager.wait_until_finished()
 
     # -- restore -------------------------------------------------------------
     def maybe_restore(self, state: Any, trainer: Any,
@@ -156,4 +172,6 @@ class UniversalCheckpoint:
     def on_fit_end(self, trainer: Any, state: Any) -> None:
         if getattr(self.args, "save_last", False) or \
                 not self.every_n_train_steps:
-            self.save(state, trainer)
+            self.save(state, trainer, sync=True)
+        else:
+            self.wait()
