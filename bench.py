@@ -75,6 +75,22 @@ def _probe_accelerator(seconds: int = 150) -> None:
 
 
 def main() -> None:
+    try:
+        _main()
+    finally:
+        # disarm: a completed bench must leave no armed watchdog (thread
+        # deadline OR pending SIGALRM) behind — embedders (e.g. the
+        # bench smoke tests) call main() in-process and live long past
+        # the deadline
+        _WATCHDOG_DEADLINE[0] = None
+        import signal
+        try:
+            signal.alarm(0)
+        except (ValueError, OSError):
+            pass
+
+
+def _main() -> None:
     import os
 
     if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
