@@ -242,7 +242,8 @@ def _run_large() -> None:
         if _trainer_bench(
                 config, f"llama13bshape_l{layers}_train_tokens_per_sec"
                 "_per_chip", per_chip, seq,
-                flops_attn_term=12.0 * layers * 5120 * seq,
+                flops_attn_term=12.0 * config.num_hidden_layers *
+                config.hidden_size * seq,
                 extra_args=["--offload_optimizer"]):
             return
     raise RuntimeError("bench-large: every ladder rung OOM")
@@ -284,7 +285,8 @@ def _run_sharded() -> None:
         name = "llama300m_offload_update_tokens_per_sec_per_chip"
     if not _trainer_bench(
             config, name, per_chip, seq,
-            flops_attn_term=12.0 * 16 * 1024 * seq, extra_args=extra):
+            flops_attn_term=12.0 * config.num_hidden_layers *
+            config.hidden_size * seq, extra_args=extra):
         raise RuntimeError("bench-sharded: OOM")
 
 

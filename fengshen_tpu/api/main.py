@@ -122,10 +122,16 @@ def build_stdlib_server(server_cfg: ServerConfig,
             length = int(self.headers.get("Content-Length", 0))
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
-                result = pipeline(req["input_text"])
-                self._send(200, {"result": result})
-            except KeyError:
+            except json.JSONDecodeError as e:
+                self._send(422, {"error": f"invalid json: {e}"})
+                return
+            if "input_text" not in req:
+                # validated BEFORE the pipeline runs: a KeyError inside
+                # the pipeline must surface as 500, not as this 422
                 self._send(422, {"error": "input_text required"})
+                return
+            try:
+                self._send(200, {"result": pipeline(req["input_text"])})
             except Exception as e:  # noqa: BLE001 — surface, don't die
                 self._send(500, {"error": str(e)[:500]})
 

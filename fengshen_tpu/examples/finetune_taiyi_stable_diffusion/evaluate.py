@@ -93,9 +93,34 @@ def main(argv=None):
         attention_mask = np.ones_like(input_ids)
     clip_model = TaiyiCLIPModel(text_config, vision_config)
     size = vision_config.image_size
-    clip_params = clip_model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
-        jnp.zeros((1, size, size, 3)))["params"]
+    clip_params = None
+    if args.clip_path:
+        # scoring with RANDOM clip weights would make every score noise:
+        # import the checkpoint or refuse
+        try:
+            from fengshen_tpu.models.clip.convert import torch_to_params
+            from fengshen_tpu.utils.convert_common import (
+                load_torch_checkpoint)
+            state = dict(load_torch_checkpoint(args.clip_path))
+            text_state = {k: v for k, v in state.items()
+                          if not k.startswith(("vision", "visual"))}
+            clip_params = torch_to_params(
+                text_state, state, text_config, vision_config,
+                text_projection=state.get("text_projection.weight"),
+                visual_projection=state.get("visual_projection.weight"),
+                logit_scale=state.get("logit_scale"))
+        except (FileNotFoundError, KeyError) as e:
+            raise SystemExit(
+                f"--clip_path {args.clip_path} has no importable "
+                f"weights ({e}); refusing to report CLIP scores from "
+                f"random towers") from e
+    if clip_params is None:
+        # demo mode (no checkpoint): scores exercise the pipeline only
+        print("note: no --clip_path — scoring with demo-scale random "
+              "towers; scores are NOT a model-quality signal")
+        clip_params = clip_model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+            jnp.zeros((1, size, size, 3)))["params"]
 
     scores = clip_score(clip_model, clip_params, input_ids,
                         attention_mask, np.stack(images),

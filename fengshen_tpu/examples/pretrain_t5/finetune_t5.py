@@ -156,8 +156,12 @@ class MT5FinetuneModule(TrainModule):
         rep = lambda x: jnp.repeat(x, C, axis=0)  # noqa: E731
         choice = batch["choice_ids"].reshape(B * C, L)
         pad = 0
+        # the SAME start token training shifts with — a nonzero
+        # decoder_start_token_id otherwise mis-scores every option
+        start = jnp.full((B * C, 1), self.config.decoder_start_token_id,
+                         choice.dtype)
         dec_in = jnp.concatenate(
-            [jnp.zeros((B * C, 1), choice.dtype),
+            [start,
              jnp.where(choice[:, :-1] < 0, pad, choice[:, :-1])], axis=1)
         logits = self.model.apply(
             {"params": params}, rep(batch["input_ids"]), dec_in,

@@ -205,10 +205,15 @@ def evaluate_and_save(trainer, module, tokenizer, loader, args,
 
     from fengshen_tpu.metrics.rouge import rouge_scores
 
-    outputs = trainer.predict(module, loader, state=state)
+    # ONE pass: materialize the batches, predict over that exact list,
+    # and take references from the same batches — alignment by
+    # construction (a second loader sweep would re-tokenize the split
+    # and silently mis-pair under any future sampler change)
+    batches = list(loader)
+    outputs = trainer.predict(module, batches, state=state)
     preds, refs = [], []
     with open(args.output_save_path, "w", encoding="utf-8") as f:
-        for out in outputs:
+        for batch, out in zip(batches, outputs):
             tokens = np.asarray(out["tokens"] if isinstance(out, dict)
                                 else out)
             texts = tokenizer.batch_decode(tokens,
@@ -216,13 +221,11 @@ def evaluate_and_save(trainer, module, tokenizer, loader, args,
             preds.extend(texts)
             for t in texts:
                 f.write(json.dumps({"pred": t}, ensure_ascii=False) + "\n")
-    # labels for rouge come from a second pass over the raw loader
-    for batch in loader:
-        labels = np.where(batch["labels"] < 0, 0, batch["labels"])
-        refs.extend(tokenizer.batch_decode(labels,
-                                           skip_special_tokens=True))
+            labels = np.where(batch["labels"] < 0, 0, batch["labels"])
+            refs.extend(tokenizer.batch_decode(
+                labels, skip_special_tokens=True))
     keys = tuple(k.strip() for k in args.rouge_keys.split(","))
-    scores = rouge_scores(preds, refs[:len(preds)], keys=keys)
+    scores = rouge_scores(preds, refs, keys=keys)
     print("rouge:", json.dumps(scores, ensure_ascii=False))
     return scores
 

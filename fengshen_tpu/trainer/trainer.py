@@ -282,12 +282,13 @@ class Trainer:
                      if hasattr(c, "maybe_restore")), None)
 
     # -- predict state ---------------------------------------------------
-    def restore_for_predict(self, module: TrainModule) -> TrainState:
+    def restore_for_predict(self, module: TrainModule,
+                            stage: str = "predict") -> TrainState:
         """Build + restore an eval-only TrainState WITHOUT running a
         validation sweep — the cheap entry for predict-only drivers
-        (e.g. classification --do_predict_only), which need weights but
-        no dev-set pass."""
-        module.setup("predict")
+        (e.g. classification --do_predict_only), and the shared
+        state-construction path of validate()."""
+        module.setup(stage)
         rng = jax.random.PRNGKey(getattr(self.args, "seed", 42))
         state, state_sh = create_sharded_state(
             self._make_init_fn(module, rng, 1, eval_only=True),
@@ -298,7 +299,10 @@ class Trainer:
         if ckpt_cb is not None:
             state = ckpt_cb.maybe_restore(state, self, weights_only=True)
         if self.global_step == prev_step:
-            self._log({"event": "predict_no_checkpoint_restored"})
+            # restore silently skipped (no checkpoint found): the run
+            # proceeds on init_params — legitimate for HF-imported
+            # weights, surprising otherwise, so SAY it
+            self._log({"event": f"{stage}_no_checkpoint_restored"})
         return state
 
     # -- validate --------------------------------------------------------
@@ -308,7 +312,6 @@ class Trainer:
         pretrain_mt5_small_predict.sh): build/restore the state, run ONE
         validation sweep over the val loader, no training."""
         args = self.args
-        module.setup("validate")
         datamodule.trainer = self
         loader = getattr(datamodule, "val_dataloader", lambda: None)()
         if loader is None:
@@ -318,20 +321,8 @@ class Trainer:
                 "validate() has no validation data — pass --val_file / "
                 "a 'validation' split (val_datasets_field="
                 f"{getattr(args, 'val_datasets_field', 'validation')!r})")
+        state = self.restore_for_predict(module, stage="validate")
         rng = jax.random.PRNGKey(getattr(args, "seed", 42))
-        rules = module.partition_rules()
-        state, _ = create_sharded_state(
-            self._make_init_fn(module, rng, 1, eval_only=True),
-            rules, self.mesh)
-        ckpt_cb = self._restore_callback()
-        prev_step = self.global_step
-        if ckpt_cb is not None:
-            state = ckpt_cb.maybe_restore(state, self, weights_only=True)
-        if self.global_step == prev_step:
-            # restore silently skipped (no checkpoint found): the sweep
-            # below runs on init_params — legitimate for HF-imported
-            # weights, surprising otherwise, so SAY it
-            self._log({"event": "validate_no_checkpoint_restored"})
         self._log({"event": "validate_start",
                    "step": self.global_step})
         self._run_validation(module, datamodule, state, rng)
