@@ -25,6 +25,8 @@ class GPT2Config:
     bos_token_id: int = 50256
     eos_token_id: int = 50256
     # TPU-native knobs
+    # >0: chunked fused LM-head+CE (ops/fused_ce.py) in CausalLMModule
+    fused_ce_chunks: int = 0
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     gradient_checkpointing: bool = False

@@ -216,12 +216,22 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
-                 init_cache=False, deterministic=True):
+                 init_cache=False, deterministic=True,
+                 return_hidden=False):
         hidden = GPT2Model(self.config, name="transformer")(
             input_ids, attention_mask, position_ids, init_cache,
             deterministic)
+        if return_hidden:
+            # the fused chunked LM-head+CE path applies the tied head
+            # itself (see lm_head_kernel)
+            return hidden
         wte = self.variables["params"]["transformer"]["wte"]["embedding"]
         return hidden @ wte.T.astype(hidden.dtype)
+
+    @staticmethod
+    def lm_head_kernel(params):
+        """[H, V] head weight for the fused-CE path (tied to wte)."""
+        return params["transformer"]["wte"]["embedding"].T
 
     def partition_rules(self):
         return SCAN_PARTITION_RULES if self.config.scan_layers \

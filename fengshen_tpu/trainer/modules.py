@@ -44,7 +44,12 @@ class CausalLMModule(TrainModule):
         return mesh is None or mesh.shape.get("tensor", 1) == 1
 
     def _lm_head_kernel(self, params):
-        """[H, V] head weight for the fused path (tied or untied)."""
+        """[H, V] head weight for the fused path. Models may publish
+        their own lookup (GPT2's wte-tied head); the default covers the
+        llama layout (tied embedding or lm_head Dense)."""
+        hook = getattr(type(self.model), "lm_head_kernel", None)
+        if hook is not None:
+            return hook(params)
         if getattr(self.config, "tie_word_embeddings", False):
             return params["model"]["embed_tokens"]["embedding"].T
         return params["lm_head"]["kernel"]
@@ -60,7 +65,7 @@ class CausalLMModule(TrainModule):
                 {"params": params}, batch["input_ids"],
                 attention_mask=batch.get("attention_mask"),
                 deterministic=False, mutable=["losses"],
-                return_hidden=True, **extra)
+                rngs={"dropout": rng}, return_hidden=True, **extra)
             kernel = self._lm_head_kernel(params).astype(hidden.dtype)
             loss, n_tokens, n_correct = causal_fused_loss(
                 hidden, kernel, labels,
@@ -78,7 +83,8 @@ class CausalLMModule(TrainModule):
         logits, mutated = self.model.apply(
             {"params": params}, batch["input_ids"],
             attention_mask=batch.get("attention_mask"),
-            deterministic=False, mutable=["losses"], **extra)
+            deterministic=False, mutable=["losses"],
+            rngs={"dropout": rng}, **extra)
         shifted_logits = logits[:, :-1]
         shifted_labels = labels[:, 1:]
         loss, n_tokens = vocab_parallel_cross_entropy(

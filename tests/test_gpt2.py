@@ -73,3 +73,41 @@ def test_gpt2_scan_layers_parity(gpt2_pair):
     out = GPT2LMHeadModel(scan_cfg).apply({"params": scan_params},
                                           jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpt2_fused_ce_matches_plain(mesh8):
+    """GPT2 (wte-tied head) through CausalLMModule's fused-CE path."""
+    import argparse
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fengshen_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    base = GPT2Config(vocab_size=64, n_embd=32, n_layer=2, n_head=4,
+                      n_positions=32, dtype="float32")
+    args = argparse.Namespace(max_seq_length=16)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 63, (2, 16)),
+                      jnp.int32)
+    batch = {"input_ids": ids}
+    rng = jax.random.PRNGKey(0)
+
+    plain = CausalLMModule(args, GPT2LMHeadModel(base), base)
+    params = plain.init_params(rng)
+    cfg_f = dataclasses.replace(base, fused_ce_chunks=4)
+    fused = CausalLMModule(args, GPT2LMHeadModel(cfg_f), cfg_f)
+
+    set_mesh(None)
+    try:
+        mesh1 = make_mesh(MeshConfig(data=8, fsdp=1, sequence=1,
+                                     tensor=1))
+        set_mesh(mesh1)
+        l_p, _ = plain.training_loss(params, batch, rng)
+        l_f, _ = fused.training_loss(params, batch, rng)
+        assert abs(float(l_p - l_f)) < 1e-5
+    finally:
+        set_mesh(None)
