@@ -82,3 +82,76 @@ def torch_to_params(state_dict: Mapping[str, Any],
     if head == "token_classification" and "classifier.weight" in state_dict:
         params["classifier"] = lin("classifier")
     return params
+
+
+def params_to_torch_state(params: Mapping[str, Any],
+                          config: MegatronBertConfig) -> dict:
+    """Inverse of `torch_to_params`: flax params → an HF
+    MegatronBert-style state_dict (numpy values), so checkpoints trained
+    here publish back into the reference's torch ecosystem
+    (`transformers.MegatronBertModel.load_state_dict`). Layer trees are
+    un-stacked from the scan layout when present."""
+    import jax
+
+    def arr(x):
+        return np.asarray(x)
+
+    def lin(prefix, tree):
+        return {f"{prefix}.weight": arr(tree["kernel"]).T,
+                f"{prefix}.bias": arr(tree["bias"])}
+
+    def ln(prefix, tree):
+        return {f"{prefix}.weight": arr(tree["scale"]),
+                f"{prefix}.bias": arr(tree["bias"])}
+
+    bert = params["bert"]
+    state: dict = {
+        "bert.embeddings.word_embeddings.weight":
+            arr(bert["word_embeddings"]["embedding"]),
+        "bert.embeddings.position_embeddings.weight":
+            arr(bert["position_embeddings"]["embedding"]),
+        "bert.embeddings.token_type_embeddings.weight":
+            arr(bert["token_type_embeddings"]["embedding"]),
+    }
+    state.update(ln("bert.encoder.ln", bert["ln"]))
+
+    if config.scan_layers:
+        stacked = bert["layer"]["block"]
+        layers = [jax.tree_util.tree_map(lambda x, i=i: np.asarray(x)[i],
+                                         stacked)
+                  for i in range(config.num_hidden_layers)]
+    else:
+        layers = [bert[f"layer_{i}"]
+                  for i in range(config.num_hidden_layers)]
+    for i, tree in enumerate(layers):
+        pre = f"bert.encoder.layer.{i}"
+        state.update(ln(f"{pre}.attention.ln", tree["attention_ln"]))
+        for name in ("query", "key", "value"):
+            state.update(lin(f"{pre}.attention.self.{name}",
+                             tree["self"][name]))
+        state.update(lin(f"{pre}.attention.output.dense",
+                         tree["attention_output_dense"]))
+        state.update(ln(f"{pre}.ln", tree["ln"]))
+        state.update(lin(f"{pre}.intermediate.dense",
+                         tree["intermediate_dense"]))
+        state.update(lin(f"{pre}.output.dense", tree["output_dense"]))
+
+    if "pooler" in bert:
+        state.update(lin("bert.pooler.dense", bert["pooler"]))
+    if "cls_predictions" in params:
+        cp = params["cls_predictions"]
+        state.update(lin("cls.predictions.transform.dense",
+                         cp["transform_dense"]))
+        state.update(ln("cls.predictions.transform.LayerNorm",
+                        cp["transform_ln"]))
+        state["cls.predictions.bias"] = arr(cp["bias"])
+        # HF ties the decoder to the word embeddings
+        state["cls.predictions.decoder.weight"] = arr(
+            bert["word_embeddings"]["embedding"])
+        state["cls.predictions.decoder.bias"] = arr(cp["bias"])
+    if "cls_seq_relationship" in params:
+        state.update(lin("cls.seq_relationship",
+                         params["cls_seq_relationship"]))
+    if "classifier" in params:
+        state.update(lin("classifier", params["classifier"]))
+    return state
