@@ -62,3 +62,68 @@ def torch_to_params(state_dict: Mapping[str, Any], config: T5Config) -> dict:
     if not config.tie_word_embeddings and "lm_head.weight" in state_dict:
         params["lm_head"] = {"kernel": t("lm_head.weight").T}
     return params
+
+
+def params_to_torch_state(params: Mapping[str, Any],
+                          config: T5Config) -> dict:
+    """Inverse of `torch_to_params`: flax params → HF
+    T5ForConditionalGeneration state_dict (numpy values) — Randeng
+    checkpoints trained here load straight into the torch ecosystem."""
+    import numpy as np
+
+    def arr(x):
+        return np.asarray(x)
+
+    def lin(prefix, tree, state):
+        state[f"{prefix}.weight"] = arr(tree["kernel"]).T
+
+    state: dict = {"shared.weight": arr(params["model"]["shared"]
+                                        ["embedding"])}
+    state["encoder.embed_tokens.weight"] = state["shared.weight"]
+    state["decoder.embed_tokens.weight"] = state["shared.weight"]
+
+    def emit_side(side: str, tree: dict, n_layers: int,
+                  causal: bool) -> None:
+        state[f"{side}.final_layer_norm.weight"] = arr(
+            tree["final_layer_norm"]["scale"])
+        for i in range(n_layers):
+            blk = tree[f"block_{i}"]
+            pre = f"{side}.block.{i}.layer"
+            state[f"{pre}.0.layer_norm.weight"] = arr(
+                blk["ln_self"]["scale"])
+            for proj in ("q", "k", "v", "o"):
+                lin(f"{pre}.0.SelfAttention.{proj}",
+                    blk["self_attention"][proj], state)
+            if i == 0:
+                state[f"{pre}.0.SelfAttention.relative_attention_bias"
+                      ".weight"] = arr(
+                    blk["self_attention"]["relative_attention_bias"]
+                    ["embedding"])
+            ff_idx = 2 if causal else 1
+            if causal:
+                state[f"{pre}.1.layer_norm.weight"] = arr(
+                    blk["ln_cross"]["scale"])
+                for proj in ("q", "k", "v", "o"):
+                    lin(f"{pre}.1.EncDecAttention.{proj}",
+                        blk["cross_attention"][proj], state)
+            state[f"{pre}.{ff_idx}.layer_norm.weight"] = arr(
+                blk["ln_ff"]["scale"])
+            ff = blk["ff"]
+            if config.is_gated_act:
+                lin(f"{pre}.{ff_idx}.DenseReluDense.wi_0", ff["wi_0"],
+                    state)
+                lin(f"{pre}.{ff_idx}.DenseReluDense.wi_1", ff["wi_1"],
+                    state)
+            else:
+                lin(f"{pre}.{ff_idx}.DenseReluDense.wi", ff["wi"], state)
+            lin(f"{pre}.{ff_idx}.DenseReluDense.wo", ff["wo"], state)
+
+    emit_side("encoder", params["model"]["encoder"], config.num_layers,
+              causal=False)
+    emit_side("decoder", params["model"]["decoder"],
+              config.num_decoder_layers, causal=True)
+    if "lm_head" in params:
+        state["lm_head.weight"] = arr(params["lm_head"]["kernel"]).T
+    elif config.tie_word_embeddings:
+        state["lm_head.weight"] = state["shared.weight"]
+    return state

@@ -427,3 +427,46 @@ def test_megatron_bert_export_round_trip():
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6)
+
+
+def test_t5_export_round_trip():
+    """fs→HF export for the Randeng/T5 family: torch loads the export
+    and reproduces our logits; re-import is the identity."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+    from fengshen_tpu.models.t5.convert import (params_to_torch_state,
+                                                torch_to_params)
+
+    cfg = T5Config(vocab_size=120, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=2, num_heads=4, dtype="float32",
+                   tie_word_embeddings=False)
+    model = T5ForConditionalGeneration(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, ids)["params"]
+    state = {k: torch.tensor(np.ascontiguousarray(v)) for k, v in
+             params_to_torch_state(params, cfg).items()}
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=120, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_heads=4, feed_forward_proj="relu",
+        tie_word_embeddings=False)
+    tm = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    missing, _ = tm.load_state_dict(state, strict=False)
+    assert not missing, missing
+
+    enc = np.array([[2, 17, 9, 42, 7, 99, 1, 5]], np.int64)
+    dec = np.array([[0, 3, 8, 21]], np.int64)
+    with torch.no_grad():
+        ref = tm(input_ids=torch.tensor(enc),
+                 decoder_input_ids=torch.tensor(dec)).logits.numpy()
+    ours = model.apply({"params": params}, jnp.asarray(enc, jnp.int32),
+                       jnp.asarray(dec, jnp.int32))
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3)
+
+    back = torch_to_params(state, cfg)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
