@@ -96,8 +96,19 @@ class _SimpleBatchSampler:
         for start in range(0, usable, global_batch):
             chunk = order[start:start + global_batch]
             mine = chunk[self.rank * self.batch:(self.rank + 1) * self.batch]
-            if len(mine):
-                yield list(mine)
+            if len(mine) < self.batch:
+                # Tail batch: pad so EVERY rank yields the same number
+                # of full batches — a rank whose slice would be empty
+                # must not fall out of step with its peers on a
+                # multi-host mesh (ADVICE r4; torch DistributedSampler
+                # drop_last=False contract). Pad from the rank's OWN
+                # slice when it has one, so per-rank dedup (e.g.
+                # save_test's `written` set) also removes the duplicates
+                # from merged multi-rank outputs; only a rank with an
+                # empty tail slice borrows rows from the global chunk.
+                src = mine if len(mine) else chunk
+                mine = np.resize(src, self.batch)
+            yield list(mine)
 
 
 class UniversalDataModule:

@@ -165,26 +165,47 @@ class TaskCollator:
         args, tok = self.args, self.tokenizer
         texta = [s[args.texta_name] for s in samples]
         textb = [s[args.textb_name] for s in samples]
-        if all(a != "" and b != "" for a, b in zip(texta, textb)):
+        # pair-vs-single is decided PER SAMPLE, like the reference: one
+        # row with an empty textb must not drop textb for the whole
+        # batch (ADVICE r4).  padding="max_length" keeps every row the
+        # same width, so the two groups reassemble by index.
+        pair_idx = [i for i, (a, b) in enumerate(zip(texta, textb))
+                    if a != "" and b != ""]
+        single_idx = [i for i in range(len(samples)) if i not in pair_idx]
+
+        def encode_pairs(idx):
+            a = [texta[i] for i in idx]
+            b = [textb[i] for i in idx]
             if args.model_type != "fengshen-roformer":
-                enc = tok(texta, textb, max_length=args.max_length,
-                          padding="max_length", truncation="longest_first",
-                          return_tensors="np")
-            else:
-                sep = tok.eos_token or tok.sep_token or ""
-                enc = tok([a + sep + b for a, b in zip(texta, textb)],
-                          max_length=args.max_length, padding="max_length",
-                          truncation=True, return_tensors="np")
-        else:
-            enc = tok(texta, max_length=args.max_length,
-                      padding="max_length", truncation=True,
-                      return_tensors="np")
-        batch = {"input_ids": enc["input_ids"].astype(np.int32),
-                 "attention_mask":
-                     enc["attention_mask"].astype(np.int32)}
-        if "token_type_ids" in enc:
-            batch["token_type_ids"] = \
-                enc["token_type_ids"].astype(np.int32)
+                return tok(a, b, max_length=args.max_length,
+                           padding="max_length",
+                           truncation="longest_first",
+                           return_tensors="np")
+            sep = tok.eos_token or tok.sep_token or ""
+            return tok([x + sep + y for x, y in zip(a, b)],
+                       max_length=args.max_length, padding="max_length",
+                       truncation=True, return_tensors="np")
+
+        def encode_singles(idx):
+            return tok([texta[i] for i in idx],
+                       max_length=args.max_length, padding="max_length",
+                       truncation=True, return_tensors="np")
+
+        parts = []
+        if pair_idx:
+            parts.append((pair_idx, encode_pairs(pair_idx)))
+        if single_idx:
+            parts.append((single_idx, encode_singles(single_idx)))
+        keys = set().union(*(e.keys() for _, e in parts))
+        batch = {}
+        for key in ("input_ids", "attention_mask", "token_type_ids"):
+            if key not in keys:
+                continue
+            out = np.zeros((len(samples), args.max_length), np.int32)
+            for idx, enc in parts:
+                if key in enc:
+                    out[idx] = enc[key].astype(np.int32)
+            batch[key] = out
         batch["labels"] = np.asarray(
             [int(s[args.label_name]) for s in samples], np.int32)
         batch["id"] = np.asarray([int(s["id"]) for s in samples], np.int32)
@@ -536,11 +557,17 @@ def save_test(data: list, args, data_model: TaskDataModel,
     """Write `{"id":…, "label": id2label[argmax]}` jsonl
     (reference: finetune_classification.py:327-341)."""
     file_name = args.output_save_path + f".{rank}"
+    # the tail batch may carry cycled duplicate rows (the sampler pads so
+    # DP ranks stay in step) — write each sample id once
+    written: set = set()
     with open(file_name, "w", encoding="utf-8") as f:
         for out in data:
             ids = np.asarray(out["id"]).reshape(-1)
             logits = np.asarray(out["logits"])
             for sample_id, sample in zip(ids, logits):
+                if int(sample_id) in written:
+                    continue
+                written.add(int(sample_id))
                 label_id = int(np.argmax(sample))
                 f.write(json.dumps(
                     {"id": int(sample_id),

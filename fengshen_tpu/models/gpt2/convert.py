@@ -76,3 +76,17 @@ def load_hf_pretrained(path: str, config: GPT2Config | None = None):
         state = {f"transformer.{k}": v for k, v in state.items()
                  if not k.startswith("lm_head")}
     return config, torch_to_params(state, config)
+
+
+def params_to_torch_state(params: dict, config, template_state,
+                          **import_kwargs) -> dict:
+    """flax params → HF state_dict-shaped numpy mapping — the exact
+    inverse of `torch_to_params`, derived numerically (see
+    fengshen_tpu.utils.convert_common.invert_import). `template_state`
+    is the source HF checkpoint (dict or dir path)."""
+    from fengshen_tpu.utils.convert_common import (invert_import,
+                                                   load_torch_checkpoint)
+    if isinstance(template_state, str):
+        template_state = load_torch_checkpoint(template_state)
+    return invert_import(torch_to_params, template_state, config, params,
+                         **import_kwargs)

@@ -35,10 +35,18 @@ def fused_lm_head_ce(hidden: jax.Array, kernel: jax.Array,
     chunk's logits are live.
     """
     B, S, H = hidden.shape
+    num_chunks = min(num_chunks, S)
     if S % num_chunks:
-        # degrade to fewer chunks rather than failing on odd seq lens
-        num_chunks = next(c for c in range(min(num_chunks, S), 0, -1)
-                          if S % c == 0)
+        # Pad the token stream up to a multiple of num_chunks so the
+        # advertised peak-HBM reduction holds at any seq len (the causal
+        # variant hands us S-1, which is odd for power-of-two S).  Padded
+        # rows carry ignore_index labels, so they contribute nothing to
+        # loss, count, or accuracy.
+        pad = num_chunks - S % num_chunks
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=ignore_index)
+        S += pad
     chunk = S // num_chunks
     hidden_c = jnp.moveaxis(
         hidden.reshape(B, num_chunks, chunk, H), 1, 0)

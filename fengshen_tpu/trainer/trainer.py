@@ -537,21 +537,42 @@ class Trainer:
                 self._val_fn = jax.jit(module.validation_loss)
             self._val_fn_module = module
         val_fn = self._val_fn
+        # per-metric (weighted sum, weight) so a metric emitted by only
+        # some batches is averaged over ITS batches, and per-batch means
+        # (accuracies) are weighted by batch rows rather than skewed by a
+        # short tail batch (ADVICE r4).  Count-like metrics (n_*, *_sum,
+        # *_count) are summed, not averaged.
         metric_sums: dict = {}
 
-        def _accumulate(metrics):
+        def _is_countlike(k: str) -> bool:
+            base = k[4:] if k.startswith("val_") else k
+            return (base.startswith("n_") or base.endswith("_sum")
+                    or base.endswith("_count"))
+
+        def _batch_rows(batch) -> float:
+            for v in jax.tree_util.tree_leaves(batch):
+                if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
+                    return float(v.shape[0])
+            return 1.0
+
+        def _accumulate(metrics, weight):
             for k, v in (metrics or {}).items():
                 try:
-                    metric_sums[k] = metric_sums.get(k, 0.0) + float(v)
+                    v = float(v)
                 except (TypeError, ValueError):
-                    pass  # non-scalar diagnostic; skip
+                    continue  # non-scalar diagnostic; skip
+                s, w = metric_sums.get(k, (0.0, 0.0))
+                if _is_countlike(k):
+                    metric_sums[k] = (s + v, -1.0)
+                else:
+                    metric_sums[k] = (s + v * weight, w + weight)
 
         for i, batch in enumerate(loader):
             if limit and i >= limit:
                 break
+            rows = _batch_rows(batch)
             try:
                 loss, metrics = val_fn(state.params, batch, rng)
-                _accumulate(metrics)
             except (TypeError, ValueError) as e:
                 # this batch doesn't fit the train batch spec — run IT on a
                 # separately cached inferred-sharding jit, but keep the
@@ -563,14 +584,16 @@ class Trainer:
                                "error": str(e)[:200]})
                 loss, metrics = self._val_fn_plain(state.params, batch,
                                                    rng)
-                _accumulate(metrics)
-            losses.append(float(loss))
+            _accumulate(metrics, rows)
+            losses.append((float(loss), rows))
         if losses:
+            total_rows = sum(w for _, w in losses)
             entry = {"step": self.global_step,
-                     "val_loss": float(np.mean(losses))}
-            for k, total in metric_sums.items():
+                     "val_loss": sum(l * w for l, w in losses)
+                     / max(total_rows, 1.0)}
+            for k, (total, w) in metric_sums.items():
                 key = k if k.startswith("val_") else f"val_{k}"
-                entry[key] = total / len(losses)
+                entry[key] = total if w < 0 else total / max(w, 1e-9)
             self._log(entry)
 
     # -- logging ---------------------------------------------------------

@@ -127,6 +127,118 @@ def lstm_cell_params(state_dict: Mapping[str, Any], prefix: str,
     return cell
 
 
+def invert_import(torch_to_params_fn, template: Mapping[str, Any],
+                  config, params: dict, **fn_kwargs) -> dict:
+    """Generic fs→HF export: the exact inverse of a permutation-style
+    importer, learned numerically (reference merge-back path:
+    fengshen/utils/llama_convert/merge_lt_mp_to_hf.py:1-164 — there a
+    hand-written inverse per family; here ONE inverse derived from the
+    import itself, so the two directions can never drift apart).
+
+    How: run `torch_to_params_fn` on a state dict whose every scalar is
+    replaced by a unique tag id. Transposes/reshapes/stacks/slices move
+    the tags exactly like they move real weights, so each flax leaf
+    position names its source torch position; flax values then scatter
+    straight back into torch-shaped buffers.
+
+    `template` supplies the torch keys/shapes/dtypes — the original HF
+    checkpoint you imported from, or a freshly instantiated HF model's
+    state_dict (values are only kept for positions the import never
+    read, e.g. RoBERTa's two reserved position rows).
+
+    Leaves the importer synthesized rather than read (zeros-init heads)
+    are detected — their values are not integral tag ids — and skipped.
+    Raises if a read leaf's values are not pure tags (an importer doing
+    arithmetic needs a hand-written inverse instead).
+    """
+    import jax
+
+    keys = list(template.keys())
+    np_template = {k: tensor(template, k) for k in keys}
+
+    def _orig_dtype(v):
+        # tensor() upcasts torch fp16/bf16 to float32; exports must come
+        # back in the checkpoint's own dtype
+        name = str(getattr(v, "dtype", np.float32)).replace("torch.", "")
+        if name == "bfloat16":
+            import ml_dtypes
+            return np.dtype(ml_dtypes.bfloat16)
+        try:
+            return np.dtype(name)
+        except TypeError:
+            return np.float32
+    dtypes = {k: _orig_dtype(template[k]) for k in keys}
+    sizes = {k: int(np_template[k].size) for k in keys}
+    offsets, off = {}, 0
+    for k in keys:
+        offsets[k] = off
+        off += sizes[k]
+    total = off
+    # tags are arange + 0.25: exactly representable in float64, and no
+    # synthesized constant array (zeros/ones init) can collide with one
+    tagged = {k: (np.arange(offsets[k], offsets[k] + sizes[k],
+                            dtype=np.float64) + 0.25
+                  ).reshape(np_template[k].shape) for k in keys}
+    tag_tree = torch_to_params_fn(tagged, config, **fn_kwargs)
+
+    tag_leaves = dict(jax.tree_util.tree_flatten_with_path(tag_tree)[0])
+    val_leaves = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    flat = np.concatenate([np_template[k].astype(np.float64).ravel()
+                           for k in keys]) if total else np.zeros(0)
+    filled = np.zeros(total, dtype=bool)
+    for path, tags in tag_leaves.items():
+        if path not in val_leaves:
+            raise KeyError(
+                f"params tree lacks leaf {jax.tree_util.keystr(path)} "
+                f"produced by the importer — wrong params/config pair?")
+        tags = np.asarray(tags, dtype=np.float64)
+        vals = np.asarray(val_leaves[path], dtype=np.float64)
+        if tags.shape != vals.shape:
+            raise ValueError(
+                f"shape mismatch at {jax.tree_util.keystr(path)}: "
+                f"importer yields {tags.shape}, params have {vals.shape}")
+        ids = tags.ravel() - 0.25
+        is_tag = (ids == np.round(ids)) & (ids >= 0) & (ids < total)
+        if not is_tag.any():
+            continue  # synthesized leaf (fresh head init) — not exported
+        if not is_tag.all() and not (
+                # mixed leaves happen when the import pads (e.g. rows of
+                # zeros appended); only the tagged positions round-trip
+                np.isin(np.unique(tags.ravel()[~is_tag]),
+                        (0.0, 1.0)).all()):
+            raise ValueError(
+                f"leaf {jax.tree_util.keystr(path)} mixes tags with "
+                f"computed values — this importer does arithmetic and "
+                f"needs a hand-written inverse")
+        idx = ids[is_tag].astype(np.int64)
+        flat[idx] = vals.ravel()[is_tag]
+        filled[idx] = True
+    # Tied duplicates: a key the importer never reads but whose template
+    # values exactly mirror a read key's (e.g. lm_head.weight tied to the
+    # embedding) must follow the finetuned values, or a torch
+    # load_state_dict on a tied model would copy the STALE tensor into
+    # the shared storage last and silently revert the finetune.
+    untouched = [k for k in keys
+                 if sizes[k] and not filled[offsets[k]:offsets[k]
+                                            + sizes[k]].any()]
+    exported = [k for k in keys
+                if sizes[k] and filled[offsets[k]:offsets[k]
+                                       + sizes[k]].all()]
+    for k in untouched:
+        for j in exported:
+            if (np_template[k].shape == np_template[j].shape
+                    and np.array_equal(np_template[k], np_template[j])):
+                flat[offsets[k]:offsets[k] + sizes[k]] = \
+                    flat[offsets[j]:offsets[j] + sizes[j]]
+                break
+    out = {}
+    for k in keys:
+        arr = flat[offsets[k]:offsets[k] + sizes[k]].reshape(
+            np_template[k].shape)
+        out[k] = arr.astype(dtypes[k])
+    return out
+
+
 def load_torch_checkpoint(ckpt_dir: str) -> Mapping[str, Any]:
     """State dict from a reference-format checkpoint dir, trying the
     file names the reference publishes under (HF pytorch_model.bin or

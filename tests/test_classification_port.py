@@ -108,6 +108,60 @@ def test_schema_first_seen_order(tmp_path):
     assert id2label == {0: "0", 1: "1"}
 
 
+def test_collator_pair_vs_single_is_per_sample(tmp_path):
+    """One row with an empty textb must not drop textb for the rest of
+    the batch — the reference decides pair-vs-single per sample
+    (reference: finetune_classification.py:87-121; ADVICE r4)."""
+    model_dir = _write_model_dir(tmp_path)
+    from transformers import BertTokenizer
+    tok = BertTokenizer.from_pretrained(str(model_dir))
+    parser = fc.build_parser()
+    args = parser.parse_args(
+        ["--texta_name", "sentence1", "--textb_name", "sentence2",
+         "--max_length", "32"])
+    coll = fc.TaskCollator(args=args, tokenizer=tok)
+    pair = {"sentence1": "蚂蚁花呗", "sentence2": "借呗开通",
+            "label": 1, "id": 0}
+    single = {"sentence1": "天气很好", "sentence2": "",
+              "label": 0, "id": 1}
+    mixed = coll([pair, single, pair])
+    pure = coll([pair, pair])
+    # the pair rows keep their textb encoding even next to a single row
+    np.testing.assert_array_equal(mixed["input_ids"][0],
+                                  pure["input_ids"][0])
+    np.testing.assert_array_equal(mixed["input_ids"][2],
+                                  pure["input_ids"][0])
+    # and the single row really is single-encoded (no second segment)
+    only_single = coll([single])
+    np.testing.assert_array_equal(mixed["input_ids"][1],
+                                  only_single["input_ids"][0])
+    assert mixed["labels"].tolist() == [1, 0, 1]
+
+
+def test_simple_batch_sampler_tail_keeps_ranks_in_step():
+    """drop_last=False pads the tail global batch by cycling its own
+    indices, so every rank yields the same number of batches
+    (ADVICE r4 — multi-host ranks must not desynchronize)."""
+    from fengshen_tpu.data.universal_datamodule import _SimpleBatchSampler
+
+    total, batch, world = 10, 2, 4  # tail global batch has 2 of 8 slots
+    per_rank = [list(_SimpleBatchSampler(total, batch, r, world,
+                                         shuffle=False, drop_last=False))
+                for r in range(world)]
+    counts = [len(b) for b in per_rank]
+    assert counts == [counts[0]] * world  # identical batch counts
+    for batches in per_rank:
+        assert all(len(b) == batch for b in batches)  # all full batches
+    # every real index is still covered across ranks
+    seen = {i for batches in per_rank for b in batches for i in b}
+    assert seen == set(range(total))
+    # drop_last=True is untouched: exact division, no padding
+    strict = [list(_SimpleBatchSampler(total, batch, r, world,
+                                       shuffle=False, drop_last=True))
+              for r in range(world)]
+    assert all(len(b) == 1 for b in strict)
+
+
 @pytest.mark.slow
 def test_backbone_import_from_hf_checkpoint(tmp_path):
     """--pretrained_model_path with real torch weights: the module's

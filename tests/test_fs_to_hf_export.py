@@ -1,0 +1,271 @@
+"""fs→HF export round-trips for the generic inverter families
+(VERDICT r4 missing #3; reference merge-back analog:
+fengshen/utils/llama_convert/fs_to_hf.py, merge_lt_mp_to_hf.py).
+
+Two properties per family:
+  1. export(import(state)) == state for EVERY key — keys the importer
+     reads must round-trip bit-exactly; keys it never reads must keep
+     their template values.
+  2. a perturbed (="finetuned") flax tree survives export → re-import
+     unchanged, so the export really carries the flax values and does
+     not just echo the template.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+
+
+def _bart():
+    import transformers
+
+    from fengshen_tpu.models.bart.modeling_bart import BartConfig
+    from fengshen_tpu.models.bart import convert
+
+    hf_cfg = transformers.BartConfig(
+        vocab_size=128, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.BartForConditionalGeneration(hf_cfg).eval()
+    cfg = BartConfig(vocab_size=128, d_model=32, encoder_layers=2,
+                     decoder_layers=2, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64,
+                     dtype="float32")
+    return convert, tm.state_dict(), cfg, {}
+
+
+def _pegasus():
+    import transformers
+
+    from fengshen_tpu.models.pegasus import PegasusConfig
+    from fengshen_tpu.models.pegasus import convert
+
+    hf_cfg = transformers.PegasusConfig(
+        vocab_size=120, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_position_embeddings=64, activation_function="relu",
+        scale_embedding=False)
+    torch.manual_seed(0)
+    tm = transformers.PegasusForConditionalGeneration(hf_cfg).eval()
+    cfg = PegasusConfig(vocab_size=120, d_model=32, encoder_layers=2,
+                        decoder_layers=2, encoder_attention_heads=4,
+                        decoder_attention_heads=4, encoder_ffn_dim=64,
+                        decoder_ffn_dim=64, max_position_embeddings=64,
+                        activation_function="relu", scale_embedding=False,
+                        dtype="float32")
+    return convert, tm.state_dict(), cfg, {}
+
+
+def _deberta():
+    import transformers
+
+    from fengshen_tpu.models.deberta_v2 import DebertaV2Config
+    from fengshen_tpu.models.deberta_v2 import convert
+
+    hf_cfg = transformers.DebertaV2Config(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, relative_attention=True,
+        position_buckets=8, norm_rel_ebd="layer_norm", share_att_key=True,
+        pos_att_type=["p2c", "c2p"], position_biased_input=False,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.DebertaV2Model(hf_cfg).eval()
+    cfg = DebertaV2Config(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, position_buckets=8, dtype="float32")
+    state = {f"deberta.{k}": v for k, v in tm.state_dict().items()}
+    return convert, state, cfg, {}
+
+
+def _roformer():
+    import transformers
+
+    from fengshen_tpu.models.roformer import RoFormerConfig
+    from fengshen_tpu.models.roformer import convert
+
+    hf_cfg = transformers.RoFormerConfig(
+        vocab_size=128, embedding_size=32, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, rotary_value=False,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.RoFormerModel(hf_cfg).eval()
+    cfg = RoFormerConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=64, max_position_embeddings=64,
+                         dtype="float32")
+    state = {f"roformer.{k}": v for k, v in tm.state_dict().items()}
+    return convert, state, cfg, {}
+
+
+def _longformer():
+    import transformers
+
+    from fengshen_tpu.models.longformer.modeling_longformer import (
+        LongformerConfig)
+    from fengshen_tpu.models.longformer import convert
+
+    hf_cfg = transformers.LongformerConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=66, attention_window=[8, 8],
+        pad_token_id=0)
+    torch.manual_seed(0)
+    tm = transformers.LongformerModel(hf_cfg, add_pooling_layer=False).eval()
+    cfg = LongformerConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, attention_window=8, dtype="float32")
+    state = {f"longformer.{k}": v for k, v in tm.state_dict().items()}
+    return convert, state, cfg, {}
+
+
+def _albert():
+    import transformers
+
+    from fengshen_tpu.models.albert import AlbertConfig
+    from fengshen_tpu.models.albert import convert
+
+    hf_cfg = transformers.AlbertConfig(
+        vocab_size=128, embedding_size=16, hidden_size=32,
+        num_hidden_layers=3, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.AlbertModel(hf_cfg).eval()
+    cfg = AlbertConfig(vocab_size=128, embedding_size=16, hidden_size=32,
+                       num_hidden_layers=3, num_attention_heads=4,
+                       intermediate_size=64, max_position_embeddings=64,
+                       dtype="float32")
+    state = {f"albert.{k}": v for k, v in tm.state_dict().items()}
+    return convert, state, cfg, {}
+
+
+def _deltalm():
+    from fengshen_tpu.models.deltalm import DeltaLMConfig
+    from fengshen_tpu.models.deltalm import convert
+
+    cfg = DeltaLMConfig.small_test_config()
+    d, f = cfg.d_model, cfg.encoder_ffn_dim
+    shapes = {"encoder.embed_tokens.weight": (cfg.vocab_size, d),
+              "encoder.embed_positions.weight": (
+                  cfg.max_position_embeddings + 2, d)}
+    for pre, n in (("encoder", cfg.encoder_layers),
+                   ("decoder", cfg.decoder_layers)):
+        shapes[f"{pre}.layernorm_embedding.weight"] = (d,)
+        shapes[f"{pre}.layernorm_embedding.bias"] = (d,)
+        shapes[f"{pre}.layer_norm.weight"] = (d,)
+        shapes[f"{pre}.layer_norm.bias"] = (d,)
+        for i in range(n):
+            p = f"{pre}.layers.{i}"
+            for att in (["self_attn"] if pre == "encoder" else
+                        ["self_attn", "encoder_attn"]):
+                for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                    shapes[f"{p}.{att}.{proj}.weight"] = (d, d)
+                    shapes[f"{p}.{att}.{proj}.bias"] = (d,)
+                shapes[f"{p}.{att}_layer_norm.weight"] = (d,)
+                shapes[f"{p}.{att}_layer_norm.bias"] = (d,)
+            fcs = ("fc1", "fc2") if pre == "encoder" else \
+                ("fc1", "fc2", "fc3", "fc4")
+            for fc in fcs:
+                wide = fc in ("fc1", "fc3")
+                shapes[f"{p}.{fc}.weight"] = (f, d) if wide else (d, f)
+                shapes[f"{p}.{fc}.bias"] = (f,) if wide else (d,)
+            shapes[f"{p}.final_layer_norm.weight"] = (d,)
+            shapes[f"{p}.final_layer_norm.bias"] = (d,)
+            if pre == "decoder":
+                shapes[f"{p}.ffn_layer_norm.weight"] = (d,)
+                shapes[f"{p}.ffn_layer_norm.bias"] = (d,)
+    rng = np.random.RandomState(7)
+    state = {k: rng.randn(*s).astype(np.float32) for k, s in shapes.items()}
+    return convert, state, cfg, {}
+
+
+def _gpt2():
+    import transformers
+
+    from fengshen_tpu.models.gpt2 import GPT2Config
+    from fengshen_tpu.models.gpt2 import convert
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=4, dtype="float32",
+                     scan_layers=True)
+    return convert, tm.state_dict(), cfg, {}
+
+
+FAMILIES = {"bart": _bart, "pegasus": _pegasus, "deberta_v2": _deberta,
+            "roformer": _roformer, "longformer": _longformer,
+            "albert": _albert, "deltalm": _deltalm, "gpt2": _gpt2}
+
+
+def test_export_follows_tied_duplicates():
+    """Keys the importer never reads but that are TIED to read tensors
+    (GPT2's lm_head.weight ↔ wte) must track the finetuned values — a
+    stale copy would be load_state_dict'ed into the shared storage last
+    and silently revert the finetune."""
+    convert, state, cfg, kw = _gpt2()
+    assert "lm_head.weight" in state  # torch materializes the tied key
+    params = convert.torch_to_params(state, cfg, **kw)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    bumped = jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(x) + 1e-3 for x in leaves])
+    out = convert.params_to_torch_state(bumped, cfg, state, **kw)
+    np.testing.assert_array_equal(out["lm_head.weight"],
+                                  out["transformer.wte.weight"])
+    assert not np.array_equal(
+        out["lm_head.weight"],
+        state["lm_head.weight"].detach().numpy())  # not the stale copy
+
+
+def test_export_preserves_template_dtype():
+    """An fp16/bf16 source checkpoint exports back in its own dtype."""
+    convert, state, cfg, kw = _bart()
+    state16 = {k: v.half() for k, v in state.items()}
+    params = convert.torch_to_params(state16, cfg, **kw)
+    out = convert.params_to_torch_state(params, cfg, state16, **kw)
+    assert all(v.dtype == np.float16 for v in out.values()), \
+        {k: v.dtype for k, v in out.items() if v.dtype != np.float16}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_export_round_trip(family):
+    convert, state, cfg, kw = FAMILIES[family]()
+    ref = {k: np.array(v.detach().numpy() if hasattr(v, "detach") else v)
+           for k, v in state.items()}
+    params = convert.torch_to_params(state, cfg, **kw)
+
+    # 1. export of the untouched import reproduces the source state dict
+    #    exactly — read keys round-trip, unread keys keep template values
+    out = convert.params_to_torch_state(params, cfg, state, **kw)
+    assert set(out) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(
+            out[k].astype(np.float32), ref[k].astype(np.float32),
+            err_msg=f"{family}: {k}")
+
+    # 2. a "finetuned" tree survives export → re-import bit-exactly
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    bumped = jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(x) + (i % 13) * 1e-3
+                  for i, x in enumerate(leaves)])
+    out2 = convert.params_to_torch_state(bumped, cfg, state, **kw)
+    back = convert.torch_to_params(out2, cfg, **kw)
+    for path_a, a in jax.tree_util.tree_flatten_with_path(bumped)[0]:
+        b = dict(jax.tree_util.tree_flatten_with_path(back)[0])[path_a]
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0, atol=1e-6,
+            err_msg=f"{family}: {jax.tree_util.keystr(path_a)}")

@@ -380,10 +380,14 @@ def test_fused_lm_head_ce_matches_unfused():
     assert int(correct) == int(((logits.argmax(-1) == labels) *
                                 valid).sum())
 
-    # odd seq lens degrade the chunk count instead of failing
-    loss13, n13, _ = fused_lm_head_ce(hidden[:, :11], kernel,
+    # odd seq lens pad up to the chunk multiple — same value as the
+    # unfused path, full chunk count preserved (ADVICE r4: the causal
+    # variant's S-1 must not silently collapse to one chunk)
+    loss11, n11, _ = fused_lm_head_ce(hidden[:, :11], kernel,
                                       labels[:, :11], num_chunks=4)
-    assert jnp.isfinite(loss13)
+    ls11, _ = stable_cross_entropy(hidden[:, :11] @ kernel, labels[:, :11])
+    assert abs(float(loss11 - ls11)) < 1e-5
+    assert int(n11) == int((labels[:, :11] != -100).sum())
 
     # causal variant == shift-by-one of the plain one
     lc, _, _ = causal_fused_loss(hidden, kernel, labels, num_chunks=4)
