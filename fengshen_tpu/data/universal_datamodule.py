@@ -102,10 +102,13 @@ class _SimpleBatchSampler:
                 # must not fall out of step with its peers on a
                 # multi-host mesh (ADVICE r4; torch DistributedSampler
                 # drop_last=False contract). Pad from the rank's OWN
-                # slice when it has one, so per-rank dedup (e.g.
-                # save_test's `written` set) also removes the duplicates
-                # from merged multi-rank outputs; only a rank with an
-                # empty tail slice borrows rows from the global chunk.
+                # slice when it has one, so per-rank dedup (save_test's
+                # `written` set) removes those duplicates; a rank with
+                # an EMPTY tail slice has to borrow rows from the global
+                # chunk, and those rows also appear on the owning rank —
+                # duplicate model outputs are identical (same params,
+                # same row), so merged multi-rank outputs must be
+                # deduped by id, which is lossless.
                 src = mine if len(mine) else chunk
                 mine = np.resize(src, self.batch)
             yield list(mine)

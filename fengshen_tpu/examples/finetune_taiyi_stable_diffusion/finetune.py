@@ -34,6 +34,23 @@ class TaiyiSDModule(TrainModule):
         super().__init__(args)
         if text_config is None and getattr(args, "model_path", None):
             text_config = BertConfig.from_pretrained(args.model_path)
+        self._pipeline_params = None
+        if vae_config is None and unet_config is None and \
+                getattr(args, "sd_pipeline_path", None):
+            # a released diffusers pipeline dir: faithful SD-1.x towers
+            # + direct weight import (reference: finetune.py:81-89
+            # StableDiffusionPipeline.from_pretrained)
+            from fengshen_tpu.models.stable_diffusion.convert import (
+                load_diffusers_pipeline)
+            unet_config, unet_params, vae_config, vae_params = \
+                load_diffusers_pipeline(args.sd_pipeline_path)
+            self._pipeline_params = {"unet": unet_params,
+                                     "vae": vae_params}
+        elif vae_config is None and unet_config is None and \
+                getattr(args, "faithful_towers", False):
+            from fengshen_tpu.models.stable_diffusion import (SDUNetConfig,
+                                                              SDVAEConfig)
+            unet_config, vae_config = SDUNetConfig(), SDVAEConfig()
         self.model = TaiyiStableDiffusion(
             text_config, vae_config or VAEConfig(),
             unet_config or UNetConfig())
@@ -55,6 +72,14 @@ class TaiyiSDModule(TrainModule):
                                  "(reference: finetune.py:91-100)")
         parser.add_argument("--train_csv", type=str, default=None)
         parser.add_argument("--image_root", type=str, default=None)
+        parser.add_argument("--sd_pipeline_path", type=str, default=None,
+                            help="released diffusers pipeline dir: use "
+                                 "the faithful SD-1.x towers and import "
+                                 "its unet/vae weights directly")
+        parser.add_argument("--faithful_towers", action="store_true",
+                            default=False,
+                            help="full SD-1.x tower architecture "
+                                 "(random init) without a pipeline dir")
         return parent_parser
 
     def init_params(self, rng):
@@ -64,7 +89,14 @@ class TaiyiSDModule(TrainModule):
         t = jnp.zeros((1,), jnp.int32)
         latent_shape = self.model.vae_config.latent_shape(size)
         noise = jnp.zeros((1,) + latent_shape, jnp.float32)
-        return self.model.init(rng, ids, pixels, t, noise)["params"]
+        params = self.model.init(rng, ids, pixels, t, noise)["params"]
+        if self._pipeline_params is not None:
+            params = dict(params)
+            params.update(self._pipeline_params)
+            # drop the host copy (~3.8 GB at real SD scale) — init_params
+            # runs once and the trainer owns the live tree from here
+            self._pipeline_params = None
+        return params
 
     def _denoise_pred(self, params, batch, rng):
         """Shared preamble: freeze towers, sample noise/timesteps, run the

@@ -6,8 +6,13 @@ with the ε / v-prediction switch, SURVEY.md §3.4)."""
 from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
 from fengshen_tpu.models.stable_diffusion.autoencoder_kl import AutoencoderKL
 from fengshen_tpu.models.stable_diffusion.unet import UNet2DConditionModel
+from fengshen_tpu.models.stable_diffusion.unet_sd import (
+    SDUNetConfig, SDUNet2DConditionModel)
+from fengshen_tpu.models.stable_diffusion.vae_sd import (SDVAEConfig,
+                                                         SDAutoencoderKL)
 from fengshen_tpu.models.stable_diffusion.modeling_taiyi_sd import (
     TaiyiStableDiffusion, diffusion_loss)
 
 __all__ = ["DDPMScheduler", "AutoencoderKL", "UNet2DConditionModel",
-           "TaiyiStableDiffusion", "diffusion_loss"]
+           "SDUNetConfig", "SDUNet2DConditionModel", "SDVAEConfig",
+           "SDAutoencoderKL", "TaiyiStableDiffusion", "diffusion_loss"]

@@ -9,11 +9,16 @@ Two surfaces:
    original CompVis single-checkpoint layout. Pure key arithmetic — works on
    any Mapping of arrays, no torch required.
 
-2. `text_encoder_to_params(...)` — import the Taiyi-SD Chinese text encoder
-   (a BertModel) into the flax TaiyiStableDiffusion text tower. The UNet /
-   VAE towers of this family are TPU-native re-designs, not diffusers
-   clones, so their released weights go through `diffusers_to_original` for
-   interchange rather than direct tower import.
+2. `unet_to_params` / `vae_to_params` / `load_diffusers_pipeline` —
+   DIRECT tower import of released diffusers weights into the faithful
+   SD-1.x flax towers (`unet_sd.SDUNet2DConditionModel`,
+   `vae_sd.SDAutoencoderKL`), whose parameter trees mirror the diffusers
+   state-dict keys; `unet_params_to_diffusers`/`vae_params_to_diffusers`
+   export back (derived exact inverses). Old (<0.17) VAE attention
+   naming (query/key/value/proj_attn) is normalized on import.
+
+3. `text_encoder_to_params(...)` — import the Taiyi-SD Chinese text encoder
+   (a BertModel) into the flax TaiyiStableDiffusion text tower.
 """
 
 from __future__ import annotations
@@ -158,6 +163,120 @@ def diffusers_to_original(unet_state: Mapping[str, Any],
     out.update({"cond_stage_model.transformer." + k: v
                 for k, v in text_enc_state.items()})
     return out
+
+
+# -- direct tower import: diffusers state dict → flax params ---------------
+
+#: old-diffusers (<0.17) VAE attention names → current names (the
+#: released 2022-era Taiyi-SD weights use the old ones)
+_OLD_ATTN_RENAMES = {"query": "to_q", "key": "to_k", "value": "to_v",
+                     "proj_attn": "to_out_0"}
+
+
+def diffusers_tower_to_params(state_dict: Mapping[str, Any]) -> dict:
+    """Generic diffusers→flax weight mapping for the SD towers.
+
+    The flax modules in `unet_sd.py` / `vae_sd.py` name their submodules
+    exactly like the diffusers state-dict keys with numeric segments
+    merged (``down_blocks.0.resnets.1`` → ``down_blocks_0/resnets_1``),
+    so the import is a mechanical key mangle plus the standard layout
+    transposes: torch Conv [O,I,kh,kw] → flax [kh,kw,I,O], Linear
+    [O,I] → [I,O], norm weight → scale.
+    """
+    import numpy as np
+
+    from fengshen_tpu.utils.convert_common import tensor as _t
+
+    params: dict = {}
+    for key in state_dict:
+        arr = _t(state_dict, key)
+        parts = key.split(".")
+        leaf_name, parts = parts[-1], parts[:-1]
+        path: list[str] = []
+        for p in parts:
+            if p.isdigit() and path:
+                path[-1] = f"{path[-1]}_{p}"
+            else:
+                path.append(_OLD_ATTN_RENAMES.get(p, p))
+        if arr.ndim == 4:
+            leaf = ("kernel", np.transpose(arr, (2, 3, 1, 0)))
+        elif arr.ndim == 2:
+            leaf = ("kernel", arr.T)
+        elif leaf_name == "weight":
+            leaf = ("scale", arr)  # GroupNorm/LayerNorm
+        else:
+            leaf = ("bias", arr)
+        node = params
+        for p in path:
+            node = node.setdefault(p, {})
+        node[leaf[0]] = leaf[1]
+    return params
+
+
+def unet_to_params(state_dict: Mapping[str, Any], config=None) -> dict:
+    """diffusers UNet2DConditionModel state dict → SDUNet2DConditionModel
+    params (reference: the released Taiyi-SD pipeline's `unet/` weights,
+    finetune_taiyi_stable_diffusion/finetune.py:81-89)."""
+    return diffusers_tower_to_params(state_dict)
+
+
+def vae_to_params(state_dict: Mapping[str, Any], config=None) -> dict:
+    """diffusers AutoencoderKL state dict → SDAutoencoderKL params."""
+    return diffusers_tower_to_params(state_dict)
+
+
+def unet_params_to_diffusers(params: dict, template_state, config=None):
+    """SDUNet params → diffusers state dict (exact inverse, derived —
+    see utils/convert_common.invert_import)."""
+    from fengshen_tpu.utils.convert_common import invert_import
+    return invert_import(unet_to_params, template_state, config, params)
+
+
+def vae_params_to_diffusers(params: dict, template_state, config=None):
+    from fengshen_tpu.utils.convert_common import invert_import
+    return invert_import(vae_to_params, template_state, config, params)
+
+
+def sd_unet_config_from_diffusers(cfg: Mapping[str, Any]):
+    """diffusers unet/config.json → SDUNetConfig."""
+    from fengshen_tpu.models.stable_diffusion.unet_sd import SDUNetConfig
+    keep = {f.name for f in __import__("dataclasses").fields(SDUNetConfig)}
+    return SDUNetConfig(**{k: (tuple(v) if isinstance(v, list) else v)
+                           for k, v in cfg.items()
+                           if k in keep and k != "dtype"})
+
+
+def sd_vae_config_from_diffusers(cfg: Mapping[str, Any]):
+    """diffusers vae/config.json → SDVAEConfig."""
+    from fengshen_tpu.models.stable_diffusion.vae_sd import SDVAEConfig
+    keep = {f.name for f in __import__("dataclasses").fields(SDVAEConfig)}
+    return SDVAEConfig(**{k: (tuple(v) if isinstance(v, list) else v)
+                          for k, v in cfg.items()
+                          if k in keep and k != "dtype"})
+
+
+def load_diffusers_pipeline(model_path: str):
+    """A released diffusers SD pipeline dir → (unet_config, unet_params,
+    vae_config, vae_params). Weights: `unet/diffusion_pytorch_model.bin`
+    (or .safetensors) + `vae/...` (reference: finetune.py:81-89
+    StableDiffusionPipeline.from_pretrained)."""
+    import json
+    import os
+
+    from fengshen_tpu.utils.convert_common import load_weight_files
+
+    def load_tower(sub):
+        with open(os.path.join(model_path, sub, "config.json")) as f:
+            cfg = json.load(f)
+        return cfg, load_weight_files(os.path.join(model_path, sub),
+                                      "diffusion_pytorch_model")
+
+    unet_cfg, unet_state = load_tower("unet")
+    vae_cfg, vae_state = load_tower("vae")
+    return (sd_unet_config_from_diffusers(unet_cfg),
+            unet_to_params(unet_state),
+            sd_vae_config_from_diffusers(vae_cfg),
+            vae_to_params(vae_state))
 
 
 def text_encoder_to_params(state_dict: Mapping[str, Any],

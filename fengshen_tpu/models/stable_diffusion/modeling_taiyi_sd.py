@@ -22,21 +22,38 @@ from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
 from fengshen_tpu.models.stable_diffusion.scheduler import DDPMScheduler
 from fengshen_tpu.models.stable_diffusion.unet import (UNetConfig,
                                                        UNet2DConditionModel)
+from fengshen_tpu.models.stable_diffusion.unet_sd import (
+    SDUNetConfig, SDUNet2DConditionModel)
+from fengshen_tpu.models.stable_diffusion.vae_sd import (SDVAEConfig,
+                                                         SDAutoencoderKL)
 
 
 class TaiyiStableDiffusion(nn.Module):
-    """The three-model latent-diffusion pipeline with a Chinese text tower."""
+    """The three-model latent-diffusion pipeline with a Chinese text
+    tower. The UNet/VAE configs pick the tower: `SDUNetConfig` /
+    `SDVAEConfig` build the diffusers-faithful SD-1.x architecture that
+    loads the released Taiyi-SD weights (convert.load_diffusers_pipeline);
+    the compact `UNetConfig` / `VAEConfig` towers remain for fast test
+    plumbing."""
 
     text_config: BertConfig
-    vae_config: VAEConfig
-    unet_config: UNetConfig
+    vae_config: Any
+    unet_config: Any
 
     def setup(self):
         self.text_encoder = BertModel(self.text_config,
                                       add_pooling_layer=False,
                                       name="text_encoder")
-        self.vae = AutoencoderKL(self.vae_config, name="vae")
-        self.unet = UNet2DConditionModel(self.unet_config, name="unet")
+        if isinstance(self.vae_config, SDVAEConfig):
+            self.vae = SDAutoencoderKL(self.vae_config, name="vae")
+        else:
+            self.vae = AutoencoderKL(self.vae_config, name="vae")
+        if isinstance(self.unet_config, SDUNetConfig):
+            self.unet = SDUNet2DConditionModel(self.unet_config,
+                                               name="unet")
+        else:
+            self.unet = UNet2DConditionModel(self.unet_config,
+                                             name="unet")
 
     def encode_text(self, input_ids, attention_mask=None,
                     deterministic=True):

@@ -1,0 +1,210 @@
+"""Diffusers-faithful AutoencoderKL (the SD first-stage VAE).
+
+Reproduces the architecture of the released Taiyi-SD/SD-1.x VAE
+(reference workload: fengshen/examples/finetune_taiyi_stable_diffusion/
+finetune.py:112-120 — `vae.encode(...).latent_dist.sample() × 0.18215`)
+with a parameter tree mirroring the diffusers state-dict keys so the
+importer in `convert.py` loads released weights directly: 32-group
+GroupNorm (eps 1e-6), 2 resnets per encoder block / 3 per decoder
+block, single-head mid-block spatial attention, asymmetric (0,1)
+downsample padding, and the quant/post-quant 1x1 convs.
+
+The compact `autoencoder_kl.VAEConfig` tower remains as the small test
+config for trainer plumbing. Layout NHWC (TPU-native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from fengshen_tpu.models.stable_diffusion.unet_sd import (
+    Attention, Downsample2D, ResnetBlock2D, Upsample2D)
+
+SCALING_FACTOR = 0.18215
+
+
+@dataclasses.dataclass
+class SDVAEConfig:
+    """Field names follow diffusers' AutoencoderKL config."""
+
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Sequence[int] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    dtype: str = "float32"
+
+    @classmethod
+    def small_test_config(cls, **overrides: Any) -> "SDVAEConfig":
+        base = dict(block_out_channels=(16, 32), layers_per_block=1,
+                    norm_num_groups=4)
+        base.update(overrides)
+        return cls(**base)
+
+    def latent_shape(self, image_size: int) -> tuple[int, int, int]:
+        factor = 2 ** (len(self.block_out_channels) - 1)
+        return (image_size // factor, image_size // factor,
+                self.latent_channels)
+
+
+class VAEAttention(nn.Module):
+    """diffusers VAE mid-block attention: group_norm inside the module,
+    single head over the flattened spatial dim, residual add."""
+
+    channels: int
+    groups: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, hh, ww, c = x.shape
+        h = nn.GroupNorm(num_groups=self.groups, epsilon=1e-6,
+                         name="group_norm")(x)
+        h = h.reshape(b, hh * ww, c)
+        # to_q/to_k/to_v carry biases here (unlike the UNet attention) —
+        # diffusers' VAE attention is nn.Linear with default bias
+        q = nn.Dense(c, dtype=self.dtype, name="to_q")(h)
+        k = nn.Dense(c, dtype=self.dtype, name="to_k")(h)
+        v = nn.Dense(c, dtype=self.dtype, name="to_v")(h)
+        scores = jnp.einsum("bqd,bkd->bqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(c, jnp.float32))
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bqk,bkd->bqd", probs, v)
+        out = nn.Dense(c, dtype=self.dtype, name="to_out_0")(out)
+        return x + out.reshape(b, hh, ww, c)
+
+
+class _VAEMidBlock(nn.Module):
+    cfg: SDVAEConfig
+    channels: int
+
+    @nn.compact
+    def __call__(self, h):
+        cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
+        h = ResnetBlock2D(self.channels, cfg.norm_num_groups, 1e-6,
+                          use_temb=False, dtype=dt, name="resnets_0")(h)
+        h = VAEAttention(self.channels, cfg.norm_num_groups, dt,
+                         name="attentions_0")(h)
+        return ResnetBlock2D(self.channels, cfg.norm_num_groups, 1e-6,
+                             use_temb=False, dtype=dt,
+                             name="resnets_1")(h)
+
+
+class _EncoderDownBlock(nn.Module):
+    cfg: SDVAEConfig
+    channels: int
+    is_last: bool
+
+    @nn.compact
+    def __call__(self, h):
+        cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
+        for j in range(cfg.layers_per_block):
+            h = ResnetBlock2D(self.channels, cfg.norm_num_groups, 1e-6,
+                              use_temb=False, dtype=dt,
+                              name=f"resnets_{j}")(h)
+        if not self.is_last:
+            # diffusers VAE downsample pads (0,1) right/bottom only
+            h = Downsample2D(self.channels, pad=((0, 1), (0, 1)),
+                             dtype=dt, name="downsamplers_0")(h)
+        return h
+
+
+class _DecoderUpBlock(nn.Module):
+    cfg: SDVAEConfig
+    channels: int
+    is_last: bool
+
+    @nn.compact
+    def __call__(self, h):
+        cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
+        for j in range(cfg.layers_per_block + 1):
+            h = ResnetBlock2D(self.channels, cfg.norm_num_groups, 1e-6,
+                              use_temb=False, dtype=dt,
+                              name=f"resnets_{j}")(h)
+        if not self.is_last:
+            h = Upsample2D(self.channels, dtype=dt,
+                           name="upsamplers_0")(h)
+        return h
+
+
+class Encoder(nn.Module):
+    cfg: SDVAEConfig
+
+    @nn.compact
+    def __call__(self, pixels):
+        cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
+        h = nn.Conv(cfg.block_out_channels[0], (3, 3),
+                    padding=((1, 1), (1, 1)), dtype=dt,
+                    name="conv_in")(pixels)
+        n = len(cfg.block_out_channels)
+        for i, ch in enumerate(cfg.block_out_channels):
+            h = _EncoderDownBlock(cfg, ch, is_last=(i == n - 1),
+                                  name=f"down_blocks_{i}")(h)
+        h = _VAEMidBlock(cfg, cfg.block_out_channels[-1],
+                         name="mid_block")(h)
+        h = nn.GroupNorm(num_groups=cfg.norm_num_groups, epsilon=1e-6,
+                         name="conv_norm_out")(h)
+        return nn.Conv(2 * cfg.latent_channels, (3, 3),
+                       padding=((1, 1), (1, 1)), dtype=dt,
+                       name="conv_out")(jax.nn.silu(h))
+
+
+class Decoder(nn.Module):
+    cfg: SDVAEConfig
+
+    @nn.compact
+    def __call__(self, latent):
+        cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
+        rev = list(reversed(cfg.block_out_channels))
+        h = nn.Conv(rev[0], (3, 3), padding=((1, 1), (1, 1)), dtype=dt,
+                    name="conv_in")(latent)
+        h = _VAEMidBlock(cfg, rev[0], name="mid_block")(h)
+        n = len(rev)
+        for i, ch in enumerate(rev):
+            h = _DecoderUpBlock(cfg, ch, is_last=(i == n - 1),
+                                name=f"up_blocks_{i}")(h)
+        h = nn.GroupNorm(num_groups=cfg.norm_num_groups, epsilon=1e-6,
+                         name="conv_norm_out")(h)
+        return nn.Conv(cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
+                       dtype=dt, name="conv_out")(jax.nn.silu(h))
+
+
+class SDAutoencoderKL(nn.Module):
+    """encode → diagonal Gaussian moments; decode ← latents. Forward
+    contract matches the compact tower (`autoencoder_kl.AutoencoderKL`)."""
+
+    config: SDVAEConfig
+
+    def setup(self):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        self.encoder = Encoder(cfg, name="encoder")
+        self.decoder = Decoder(cfg, name="decoder")
+        self.quant_conv = nn.Conv(2 * cfg.latent_channels, (1, 1),
+                                  dtype=dt, name="quant_conv")
+        self.post_quant_conv = nn.Conv(cfg.latent_channels, (1, 1),
+                                       dtype=dt, name="post_quant_conv")
+
+    def encode(self, pixels):
+        moments = self.quant_conv(self.encoder(pixels))
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, latent):
+        return self.decoder(self.post_quant_conv(latent))
+
+    def __call__(self, pixels, rng=None):
+        mean, logvar = self.encode(pixels)
+        if rng is not None:
+            latent = mean + jnp.exp(0.5 * logvar) * \
+                jax.random.normal(rng, mean.shape)
+        else:
+            latent = mean
+        return self.decode(latent), mean, logvar

@@ -239,6 +239,41 @@ def invert_import(torch_to_params_fn, template: Mapping[str, Any],
     return out
 
 
+def load_weight_files(ckpt_dir: str, stem: str) -> dict:
+    """Merge a checkpoint's weight files for one canonical `stem`
+    (e.g. ``pytorch_model`` or ``diffusion_pytorch_model``): the exact
+    ``{stem}.safetensors`` if present, else sharded
+    ``{stem}*.safetensors``, else ``{stem}*.bin``. Variant files a full
+    HF snapshot may carry (``.fp16``, ``.non_ema``) are only read when
+    no canonical file exists."""
+    import glob
+    import os
+
+    exact = os.path.join(ckpt_dir, f"{stem}.safetensors")
+    st_files = [exact] if os.path.exists(exact) else sorted(
+        f for f in glob.glob(os.path.join(ckpt_dir,
+                                          f"{stem}*.safetensors"))
+        if ".fp16." not in f and ".non_ema." not in f) or sorted(
+        glob.glob(os.path.join(ckpt_dir, f"{stem}*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+        state: dict = {}
+        for f in st_files:
+            with safe_open(f, framework="pt") as sf:
+                for key in sf.keys():
+                    state[key] = sf.get_tensor(key)
+        return state
+    import torch
+    state = {}
+    for f in sorted(glob.glob(os.path.join(ckpt_dir, f"{stem}*.bin"))):
+        state.update(torch.load(f, map_location="cpu",
+                                weights_only=True))
+    if not state:
+        raise FileNotFoundError(
+            f"no {stem}*.safetensors / {stem}*.bin under {ckpt_dir}")
+    return state
+
+
 def load_torch_checkpoint(ckpt_dir: str) -> Mapping[str, Any]:
     """State dict from a reference-format checkpoint dir, trying the
     file names the reference publishes under (HF pytorch_model.bin or
