@@ -102,6 +102,8 @@ def _main() -> None:
         return _run_large()
     if mode == "sharded":
         return _run_sharded()
+    if mode == "decode":
+        return _run_decode()
 
     batches = os.environ.get("BENCH_BATCH")
     # OOM-fallback ladder: the tuned per-chip batch first, then safer
@@ -288,6 +290,129 @@ def _run_sharded() -> None:
             flops_attn_term=12.0 * config.num_hidden_layers *
             config.hidden_size * seq, extra_args=extra):
         raise RuntimeError("bench-sharded: OOM")
+
+
+def _run_decode() -> None:
+    """BENCH_CONFIG=decode: jitted KV-cached generation throughput
+    (VERDICT r4 item 5; reference serving analog:
+    fengshen/examples/ziya_inference — greedy/sampled causal decode —
+    and the qa_t5/summary beam decodes).
+
+    Default row: greedy decode on the 300M-shape LLaMA (bf16, flash
+    prefill, scan KV cache); BENCH_INT8_LMHEAD=1 measures the int8
+    serving head. BENCH_DECODE=beam instead measures num_beams=4
+    seq2seq beam search on a Randeng-T5-ish encoder-decoder. Metric is
+    GENERATED tokens/sec/chip (prompt prefill included in the time).
+    CPU-smokable with the usual BENCH_* shrinks + BENCH_NEW_TOKENS.
+    """
+    import os
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
+
+    n_dev = len(jax.devices())
+    batch = int(os.environ.get("BENCH_BATCH", "8")) * n_dev
+    prompt = int(os.environ.get("BENCH_PROMPT", "128"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "512"))
+    runs = max(1, int(os.environ.get("BENCH_DECODE_RUNS", "3")))
+    rng = np.random.RandomState(0)
+    # shard the batch over all chips (the serving layout); params stay
+    # replicated — without this a multi-chip host would decode on one
+    # device and the /n_dev per-chip number would lie
+    mesh = make_mesh(MeshConfig(data=n_dev, fsdp=1, sequence=1, tensor=1))
+    set_mesh(mesh)
+    batch_sh = NamedSharding(mesh, P(("data",)))
+
+    if os.environ.get("BENCH_DECODE", "greedy") == "beam":
+        from fengshen_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+        from fengshen_tpu.utils.generate import seq2seq_generate
+
+        config = T5Config(
+            vocab_size=int(os.environ.get("BENCH_VOCAB", "32128")),
+            d_model=int(os.environ.get("BENCH_HIDDEN", "768")),
+            d_kv=64,
+            d_ff=int(os.environ.get("BENCH_INTER", "2048")),
+            num_layers=int(os.environ.get("BENCH_LAYERS", "12")),
+            num_heads=int(os.environ.get("BENCH_HEADS", "12")),
+            dtype="bfloat16", tie_word_embeddings=False,
+            # cache must out-size max_new_tokens or seq2seq_generate
+            # silently falls back to the uncached O(L^2) re-run path —
+            # the row must measure the KV-cached serving loop
+            decode_cache_length=new_tokens + prompt + 8)
+        model = T5ForConditionalGeneration(config)
+        src = jax.device_put(
+            jnp.asarray(rng.randint(1, config.vocab_size - 1,
+                                    (batch, prompt)), jnp.int32),
+            batch_sh)
+        params = jax.jit(lambda r: model.init(
+            r, jnp.zeros((1, 8), jnp.int32),
+            jnp.zeros((1, 4), jnp.int32))["params"])(jax.random.PRNGKey(0))
+
+        @jax.jit
+        def _gen(params, src):
+            return seq2seq_generate(
+                model, params, src, max_new_tokens=new_tokens,
+                num_beams=4, eos_token_id=None, pad_token_id=0,
+                decoder_start_token_id=0)
+
+        def decode():
+            return _gen(params, src)
+        metric = "t5beam4_decode_tokens_per_sec_per_chip"
+    else:
+        from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from fengshen_tpu.utils.generate import generate
+
+        config = LlamaConfig(
+            vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", "1024")),
+            intermediate_size=int(os.environ.get("BENCH_INTER", "2816")),
+            num_hidden_layers=int(os.environ.get("BENCH_LAYERS", "16")),
+            num_attention_heads=int(os.environ.get("BENCH_HEADS", "8")),
+            max_position_embeddings=prompt + new_tokens,
+            dtype="bfloat16", scan_layers=True,
+            attention_impl=os.environ.get("BENCH_ATTN", "flash"),
+            int8_lm_head=bool(int(os.environ.get("BENCH_INT8_LMHEAD",
+                                                 "0"))))
+        model = LlamaForCausalLM(config)
+        ids = jax.device_put(
+            jnp.asarray(rng.randint(1, config.vocab_size - 1,
+                                    (batch, prompt)), jnp.int32),
+            batch_sh)
+        params = jax.jit(lambda r: model.init(
+            r, jnp.zeros((1, 8), jnp.int32))["params"])(
+            jax.random.PRNGKey(0))
+
+        @jax.jit
+        def _gen(params, ids):
+            return generate(model, params, ids,
+                            max_new_tokens=new_tokens,
+                            eos_token_id=None, pad_token_id=0)
+
+        def decode():
+            return _gen(params, ids)
+        metric = ("llama300m_int8_decode_tokens_per_sec_per_chip"
+                  if config.int8_lm_head else
+                  "llama300m_decode_tokens_per_sec_per_chip")
+
+    jax.block_until_ready(decode())  # compile
+    _watchdog()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = decode()
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    set_mesh(None)
+    tps = batch * new_tokens * runs / dt
+    # no MFU target for decode (bandwidth-bound); vs_baseline is
+    # tokens/sec/chip relative to the training north-star scale (40%
+    # MFU train ≈ 43k tok/s at 300M) — a rough single-number context
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tps / n_dev, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps / n_dev / 43000.0, 4),
+    }))
 
 
 def _run(per_chip_batch: int) -> None:

@@ -65,3 +65,30 @@ def test_bench_large_ladder_rung(monkeypatch):
                                    "BENCH_KV": "2",
                                    "BENCH_FUSED_CE": "4"})
     assert row["metric"].startswith("llama13bshape_l2")
+
+
+def test_bench_decode_greedy(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_CONFIG": "decode",
+                                   "BENCH_PROMPT": "16",
+                                   "BENCH_NEW_TOKENS": "16",
+                                   "BENCH_DECODE_RUNS": "1"})
+    assert row["metric"] == "llama300m_decode_tokens_per_sec_per_chip"
+
+
+def test_bench_decode_int8(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_CONFIG": "decode",
+                                   "BENCH_INT8_LMHEAD": "1",
+                                   "BENCH_PROMPT": "16",
+                                   "BENCH_NEW_TOKENS": "16",
+                                   "BENCH_DECODE_RUNS": "1"})
+    assert row["metric"] == \
+        "llama300m_int8_decode_tokens_per_sec_per_chip"
+
+
+def test_bench_decode_beam(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_CONFIG": "decode",
+                                   "BENCH_DECODE": "beam",
+                                   "BENCH_PROMPT": "16",
+                                   "BENCH_NEW_TOKENS": "16",
+                                   "BENCH_DECODE_RUNS": "1"})
+    assert row["metric"] == "t5beam4_decode_tokens_per_sec_per_chip"

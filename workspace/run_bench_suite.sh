@@ -43,6 +43,15 @@ BENCH_CONFIG=sharded BENCH_OFFLOAD=1 python bench.py | tee /tmp/bench_offload.js
 
 echo "== probe"; probe || exit 1
 
+echo "== decode throughput: greedy KV-cached (300M shape)"
+BENCH_CONFIG=decode python bench.py | tee /tmp/bench_decode_greedy.json
+echo "== decode throughput: int8 LM head"
+BENCH_CONFIG=decode BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_decode_int8.json
+echo "== decode throughput: seq2seq beam-4 (T5-base shape)"
+BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_beam.json
+
+echo "== probe"; probe || exit 1
+
 echo "== block-sparse vs dense flash timing (S=4096/8192)"
 python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
 
