@@ -1,0 +1,403 @@
+"""Host-resident parameter streaming — the ZeRO-3 / param-offload analog.
+
+The reference's offload surface moves BOTH optimizer state and params to
+CPU (reference: fengshen/strategies/megatron_deepspeed.py:55-104
+`offload_optimizer` / `offload_param` device=cpu|nvme; the "7 GB finetune
+of 1.3B" recipe fengshen/examples/classification/
+demo_classification_afqmc_erlangshen_offload.sh:9-33). The existing
+`--offload_optimizer` parks the adam moments host-side; this module goes
+the rest of the way: PARAMETERS live in host memory and stream to HBM one
+transformer layer at a time inside the step, so device memory holds one
+layer's params + grads + moments plus the boundary activations — never
+the whole model.
+
+Mechanism (XLA in this build cannot annotate memory spaces inside one
+SPMD program — same constraint as the offloaded optimizer step, see
+trainer.py `_build_offloaded_train_step`): the step is decomposed into
+per-layer jitted programs with H2D/D2H transfers between them.
+
+  forward   h0 = bottom(p_bot, batch)           # embeddings
+            h_{l+1} = layer(p_l ⇐ host, h_l)    # one layer in HBM
+  top       loss, g_top, g_h = grad(top)(p_top, h_L, batch)
+  backward  g_l, g_h = vjp(layer)(p_l ⇐ host, h_l, g_h)   # recompute
+            g_l ⇒ host                                    # grads park
+  update    for every part: p, g, m, v ⇐ host → adamw → ⇒ host
+
+The update applies optax-equivalent clip_by_global_norm + AdamW (bias
+correction, decoupled weight decay) one part at a time, so global-norm
+clipping stays exact while HBM never holds more than one part's
+(p, g, m, v) quadruple. The price is one extra forward (vjp recompute —
+the same trade `jax.checkpoint` makes) plus PCIe/DMA traffic per layer;
+the reward is fitting models whose params + moments dwarf HBM.
+
+Two family splits ship: the flagship LLaMA causal LM and the
+classification TaskModel over a MegatronBert backbone (the AFQMC 7 GB
+recipe). Both are parity-tested against the monolithic jitted step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class StreamSpec:
+    """A model factored into bottom / repeated layer / top segments.
+
+    bottom_fn(p, batch, rng) -> h0
+    layer_fn(p, h, batch, rng) -> h
+    top_fn(p, h, batch, rng) -> (loss, metrics_dict)
+    """
+
+    bottom_fn: Callable
+    layer_fn: Callable
+    top_fn: Callable
+    bottom: Any
+    layers: list
+    top: Any
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+def _zeros_like_host(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, x.dtype), tree)
+
+
+def _sq_norm_host(tree) -> float:
+    """Squared global norm of an already-hosted numpy tree — no extra
+    device round-trips on the streaming critical path."""
+    return float(sum(
+        float(np.vdot(g.astype(np.float32), g.astype(np.float32)))
+        for g in jax.tree_util.tree_leaves(tree)))
+
+
+class StreamedAdamW:
+    """Streaming train step with an exact optax
+    `chain(clip_by_global_norm, adamw)` update (no weight-decay mask)."""
+
+    def __init__(self, spec: StreamSpec, learning_rate: float = 1e-5,
+                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0, clip_norm: float = 1.0,
+                 lr_schedule: Optional[Callable[[int], float]] = None,
+                 use_decay_mask: bool = False):
+        self.spec = spec
+        self.hparams = (b1, b2, eps, weight_decay)
+        self.learning_rate = learning_rate
+        self.lr_schedule = lr_schedule
+        self.clip_norm = clip_norm
+        self.count = 0
+        # host-resident master copies: params + adam moments per part
+        self.parts = [_host(spec.bottom)] + \
+            [_host(p) for p in spec.layers] + [_host(spec.top)]
+        self.m = [_zeros_like_host(p) for p in self.parts]
+        self.v = [_zeros_like_host(p) for p in self.parts]
+        if use_decay_mask:
+            # the recipe's no-decay grouping: biases/LayerNorm excluded
+            # (model_utils.decay_mask_fn parity)
+            from fengshen_tpu.models.model_utils import decay_mask_fn
+            self.masks = [jax.tree_util.tree_map(
+                np.float32, decay_mask_fn(p)) for p in self.parts]
+        else:
+            self.masks = [jax.tree_util.tree_map(
+                lambda x: np.float32(1.0), p) for p in self.parts]
+        self._jits: dict = {}
+
+    # -- jitted programs (compiled once; shapes repeat across layers) ----
+    def _fwd_bottom(self):
+        if "fb" not in self._jits:
+            self._jits["fb"] = jax.jit(self.spec.bottom_fn)
+        return self._jits["fb"]
+
+    def _fwd_layer(self):
+        if "fl" not in self._jits:
+            self._jits["fl"] = jax.jit(self.spec.layer_fn)
+        return self._jits["fl"]
+
+    def _grad_top(self):
+        if "gt" not in self._jits:
+            def run(p, h, batch, rng):
+                def f(p, h):
+                    return self.spec.top_fn(p, h, batch, rng)
+                (loss, metrics), (gp, gh) = jax.value_and_grad(
+                    f, argnums=(0, 1), has_aux=True)(p, h)
+                return loss, metrics, gp, gh
+            self._jits["gt"] = jax.jit(run)
+        return self._jits["gt"]
+
+    def _vjp_layer(self):
+        if "vl" not in self._jits:
+            def run(p, h, batch, rng, g_out):
+                def f(p, h):
+                    return self.spec.layer_fn(p, h, batch, rng)
+                _, vjp = jax.vjp(f, p, h)
+                gp, gh = vjp(g_out)
+                return gp, gh
+            self._jits["vl"] = jax.jit(run)
+        return self._jits["vl"]
+
+    def _vjp_bottom(self):
+        if "vb" not in self._jits:
+            def run(p, batch, rng, g_out):
+                def f(p):
+                    return self.spec.bottom_fn(p, batch, rng)
+                _, vjp = jax.vjp(f, p)
+                return vjp(g_out)[0]
+            self._jits["vb"] = jax.jit(run)
+        return self._jits["vb"]
+
+    def _update(self):
+        if "up" not in self._jits:
+            b1, b2, eps, wd = self.hparams
+
+            def run(p, g, m, v, mask, scale, lr, count):
+                def leaf(p, g, m, v, mask):
+                    g = (g * scale).astype(m.dtype)
+                    m2 = b1 * m + (1 - b1) * g
+                    v2 = b2 * v + (1 - b2) * g * g
+                    mhat = m2 / (1 - b1 ** count)
+                    vhat = v2 / (1 - b2 ** count)
+                    upd = mhat / (jnp.sqrt(vhat) + eps) + wd * mask * p
+                    return (p - lr * upd).astype(p.dtype), m2, v2
+                out = jax.tree_util.tree_map(leaf, p, g, m, v, mask)
+                new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                               is_leaf=lambda t:
+                                               isinstance(t, tuple))
+                new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                               is_leaf=lambda t:
+                                               isinstance(t, tuple))
+                new_v = jax.tree_util.tree_map(lambda t: t[2], out,
+                                               is_leaf=lambda t:
+                                               isinstance(t, tuple))
+                return new_p, new_m, new_v
+            self._jits["up"] = jax.jit(run, donate_argnums=(0, 1, 2, 3))
+        return self._jits["up"]
+
+    # -- the streamed step ----------------------------------------------
+    def step(self, batch, rng=None):
+        rng = jax.random.PRNGKey(0) if rng is None else rng
+        n_layers = len(self.spec.layers)
+        rngs = jax.random.split(rng, n_layers + 2)
+        dev = jax.device_put
+
+        # forward: boundaries[l] is the INPUT to layer l
+        h = self._fwd_bottom()(dev(self.parts[0]), batch, rngs[0])
+        boundaries = [h]
+        for l in range(n_layers):
+            h = self._fwd_layer()(dev(self.parts[1 + l]), h, batch,
+                                  rngs[1 + l])
+            if l < n_layers - 1:
+                boundaries.append(h)
+
+        loss, metrics, g_top, g_h = self._grad_top()(
+            dev(self.parts[-1]), h, batch, rngs[-1])
+        grads: list = [None] * (n_layers + 2)
+        grads[-1] = _host(g_top)
+        sq = _sq_norm_host(grads[-1])
+
+        # backward: stream each layer a second time, recompute via vjp
+        for l in reversed(range(n_layers)):
+            g_l, g_h = self._vjp_layer()(
+                dev(self.parts[1 + l]), boundaries[l], batch,
+                rngs[1 + l], g_h)
+            grads[1 + l] = _host(g_l)
+            sq += _sq_norm_host(grads[1 + l])
+        g_bot = self._vjp_bottom()(dev(self.parts[0]), batch, rngs[0],
+                                   g_h)
+        grads[0] = _host(g_bot)
+        sq += _sq_norm_host(grads[0])
+
+        # optax clip_by_global_norm: scale only when the norm exceeds
+        global_norm = float(np.sqrt(sq))
+        scale = 1.0 if (self.clip_norm is None or
+                        global_norm <= self.clip_norm) else \
+            self.clip_norm / max(global_norm, 1e-12)
+
+        self.count += 1
+        lr = self.lr_schedule(self.count) if self.lr_schedule else \
+            self.learning_rate
+        for i in range(len(self.parts)):
+            p, m, v = self._update()(
+                dev(self.parts[i]), dev(grads[i]), dev(self.m[i]),
+                dev(self.v[i]), dev(self.masks[i]), jnp.float32(scale),
+                jnp.float32(lr), jnp.int32(self.count))
+            self.parts[i], self.m[i], self.v[i] = \
+                _host(p), _host(m), _host(v)
+            grads[i] = None  # free host grad as soon as it's consumed
+        metrics = {k: float(vv) for k, vv in (metrics or {}).items()}
+        metrics["grad_norm"] = global_norm
+        return float(loss), metrics
+
+    def params(self):
+        """Joined params pytree (host copies → jnp) for eval/predict."""
+        return self._join(self.parts[0],
+                          self.parts[1:-1], self.parts[-1])
+
+    def _join(self, bottom, layers, top):
+        raise NotImplementedError  # installed by the spec factory
+
+
+# -- family split: LLaMA causal LM ----------------------------------------
+
+def llama_stream_spec(config, params,
+                      deterministic: bool = True) -> StreamSpec:
+    """Factor LlamaForCausalLM params into embed / decoder layers /
+    (norm + lm_head + causal CE)."""
+    from fengshen_tpu.models.llama.modeling_llama import LlamaDecoderLayer
+    from fengshen_tpu.ops.norms import RMSNorm
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    model_p = params["model"]
+    if config.scan_layers:
+        stacked = model_p["layers"]["layer"]
+        layers = [jax.tree_util.tree_map(lambda x: x[i], stacked)
+                  for i in range(config.num_hidden_layers)]
+    else:
+        layers = [model_p[f"layers_{i}"]
+                  for i in range(config.num_hidden_layers)]
+    bottom = {"embed_tokens": model_p["embed_tokens"]}
+    top = {"norm": model_p["norm"], "lm_head": params["lm_head"]}
+    dt = jnp.dtype(config.dtype)
+
+    def bottom_fn(p, batch, rng):
+        table = p["embed_tokens"]["embedding"]
+        return jnp.take(table, batch["input_ids"], axis=0).astype(dt)
+
+    layer_mod = LlamaDecoderLayer(config)
+
+    def layer_fn(p, h, batch, rng):
+        return layer_mod.apply(
+            {"params": p}, h, batch.get("attention_mask"),
+            deterministic=deterministic)
+
+    norm_mod = RMSNorm(epsilon=config.rms_norm_eps)
+
+    def top_fn(p, h, batch, rng):
+        h = norm_mod.apply({"params": p["norm"]}, h)
+        logits = h @ p["lm_head"]["kernel"].astype(h.dtype)
+        labels = batch.get("labels", batch["input_ids"])
+        loss, n = stable_cross_entropy(logits[:, :-1], labels[:, 1:])
+        return loss, {"n_tokens": n}
+
+    spec = StreamSpec(bottom_fn, layer_fn, top_fn, bottom, layers, top)
+
+    def join(bottom, layers, top):
+        if config.scan_layers:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                *layers)
+            model = {"embed_tokens": bottom["embed_tokens"],
+                     "layers": {"layer": stacked},
+                     "norm": top["norm"]}
+        else:
+            model = {"embed_tokens": bottom["embed_tokens"],
+                     "norm": top["norm"]}
+            for i, l in enumerate(layers):
+                model[f"layers_{i}"] = l
+        return {"model": model, "lm_head": top["lm_head"]}
+
+    spec.join = join
+    return spec
+
+
+# -- family split: classification TaskModel over MegatronBert -------------
+
+def megatron_classifier_stream_spec(config, params, num_labels: int,
+                                    deterministic: bool = True
+                                    ) -> StreamSpec:
+    """Factor the AFQMC TaskModel (erlangshen/MegatronBert backbone +
+    cls_layer) for streaming — the mechanical 7 GB recipe
+    (reference: demo_classification_afqmc_erlangshen_offload.sh:9-33).
+
+    `deterministic=False` trains with the config's dropout, driven by
+    the per-layer rng the engine threads through — the vjp recompute
+    reuses the SAME rng, so forward and backward see identical masks."""
+    from flax import linen as nn
+
+    from fengshen_tpu.models.megatron_bert.modeling_megatron_bert import (
+        LayerNorm, MegatronBertLayer)
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    enc = params["bert_encoder"]
+    if config.scan_layers:
+        stacked = enc["layer"]["block"]
+        layers = [jax.tree_util.tree_map(lambda x: x[i], stacked)
+                  for i in range(config.num_hidden_layers)]
+    else:
+        layers = [enc[f"layer_{i}"]
+                  for i in range(config.num_hidden_layers)]
+    bottom = {k: enc[k] for k in ("word_embeddings",
+                                  "position_embeddings",
+                                  "token_type_embeddings")}
+    top = {"ln": enc["ln"], "pooler": enc["pooler"],
+           "cls_layer": params["cls_layer"]}
+    dt = jnp.dtype(config.dtype)
+
+    def bottom_fn(p, batch, rng):
+        ids = batch["input_ids"]
+        seq = ids.shape[1]
+        tok_type = batch.get("token_type_ids", jnp.zeros_like(ids))
+        h = jnp.take(p["word_embeddings"]["embedding"], ids, axis=0) + \
+            p["position_embeddings"]["embedding"][None, :seq] + \
+            jnp.take(p["token_type_embeddings"]["embedding"], tok_type,
+                     axis=0)
+        h = h.astype(dt)
+        if not deterministic:
+            h = nn.Dropout(config.hidden_dropout_prob).apply(
+                {}, h, deterministic=False, rngs={"dropout": rng})
+        return h
+
+    layer_mod = MegatronBertLayer(config)
+
+    def layer_fn(p, h, batch, rng):
+        return layer_mod.apply({"params": p}, h,
+                               batch.get("attention_mask"),
+                               deterministic=deterministic,
+                               rngs=None if deterministic else
+                               {"dropout": rng})
+
+    ln_mod = LayerNorm(epsilon=config.layer_norm_eps)
+
+    def top_fn(p, h, batch, rng):
+        h = ln_mod.apply({"params": p["ln"]}, h)
+        pooled = jnp.tanh(
+            h[:, 0] @ p["pooler"]["kernel"].astype(h.dtype) +
+            p["pooler"]["bias"].astype(h.dtype))
+        logits = pooled @ p["cls_layer"]["kernel"].astype(h.dtype) + \
+            p["cls_layer"]["bias"].astype(h.dtype)
+        labels = batch["labels"]
+        loss, _ = stable_cross_entropy(logits[:, None, :],
+                                       labels[:, None])
+        acc = jnp.mean(logits.argmax(-1) == labels)
+        return loss, {"acc": acc}
+
+    spec = StreamSpec(bottom_fn, layer_fn, top_fn, bottom, layers, top)
+
+    def join(bottom, layers, top):
+        if config.scan_layers:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                *layers)
+            enc = {**bottom, "layer": {"block": stacked},
+                   "ln": top["ln"], "pooler": top["pooler"]}
+        else:
+            enc = {**bottom, "ln": top["ln"], "pooler": top["pooler"]}
+            for i, l in enumerate(layers):
+                enc[f"layer_{i}"] = l
+        return {"bert_encoder": enc, "cls_layer": top["cls_layer"]}
+
+    spec.join = join
+    return spec
+
+
+def make_streamed(spec: StreamSpec, **kw) -> StreamedAdamW:
+    eng = StreamedAdamW(spec, **kw)
+    eng._join = lambda b, ls, t: spec.join(b, ls, t)
+    return eng

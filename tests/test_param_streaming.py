@@ -1,0 +1,182 @@
+"""Host-resident parameter streaming (trainer/param_streaming.py — the
+ZeRO-3/offload-param analog, VERDICT r4 missing #4).
+
+The streamed step must be EXACTLY the monolithic jitted step, just
+scheduled differently: same loss, same post-update params as
+optax.chain(clip_by_global_norm, adamw) over the whole tree at once.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fengshen_tpu.trainer.param_streaming import (
+    StreamedAdamW, llama_stream_spec, make_streamed,
+    megatron_classifier_stream_spec)
+
+HP = dict(learning_rate=3e-3, weight_decay=0.01, clip_norm=1.0)
+
+
+def _ref_update(loss_fn, params, batch, steps=2):
+    tx = optax.chain(optax.clip_by_global_norm(HP["clip_norm"]),
+                     optax.adamw(HP["learning_rate"],
+                                 weight_decay=HP["weight_decay"]))
+    opt = tx.init(params)
+    losses = []
+    step = jax.jit(lambda p, o, b: _step(p, o, b))
+
+    def _step(p, o, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        upd, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, upd), o, loss
+
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, batch)
+        losses.append(float(loss))
+    return params, losses
+
+
+def _assert_tree_close(a, b, atol=2e-5):
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = dict(jax.tree_util.tree_flatten_with_path(b)[0])
+    assert len(fa) == len(fb)
+    for path, leaf in fa:
+        np.testing.assert_allclose(
+            np.asarray(leaf, np.float32),
+            np.asarray(fb[path], np.float32), atol=atol,
+            err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_llama_streamed_step_matches_monolithic(scan):
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=3, num_attention_heads=4,
+                      max_position_embeddings=32, dtype="float32",
+                      param_dtype="float32", scan_layers=scan)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, 96, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+    batch = {"input_ids": ids}
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["input_ids"])
+        return stable_cross_entropy(logits[:, :-1],
+                                    b["input_ids"][:, 1:])[0]
+
+    ref_params, ref_losses = _ref_update(loss_fn, params, batch)
+
+    eng = make_streamed(llama_stream_spec(cfg, params), **HP)
+    losses = [eng.step(batch)[0] for _ in range(2)]
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-5)
+    _assert_tree_close(eng.params(), ref_params)
+
+
+def test_megatron_classifier_streamed_step_matches_monolithic():
+    from fengshen_tpu.examples.classification.finetune_classification \
+        import TaskModel
+    from fengshen_tpu.models.megatron_bert import MegatronBertConfig
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    cfg = MegatronBertConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, dtype="float32",
+        param_dtype="float32", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    model = TaskModel(cfg, "huggingface-megatron_bert", num_labels=3)
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(1, 96, (4, 12)), jnp.int32)
+    mask = jnp.ones_like(ids)
+    labels = jnp.asarray(rng.randint(0, 3, (4,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    batch = {"input_ids": ids, "attention_mask": mask, "labels": labels}
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["input_ids"],
+                             attention_mask=b["attention_mask"])
+        return stable_cross_entropy(logits[:, None, :],
+                                    b["labels"][:, None])[0]
+
+    ref_params, ref_losses = _ref_update(loss_fn, params, batch)
+
+    eng = make_streamed(
+        megatron_classifier_stream_spec(cfg, params, num_labels=3), **HP)
+    losses = [eng.step(batch)[0] for _ in range(2)]
+    np.testing.assert_allclose(losses, ref_losses, atol=1e-5)
+    _assert_tree_close(eng.params(), ref_params)
+    # metrics come through
+    _, metrics = eng.step(batch)
+    assert "acc" in metrics and "grad_norm" in metrics
+
+
+def test_streamed_clip_engages():
+    """With a tiny clip threshold the streamed update must scale exactly
+    like optax.clip_by_global_norm."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    cfg = LlamaConfig(vocab_size=61, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      max_position_embeddings=16, dtype="float32",
+                      param_dtype="float32", scan_layers=True)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.RandomState(2).randint(1, 60, (2, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    batch = {"input_ids": ids}
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["input_ids"])
+        return stable_cross_entropy(logits[:, :-1],
+                                    b["input_ids"][:, 1:])[0]
+
+    hp = dict(HP, clip_norm=1e-3)  # definitely engages
+    tx = optax.chain(optax.clip_by_global_norm(1e-3),
+                     optax.adamw(hp["learning_rate"],
+                                 weight_decay=hp["weight_decay"]))
+    opt = tx.init(params)
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    upd, opt = tx.update(grads, opt, params)
+    ref_params = optax.apply_updates(params, upd)
+
+    eng = make_streamed(llama_stream_spec(cfg, params), **hp)
+    eng.step(batch)
+    _assert_tree_close(eng.params(), ref_params)
+
+
+@pytest.mark.slow
+def test_offload_params_e2e(tmp_path, mesh8):
+    """finetune_classification --offload_params: the streamed AFQMC
+    recipe end-to-end (train → predict → save_test)."""
+    import json
+
+    from tests.test_classification_port import (_write_model_dir,
+                                                _write_task_dir)
+    from fengshen_tpu.examples.classification import (
+        finetune_classification as fc)
+
+    data_dir = _write_task_dir(tmp_path)
+    model_dir = _write_model_dir(tmp_path, model_type="megatron-bert")
+    out = tmp_path / "pred.json"
+    fc.main([
+        "--data_dir", str(data_dir), "--train_data", "train.json",
+        "--valid_data", "dev.json", "--test_data", "test.json",
+        "--pretrained_model_path", str(model_dir),
+        "--model_type", "huggingface-megatron_bert",
+        "--texta_name", "sentence1", "--textb_name", "sentence2",
+        "--max_length", "32", "--train_batchsize", "4",
+        "--valid_batchsize", "4", "--max_epochs", "1",
+        "--learning_rate", "1e-4", "--offload_params",
+        "--output_save_path", str(out),
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--precision", "fp32"])
+    lines = [json.loads(x) for x in open(str(out) + ".0")]
+    assert len(lines) == 6
+    assert sorted(l["id"] for l in lines) == list(range(6))

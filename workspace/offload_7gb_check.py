@@ -1,0 +1,95 @@
+"""Measured 7GB claim (VERDICT r4 missing #4 / weak #3): run the 1.3B
+AFQMC-shape recipe with host-resident parameter streaming on the real
+chip and record the HBM high-water mark.
+
+Reference claim: demo_classification_afqmc_erlangshen_offload.sh:9-33
+finetunes Erlangshen-MegatronBert-1.3B on one 8GB GPU via DeepSpeed
+ZeRO-3 + offload. Analog here: `--offload_params` streams layer params
++ adam moments from host memory (trainer/param_streaming.py), so HBM
+holds one layer's working set + boundary activations.
+
+Run ONLY after the relay probe succeeds (never wrap in `timeout`).
+Prints one JSON line with peak HBM bytes; paste into
+docs/performance.md replacing the analytic argument (commit 150651b).
+"""
+
+import json
+import os
+import threading
+import time
+
+_done = threading.Event()
+DEADLINE = float(os.environ.get("CHECK_DEADLINE", "1800"))
+
+
+def _watch():
+    if not _done.wait(DEADLINE):
+        import sys
+        sys.stderr.write("offload_7gb_check: WEDGED, aborting\n")
+        os._exit(3)
+
+
+threading.Thread(target=_watch, daemon=True).start()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fengshen_tpu.examples.classification.finetune_classification import (  # noqa: E402
+    TaskModel)
+from fengshen_tpu.models.megatron_bert import MegatronBertConfig  # noqa: E402
+from fengshen_tpu.trainer.param_streaming import (  # noqa: E402
+    make_streamed, megatron_classifier_stream_spec)
+from fengshen_tpu.utils.utils import report_memory  # noqa: E402
+
+# Erlangshen-MegatronBert-1.3B shape (reference config): hidden 2048,
+# 24 layers, 32 heads, ffn 8192 — the afqmc recipe at seq 128, batch 16
+cfg = MegatronBertConfig(
+    vocab_size=int(os.environ.get("CHECK_VOCAB", "21128")),
+    hidden_size=int(os.environ.get("CHECK_HIDDEN", "2048")),
+    num_hidden_layers=int(os.environ.get("CHECK_LAYERS", "24")),
+    num_attention_heads=int(os.environ.get("CHECK_HEADS", "32")),
+    intermediate_size=int(os.environ.get("CHECK_INTER", "8192")),
+    max_position_embeddings=512, dtype="bfloat16",
+    param_dtype="float32", hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0)
+seq = int(os.environ.get("CHECK_SEQ", "128"))
+batch = int(os.environ.get("CHECK_BATCH", "16"))
+
+model = TaskModel(cfg, "huggingface-megatron_bert", num_labels=2)
+rng = np.random.RandomState(0)
+ids = jnp.asarray(rng.randint(1, cfg.vocab_size - 1, (batch, seq)),
+                  jnp.int32)
+batch_d = {"input_ids": ids,
+           "attention_mask": jnp.ones_like(ids),
+           "labels": jnp.asarray(rng.randint(0, 2, (batch,)), jnp.int32)}
+
+# init on HOST via eval_shape + per-part normal init so the full fp32
+# tree never touches HBM (the whole point of the exercise)
+abstract = jax.eval_shape(
+    lambda: model.init(jax.random.PRNGKey(0), ids[:1, :8]))["params"]
+host_params = jax.tree_util.tree_map(
+    lambda s: (rng.randn(*s.shape) * 0.02).astype(s.dtype), abstract)
+
+spec = megatron_classifier_stream_spec(cfg, host_params, num_labels=2)
+del host_params
+eng = make_streamed(spec, learning_rate=2e-5, weight_decay=0.01,
+                    clip_norm=1.0)
+
+t0 = time.time()
+for step in range(int(os.environ.get("CHECK_STEPS", "3"))):
+    loss, metrics = eng.step(batch_d, jax.random.PRNGKey(step))
+    mem = report_memory(f"step{step}")
+    print(f"step {step}: loss={loss:.4f} "
+          f"grad_norm={metrics['grad_norm']:.3g} "
+          f"dt={time.time()-t0:.1f}s", flush=True)
+
+mem = report_memory("final")
+peak = max(d["peak_bytes_in_use"] for d in mem.values())
+_done.set()
+print(json.dumps({
+    "metric": "afqmc_1p3b_streamed_peak_hbm_gb",
+    "value": round(peak / 1e9, 3),
+    "unit": "GB",
+    "vs_baseline": round(7.0 / max(peak / 1e9, 1e-9), 3),
+}))

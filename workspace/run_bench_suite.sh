@@ -52,6 +52,11 @@ BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_be
 
 echo "== probe"; probe || exit 1
 
+echo "== measured 7GB claim: 1.3B AFQMC shape with param streaming"
+python workspace/offload_7gb_check.py | tee /tmp/bench_offload_7gb.json
+
+echo "== probe"; probe || exit 1
+
 echo "== block-sparse vs dense flash timing (S=4096/8192)"
 python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
 
