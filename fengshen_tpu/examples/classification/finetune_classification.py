@@ -587,7 +587,8 @@ def save_test(data: list, args, data_model: TaskDataModel,
 
 # -- param-streaming fit (ZeRO-3 analog) -----------------------------------
 
-def _fit_streamed(args, module: "ClassificationModule", data_model):
+def _fit_streamed(args, module: "ClassificationModule", data_model,
+                  ckpt=None):
     """Train with host-resident parameter streaming: HBM holds one
     transformer layer's (params, grads, moments) plus boundary
     activations (reference 7GB recipe:
@@ -629,10 +630,26 @@ def _fit_streamed(args, module: "ClassificationModule", data_model):
         b2=getattr(args, "adam_beta2", 0.999),
         eps=getattr(args, "adam_epsilon", 1e-8),
         weight_decay=getattr(args, "weight_decay", 0.01),
-        clip_norm=getattr(args, "gradient_clip_val", 1.0) or 1.0,
+        # 0 = no clipping, exactly like configure_optimizers
+        clip_norm=getattr(args, "gradient_clip_val", 0.0) or None,
         use_decay_mask=True)
 
-    max_steps = getattr(args, "max_steps", 0) or 0
+    class _TrainerView:
+        """What UniversalCheckpoint.save reads off a trainer."""
+        global_step = 0
+        consumed_samples = 0
+
+    view = _TrainerView()
+
+    def _state():
+        return TrainState.create(apply_fn=module.model.apply,
+                                 params=eng.params(),
+                                 tx=optax.set_to_zero())
+
+    # the trainer's default max_steps is -1 ("until the epochs run
+    # out"); only a POSITIVE value limits the streamed loop
+    raw_max = getattr(args, "max_steps", 0) or 0
+    max_steps = raw_max if raw_max > 0 else total_steps
     max_epochs = getattr(args, "max_epochs", None) or 1
     step = 0
     rng = jax.random.PRNGKey(getattr(args, "seed", 42))
@@ -643,6 +660,8 @@ def _fit_streamed(args, module: "ClassificationModule", data_model):
             rng, step_rng = jax.random.split(rng)
             loss, metrics = eng.step(batch, step_rng)
             step += 1
+            view.global_step = step
+            view.consumed_samples = step * args.train_batchsize
             if step % max(getattr(args, "log_every_n_steps", 1), 1) == 0:
                 mem = report_memory("streamed")
                 peak = max((d["peak_bytes_in_use"] for d in mem.values()),
@@ -653,12 +672,18 @@ def _fit_streamed(args, module: "ClassificationModule", data_model):
                     metrics.get("acc", float("nan")),
                     metrics.get("grad_norm", float("nan")),
                     peak / 1e9)
-            if max_steps and step >= max_steps:
+            if ckpt is not None and ckpt.every_n_train_steps and \
+                    step % ckpt.every_n_train_steps == 0:
+                # join the host parts only when a save actually fires
+                ckpt.on_train_step_end(view, _state())
+            if step >= max_steps:
                 break
-        if max_steps and step >= max_steps:
+        if step >= max_steps:
             break
-    return TrainState.create(apply_fn=module.model.apply,
-                             params=eng.params(), tx=optax.set_to_zero())
+    final = _state()
+    if ckpt is not None:
+        ckpt.on_fit_end(view, final)
+    return final
 
 
 # -- main ------------------------------------------------------------------
@@ -709,7 +734,8 @@ def main(argv=None):
     if args.do_predict_only:
         state = trainer.restore_for_predict(module)
     elif getattr(args, "offload_params", False):
-        state = _fit_streamed(args, module, data_model)
+        state = _fit_streamed(args, module, data_model,
+                              ckpt=ckpt.callbacks)
     else:
         state = trainer.fit(module, data_model)
     result = trainer.predict(module, data_model.predict_dataloader(),

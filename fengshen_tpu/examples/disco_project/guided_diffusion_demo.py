@@ -1,9 +1,14 @@
-"""CLIP-guided diffusion (disco-style) demo.
+"""CLIP-guided diffusion (disco) over the SD towers.
 
-Port of the reference project (reference: fengshen/examples/disco_project/
-— disco-diffusion with the Taiyi Chinese CLIP): at each DDPM step the
-latent is nudged by the gradient of the CLIP similarity between the
-decoded image and the text prompt.
+The reference project (reference: fengshen/examples/disco_project/
+disco.py — disco-diffusion with the Taiyi Chinese CLIP) guides every
+denoise step by the gradient of the CLIP similarity between augmented
+cutouts of the decoded image and the text prompt, plus TV/range/sat
+regularizers. The full machinery lives in `guidance.py` (cutouts,
+spherical distance, losses, ε-bending with magnitude clamp); this
+driver wires it to the Taiyi SD towers — the faithful SD-1.x
+architecture when `--sd_pipeline_path` points at a released diffusers
+dir, or compact random-init towers for the demo path.
 """
 
 from __future__ import annotations
@@ -14,44 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def clip_guided_sample(sd_model, sd_params, clip_model, clip_params,
-                       input_ids, clip_text_ids, image_size: int = 64,
-                       num_steps: int = 20, guidance_strength: float = 0.5,
-                       rng=None):
-    """DDPM sampling with CLIP-similarity gradient guidance: the shared
-    text_to_image loop with a per-step latent-guidance hook (the
-    disco-diffusion core)."""
-    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
-        SCALING_FACTOR)
-    from fengshen_tpu.models.stable_diffusion.sampling import text_to_image
-
-    batch = input_ids.shape[0]
-    clip_text = clip_model.apply(
-        {"params": clip_params}, input_ids=clip_text_ids,
-        pixel_values=None)[0]
-
-    def clip_score(latents):
-        pixels = sd_model.apply(
-            {"params": sd_params}, latents / SCALING_FACTOR,
-            method=lambda m, z: m.vae.decode(z))
-        size = clip_model.vision_config.image_size
-        pixels = jax.image.resize(
-            pixels, (batch, size, size, pixels.shape[-1]), "bilinear")
-        _, img_emb, _ = clip_model.apply({"params": clip_params},
-                                         input_ids=None,
-                                         pixel_values=pixels)
-        return (clip_text * img_emb).sum(-1).mean()
-
-    grad_fn = jax.grad(clip_score)
-
-    def guide(latents):
-        return latents + guidance_strength * grad_fn(latents)
-
-    return text_to_image(sd_model, sd_params, input_ids,
-                         image_size=image_size, num_steps=num_steps,
-                         guidance_scale=0.0, rng=rng,
-                         latent_guidance_fn=guide)
+from fengshen_tpu.examples.disco_project.guidance import (DiscoConfig,
+                                                          clip_guided_sample)
 
 
 def main(argv=None):
@@ -59,18 +28,46 @@ def main(argv=None):
     parser.add_argument("--prompt", type=str, default="一幅山水画")
     parser.add_argument("--image_size", type=int, default=32)
     parser.add_argument("--num_steps", type=int, default=4)
+    parser.add_argument("--clip_guidance_scale", type=float, default=500.0)
+    parser.add_argument("--tv_scale", type=float, default=0.0)
+    parser.add_argument("--range_scale", type=float, default=150.0)
+    parser.add_argument("--sat_scale", type=float, default=0.0)
+    parser.add_argument("--sd_pipeline_path", type=str, default=None,
+                        help="released diffusers pipeline dir → faithful "
+                             "SD-1.x towers with imported weights "
+                             "(requires --model_path for the matching "
+                             "Chinese text encoder)")
+    parser.add_argument("--model_path", type=str, default=None,
+                        help="Taiyi text-encoder dir (BertConfig); "
+                             "required with --sd_pipeline_path so the "
+                             "cross-attention dims match")
+    parser.add_argument("--faithful_towers", action="store_true",
+                        default=False)
+    parser.add_argument("--output", type=str, default=None,
+                        help="save the first sample as a PNG")
     args = parser.parse_args(argv)
 
     from fengshen_tpu.models.bert import BertConfig
     from fengshen_tpu.models.clip import CLIPVisionConfig, TaiyiCLIPModel
-    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import VAEConfig
     from fengshen_tpu.models.stable_diffusion.modeling_taiyi_sd import (
         TaiyiStableDiffusion)
-    from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
 
-    text_cfg = BertConfig.small_test_config()
-    sd = TaiyiStableDiffusion(text_cfg, VAEConfig.small_test_config(),
-                              UNetConfig.small_test_config())
+    from fengshen_tpu.models.stable_diffusion.convert import resolve_towers
+
+    if args.sd_pipeline_path:
+        if not args.model_path:
+            raise SystemExit(
+                "--sd_pipeline_path needs --model_path: the released "
+                "UNet's cross-attention expects the matching Chinese "
+                "text encoder (hidden 768), not the demo toy config")
+        text_cfg = BertConfig.from_pretrained(args.model_path)
+    else:
+        text_cfg = BertConfig.small_test_config()
+    unet_cfg, vae_cfg, pipeline_params = resolve_towers(
+        args.sd_pipeline_path, faithful=args.faithful_towers,
+        small_test=True)
+    sd = TaiyiStableDiffusion(text_cfg, vae_cfg, unet_cfg)
+
     vis_cfg = CLIPVisionConfig.small_test_config(
         image_size=args.image_size)
     clip = TaiyiCLIPModel(text_cfg, vis_cfg)
@@ -81,14 +78,31 @@ def main(argv=None):
     from fengshen_tpu.models.stable_diffusion.sampling import (
         init_sampling_params)
     sd_params = init_sampling_params(sd, jax.random.PRNGKey(0), size)
+    if pipeline_params is not None:
+        sd_params = dict(sd_params)
+        sd_params.update(pipeline_params)
     clip_params = clip.init(
         jax.random.PRNGKey(1), ids,
         jnp.zeros((1, vis_cfg.image_size, vis_cfg.image_size, 3)))["params"]
 
-    images = clip_guided_sample(sd, sd_params, clip, clip_params, ids, ids,
-                                image_size=size, num_steps=args.num_steps)
-    print("sampled:", images.shape)
-    return np.asarray(images)
+    config = DiscoConfig(
+        clip_guidance_scale=args.clip_guidance_scale,
+        tv_scale=args.tv_scale, range_scale=args.range_scale,
+        sat_scale=args.sat_scale,
+        # demo shapes are tiny; keep the cutout batches small
+        cut_overview_early=4, cut_overview_late=2,
+        cut_innercut_early=1, cut_innercut_late=2)
+    images = clip_guided_sample(sd, sd_params, clip, clip_params, ids,
+                                ids, image_size=size,
+                                num_steps=args.num_steps, config=config)
+    arr = np.asarray(images)
+    print("sampled:", arr.shape)
+    if args.output:
+        from PIL import Image
+        Image.fromarray(
+            (arr[0] * 255).astype(np.uint8)).save(args.output)
+        print("saved:", args.output)
+    return arr
 
 
 if __name__ == "__main__":

@@ -35,22 +35,19 @@ class TaiyiSDModule(TrainModule):
         if text_config is None and getattr(args, "model_path", None):
             text_config = BertConfig.from_pretrained(args.model_path)
         self._pipeline_params = None
-        if vae_config is None and unet_config is None and \
-                getattr(args, "sd_pipeline_path", None):
-            # a released diffusers pipeline dir: faithful SD-1.x towers
-            # + direct weight import (reference: finetune.py:81-89
-            # StableDiffusionPipeline.from_pretrained)
+        if vae_config is None and unet_config is None and (
+                getattr(args, "sd_pipeline_path", None) or
+                getattr(args, "faithful_towers", False)):
+            # released diffusers dir → faithful SD-1.x towers + direct
+            # weight import (reference: finetune.py:81-89
+            # StableDiffusionPipeline.from_pretrained); --faithful_towers
+            # → same architecture, random init
             from fengshen_tpu.models.stable_diffusion.convert import (
-                load_diffusers_pipeline)
-            unet_config, unet_params, vae_config, vae_params = \
-                load_diffusers_pipeline(args.sd_pipeline_path)
-            self._pipeline_params = {"unet": unet_params,
-                                     "vae": vae_params}
-        elif vae_config is None and unet_config is None and \
-                getattr(args, "faithful_towers", False):
-            from fengshen_tpu.models.stable_diffusion import (SDUNetConfig,
-                                                              SDVAEConfig)
-            unet_config, vae_config = SDUNetConfig(), SDVAEConfig()
+                resolve_towers)
+            unet_config, vae_config, self._pipeline_params = \
+                resolve_towers(
+                    getattr(args, "sd_pipeline_path", None),
+                    faithful=getattr(args, "faithful_towers", False))
         self.model = TaiyiStableDiffusion(
             text_config, vae_config or VAEConfig(),
             unet_config or UNetConfig())

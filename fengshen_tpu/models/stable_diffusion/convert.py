@@ -279,6 +279,35 @@ def load_diffusers_pipeline(model_path: str):
             vae_to_params(vae_state))
 
 
+def resolve_towers(sd_pipeline_path=None, faithful: bool = False,
+                   small_test: bool = False):
+    """Shared tower selection for the SD drivers (finetune_taiyi_sd,
+    disco demo): returns (unet_config, vae_config, pipeline_params) —
+    `pipeline_params` is a {'unet':…, 'vae':…} import dict when a
+    released diffusers dir was given, else None."""
+    if sd_pipeline_path:
+        unet_cfg, unet_params, vae_cfg, vae_params = \
+            load_diffusers_pipeline(sd_pipeline_path)
+        return unet_cfg, vae_cfg, {"unet": unet_params,
+                                   "vae": vae_params}
+    if faithful:
+        from fengshen_tpu.models.stable_diffusion.unet_sd import (
+            SDUNetConfig)
+        from fengshen_tpu.models.stable_diffusion.vae_sd import (
+            SDVAEConfig)
+        if small_test:
+            return (SDUNetConfig.small_test_config(),
+                    SDVAEConfig.small_test_config(), None)
+        return SDUNetConfig(), SDVAEConfig(), None
+    from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
+        VAEConfig)
+    from fengshen_tpu.models.stable_diffusion.unet import UNetConfig
+    if small_test:
+        return (UNetConfig.small_test_config(),
+                VAEConfig.small_test_config(), None)
+    return UNetConfig(), VAEConfig(), None
+
+
 def text_encoder_to_params(state_dict: Mapping[str, Any],
                            text_config) -> dict:
     """Taiyi-SD Chinese text encoder (HF BertModel state dict) → the flax

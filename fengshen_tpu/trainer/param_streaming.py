@@ -236,7 +236,8 @@ class StreamedAdamW:
         return float(loss), metrics
 
     def params(self):
-        """Joined params pytree (host copies → jnp) for eval/predict."""
+        """Joined params pytree (HOST numpy — transfers happen only when
+        a consumer uses it) for eval/predict/checkpointing."""
         return self._join(self.parts[0],
                           self.parts[1:-1], self.parts[-1])
 
@@ -290,8 +291,10 @@ def llama_stream_spec(config, params,
 
     def join(bottom, layers, top):
         if config.scan_layers:
+            # np.stack keeps the joined tree HOST-resident — a jnp join
+            # would materialize the full model in HBM, defeating offload
             stacked = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
                 *layers)
             model = {"embed_tokens": bottom["embed_tokens"],
                      "layers": {"layer": stacked},
@@ -383,7 +386,7 @@ def megatron_classifier_stream_spec(config, params, num_labels: int,
     def join(bottom, layers, top):
         if config.scan_layers:
             stacked = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
                 *layers)
             enc = {**bottom, "layer": {"block": stacked},
                    "ln": top["ln"], "pooler": top["pooler"]}
