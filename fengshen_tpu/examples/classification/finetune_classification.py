@@ -683,7 +683,9 @@ def _fit_streamed(args, module: "ClassificationModule", data_model,
     final = _state()
     if ckpt is not None:
         ckpt.on_fit_end(view, final)
-    return final
+    # predict dispatches per batch; park the joined tree on device ONCE
+    # so the model is not re-uploaded over PCIe for every test batch
+    return final.replace(params=jax.device_put(final.params))
 
 
 # -- main ------------------------------------------------------------------

@@ -69,6 +69,20 @@ def _grayscale(img):
     return jnp.broadcast_to(g, img.shape)
 
 
+#: CLIP preprocessing stats (reference disco.py `normalize`; same
+#: constants as data/clip_dataloader/image_text.py CLIPCollator)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def clip_normalize(img):
+    """[0,1] pixels → CLIP-normalized (applied AFTER the cutout augs,
+    like the reference's `normalize(cuts(...))`, disco.py:628)."""
+    mean = jnp.asarray(CLIP_MEAN, img.dtype)
+    std = jnp.asarray(CLIP_STD, img.dtype)
+    return (img - mean) / std
+
+
 # -- cutouts (reference: MakeCutoutsDango, disco.py:279-353) --------------
 
 def make_cutouts(rng, img, cut_size: int, overview: int = 4,
@@ -206,7 +220,7 @@ def clip_guided_sample(sd_model, sd_params, clip_model, clip_params,
                         ic_grey_p=grey_p)
                     _, img_emb, _ = clip_model.apply(
                         {"params": clip_params}, input_ids=None,
-                        pixel_values=cuts)
+                        pixel_values=clip_normalize(cuts))
                     n_cuts = overview + innercut
                     dists = spherical_dist_loss(
                         img_emb.reshape(n_cuts, batch, -1),

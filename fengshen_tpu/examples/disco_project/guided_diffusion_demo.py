@@ -81,6 +81,21 @@ def main(argv=None):
     if pipeline_params is not None:
         sd_params = dict(sd_params)
         sd_params.update(pipeline_params)
+        # the released text-encoder weights too, when --model_path holds
+        # a torch checkpoint — a random text tower would make the UNet's
+        # conditioning noise
+        try:
+            from fengshen_tpu.models.stable_diffusion.convert import (
+                text_encoder_to_params)
+            from fengshen_tpu.utils.convert_common import (
+                load_torch_checkpoint)
+            state = load_torch_checkpoint(args.model_path)
+            sd_params["text_encoder"] = text_encoder_to_params(
+                state, text_cfg)
+        except FileNotFoundError:
+            print("WARNING: no torch checkpoint under --model_path; the "
+                  "text encoder stays randomly initialized and the "
+                  "prompt will not steer the UNet")
     clip_params = clip.init(
         jax.random.PRNGKey(1), ids,
         jnp.zeros((1, vis_cfg.image_size, vis_cfg.image_size, 3)))["params"]

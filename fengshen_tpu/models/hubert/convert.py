@@ -30,8 +30,15 @@ def _weight_norm_conv(state_dict: Mapping[str, Any], prefix: str
     checkpoints ((out, 1, 1) gains) also import correctly."""
     if f"{prefix}.weight" in state_dict:
         return tensor(state_dict, f"{prefix}.weight")
-    g = tensor(state_dict, f"{prefix}.weight_g")
-    v = tensor(state_dict, f"{prefix}.weight_v")
+    if f"{prefix}.parametrizations.weight.original0" in state_dict:
+        # torch >= 2.1 parametrize naming: original0 = g, original1 = v
+        g = tensor(state_dict,
+                   f"{prefix}.parametrizations.weight.original0")
+        v = tensor(state_dict,
+                   f"{prefix}.parametrizations.weight.original1")
+    else:
+        g = tensor(state_dict, f"{prefix}.weight_g")
+        v = tensor(state_dict, f"{prefix}.weight_v")
     if g.shape[0] == 1:      # dim=2: per-kernel-position gain
         axes = (0, 1)
     else:                    # dim=0: per-out-channel gain
@@ -51,14 +58,19 @@ def torch_to_params(state_dict: Mapping[str, Any],
     params: dict = {}
     for i in range(len(config.conv_layers)):
         # torch Conv1d [out, in, k] → flax [k, in, out]
-        w = t(f"feature_extractor.conv_layers.{i}.conv.weight")
+        pre = f"feature_extractor.conv_layers.{i}"
+        w = t(f"{pre}.conv.weight")
         params[f"conv_{i}"] = {"kernel": w.transpose(2, 1, 0)}
-        if i == 0 and \
-                f"feature_extractor.conv_layers.0.layer_norm.weight" in sd:
-            params["conv_norm_0"] = ln(
-                "feature_extractor.conv_layers.0.layer_norm")
+        if f"{pre}.conv.bias" in sd:
+            params[f"conv_{i}"]["bias"] = t(f"{pre}.conv.bias")
+        if f"{pre}.layer_norm.weight" in sd:
+            # layer 0 GroupNorm in "group" mode, per-layer LayerNorm in
+            # "layer" mode — both live under .layer_norm in HF naming
+            params[f"conv_norm_{i}"] = ln(f"{pre}.layer_norm")
     params["feature_projection"] = lin("feature_projection.projection")
     params["feature_norm"] = ln("feature_projection.layer_norm")
+    if "encoder.layer_norm.weight" in sd:
+        params["encoder_norm"] = ln("encoder.layer_norm")
     if "masked_spec_embed" in sd:
         params["mask_embedding"] = t("masked_spec_embed")
 

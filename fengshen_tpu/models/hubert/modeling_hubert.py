@@ -1,13 +1,20 @@
 """Hubert in flax: conv waveform encoder + transformer + masked
 cluster prediction.
 
-Behavioural port of the reference workload (reference:
+Released-architecture port of the reference workload (reference:
 fengshen/examples/hubert/pretrain_hubert.py:19-55 over fairseq's
 HubertModel; data at fengshen/data/hubert/hubert_dataset.py): raw audio →
-strided conv feature encoder (~50Hz frames) → span-masked frames replaced
-by a learned mask embedding → transformer encoder → per-frame logits over
-k-means cluster codebooks; loss is CE at masked (and optionally unmasked)
-frames.
+strided conv feature encoder (~50Hz frames, hubert-base "group" norm or
+hubert-large "layer" norm mode, exact erf gelu) → pre-projection
+LayerNorm → span-masked frames replaced by a learned mask embedding →
+weight-normed SamePad conv positional embedding → encoder LayerNorm →
+post-LN transformer → per-frame logits over k-means cluster codebooks;
+loss is CE at masked (and optionally unmasked) frames.
+
+Forward parity with `transformers.HubertModel` (the released-checkpoint
+format) is tested in tests/test_hubert.py for both conv-norm modes; the
+pre-LN `do_stable_layer_norm=True` encoder variant (hubert-large's
+transformer) is not modeled.
 """
 
 from __future__ import annotations
@@ -41,6 +48,10 @@ class HubertConfig:
     # fairseq-style conv positional embedding over frames
     pos_conv_kernel: int = 128
     pos_conv_groups: int = 16
+    # fairseq/HF conv-encoder norm mode: "group" (hubert-base: bias-free
+    # convs, one channel-wise GroupNorm after layer 0) or "layer"
+    # (hubert-large: biased convs, LayerNorm after every conv)
+    feat_extract_norm: str = "group"
     layer_norm_eps: float = 1e-5
     dtype: str = "float32"
     param_dtype: str = "float32"
@@ -92,19 +103,30 @@ class HubertModel(nn.Module):
         """waveform [B, T] → (logits [B, F, num_clusters], features)."""
         cfg = self.config
         dt = jnp.dtype(cfg.dtype)
+        layer_mode = cfg.feat_extract_norm == "layer"
         h = waveform[..., None]  # [B, T, 1]
         for i, (ch, kernel, stride) in enumerate(cfg.conv_layers):
             # VALID padding: fairseq/HF HuBERT convs are unpadded, which
             # fixes the frame count expected by the k-means label pipeline
             h = nn.Conv(ch, (kernel,), strides=(stride,), padding="VALID",
-                        use_bias=False, dtype=dt, name=f"conv_{i}")(h)
-            h = nn.GroupNorm(num_groups=min(8, ch),
-                             name=f"conv_norm_{i}")(h) if i == 0 else h
-            h = jax.nn.gelu(h)
+                        use_bias=layer_mode, dtype=dt,
+                        name=f"conv_{i}")(h)
+            if layer_mode:
+                # hubert-large: LayerNorm over channels after every conv
+                h = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                 name=f"conv_norm_{i}")(h)
+            elif i == 0:
+                # hubert-base: ONE channel-wise GroupNorm (group per
+                # channel — fairseq mode="default"/HF "group")
+                h = nn.GroupNorm(num_groups=ch, epsilon=cfg.layer_norm_eps,
+                                 name="conv_norm_0")(h)
+            h = jax.nn.gelu(h, approximate=False)  # torch erf gelu
+        # HF/fairseq order: LayerNorm over the CONV dim, THEN project
+        # (feature_projection.layer_norm before .projection)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="feature_norm")(h)
         features = nn.Dense(cfg.hidden_size, dtype=dt,
                             name="feature_projection")(h)
-        features = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
-                                name="feature_norm")(features)
 
         mask_emb = self.param("mask_embedding",
                               nn.initializers.normal(0.02),
@@ -116,13 +138,20 @@ class HubertModel(nn.Module):
                                  features)
 
         # conv positional embedding (fairseq pos_conv): grouped conv over
-        # frames, gelu, added to features — gives the stack its positional
-        # signal (BertLayer alone is position-agnostic)
-        pos = nn.Conv(cfg.hidden_size, (cfg.pos_conv_kernel,),
-                      padding="SAME",
+        # frames with k//2 padding — fairseq trims the LAST frame when
+        # the kernel is even (SamePadLayer) — gelu, added to features
+        k = cfg.pos_conv_kernel
+        pos = nn.Conv(cfg.hidden_size, (k,),
+                      padding=((k // 2, k // 2),),
                       feature_group_count=cfg.pos_conv_groups,
                       dtype=dt, name="pos_conv")(features)
-        features = features + jax.nn.gelu(pos)
+        if k % 2 == 0:
+            pos = pos[:, :-1]
+        features = features + jax.nn.gelu(pos, approximate=False)
+        # encoder-level LayerNorm after the positional add
+        # (HF HubertEncoder.layer_norm; do_stable_layer_norm=False)
+        features = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                name="encoder_norm")(features)
 
         bert_cfg = cfg._bert_config()
         hidden = features
