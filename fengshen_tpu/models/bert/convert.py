@@ -44,3 +44,11 @@ def model_to_params(state_dict: Mapping[str, Any],
     """For a bare BertModel state dict (no `bert.` prefix / no MLM head)."""
     prefixed = {f"bert.{k}": v for k, v in state_dict.items()}
     return torch_to_params(prefixed, config)["bert"]
+
+
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+params_to_torch_state = make_derived_export(torch_to_params)

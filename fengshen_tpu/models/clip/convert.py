@@ -76,3 +76,12 @@ def torch_to_params(text_state: Mapping[str, Any],
         x = x.detach().cpu().float().numpy() if hasattr(x, "detach") else x
         params["logit_scale"] = np.array(x, copy=True)
     return params
+
+
+#: CLIPVisionTransformer params → HF vision state dict: derived exact
+#: inverse of `vision_to_params` (import kwargs, e.g. `prefix`, pass
+#: through so non-default-rooted checkpoints invert correctly)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+vision_params_to_torch_state = make_derived_export(vision_to_params)

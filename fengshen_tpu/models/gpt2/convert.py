@@ -78,15 +78,9 @@ def load_hf_pretrained(path: str, config: GPT2Config | None = None):
     return config, torch_to_params(state, config)
 
 
-def params_to_torch_state(params: dict, config, template_state,
-                          **import_kwargs) -> dict:
-    """flax params → HF state_dict-shaped numpy mapping — the exact
-    inverse of `torch_to_params`, derived numerically (see
-    fengshen_tpu.utils.convert_common.invert_import). `template_state`
-    is the source HF checkpoint (dict or dir path)."""
-    from fengshen_tpu.utils.convert_common import (invert_import,
-                                                   load_torch_checkpoint)
-    if isinstance(template_state, str):
-        template_state = load_torch_checkpoint(template_state)
-    return invert_import(torch_to_params, template_state, config, params,
-                         **import_kwargs)
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+params_to_torch_state = make_derived_export(torch_to_params)

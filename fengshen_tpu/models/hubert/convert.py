@@ -97,3 +97,56 @@ def torch_to_params(state_dict: Mapping[str, Any],
     if "final_proj.weight" in sd:
         params["cluster_head"] = lin("final_proj")
     return params
+
+
+def params_to_torch_state(params: dict, config, template_state,
+                          **import_kwargs) -> dict:
+    """flax params → HF state_dict-shaped numpy mapping — the derived
+    exact inverse of `torch_to_params` (utils/convert_common.
+    invert_import), plus a hand-inverted pos-conv weight-norm: the
+    import COLLAPSES (g, v) into an effective weight (arithmetic the
+    numeric inverter rightly refuses), so the export re-decomposes the
+    effective weight as v := w, g := ‖w‖ over the norm axes — an exact
+    preimage under g·v/‖v‖."""
+    from fengshen_tpu.utils.convert_common import (invert_import,
+                                                   load_torch_checkpoint)
+    if isinstance(template_state, str):
+        template_state = load_torch_checkpoint(template_state)
+    prefix = "encoder.pos_conv_embed.conv"
+    maybe_hubert = "hubert." if any(
+        k.startswith("hubert.") for k in template_state) else ""
+    wn_keys = [k for k in template_state
+               if k.startswith(f"{maybe_hubert}{prefix}.") and
+               ("weight_g" in k or "weight_v" in k or
+                "parametrizations" in k)]
+    if not wn_keys:
+        return invert_import(torch_to_params, template_state, config,
+                             params, **import_kwargs)
+    g_key = next(k for k in wn_keys
+                 if k.endswith(("weight_g", "original0")))
+    g_shape = tuple(template_state[g_key].shape)
+    # swap (g, v) for one plain-weight key so the permutation inverse
+    # applies, then decompose back
+    eff = _weight_norm_conv(
+        {k[len(maybe_hubert):]: v for k, v in template_state.items()
+         if k.startswith(maybe_hubert)}, prefix)
+    template2 = {k: v for k, v in template_state.items()
+                 if k not in wn_keys}
+    template2[f"{maybe_hubert}{prefix}.weight"] = eff
+    out = invert_import(torch_to_params, template2, config, params,
+                        **import_kwargs)
+    w = out.pop(f"{maybe_hubert}{prefix}.weight")
+    axes = (0, 1) if g_shape[0] == 1 else (1, 2)
+    g = np.sqrt((w.astype(np.float64) ** 2).sum(axis=axes,
+                                                keepdims=True))
+    for k in wn_keys:
+        # keep each key's own checkpoint dtype (fp16 templates must
+        # export fp16, like every other key)
+        src = template_state[k]
+        dt = str(getattr(src, "dtype", "float32")).replace("torch.", "")
+        val = g if k.endswith(("weight_g", "original0")) else w
+        try:
+            out[k] = val.astype(np.dtype(dt))
+        except TypeError:
+            out[k] = val.astype(np.float32)
+    return out

@@ -51,20 +51,9 @@ def torch_to_params(state_dict: Mapping[str, Any], config: RoFormerConfig,
     return params
 
 
-def params_to_torch_state(params: dict, config, template_state,
-                          **import_kwargs) -> dict:
-    """flax params → HF/reference state_dict-shaped numpy mapping — the
-    exact inverse of `torch_to_params`, derived from it numerically (see
-    fengshen_tpu.utils.convert_common.invert_import; reference merge-back
-    analog: fengshen/utils/llama_convert/merge_lt_mp_to_hf.py).
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
 
-    `template_state` is the source checkpoint you imported from (a state
-    dict or a checkpoint dir path) — it supplies key names/shapes/dtypes
-    and the values of any positions the import never read.
-    """
-    from fengshen_tpu.utils.convert_common import (invert_import,
-                                                   load_torch_checkpoint)
-    if isinstance(template_state, str):
-        template_state = load_torch_checkpoint(template_state)
-    return invert_import(torch_to_params, template_state, config, params,
-                         **import_kwargs)
+params_to_torch_state = make_derived_export(torch_to_params)

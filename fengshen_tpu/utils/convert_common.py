@@ -153,7 +153,14 @@ def invert_import(torch_to_params_fn, template: Mapping[str, Any],
     """
     import jax
 
-    keys = list(template.keys())
+    def _is_tensor(v):
+        return hasattr(v, "detach") or isinstance(v, np.ndarray) or (
+            hasattr(v, "shape") and hasattr(v, "dtype"))
+
+    # Lightning-format checkpoints carry non-tensor metadata (epoch,
+    # optimizer_states, a nested state_dict…) — only weight entries
+    # participate in the inversion
+    keys = [k for k in template.keys() if _is_tensor(template[k])]
     np_template = {k: tensor(template, k) for k in keys}
 
     def _orig_dtype(v):
@@ -237,6 +244,30 @@ def invert_import(torch_to_params_fn, template: Mapping[str, Any],
             np_template[k].shape)
         out[k] = arr.astype(dtypes[k])
     return out
+
+
+def make_derived_export(torch_to_params_fn):
+    """Build a family's ``params_to_torch_state`` as the derived exact
+    inverse of its importer (see `invert_import`). The returned function
+    takes ``(params, config, template_state, **import_kwargs)`` where
+    ``template_state`` is the source checkpoint (a state dict, a raw
+    Lightning checkpoint dict, or a checkpoint dir path) supplying key
+    names/shapes/dtypes and values for positions the import never read."""
+
+    def params_to_torch_state(params, config, template_state,
+                              **import_kwargs):
+        if isinstance(template_state, str):
+            template_state = load_torch_checkpoint(template_state)
+        if "state_dict" in template_state and not hasattr(
+                template_state["state_dict"], "detach"):
+            # raw Lightning checkpoint: invert against the inner weights
+            # (keys keep their own naming, incl. any `model.` prefix)
+            template_state = template_state["state_dict"]
+        return invert_import(torch_to_params_fn, template_state, config,
+                             params, **import_kwargs)
+
+    params_to_torch_state.__doc__ = make_derived_export.__doc__
+    return params_to_torch_state
 
 
 def load_weight_files(ckpt_dir: str, stem: str) -> dict:

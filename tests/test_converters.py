@@ -289,8 +289,11 @@ def test_hubert_convert_structural_roundtrip():
         cfg.conv_layers[0][0],)
     shapes["feature_projection.projection.weight"] = (d, in_ch)
     shapes["feature_projection.projection.bias"] = (d,)
-    shapes["feature_projection.layer_norm.weight"] = (d,)
-    shapes["feature_projection.layer_norm.bias"] = (d,)
+    # HF order: layer_norm over the CONV dim, then project
+    shapes["feature_projection.layer_norm.weight"] = (in_ch,)
+    shapes["feature_projection.layer_norm.bias"] = (in_ch,)
+    shapes["encoder.layer_norm.weight"] = (d,)
+    shapes["encoder.layer_norm.bias"] = (d,)
     shapes["masked_spec_embed"] = (d,)
     # real HF/fairseq checkpoints use weight_norm(conv, dim=2):
     # g is (1, 1, K), one gain per kernel position

@@ -208,3 +208,18 @@ def test_zen2_convert_mlm_parity(inputs):
     ref = h @ sd["bert.embeddings.word_embeddings.weight"].T + \
         sd["cls.predictions.bias"]
     np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
+
+
+def test_zen2_export_echo():
+    """fs→reference export (derived inverse, incl. the intentional
+    r_r/r_w bias swap): export(import(sd)) echoes every tensor."""
+    from fengshen_tpu.models.zen2.convert import (params_to_torch_state,
+                                                  torch_to_params)
+
+    sd = _rng_sd()
+    cfg = _cfg()
+    params = torch_to_params(sd, cfg, head="masked_lm")
+    out = params_to_torch_state(params, cfg, sd, head="masked_lm")
+    assert set(out) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(out[k], sd[k], err_msg=k)
