@@ -86,16 +86,26 @@ def _dense(cfg, feats, name):
 
 
 class BertLayer(nn.Module):
+    """Post-LN transformer layer; `pre_ln=True` flips it to the pre-LN
+    order (norm → attn → residual, norm → ff → residual — e.g. HF's
+    HubertEncoderLayerStableLayerNorm) with IDENTICAL parameter names,
+    so importers and partition rules serve both variants."""
+
     config: BertConfig
+    pre_ln: bool = False
 
     @nn.compact
     def __call__(self, hidden, attention_mask=None, deterministic=True):
         cfg = self.config
         batch, seq, _ = hidden.shape
         n_head, head_dim = cfg.num_attention_heads, cfg.head_dim
-        q = _dense(cfg, cfg.hidden_size, "query")(hidden)
-        k = _dense(cfg, cfg.hidden_size, "key")(hidden)
-        v = _dense(cfg, cfg.hidden_size, "value")(hidden)
+        attn_ln = LayerNorm(epsilon=cfg.layer_norm_eps,
+                            name="attention_ln")
+        out_ln = LayerNorm(epsilon=cfg.layer_norm_eps, name="output_ln")
+        x = attn_ln(hidden) if self.pre_ln else hidden
+        q = _dense(cfg, cfg.hidden_size, "query")(x)
+        k = _dense(cfg, cfg.hidden_size, "key")(x)
+        v = _dense(cfg, cfg.hidden_size, "value")(x)
         q = q.reshape(batch, seq, n_head, head_dim)
         k = k.reshape(batch, seq, n_head, head_dim)
         v = v.reshape(batch, seq, n_head, head_dim)
@@ -119,16 +129,15 @@ class BertLayer(nn.Module):
         out = _dense(cfg, cfg.hidden_size, "attention_output_dense")(out)
         out = nn.Dropout(cfg.hidden_dropout_prob)(
             out, deterministic=deterministic)
-        hidden = LayerNorm(epsilon=cfg.layer_norm_eps,
-                           name="attention_ln")(hidden + out)
-        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
+        hidden = hidden + out if self.pre_ln else attn_ln(hidden + out)
+        h = out_ln(hidden) if self.pre_ln else hidden
+        h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(h)
         h = get_activation(cfg.hidden_act)(h)
         h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
-        return LayerNorm(epsilon=cfg.layer_norm_eps,
-                         name="output_ln")(hidden + h)
+        return hidden + h if self.pre_ln else out_ln(hidden + h)
 
 
 class BertModel(nn.Module):

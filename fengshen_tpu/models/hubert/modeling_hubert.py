@@ -12,9 +12,10 @@ post-LN transformer → per-frame logits over k-means cluster codebooks;
 loss is CE at masked (and optionally unmasked) frames.
 
 Forward parity with `transformers.HubertModel` (the released-checkpoint
-format) is tested in tests/test_hubert.py for both conv-norm modes; the
-pre-LN `do_stable_layer_norm=True` encoder variant (hubert-large's
-transformer) is not modeled.
+format) is tested in tests/test_hubert.py for both conv-norm modes and
+both encoder variants — post-LN (hubert-base) and the pre-LN
+`do_stable_layer_norm=True` stack (hubert-large, `BertLayer(pre_ln=
+True)` with the encoder LayerNorm after the layers).
 """
 
 from __future__ import annotations
@@ -52,6 +53,9 @@ class HubertConfig:
     # convs, one channel-wise GroupNorm after layer 0) or "layer"
     # (hubert-large: biased convs, LayerNorm after every conv)
     feat_extract_norm: str = "group"
+    # hubert-large's pre-LN transformer: encoder LayerNorm moves AFTER
+    # the stack and each layer normalizes before attention/ffn
+    do_stable_layer_norm: bool = False
     layer_norm_eps: float = 1e-5
     dtype: str = "float32"
     param_dtype: str = "float32"
@@ -148,16 +152,23 @@ class HubertModel(nn.Module):
         if k % 2 == 0:
             pos = pos[:, :-1]
         features = features + jax.nn.gelu(pos, approximate=False)
-        # encoder-level LayerNorm after the positional add
-        # (HF HubertEncoder.layer_norm; do_stable_layer_norm=False)
-        features = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
-                                name="encoder_norm")(features)
+        # encoder-level LayerNorm: BEFORE the stack for the post-LN
+        # encoder (HF HubertEncoder), AFTER it for hubert-large's
+        # pre-LN stable variant (HubertEncoderStableLayerNorm)
+        if not cfg.do_stable_layer_norm:
+            features = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                    name="encoder_norm")(features)
 
         bert_cfg = cfg._bert_config()
         hidden = features
         for i in range(cfg.num_hidden_layers):
-            hidden = BertLayer(bert_cfg, name=f"layer_{i}")(
+            hidden = BertLayer(bert_cfg,
+                               pre_ln=cfg.do_stable_layer_norm,
+                               name=f"layer_{i}")(
                 hidden, None, deterministic)
+        if cfg.do_stable_layer_norm:
+            hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                  name="encoder_norm")(hidden)
         logits = nn.Dense(cfg.num_clusters, dtype=dt,
                           name="cluster_head")(hidden)
         return logits, hidden

@@ -99,3 +99,45 @@ def test_hubert_hf_parity_layer_norm_convs():
     """conv-encoder "layer" mode (biased convs + per-layer LayerNorm,
     the hubert-large extractor) against the HF oracle."""
     _hf_parity_case("layer")
+
+
+def test_hubert_hf_parity_stable_layer_norm():
+    """hubert-large's full encoder: "layer" conv norms AND the pre-LN
+    stable transformer (encoder LayerNorm after the stack)."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from fengshen_tpu.models.hubert import HubertConfig, HubertModel
+    from fengshen_tpu.models.hubert.convert import torch_to_params
+
+    hf_cfg = transformers.HubertConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, conv_dim=(16, 16), conv_kernel=(10, 3),
+        conv_stride=(5, 2), num_feat_extract_layers=2,
+        num_conv_pos_embeddings=7, num_conv_pos_embedding_groups=4,
+        feat_extract_norm="layer", do_stable_layer_norm=True,
+        conv_bias=True, feat_proj_dropout=0.0, hidden_dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0, layerdrop=0.0,
+        feat_proj_layer_norm=True, attn_implementation="eager")
+    torch.manual_seed(0)
+    tm = transformers.HubertModel(hf_cfg).eval()
+
+    cfg = HubertConfig(conv_layers=((16, 10, 5), (16, 3, 2)),
+                       hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=64,
+                       pos_conv_kernel=7, pos_conv_groups=4,
+                       feat_extract_norm="layer",
+                       do_stable_layer_norm=True,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0)
+    params = torch_to_params(tm.state_dict(), cfg)
+    model = HubertModel(cfg)
+    wav = np.random.RandomState(5).randn(2, 400).astype(np.float32)
+    init = model.init(jax.random.PRNGKey(0), jnp.asarray(wav))["params"]
+    params["cluster_head"] = init["cluster_head"]
+    params.setdefault("mask_embedding", init["mask_embedding"])
+
+    _, hidden = model.apply({"params": params}, jnp.asarray(wav))
+    with torch.no_grad():
+        ref = tm(torch.tensor(wav)).last_hidden_state.numpy()
+    np.testing.assert_allclose(np.asarray(hidden), ref, atol=3e-4)
