@@ -1,0 +1,111 @@
+"""Original-TensorFlow BERT checkpoint → flax params, directly.
+
+Replaces the reference's TF→torch conversion script (reference:
+fengshen/utils/convert_tf_checkpoint_to_pytorch.py:1-62 — a wrapper
+over HF `load_tf_weights_in_bert` that materializes a torch model just
+to re-serialize it). TPU-first version: read the checkpoint variables
+with `tf.train.load_checkpoint` and map the original google-research
+BERT naming straight onto the flax tree — TF kernels are already
+[in, out] like flax Dense, so no transposes at all.
+
+Variable naming (google-research/bert):
+    bert/embeddings/{word,position,token_type}_embeddings
+    bert/embeddings/LayerNorm/{gamma,beta}
+    bert/encoder/layer_N/attention/self/{query,key,value}/{kernel,bias}
+    bert/encoder/layer_N/attention/output/dense/…  + LayerNorm
+    bert/encoder/layer_N/{intermediate,output}/dense/… + output/LayerNorm
+    bert/pooler/dense/{kernel,bias}
+    cls/predictions/transform/{dense,LayerNorm}/… + output_bias
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tf_bert_checkpoint_to_params(ckpt_path: str, config) -> dict:
+    """TF checkpoint path (the `model.ckpt` prefix) → the same tree
+    `models/bert/convert.torch_to_params` produces: {"bert": …} plus the
+    MLM transform head when present."""
+    import tensorflow as tf
+
+    reader = tf.train.load_checkpoint(ckpt_path)
+    names = set(reader.get_variable_to_shape_map())
+
+    def t(name):
+        if name not in names:
+            raise KeyError(
+                f"variable {name!r} not in TF checkpoint {ckpt_path} "
+                f"(has {sorted(names)[:5]}…)")
+        return np.asarray(reader.get_tensor(name))
+
+    def lin(prefix):
+        return {"kernel": t(f"{prefix}/kernel"),
+                "bias": t(f"{prefix}/bias")}
+
+    def ln(prefix):
+        return {"scale": t(f"{prefix}/gamma"), "bias": t(f"{prefix}/beta")}
+
+    bert = {
+        "word_embeddings": {
+            "embedding": t("bert/embeddings/word_embeddings")},
+        "position_embeddings": {
+            "embedding": t("bert/embeddings/position_embeddings")},
+        "token_type_embeddings": {
+            "embedding": t("bert/embeddings/token_type_embeddings")},
+        "embeddings_ln": ln("bert/embeddings/LayerNorm"),
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"bert/encoder/layer_{i}"
+        bert[f"layer_{i}"] = {
+            "query": lin(f"{p}/attention/self/query"),
+            "key": lin(f"{p}/attention/self/key"),
+            "value": lin(f"{p}/attention/self/value"),
+            "attention_output_dense": lin(f"{p}/attention/output/dense"),
+            "attention_ln": ln(f"{p}/attention/output/LayerNorm"),
+            "intermediate_dense": lin(f"{p}/intermediate/dense"),
+            "output_dense": lin(f"{p}/output/dense"),
+            "output_ln": ln(f"{p}/output/LayerNorm"),
+        }
+    if "bert/pooler/dense/kernel" in names:
+        bert["pooler"] = lin("bert/pooler/dense")
+    params: dict = {"bert": bert}
+    if "cls/predictions/transform/dense/kernel" in names:
+        params["transform_dense"] = lin("cls/predictions/transform/dense")
+        params["transform_ln"] = ln("cls/predictions/transform/LayerNorm")
+        params["bias"] = t("cls/predictions/output_bias")
+    return params
+
+
+def main(argv=None):
+    """CLI analog of the reference script: TF checkpoint → ONE logical
+    orbax checkpoint (no intermediate torch bin)."""
+    import argparse
+    import os
+
+    parser = argparse.ArgumentParser("tf-bert -> fengshen-tpu convert")
+    parser.add_argument("--tf_checkpoint_path", required=True, type=str)
+    parser.add_argument("--bert_config_file", required=True, type=str)
+    parser.add_argument("--output_path", required=True, type=str)
+    args = parser.parse_args(argv)
+
+    from fengshen_tpu.models.bert import BertConfig
+
+    # the google-research layout names the file bert_config.json, so
+    # pass the FILE path through (from_pretrained handles files; a
+    # dirname would make it look for config.json and miss)
+    config = BertConfig.from_pretrained(args.bert_config_file)
+    params = tf_bert_checkpoint_to_params(args.tf_checkpoint_path, config)
+
+    import orbax.checkpoint as ocp
+    os.makedirs(args.output_path, exist_ok=True)
+    config.save_pretrained(args.output_path)
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.abspath(os.path.join(args.output_path, "params")),
+              params, force=True)
+    ckpt.wait_until_finished()
+    print(f"converted -> {args.output_path}")
+
+
+if __name__ == "__main__":
+    main()
