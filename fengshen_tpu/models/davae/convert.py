@@ -56,3 +56,11 @@ def critic_to_params(state_dict: Mapping[str, Any]) -> dict:
         sd = strip_prefix(sd, "vae_model.")
     _, lin, _ = make_helpers(sd)
     return {"fc1": lin("Disc.0"), "out": lin("Disc.2")}
+
+
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+params_to_torch_state = make_derived_export(torch_to_params)

@@ -37,3 +37,11 @@ def cls_to_params(state_dict: Mapping[str, Any]) -> dict:
         [k, np.zeros((k.shape[0], 1), k.dtype)], 1),
         "bias": np.concatenate([b, np.zeros((1,), b.dtype)])}
     return {"fc1": lin("fc1"), "fc2": lin("fc2"), "out": out}
+
+
+#: fs→torch exports: derived exact inverses of the two importers
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+gen_params_to_torch_state = make_derived_export(gen_to_params)
+cls_params_to_torch_state = make_derived_export(cls_to_params)

@@ -30,3 +30,11 @@ def torch_to_params(state_dict: Mapping[str, Any]) -> dict:
         "dec_fc2": lin("decoder.fc2"),
         "dec_fc3": lin("decoder.fc3"),
     }
+
+
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+params_to_torch_state = make_derived_export(torch_to_params)

@@ -50,3 +50,11 @@ def torch_to_params(state_dict: Mapping[str, Any], config) -> dict:
             "dense_4h_to_h": lin(f"{pre}.mlp.dense_4h_to_h"),
         }
     return {"backbone": backbone}
+
+
+#: fs→torch export: derived exact inverse of `torch_to_params`
+#: (template_state = the source checkpoint: dict, Lightning ckpt, or dir)
+from fengshen_tpu.utils.convert_common import (  # noqa: E402
+    make_derived_export)
+
+params_to_torch_state = make_derived_export(torch_to_params)

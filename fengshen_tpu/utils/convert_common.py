@@ -186,7 +186,11 @@ def invert_import(torch_to_params_fn, template: Mapping[str, Any],
     tagged = {k: (np.arange(offsets[k], offsets[k] + sizes[k],
                             dtype=np.float64) + 0.25
                   ).reshape(np_template[k].shape) for k in keys}
-    tag_tree = torch_to_params_fn(tagged, config, **fn_kwargs)
+    if config is None:
+        # config-free importers (ppvae, gavae towers) take one argument
+        tag_tree = torch_to_params_fn(tagged, **fn_kwargs)
+    else:
+        tag_tree = torch_to_params_fn(tagged, config, **fn_kwargs)
 
     tag_leaves = dict(jax.tree_util.tree_flatten_with_path(tag_tree)[0])
     val_leaves = dict(jax.tree_util.tree_flatten_with_path(params)[0])

@@ -288,3 +288,17 @@ def test_transfo_xl_sharded_matches_replicated(mesh8):
                                                     jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4)
+
+
+def test_transfo_xl_export_echo():
+    """fs→reference export (derived inverse): echo of every tensor."""
+    from fengshen_tpu.models.transfo_xl_denoise.convert import (
+        params_to_torch_state, torch_to_params)
+
+    sd = _sd()
+    cfg = _config()
+    params = torch_to_params(sd, cfg)
+    out = params_to_torch_state(params, cfg, sd)
+    assert set(out) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(out[k], sd[k], err_msg=k)

@@ -309,3 +309,34 @@ def test_della_convert_forward_parity():
                               np.asarray(posts[i][1])], -1)
         np.testing.assert_allclose(got, ref_posts[i].numpy(), atol=3e-4)
     np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=2e-3)
+
+
+def test_ppvae_export_echo():
+    """fs→reference export for the config-free PluginVAE importer."""
+    from fengshen_tpu.models.ppvae.convert import (params_to_torch_state,
+                                                   torch_to_params)
+
+    LD, BD = 16, 4
+    rng = np.random.RandomState(5)
+
+    def lin(i, o):
+        return (rng.randn(o, i).astype(np.float32) * 0.3,
+                rng.randn(o).astype(np.float32) * 0.1)
+
+    sd = {}
+    for n, (i, o) in (("encoder.fc1", (LD, LD // 2)),
+                      ("encoder.fc2", (LD // 2, LD // 4)),
+                      ("encoder.mean", (LD // 4, BD)),
+                      ("encoder.log_var", (LD // 4, BD)),
+                      ("decoder.fc1", (BD, LD // 4)),
+                      ("decoder.fc2", (LD // 4, LD // 2)),
+                      ("decoder.fc3", (LD // 2, LD))):
+        w, b = lin(i, o)
+        sd[f"pluginvae.{n}.weight"] = w
+        sd[f"pluginvae.{n}.bias"] = b
+
+    params = torch_to_params(sd)
+    out = params_to_torch_state(params, None, sd)
+    assert set(out) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(out[k], sd[k], err_msg=k)
