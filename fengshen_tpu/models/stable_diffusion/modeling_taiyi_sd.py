@@ -15,6 +15,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.bert import BertConfig, BertModel
 from fengshen_tpu.models.stable_diffusion.autoencoder_kl import (
@@ -81,6 +82,20 @@ class TaiyiStableDiffusion(nn.Module):
         text = self.encode_text(input_ids, attention_mask, deterministic)
         pred = self.denoise(noisy, timesteps, text)
         return pred, latents
+
+    def partition_rules(self):
+        """Combined rules for the three towers: the bert text rules plus
+        the SD conv/transformer rules when the faithful towers are in
+        use (compact test towers replicate)."""
+        from fengshen_tpu.models.bert.modeling_bert import (
+            PARTITION_RULES as BERT_RULES)
+        rules = [r for r in BERT_RULES if r[0] != ".*"]
+        if isinstance(self.unet_config, SDUNetConfig) or \
+                isinstance(self.vae_config, SDVAEConfig):
+            from fengshen_tpu.models.stable_diffusion.unet_sd import (
+                SD_PARTITION_RULES)
+            rules += [r for r in SD_PARTITION_RULES if r[0] != ".*"]
+        return rules + [(".*", P(None))]
 
 
 def diffusion_loss(pred, latents, noise, timesteps,

@@ -29,6 +29,30 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+from jax.sharding import PartitionSpec as P
+
+#: fsdp/tensor sharding for the SD towers (the reference trains SD under
+#: DeepSpeed ZeRO; here the fsdp axis shards the big conv out-channels
+#: and the transformer/ff matmuls ride the tensor axis). `_spec_fits`
+#: drops any axis a tiny channel count cannot divide, so small test
+#: configs degrade to replicated instead of failing.
+SD_PARTITION_RULES: list[tuple[str, P]] = [
+    (r"(to_q|to_k|to_v)/kernel", P(None, "tensor")),
+    (r"to_out_0/kernel", P("tensor", None)),
+    (r"ff/net_0/proj/kernel", P(None, "tensor")),
+    (r"ff/net_2/kernel", P("tensor", None)),
+    (r"time_emb_proj/kernel", P(None, "fsdp")),
+    (r"(linear_1|linear_2)/kernel", P(None, "fsdp")),
+    # `(^|/)conv` anchors the down/upsampler convs without catching
+    # quant_conv/post_quant_conv (4- and 8-channel 1x1s that must stay
+    # replicated)
+    (r"(conv1|conv2|conv_shortcut|(^|/)conv)/kernel",
+     P(None, None, None, "fsdp")),
+    (r"(proj_in|proj_out)/kernel", P(None, None, None, "fsdp")),
+    (".*", P(None)),
+]
+
+
 @dataclasses.dataclass
 class SDUNetConfig:
     """Field names follow diffusers' UNet2DConditionModel config."""
@@ -361,3 +385,6 @@ class SDUNet2DConditionModel(nn.Module):
                          epsilon=cfg.norm_eps, name="conv_norm_out")(h)
         return nn.Conv(cfg.out_channels, (3, 3), padding=((1, 1), (1, 1)),
                        dtype=dt, name="conv_out")(jax.nn.silu(h))
+
+    def partition_rules(self):
+        return SD_PARTITION_RULES

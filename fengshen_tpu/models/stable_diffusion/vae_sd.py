@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from fengshen_tpu.models.stable_diffusion.unet_sd import (
-    Attention, Downsample2D, ResnetBlock2D, Upsample2D)
+    SD_PARTITION_RULES, Attention, Downsample2D, ResnetBlock2D,
+    Upsample2D)
 
 SCALING_FACTOR = 0.18215
 
@@ -208,3 +209,6 @@ class SDAutoencoderKL(nn.Module):
         else:
             latent = mean
         return self.decode(latent), mean, logvar
+
+    def partition_rules(self):
+        return SD_PARTITION_RULES
