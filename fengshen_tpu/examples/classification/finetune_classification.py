@@ -594,15 +594,8 @@ def _fit_streamed(args, module: "ClassificationModule", data_model,
     activations (reference 7GB recipe:
     demo_classification_afqmc_erlangshen_offload.sh:9-33). Returns a
     TrainState the predict path consumes."""
-    import optax
-
     from fengshen_tpu.trainer.param_streaming import (
-        make_streamed, megatron_classifier_stream_spec)
-    from fengshen_tpu.trainer.train_state import TrainState
-    from fengshen_tpu.utils.utils import report_memory
-
-    from fengshen_tpu.models.model_utils import (get_scheduler,
-                                                 get_total_steps)
+        megatron_classifier_stream_spec, run_streamed_fit)
 
     if module.model_type != "huggingface-megatron_bert":
         raise ValueError(
@@ -610,82 +603,35 @@ def _fit_streamed(args, module: "ClassificationModule", data_model,
             f"erlangshen recipe); got model_type={module.model_type}")
     params = module.init_params(jax.random.PRNGKey(
         getattr(args, "seed", 42)))
+    if ckpt is not None:
+        # resume support: restore weights (the streamed checkpoints are
+        # weights-only; moments restart) before the engine takes over
+        import optax
+
+        from fengshen_tpu.trainer.train_state import TrainState
+        state0 = TrainState.create(apply_fn=module.model.apply,
+                                   params=params,
+                                   tx=optax.set_to_zero())
+        class _View:  # maybe_restore records the restored step here
+            global_step = 0
+            consumed_samples = 0
+        state0 = ckpt.maybe_restore(state0, _View(), weights_only=True)
+        params = state0.params
     spec = megatron_classifier_stream_spec(module.config, params,
                                            args.num_labels,
                                            deterministic=False)
     del params  # the engine holds the host master copies now
 
-    loader = data_model.train_dataloader()
-    total_steps = get_total_steps(args, len(loader.dataset),
-                                  args.train_batchsize)
-    # the SAME recipe as the monolithic path (configure_optimizers):
-    # configured scheduler, adam betas/eps, no-decay mask on bias/LN
-    schedule = get_scheduler(args, total_steps)
-    eng = make_streamed(
-        spec,
-        # optax schedules are 0-based on the update count; the engine's
-        # count is 1-based
-        lr_schedule=lambda count: float(schedule(count - 1)),
-        b1=getattr(args, "adam_beta1", 0.9),
-        b2=getattr(args, "adam_beta2", 0.999),
-        eps=getattr(args, "adam_epsilon", 1e-8),
-        weight_decay=getattr(args, "weight_decay", 0.01),
-        # 0 = no clipping, exactly like configure_optimizers
-        clip_norm=getattr(args, "gradient_clip_val", 0.0) or None,
-        use_decay_mask=True)
+    def log(step, loss, metrics, peak):
+        logger.info(
+            "streamed step=%d loss=%.4f acc=%.3f grad_norm=%.3g "
+            "peak_hbm_gb=%.2f", step, loss,
+            metrics.get("acc", float("nan")),
+            metrics.get("grad_norm", float("nan")), peak / 1e9)
 
-    class _TrainerView:
-        """What UniversalCheckpoint.save reads off a trainer."""
-        global_step = 0
-        consumed_samples = 0
-
-    view = _TrainerView()
-
-    def _state():
-        return TrainState.create(apply_fn=module.model.apply,
-                                 params=eng.params(),
-                                 tx=optax.set_to_zero())
-
-    # the trainer's default max_steps is -1 ("until the epochs run
-    # out"); only a POSITIVE value limits the streamed loop
-    raw_max = getattr(args, "max_steps", 0) or 0
-    max_steps = raw_max if raw_max > 0 else total_steps
-    max_epochs = getattr(args, "max_epochs", None) or 1
-    step = 0
-    rng = jax.random.PRNGKey(getattr(args, "seed", 42))
-    for epoch in range(max_epochs):
-        for batch in loader:
-            batch = {k: jnp.asarray(v) for k, v in batch.items()
-                     if k != "id"}
-            rng, step_rng = jax.random.split(rng)
-            loss, metrics = eng.step(batch, step_rng)
-            step += 1
-            view.global_step = step
-            view.consumed_samples = step * args.train_batchsize
-            if step % max(getattr(args, "log_every_n_steps", 1), 1) == 0:
-                mem = report_memory("streamed")
-                peak = max((d["peak_bytes_in_use"] for d in mem.values()),
-                           default=0)
-                logger.info(
-                    "streamed step=%d loss=%.4f acc=%.3f "
-                    "grad_norm=%.3g peak_hbm_gb=%.2f", step, loss,
-                    metrics.get("acc", float("nan")),
-                    metrics.get("grad_norm", float("nan")),
-                    peak / 1e9)
-            if ckpt is not None and ckpt.every_n_train_steps and \
-                    step % ckpt.every_n_train_steps == 0:
-                # join the host parts only when a save actually fires
-                ckpt.on_train_step_end(view, _state())
-            if step >= max_steps:
-                break
-        if step >= max_steps:
-            break
-    final = _state()
-    if ckpt is not None:
-        ckpt.on_fit_end(view, final)
-    # predict dispatches per batch; park the joined tree on device ONCE
-    # so the model is not re-uploaded over PCIe for every test batch
-    return final.replace(params=jax.device_put(final.params))
+    return run_streamed_fit(args, spec, data_model.train_dataloader(),
+                            module.model.apply, ckpt=ckpt, log=log,
+                            park_on_device=True)
 
 
 # -- main ------------------------------------------------------------------

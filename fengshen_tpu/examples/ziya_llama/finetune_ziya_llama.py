@@ -165,6 +165,13 @@ class Llama(CausalLMModule):
         parser.add_argument("--packed_rows", type=int, default=None,
                             help="fixed packed-row count per batch "
                                  "(static shapes for TPU jit)")
+        parser.add_argument(
+            "--offload_params", action="store_true", default=False,
+            help="ZeRO-3 analog: params + adam moments live in host "
+                 "memory and stream to HBM one decoder layer at a time "
+                 "(trainer/param_streaming.py) — for models whose "
+                 "params+moments dwarf one chip's HBM (the 13B "
+                 "finetune). Incompatible with --packed.")
         return parent_parser
 
     def setup(self, stage: str = "fit") -> None:
@@ -235,9 +242,47 @@ def main(argv=None):
     module = Llama(args)
     if args.packed:
         module.config.packed_sequences = True
+    # Trainer.__init__ installs the process-global mesh the datamodule's
+    # DP sharding reads — load-bearing in BOTH branches
     trainer = Trainer(args)
-    trainer.callbacks.append(UniversalCheckpoint(args))
-    trainer.fit(module, datamodule)
+    ckpt = UniversalCheckpoint(args)
+    if getattr(args, "offload_params", False):
+        if args.packed:
+            raise ValueError("--offload_params streams per-layer with "
+                             "default positions; use unpacked batches")
+        import jax
+        import optax
+
+        from fengshen_tpu.trainer.param_streaming import (
+            llama_stream_spec, run_streamed_fit)
+        from fengshen_tpu.trainer.train_state import TrainState
+
+        module.setup("fit")
+        params = module.init_params(jax.random.PRNGKey(
+            getattr(args, "seed", 42)))
+        # resume: restore weights before the engine takes the host
+        # master copies (streamed checkpoints are weights-only)
+        state0 = TrainState.create(apply_fn=module.model.apply,
+                                   params=params, tx=optax.set_to_zero())
+        class _View:  # maybe_restore records the restored step here
+            global_step = 0
+            consumed_samples = 0
+        state0 = ckpt.maybe_restore(state0, _View(), weights_only=True)
+        spec = llama_stream_spec(module.config, state0.params)
+        del params, state0
+
+        def log(step, loss, metrics, peak):
+            print(f"[streamed] step={step} loss={loss:.4f} "
+                  f"grad_norm={metrics.get('grad_norm', 0):.3g} "
+                  f"peak_hbm_gb={peak / 1e9:.2f}", flush=True)
+
+        # no device park: the streamed models are the ones whose params
+        # dwarf one chip's HBM
+        run_streamed_fit(args, spec, datamodule.train_dataloader(),
+                         module.model.apply, ckpt=ckpt, log=log)
+    else:
+        trainer.callbacks.append(ckpt)
+        trainer.fit(module, datamodule)
 
 
 if __name__ == "__main__":

@@ -404,3 +404,81 @@ def make_streamed(spec: StreamSpec, **kw) -> StreamedAdamW:
     eng = StreamedAdamW(spec, **kw)
     eng._join = lambda b, ls, t: spec.join(b, ls, t)
     return eng
+
+
+def run_streamed_fit(args, spec: StreamSpec, loader, apply_fn,
+                     ckpt=None, log=None, park_on_device=False):
+    """The shared streamed training loop (reference recipe parity:
+    configured scheduler, adam betas/eps, no-decay mask, global-norm
+    clip): drives `StreamedAdamW` over `loader`, fires the checkpoint
+    callbacks, and returns a TrainState whose params are parked on
+    device once for the predict path."""
+    import optax
+
+    from fengshen_tpu.models.model_utils import (get_scheduler,
+                                                 get_total_steps)
+    from fengshen_tpu.trainer.train_state import TrainState
+    from fengshen_tpu.utils.utils import report_memory
+
+    total_steps = get_total_steps(args, len(loader.dataset),
+                                  args.train_batchsize)
+    schedule = get_scheduler(args, total_steps)
+    eng = make_streamed(
+        spec,
+        # optax schedules are 0-based; the engine count is 1-based
+        lr_schedule=lambda count: float(schedule(count - 1)),
+        b1=getattr(args, "adam_beta1", 0.9),
+        b2=getattr(args, "adam_beta2", 0.999),
+        eps=getattr(args, "adam_epsilon", 1e-8),
+        weight_decay=getattr(args, "weight_decay", 0.01),
+        clip_norm=getattr(args, "gradient_clip_val", 0.0) or None,
+        use_decay_mask=True)
+
+    class _TrainerView:
+        global_step = 0
+        consumed_samples = 0
+
+    view = _TrainerView()
+
+    def _state():
+        return TrainState.create(apply_fn=apply_fn, params=eng.params(),
+                                 tx=optax.set_to_zero())
+
+    raw_max = getattr(args, "max_steps", 0) or 0
+    max_steps = raw_max if raw_max > 0 else total_steps
+    max_epochs = getattr(args, "max_epochs", None) or 1
+    step = 0
+    rng = jax.random.PRNGKey(getattr(args, "seed", 42))
+    for _epoch in range(max_epochs):
+        for batch in loader:
+            batch = {k: jnp.asarray(v) for k, v in batch.items()
+                     if k != "id"}
+            rng, step_rng = jax.random.split(rng)
+            loss, metrics = eng.step(batch, step_rng)
+            step += 1
+            view.global_step = step
+            view.consumed_samples = step * args.train_batchsize
+            if log is not None and step % max(
+                    getattr(args, "log_every_n_steps", 1), 1) == 0:
+                mem = report_memory("streamed")
+                peak = max((d["peak_bytes_in_use"]
+                            for d in mem.values()), default=0)
+                log(step, loss, metrics, peak)
+            if ckpt is not None and ckpt.every_n_train_steps and \
+                    step % ckpt.every_n_train_steps == 0:
+                # join the host parts only when a save actually fires
+                ckpt.on_train_step_end(view, _state())
+            if step >= max_steps:
+                break
+        if step >= max_steps:
+            break
+    final = _state()
+    if ckpt is not None:
+        ckpt.on_fit_end(view, final)
+    if park_on_device:
+        # predict dispatches per batch; park the joined tree on device
+        # ONCE. Callers whose model dwarfs HBM (the 13B streamed
+        # finetune) must NOT ask for this — the host-resident tree is
+        # the point.
+        return final.replace(params=jax.device_put(final.params))
+    return final

@@ -180,3 +180,56 @@ def test_offload_params_e2e(tmp_path, mesh8):
     lines = [json.loads(x) for x in open(str(out) + ".0")]
     assert len(lines) == 6
     assert sorted(l["id"] for l in lines) == list(range(6))
+
+
+@pytest.mark.slow
+def test_ziya_offload_params_e2e(tmp_path, mesh8, capsys):
+    """finetune_ziya_llama --offload_params: the flagship SFT recipe
+    through the streaming engine (the 13B-finetune mechanism at tiny
+    shape)."""
+    import json
+    import unittest.mock as mock
+
+    from fengshen_tpu.examples.ziya_llama import finetune_ziya_llama
+    from fengshen_tpu.models.llama import LlamaConfig
+
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+
+    class CharTok:
+        pad_token_id = 0
+        eos_token_id = 2
+
+        def encode(self, text, add_special_tokens=True):
+            ids = [min(3 + (ord(c) % 90), 95) for c in text]
+            return ([1] + ids) if add_special_tokens else ids
+
+        @classmethod
+        def from_pretrained(cls, path):
+            return cls()
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=64,
+                      dtype="float32", param_dtype="float32")
+    cfg.save_pretrained(str(model_dir))
+    train = tmp_path / "sft.json"
+    with open(train, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({"query": "你好" * (1 + i % 3),
+                                "answer": "hello"},
+                               ensure_ascii=False) + "\n")
+
+    with mock.patch("transformers.AutoTokenizer.from_pretrained",
+                    CharTok.from_pretrained):
+        finetune_ziya_llama.main([
+            "--model_path", str(model_dir), "--train_file", str(train),
+            "--train_batchsize", "4", "--max_steps", "2",
+            "--max_seq_length", "32", "--log_every_n_steps", "1",
+            "--warmup_steps", "1", "--offload_params",
+            "--default_root_dir", str(tmp_path / "runs"),
+            "--save_ckpt_path", str(tmp_path / "ckpt"),
+            "--load_ckpt_path", str(tmp_path / "ckpt"),
+            "--seed", "1"])
+    out = capsys.readouterr().out
+    assert "[streamed] step=2" in out
