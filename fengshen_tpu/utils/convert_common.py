@@ -211,7 +211,23 @@ def invert_import(torch_to_params_fn, template: Mapping[str, Any],
         ids = tags.ravel() - 0.25
         is_tag = (ids == np.round(ids)) & (ids >= 0) & (ids < total)
         if not is_tag.any():
-            continue  # synthesized leaf (fresh head init) — not exported
+            # No direct tags. A genuinely synthesized leaf is a CONSTANT
+            # init (zeros/ones/any fill value) — constant arrays carry
+            # no template information, so skipping them is safe. Any
+            # NON-constant tag-free leaf must be derived from template
+            # tensors by arithmetic (sums, differences, scales — which
+            # all destroy the +0.25 tag fingerprint while keeping the
+            # values distinct): exporting would silently emit stale
+            # template values, so refuse loudly instead.
+            tvals = tags.ravel()
+            if tvals.size and not np.all(tvals == tvals.flat[0]):
+                raise ValueError(
+                    f"leaf {jax.tree_util.keystr(path)} is tag-free but "
+                    "non-constant — it looks DERIVED from template "
+                    "tensors by arithmetic; this importer needs a "
+                    "hand-written inverse (refusing to export stale "
+                    "template values)")
+            continue  # synthesized constant (fresh head init)
         if not is_tag.all() and not (
                 # mixed leaves happen when the import pads (e.g. rows of
                 # zeros appended); only the tagged positions round-trip
