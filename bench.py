@@ -82,12 +82,84 @@ def main() -> None:
         # deadline OR pending SIGALRM) behind — embedders (e.g. the
         # bench smoke tests) call main() in-process and live long past
         # the deadline
-        _WATCHDOG_DEADLINE[0] = None
-        import signal
-        try:
-            signal.alarm(0)
-        except (ValueError, OSError):
-            pass
+        _disarm_watchdog()
+
+
+# What an OOM looks like through the relay: compile-time OOMs carry the
+# classic "Ran out of memory" allocator text, but RUNTIME OOMs surface as
+# a bare "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted)."
+# (round-5 hardware log) — matching only the former crashed three ladder
+# modes on the first healthy relay in three rounds.
+_OOM_SIGNATURES = ("Ran out of memory", "RESOURCE_EXHAUSTED",
+                   "ResourceExhausted")
+
+
+def _is_oom_text(text: str) -> bool:
+    return any(sig in text for sig in _OOM_SIGNATURES)
+
+
+def _disarm_watchdog() -> None:
+    import signal
+
+    _WATCHDOG_DEADLINE[0] = None
+    try:
+        signal.alarm(0)
+    except (ValueError, OSError):
+        pass
+
+
+def _spawn_rung(env_overrides: dict) -> tuple[int, str]:
+    """One pinned bench attempt in a FRESH interpreter.
+
+    Ladder rungs must not share a process: a rung that OOMs leaves its
+    device buffers pinned on the relay until the client disconnects (the
+    round-5 window showed rung N's leaked buffers OOM-ing rung N+1's
+    state init at a size that fits a clean chip), and a fresh process is
+    the only reliable release. stdout (the one JSON metric line) is
+    inherited; stderr is captured so the caller can tell OOM (ladder
+    down) from wedge (stop) from real failure (propagate), then echoed.
+    """
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ,
+           **{k: str(v) for k, v in env_overrides.items()}}
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stderr=subprocess.PIPE, text=True)
+    sys.stderr.write(proc.stderr or "")
+    sys.stderr.flush()
+    return proc.returncode, proc.stderr or ""
+
+
+def _ladder_of_rungs(rungs: list, label: str,
+                     spawn=_spawn_rung) -> None:
+    """Run pinned-rung subprocesses until one succeeds.
+
+    OOM → step down; wedge (a child watchdog abort) → exit immediately
+    (further rungs would each burn a 150s probe against a dead relay);
+    anything else → propagate the child's rc."""
+    import sys
+
+    _disarm_watchdog()  # children carry their own watchdogs
+    for env_overrides in rungs:
+        rc, err = spawn(env_overrides)
+        if rc == 0:
+            print(f"bench[{label}]: rung {env_overrides} succeeded",
+                  file=sys.stderr, flush=True)
+            return
+        if "accelerator unresponsive" in err:
+            print(f"bench[{label}]: relay wedged, aborting ladder",
+                  file=sys.stderr, flush=True)
+            sys.exit(1)
+        if not _is_oom_text(err):
+            print(f"bench[{label}]: non-OOM failure (rc={rc}), not "
+                  "laddering", file=sys.stderr, flush=True)
+            sys.exit(rc)
+        print(f"bench[{label}]: OOM at {env_overrides}, stepping down",
+              file=sys.stderr, flush=True)
+    raise RuntimeError(f"bench[{label}]: every ladder rung OOM")
 
 
 def _main() -> None:
@@ -106,24 +178,29 @@ def _main() -> None:
         return _run_decode()
 
     batches = os.environ.get("BENCH_BATCH")
-    # OOM-fallback ladder: the tuned per-chip batch first, then safer
-    # sizes — a compile-time OOM on a differently-provisioned chip must
-    # degrade the number, not zero the signal.
-    candidates = [int(batches)] if batches else [28, 24, 16]
-    last_err = None
-    for per_chip in candidates:
-        try:
-            _watchdog()  # re-arm per attempt: each compile gets 540s
-            return _run(per_chip)
-        except Exception as e:  # noqa: BLE001 — retry only compile OOM
-            if "Ran out of memory" not in str(e):
-                raise
-            # keep only the message: holding the exception would pin the
-            # failed attempt's device buffers via its traceback frames
-            last_err = str(e)[:2000]
-            print(f"bench: batch {per_chip} OOM, retrying smaller",
-                  file=__import__("sys").stderr, flush=True)
-    raise RuntimeError(f"bench: all batch sizes OOM; last: {last_err}")
+    if batches:  # pinned: run in-process, let failures propagate
+        return _run(int(batches))
+    # OOM-fallback ladder, one fresh process per rung: the tuned batch
+    # first, then safer sizes — an OOM on a differently-provisioned chip
+    # must degrade the number, not zero the driver signal. On tiles too
+    # small for the materialized-logits path, the chunked fused-CE
+    # config is the honest best config (round-5: fused-CE batch 28 ran
+    # where materialized 28/24 OOM'd).
+    fce_env = os.environ.get("BENCH_FUSED_CE")
+    if fce_env or os.environ.get("BENCH_INT8_LMHEAD", "0") != "0":
+        # a lever row (explicit fused-CE chunking and/or int8 head)
+        # must not silently mix IN the other lever on fallback — the
+        # row would be incomparable to its baseline. Pure batch ladder.
+        rungs = [{"BENCH_BATCH": b, "BENCH_FUSED_CE": fce_env or 0}
+                 for b in (28, 24, 16, 8)]
+    else:
+        rungs = [{"BENCH_BATCH": 28, "BENCH_FUSED_CE": 0},
+                 {"BENCH_BATCH": 24, "BENCH_FUSED_CE": 0},
+                 {"BENCH_BATCH": 28, "BENCH_FUSED_CE": 8},
+                 {"BENCH_BATCH": 16, "BENCH_FUSED_CE": 0},
+                 {"BENCH_BATCH": 16, "BENCH_FUSED_CE": 8},
+                 {"BENCH_BATCH": 8, "BENCH_FUSED_CE": 0}]
+    _ladder_of_rungs(rungs, "default")
 
 
 def _trainer_bench(config, metric_name: str, per_chip: int,
@@ -176,10 +253,14 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
         jax.block_until_ready(state.params)
     except Exception as e:  # noqa: BLE001 — ladder on OOM only
         set_mesh(None)
-        if "Ran out of memory" not in str(e):
+        if not _is_oom_text(str(e)):
             raise
+        # the excerpt keeps the OOM signature in stderr so a parent
+        # _ladder_of_rungs classifies this rung as OOM (step down),
+        # not as a real failure (abort)
         print(f"bench[{metric_name}]: OOM at per_chip={per_chip}, "
-              "stepping down", file=sys.stderr, flush=True)
+              f"stepping down ({str(e)[:160]})", file=sys.stderr,
+              flush=True)
         return False
     set_mesh(None)
     metrics = [json.loads(line)
@@ -222,33 +303,39 @@ def _run_large() -> None:
         print("bench-large: set BOTH BENCH_LAYERS and BENCH_BATCH to pin "
               "a rung; ignoring the lone override and running the ladder",
               file=sys.stderr, flush=True)
-    ladder = ([(int(layers_env), int(batch_env))] if layers_env and
-              batch_env else [(8, 4), (8, 2), (6, 2), (4, 1)])
-    for layers, per_chip in ladder:
-        _watchdog()
-        # env dim overrides exist ONLY for CPU smoking (a 5120-dim
-        # compile exceeds the watchdog on the CPU backend); hardware
-        # runs use the 13B defaults
-        config = LlamaConfig(
-            vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
-            hidden_size=int(os.environ.get("BENCH_HIDDEN", "5120")),
-            intermediate_size=int(os.environ.get("BENCH_INTER", "13824")),
-            num_hidden_layers=layers,
-            num_attention_heads=int(os.environ.get("BENCH_HEADS", "40")),
-            num_key_value_heads=int(os.environ.get("BENCH_KV", "8")),
-            max_position_embeddings=seq, dtype="bfloat16",
-            param_dtype="bfloat16", attention_impl="flash",
-            scan_layers=True, gradient_checkpointing=True,
-            remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"),
-            fused_ce_chunks=int(os.environ.get("BENCH_FUSED_CE", "0")))
-        if _trainer_bench(
-                config, f"llama13bshape_l{layers}_train_tokens_per_sec"
-                "_per_chip", per_chip, seq,
-                flops_attn_term=12.0 * config.num_hidden_layers *
-                config.hidden_size * seq,
-                extra_args=["--offload_optimizer"]):
-            return
-    raise RuntimeError("bench-large: every ladder rung OOM")
+    if not (layers_env and batch_env):
+        # each rung in a fresh process (see _spawn_rung): a failed
+        # rung's relay-side buffers otherwise OOM the next rung
+        return _ladder_of_rungs(
+            [{"BENCH_CONFIG": "large", "BENCH_LAYERS": l,
+              "BENCH_BATCH": b}
+             for l, b in ((8, 4), (8, 2), (6, 2), (4, 1), (2, 1))],
+            "large")
+    layers, per_chip = int(layers_env), int(batch_env)
+    _watchdog()
+    # env dim overrides exist ONLY for CPU smoking (a 5120-dim
+    # compile exceeds the watchdog on the CPU backend); hardware
+    # runs use the 13B defaults
+    config = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
+        hidden_size=int(os.environ.get("BENCH_HIDDEN", "5120")),
+        intermediate_size=int(os.environ.get("BENCH_INTER", "13824")),
+        num_hidden_layers=layers,
+        num_attention_heads=int(os.environ.get("BENCH_HEADS", "40")),
+        num_key_value_heads=int(os.environ.get("BENCH_KV", "8")),
+        max_position_embeddings=seq, dtype="bfloat16",
+        param_dtype="bfloat16", attention_impl="flash",
+        scan_layers=True, gradient_checkpointing=True,
+        remat_policy=os.environ.get("BENCH_REMAT", "dots_no_batch"),
+        fused_ce_chunks=int(os.environ.get("BENCH_FUSED_CE", "0")))
+    if not _trainer_bench(
+            config, f"llama13bshape_l{layers}_train_tokens_per_sec"
+            "_per_chip", per_chip, seq,
+            flops_attn_term=12.0 * config.num_hidden_layers *
+            config.hidden_size * seq,
+            extra_args=["--offload_optimizer"]):
+        raise RuntimeError(
+            f"bench-large: rung l{layers} b{per_chip} OOM")
 
 
 def _run_sharded() -> None:

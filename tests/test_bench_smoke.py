@@ -92,3 +92,77 @@ def test_bench_decode_beam(monkeypatch):
                                    "BENCH_NEW_TOKENS": "16",
                                    "BENCH_DECODE_RUNS": "1"})
     assert row["metric"] == "t5beam4_decode_tokens_per_sec_per_chip"
+
+
+# ---- fresh-process OOM ladder (round-5 fix) -------------------------
+# The first healthy relay in three rounds crashed three bench modes:
+# runtime OOMs surface as a bare "ResourceExhausted" (not "Ran out of
+# memory"), and an OOM'd rung's relay-side buffers OOM the NEXT rung
+# when rungs share a process. The ladder now matches both signatures
+# and runs each rung via _spawn_rung; these tests drive the ladder
+# decision logic through a stub spawner.
+
+
+def test_is_oom_text_matches_both_relay_forms():
+    import bench
+
+    assert bench._is_oom_text(
+        "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted).")
+    assert bench._is_oom_text(
+        "XlaRuntimeError: Ran out of memory in memory space hbm")
+    assert not bench._is_oom_text("INTERNAL: HTTP 500: compile helper")
+
+
+def test_ladder_steps_down_on_oom_then_stops():
+    import bench
+
+    calls = []
+
+    def spawn(env):
+        calls.append(env)
+        return (0, "") if len(calls) == 3 else \
+            (1, "jax.errors.JaxRuntimeError: RESOURCE_EXHAUSTED: TPU "
+                "backend error (ResourceExhausted).")
+
+    bench._ladder_of_rungs(
+        [{"BENCH_BATCH": b} for b in (28, 24, 16, 8)], "t",
+        spawn=spawn)
+    assert [c["BENCH_BATCH"] for c in calls] == [28, 24, 16]
+
+
+def test_ladder_aborts_on_wedge_without_retrying(capsys):
+    import bench
+
+    calls = []
+
+    def spawn(env):
+        calls.append(env)
+        return 1, ("bench watchdog (thread): accelerator unresponsive,"
+                   " aborting")
+
+    with pytest.raises(SystemExit):
+        bench._ladder_of_rungs([{"BENCH_BATCH": 28},
+                                {"BENCH_BATCH": 8}], "t", spawn=spawn)
+    assert len(calls) == 1  # no pointless probes against a dead relay
+
+
+def test_ladder_propagates_non_oom_failure():
+    import bench
+
+    def spawn(env):
+        return 7, "ValueError: something real broke"
+
+    with pytest.raises(SystemExit) as exc:
+        bench._ladder_of_rungs([{"BENCH_BATCH": 28},
+                                {"BENCH_BATCH": 8}], "t", spawn=spawn)
+    assert exc.value.code == 7
+
+
+def test_ladder_raises_when_every_rung_ooms():
+    import bench
+
+    def spawn(env):
+        return 1, "Ran out of memory in memory space hbm"
+
+    with pytest.raises(RuntimeError, match="every ladder rung OOM"):
+        bench._ladder_of_rungs([{"BENCH_BATCH": 28}], "t", spawn=spawn)

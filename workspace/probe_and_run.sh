@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll the relay with the watchdogged probe every 20 min; on the first
+# healthy probe, run the remaining round-5 bench rows and exit. Each
+# probe is a fresh process (round-3/4 practice) — at most one orphaned
+# 256x256 matmul is left on an already-wedged relay per poll.
+cd "$(dirname "$0")/.."
+while true; do
+  if python workspace/probe.py; then
+    echo "relay healthy at $(date -u +%H:%M:%S) — running remaining rows"
+    bash workspace/run_bench_remaining_r5.sh 2>&1 | tee /tmp/bench_remaining_r5.log
+    exit 0
+  fi
+  sleep 1200
+done

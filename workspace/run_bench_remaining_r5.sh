@@ -1,0 +1,56 @@
+#!/bin/bash
+# Rows NOT yet captured in the round-5 hardware window (the relay
+# wedged at the decode-int8 row after ~8 healthy minutes). Already
+# banked on 2026-07-31: sharded 25,760 tok/s, fused-CE@b28 27,724
+# tok/s, offload-update 14,103 tok/s, decode-greedy 2,351 tok/s/chip
+# (docs/performance.md "Round-5 hardware window"). Run this on the
+# NEXT healthy probe; same rules as run_bench_suite.sh (no external
+# timeouts ever).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  python workspace/probe.py || exit 1
+}
+
+echo "== probe"; probe
+
+echo "== 13B-shape bench (north star; fresh-process rung ladder)"
+BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
+
+echo "== probe"; probe
+
+echo "== default bench (fresh-process batch/fused-CE ladder)"
+python bench.py | tee /tmp/bench_default.json
+
+echo "== probe"; probe
+
+echo "== fused CE + bigger batch"
+BENCH_FUSED_CE=8 BENCH_BATCH=32 python bench.py | tee /tmp/bench_fused_ce_b32.json || true
+
+echo "== headroom lever: int8 LM-head (train)"
+BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
+
+echo "== probe"; probe
+
+echo "== measured 7GB claim: 1.3B AFQMC shape with param streaming"
+python workspace/offload_7gb_check.py | tee /tmp/bench_offload_7gb.json
+
+echo "== probe"; probe
+
+echo "== decode throughput: seq2seq beam-4 (T5-base shape)"
+BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_beam.json
+
+echo "== probe"; probe
+
+echo "== WEDGE-SUSPECT ROWS LAST =="
+echo "== decode throughput: int8 LM head (wedged the relay in r5)"
+BENCH_CONFIG=decode BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_decode_int8.json
+
+echo "== probe"; probe
+
+echo "== block-sparse vs dense flash timing (wedged r3)"
+python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
+
+echo "== probe"; probe
+echo "ALL DONE"

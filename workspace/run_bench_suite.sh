@@ -1,64 +1,70 @@
 #!/bin/bash
-# The round-3 pending hardware rows, in one pass. Run ONLY after the
-# 256x256 probe succeeds (see .claude/skills/verify/SKILL.md). No
-# `timeout` wrappers anywhere — killed in-flight TPU work wedges the
-# relay; bench.py's internal watchdog is the only safe abort.
+# The pending hardware rows, in one pass. Run ONLY after the 256x256
+# probe succeeds (see .claude/skills/verify/SKILL.md). No `timeout`
+# wrappers anywhere — killed in-flight TPU work wedges the relay;
+# bench.py's internal watchdog is the only safe abort.
+#
+# Ordering is by value-per-healthy-minute (the round-5 window lasted
+# ~8 minutes before the decode-int8 row wedged the relay): the 13B
+# north-star ladder FIRST, then the 300M regression rows, then levers,
+# then the wedge-suspect rows (int8 decode wedged r5, block-sparse
+# timing wedged r3) dead last.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 probe() {
-  python - << 'EOF'
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256), jnp.bfloat16)
-print("probe ok:", float((x @ x).block_until_ready()[0, 0]))
-EOF
+  python workspace/probe.py || exit 1
 }
 
-echo "== probe"; probe || exit 1
+echo "== probe"; probe
 
-echo "== default bench (regression guard: expect ~1.9 vs_baseline)"
+echo "== 13B-shape bench (GQA + offload ladder; first compile is long)"
+BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
+
+echo "== probe"; probe
+
+echo "== default bench (regression guard)"
 python bench.py | tee /tmp/bench_default.json
 
 echo "== sharded-step bench"
 BENCH_CONFIG=sharded python bench.py | tee /tmp/bench_sharded.json
 
-echo "== probe"; probe || exit 1
+echo "== probe"; probe
 
-echo "== 13B-shape bench (GQA + offload ladder; first compile is long)"
-BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
-
-echo "== probe"; probe || exit 1
-
-echo "== headroom lever: int8 LM-head on the default 300M shape"
-BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
-
-echo "== headroom lever: chunked fused LM-head+CE (frees ~3.7GB logits)"
+echo "== headroom lever: chunked fused LM-head+CE (frees the fp32 logits)"
 BENCH_FUSED_CE=8 python bench.py | tee /tmp/bench_fused_ce.json
 echo "== fused CE + bigger batch (the point of the lever)"
 BENCH_FUSED_CE=8 BENCH_BATCH=40 python bench.py | tee /tmp/bench_fused_ce_b40.json || true
 BENCH_FUSED_CE=8 BENCH_BATCH=32 python bench.py | tee /tmp/bench_fused_ce_b32.json || true
 
+echo "== headroom lever: int8 LM-head on the default 300M shape"
+BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
+
 echo "== headroom lever: offloaded optimizer update (300M via Trainer)"
 BENCH_CONFIG=sharded BENCH_OFFLOAD=1 python bench.py | tee /tmp/bench_offload.json
 
-echo "== probe"; probe || exit 1
-
-echo "== decode throughput: greedy KV-cached (300M shape)"
-BENCH_CONFIG=decode python bench.py | tee /tmp/bench_decode_greedy.json
-echo "== decode throughput: int8 LM head"
-BENCH_CONFIG=decode BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_decode_int8.json
-echo "== decode throughput: seq2seq beam-4 (T5-base shape)"
-BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_beam.json
-
-echo "== probe"; probe || exit 1
+echo "== probe"; probe
 
 echo "== measured 7GB claim: 1.3B AFQMC shape with param streaming"
 python workspace/offload_7gb_check.py | tee /tmp/bench_offload_7gb.json
 
-echo "== probe"; probe || exit 1
+echo "== probe"; probe
 
-echo "== block-sparse vs dense flash timing (S=4096/8192)"
+echo "== decode throughput: greedy KV-cached (300M shape)"
+BENCH_CONFIG=decode python bench.py | tee /tmp/bench_decode_greedy.json
+echo "== decode throughput: seq2seq beam-4 (T5-base shape)"
+BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_beam.json
+
+echo "== probe"; probe
+
+echo "== WEDGE-SUSPECT ROWS LAST =="
+echo "== decode throughput: int8 LM head (wedged the relay in r5)"
+BENCH_CONFIG=decode BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_decode_int8.json
+
+echo "== probe"; probe
+
+echo "== block-sparse vs dense flash timing (S=4096/8192; wedged r3)"
 python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
 
-echo "== probe"; probe || exit 1
+echo "== probe"; probe
 echo "ALL DONE — paste the rows into docs/performance.md"
