@@ -165,11 +165,21 @@ def _ladder_of_rungs(rungs: list, label: str,
 def _main() -> None:
     import os
 
-    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
-        _probe_accelerator()
-    _watchdog()
-
     mode = os.environ.get("BENCH_CONFIG", "default")
+    batches = os.environ.get("BENCH_BATCH")
+    # A ladder PARENT never touches the accelerator (no probe, no
+    # watchdog): each child rung probes for itself, and a parent-held
+    # client would contend with its children on exclusive-access
+    # backends (directly-attached TPU device lock, GPU preallocation).
+    is_parent = (
+        (mode == "default" and not batches) or
+        (mode == "large" and not (os.environ.get("BENCH_LAYERS") and
+                                  batches)))
+    if not is_parent:
+        if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
+            _probe_accelerator()
+        _watchdog()
+
     if mode == "large":
         return _run_large()
     if mode == "sharded":
@@ -177,7 +187,6 @@ def _main() -> None:
     if mode == "decode":
         return _run_decode()
 
-    batches = os.environ.get("BENCH_BATCH")
     if batches:  # pinned: run in-process, let failures propagate
         return _run(int(batches))
     # OOM-fallback ladder, one fresh process per rung: the tuned batch
@@ -205,11 +214,18 @@ def _main() -> None:
 
 def _trainer_bench(config, metric_name: str, per_chip: int,
                    seq: int, flops_attn_term: float,
-                   extra_args: list, steps: int = 8) -> bool:
+                   extra_args: list, steps: int = 15) -> bool:
     """One Trainer-driven bench attempt in a FRESH run dir (Trainer
     appends to metrics.jsonl, so reusing a dir would mix runs/rungs).
     Returns True on success; raises on non-OOM errors; returns False on
-    compile/runtime OOM so the caller's ladder can step down."""
+    compile/runtime OOM so the caller's ladder can step down.
+
+    Logging is windowed (every 3 steps), not per-step: materializing
+    metrics each step blocks dispatch on the host pulling device values
+    — through the axon relay that adds a full tunnel round-trip to
+    EVERY step (the round-5 window measured trainer rows well below the
+    raw-loop row on the same shape). With a 3-step window, steady-state
+    steps pipeline back-to-back and only the window edge syncs."""
     import argparse
     import sys
     import tempfile
@@ -231,7 +247,7 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     args = parser.parse_args([
         "--max_steps", str(steps),
         "--train_batchsize", str(per_chip * n_dev),
-        "--log_every_n_steps", "1", "--warmup_steps", "1",
+        "--log_every_n_steps", "3", "--warmup_steps", "1",
         "--default_root_dir", root] + extra_args)
     rng = np.random.RandomState(0)
     rows = [{"input_ids":
@@ -255,17 +271,19 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
         set_mesh(None)
         if not _is_oom_text(str(e)):
             raise
-        # the excerpt keeps the OOM signature in stderr so a parent
-        # _ladder_of_rungs classifies this rung as OOM (step down),
-        # not as a real failure (abort)
-        print(f"bench[{metric_name}]: OOM at per_chip={per_chip}, "
-              f"stepping down ({str(e)[:160]})", file=sys.stderr,
-              flush=True)
+        # the fixed "(ResourceExhausted)" marker guarantees a parent
+        # _ladder_of_rungs classifies this rung as OOM (step down) no
+        # matter how the backend phrased the message; the excerpt is
+        # for the human log
+        print(f"bench[{metric_name}]: OOM (ResourceExhausted) at "
+              f"per_chip={per_chip}, stepping down ({str(e)[:160]})",
+              file=sys.stderr, flush=True)
         return False
     set_mesh(None)
     metrics = [json.loads(line)
                for line in open(f"{root}/metrics.jsonl")]
-    # steady-state: skip the compile step and one settling step
+    # steady-state: drop the first two 3-step windows (compile +
+    # settling); average the remaining windowed readings
     tps_list = [m["tokens_per_sec"] for m in metrics
                 if "tokens_per_sec" in m][2:]
     tps = float(np.mean(tps_list)) if tps_list else 0.0
