@@ -162,24 +162,23 @@ def _ladder_of_rungs(rungs: list, label: str,
     raise RuntimeError(f"bench[{label}]: every ladder rung OOM")
 
 
+def _probe_and_arm() -> None:
+    """Probe + arm the watchdog — called at the top of every LEAF bench
+    path (one that actually touches the accelerator). Ladder parents
+    never call it: each child rung probes for itself, and a parent-held
+    client would contend with its children on exclusive-access backends
+    (directly-attached TPU device lock, GPU preallocation)."""
+    import os
+
+    if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
+        _probe_accelerator()
+    _watchdog()
+
+
 def _main() -> None:
     import os
 
     mode = os.environ.get("BENCH_CONFIG", "default")
-    batches = os.environ.get("BENCH_BATCH")
-    # A ladder PARENT never touches the accelerator (no probe, no
-    # watchdog): each child rung probes for itself, and a parent-held
-    # client would contend with its children on exclusive-access
-    # backends (directly-attached TPU device lock, GPU preallocation).
-    is_parent = (
-        (mode == "default" and not batches) or
-        (mode == "large" and not (os.environ.get("BENCH_LAYERS") and
-                                  batches)))
-    if not is_parent:
-        if os.environ.get("BENCH_SKIP_PROBE", "0") != "1":
-            _probe_accelerator()
-        _watchdog()
-
     if mode == "large":
         return _run_large()
     if mode == "sharded":
@@ -187,7 +186,9 @@ def _main() -> None:
     if mode == "decode":
         return _run_decode()
 
+    batches = os.environ.get("BENCH_BATCH")
     if batches:  # pinned: run in-process, let failures propagate
+        _probe_and_arm()
         return _run(int(batches))
     # OOM-fallback ladder, one fresh process per rung: the tuned batch
     # first, then safer sizes — an OOM on a differently-provisioned chip
@@ -238,6 +239,10 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     from fengshen_tpu.trainer.modules import CausalLMModule
     from fengshen_tpu.trainer.trainer import PEAK_FLOPS
 
+    # 900s, not the default 540: a 13B-shape rung is a long remote
+    # compile plus 15 steps — a slow-but-healthy rung hitting the
+    # watchdog would read as a wedge and abort the whole ladder
+    _watchdog(900)
     n_dev = len(jax.devices())
     root = tempfile.mkdtemp(prefix="fstpu_bench_")
     parser = argparse.ArgumentParser()
@@ -323,14 +328,23 @@ def _run_large() -> None:
               file=sys.stderr, flush=True)
     if not (layers_env and batch_env):
         # each rung in a fresh process (see _spawn_rung): a failed
-        # rung's relay-side buffers otherwise OOM the next rung
+        # rung's relay-side buffers otherwise OOM the next rung.
+        # Lower rungs mix in chunked fused CE (~1-2 GB of fp32 logits
+        # freed at seq 2048) — on a small tile that rescues a deeper
+        # rung, which is worth more than a materialized shallow one.
+        rungs = [(8, 4, 0), (8, 4, 8), (8, 2, 8), (6, 2, 8),
+                 (4, 1, 8), (2, 1, 8)]
+        if os.environ.get("BENCH_FUSED_CE"):  # explicit: honor it
+            fce = os.environ["BENCH_FUSED_CE"]
+            rungs = list(dict.fromkeys(
+                (l, b, fce) for l, b, _ in rungs))
         return _ladder_of_rungs(
             [{"BENCH_CONFIG": "large", "BENCH_LAYERS": l,
-              "BENCH_BATCH": b}
-             for l, b in ((8, 4), (8, 2), (6, 2), (4, 1), (2, 1))],
+              "BENCH_BATCH": b, "BENCH_FUSED_CE": f}
+             for l, b, f in rungs],
             "large")
     layers, per_chip = int(layers_env), int(batch_env)
-    _watchdog()
+    _probe_and_arm()
     # env dim overrides exist ONLY for CPU smoking (a 5120-dim
     # compile exceeds the watchdog on the CPU backend); hardware
     # runs use the 13B defaults

@@ -30,5 +30,15 @@ import jax.numpy as jnp
 
 x = jnp.ones((256, 256), jnp.bfloat16)
 v = float((x @ x).block_until_ready()[0, 0])
-_done.set()
 print(f"probe ok: backend={jax.default_backend()} val={v} dt={time.time()-t0:.1f}s")
+try:  # tile capacity diagnostic (the r5 window OOM'd at r2-proven sizes)
+    stats = jax.devices()[0].memory_stats() or {}
+    lim = stats.get("bytes_limit")
+    used = stats.get("bytes_in_use")
+    if lim:
+        print(f"probe hbm: limit={lim/2**30:.2f}GiB in_use={(used or 0)/2**30:.2f}GiB")
+except Exception as e:  # noqa: BLE001 — diagnostic only
+    print(f"probe hbm: unavailable ({e})")
+# disarm only after the LAST device call — the diagnostic is a relay
+# round-trip too, and a hung probe defeats the probe's whole contract
+_done.set()
