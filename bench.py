@@ -228,6 +228,7 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     raw-loop row on the same shape). With a 3-step window, steady-state
     steps pipeline back-to-back and only the window edge syncs."""
     import argparse
+    import os
     import sys
     import tempfile
 
@@ -244,6 +245,12 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     # watchdog would read as a wedge and abort the whole ladder
     _watchdog(900)
     n_dev = len(jax.devices())
+    # BENCH_STEPS_PER_EXEC=K: scan K optimizer steps inside one jitted
+    # dispatch (Trainer --steps_per_execution) — A/B row for the relay
+    # dispatch-latency tax measured in the round-5 window
+    spe = os.environ.get("BENCH_STEPS_PER_EXEC")
+    if spe:
+        extra_args = extra_args + ["--steps_per_execution", spe]
     root = tempfile.mkdtemp(prefix="fstpu_bench_")
     parser = argparse.ArgumentParser()
     add_module_args(parser)

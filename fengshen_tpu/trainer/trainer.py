@@ -51,11 +51,39 @@ def _prefetch(loader, shardings, depth: int = 2):
     import collections
     queue = collections.deque()
     for batch in loader:
-        queue.append((batch, jax.device_put(batch, shardings)))
+        queue.append(([batch], jax.device_put(batch, shardings)))
         if len(queue) >= depth:
             yield queue.popleft()
     while queue:
         yield queue.popleft()
+
+
+def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
+    """K-step grouping for --steps_per_execution: stack K host batches on
+    a new leading axis and issue ONE device_put; the scan-based K-step
+    program then runs K optimizer steps per dispatch. Yields
+    (list_of_k_host_batches, stacked_device_batch)."""
+    import collections
+    queue = collections.deque()
+    group = []
+    for batch in loader:
+        group.append(batch)
+        if len(group) < k:
+            continue
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *group)
+        queue.append((group, jax.device_put(stacked, shardings)))
+        group = []
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+    if group:
+        # a partial tail cannot feed the K-step program (a different
+        # leading axis means a recompile) — drop it LOUDLY
+        print(f"[fengshen-tpu] steps_per_execution={k}: dropping "
+              f"{len(group)} tail batch(es) short of a full group",
+              flush=True)
 
 
 def add_trainer_args(parent_parser: argparse.ArgumentParser):
@@ -68,6 +96,16 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
                         help="steps between validation runs (0 = per epoch)")
     parser.add_argument("--limit_val_batches", default=0, type=int)
     parser.add_argument("--log_every_n_steps", default=10, type=int)
+    parser.add_argument(
+        "--steps_per_execution", default=1, type=int,
+        help="run K optimizer steps inside ONE jitted program "
+             "(lax.scan over K stacked batches): amortizes host "
+             "dispatch / interconnect round-trips when per-step launch "
+             "latency is comparable to step compute. Checkpoint, "
+             "validation, and preemption checks run between "
+             "executions; a tail short of K batches is dropped loudly; "
+             "max_steps is rounded DOWN to a multiple of K; ignored "
+             "(with a warning) under --offload_optimizer")
     parser.add_argument("--accumulate_grad_batches", default=1, type=int)
     parser.add_argument("--gradient_clip_val", default=0.0, type=float)
     parser.add_argument("--precision", default="bf16", type=str,
@@ -192,9 +230,59 @@ class Trainer:
                 lambda spec: NamedSharding(mesh, spec), batch_spec,
                 is_leaf=lambda x: isinstance(x, P))
 
+        # eval/predict always feed single batches — stash the per-batch
+        # shardings regardless of which train feed shape is returned
+        self._batch_sh = batch_shardings
+
+        spe = max(int(getattr(self.args, "steps_per_execution", 1)), 1)
         if getattr(self.args, "offload_optimizer", False):
+            if spe > 1:
+                import sys
+                print("[fengshen-tpu] --steps_per_execution is ignored "
+                      "with --offload_optimizer (the offloaded update is "
+                      "a two-program step with a host round-trip per "
+                      "step — scanning K steps on-device would keep the "
+                      "moments in HBM and defeat the offload)",
+                      file=sys.stderr, flush=True)
             return self._build_offloaded_train_step(
                 module, state_sh, batch_shardings), batch_shardings
+
+        if spe > 1:
+            # K steps per dispatch: scan over K stacked batches. The rng
+            # fold_in(rng, state.step) inside grad_step makes substep
+            # randomness identical to the K=1 path step for step.
+            def multi_step(state: TrainState, batches, rng):
+                def body(st, batch):
+                    grads, m = grad_step(st.params, batch, rng, st.step)
+                    return st.apply_gradients(grads), m
+                state, metrics = jax.lax.scan(body, state, batches)
+                # same reduction policy as grad accumulation: floats
+                # average over the K substeps, counts keep the last
+                metrics = jax.tree_util.tree_map(
+                    lambda m: m.mean() if jnp.issubdtype(
+                        m.dtype, jnp.floating) else m[-1], metrics)
+                return state, metrics
+
+            def to_stacked(spec, leaf):
+                shape = (spe,) + tuple(np.shape(leaf)) \
+                    if leaf is not None else ()
+                return NamedSharding(
+                    mesh, _spec_fits(P(None, *spec), mesh, shape))
+
+            if sample_batch is not None:
+                stacked_sh = jax.tree_util.tree_map(
+                    to_stacked, batch_spec, sample_batch,
+                    is_leaf=lambda x: isinstance(x, P))
+            else:
+                stacked_sh = jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(mesh, P(None, *spec)),
+                    batch_spec, is_leaf=lambda x: isinstance(x, P))
+            return jax.jit(
+                multi_step,
+                in_shardings=(state_sh, stacked_sh, None),
+                out_shardings=(state_sh, None),
+                donate_argnums=(0,),
+            ), stacked_sh
 
         return jax.jit(
             train_step,
@@ -344,6 +432,28 @@ class Trainer:
         from fengshen_tpu.models.model_utils import get_total_steps
         total_steps = get_total_steps(args, dataset_len, world_batch)
 
+        max_steps = getattr(args, "max_steps", -1)
+        if max_steps is None or max_steps <= 0:
+            max_steps = total_steps
+        spe = 1 if getattr(args, "offload_optimizer", False) else \
+            max(int(getattr(args, "steps_per_execution", 1)), 1)
+        if spe > 1:
+            # a K-step program only stops on execution boundaries, so
+            # the step budget must be a multiple of K — clamp/round
+            # DOWN and say so rather than silently overshooting the LR
+            # schedule (parity contract with the K=1 run)
+            if spe > max_steps:
+                self._log({"event": "steps_per_execution_clamped",
+                           "from": spe, "to": int(max_steps)})
+                spe = int(max_steps)
+                args.steps_per_execution = spe
+            if max_steps % spe:
+                self._log({"event": "max_steps_rounded_down",
+                           "from": int(max_steps),
+                           "to": int(max_steps - max_steps % spe),
+                           "steps_per_execution": spe})
+                max_steps -= max_steps % spe
+
         # build sharded state (peek never advances the stateful sampler)
         sample_batch = meta_loader.peek() if hasattr(meta_loader, "peek") \
             else next(iter(meta_loader))
@@ -369,7 +479,10 @@ class Trainer:
         step_fn, batch_sh = self._build_train_step(module, state_sh,
                                                    batch_spec, sample_batch)
         self._state_sh = state_sh
-        self._batch_sh = batch_sh
+        # eval/predict always feed SINGLE batches — under
+        # steps_per_execution>1 the train feed (batch_sh) is stacked;
+        # _build_train_step stashed the per-batch shardings for the
+        # validation path in self._batch_sh either way
 
         n_params = sum(np.prod(p.shape) for p in
                        jax.tree_util.tree_leaves(state.params))
@@ -379,9 +492,6 @@ class Trainer:
 
         flops_per_tok = module.flops_per_token() or 6.0 * float(n_params)
         peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, None)
-        max_steps = getattr(args, "max_steps", -1)
-        if max_steps is None or max_steps <= 0:
-            max_steps = total_steps
         log_every = max(int(getattr(args, "log_every_n_steps", 10)), 1)
         val_interval = int(getattr(args, "val_check_interval", 0) or 0)
 
@@ -391,6 +501,12 @@ class Trainer:
             profile_range = (lo, hi)
             self._profiling = False
 
+        def crossed(prev: int, cur: int, every: int) -> bool:
+            # did [prev+1, cur] contain a multiple of `every`? (an
+            # execution advances global_step by spe, which may jump
+            # over the exact multiple)
+            return every > 0 and (cur // every) > (prev // every)
+
         t_last = time.perf_counter()
         tokens_since = 0
         epoch = 0
@@ -398,15 +514,22 @@ class Trainer:
         while not done:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(epoch)
-            for batch, device_batch in _prefetch(train_loader, batch_sh):
+            feed = (_prefetch(train_loader, batch_sh) if spe == 1 else
+                    _prefetch_grouped(train_loader, batch_sh, spe))
+            for group, device_batch in feed:
                 if profile_range is not None:
                     self._maybe_profile(profile_range)
                 state, metrics = step_fn(state, device_batch, rng)
-                self.global_step = int(self.global_step) + 1
-                self.consumed_samples += world_batch
-                tokens_since += module.tokens_in_batch(batch)
+                prev_step = int(self.global_step)
+                self.global_step = prev_step + len(group)
+                # callbacks (e.g. every-n checkpointing) need the span
+                # of this execution to detect crossed boundaries
+                self.prev_global_step = prev_step
+                self.consumed_samples += world_batch * len(group)
+                tokens_since += sum(module.tokens_in_batch(b)
+                                    for b in group)
 
-                if self.global_step % log_every == 0:
+                if crossed(prev_step, self.global_step, log_every):
                     metrics = {k: float(v) for k, v in metrics.items()}
                     now = time.perf_counter()
                     dt = now - t_last
@@ -422,7 +545,7 @@ class Trainer:
                     self._log(entry)
                     t_last, tokens_since = now, 0
 
-                if val_interval and self.global_step % val_interval == 0:
+                if crossed(prev_step, self.global_step, val_interval):
                     self._run_validation(module, datamodule, state, rng)
                 for cb in self.callbacks:
                     if hasattr(cb, "on_train_step_end"):
@@ -463,7 +586,12 @@ class Trainer:
         """Start/stop a jax.profiler trace over the configured step window
         (SURVEY.md §5.1: trace-guided perf work instead of guesses)."""
         lo, hi = profile_range
-        if not self._profiling and self.global_step == lo:
+        if getattr(self, "_profile_done", False):
+            return
+        # >= lo (not a range test): under --steps_per_execution the
+        # observed global_step values can jump clean over [lo, hi) — a
+        # late start still captures at least one full execution
+        if not self._profiling and self.global_step >= lo:
             path = os.path.join(
                 getattr(self.args, "default_root_dir", "./runs"), "profile")
             os.makedirs(path, exist_ok=True)
@@ -474,6 +602,7 @@ class Trainer:
         elif self._profiling and self.global_step >= hi:
             jax.profiler.stop_trace()
             self._profiling = False
+            self._profile_done = True
             self._log({"event": "profile_stop", "step": self.global_step})
 
     # -- predict ---------------------------------------------------------

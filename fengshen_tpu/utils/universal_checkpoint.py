@@ -165,8 +165,15 @@ class UniversalCheckpoint:
 
     # -- trainer hooks --------------------------------------------------------
     def on_train_step_end(self, trainer: Any, state: Any) -> None:
-        if self.every_n_train_steps and \
-                trainer.global_step % self.every_n_train_steps == 0:
+        if not self.every_n_train_steps:
+            return
+        # boundary-CROSSING, not equality: under --steps_per_execution K
+        # global_step advances K at a time and can jump over the exact
+        # multiple (trainer sets prev_global_step per execution)
+        prev = int(getattr(trainer, "prev_global_step",
+                           trainer.global_step - 1))
+        if (trainer.global_step // self.every_n_train_steps) > \
+                (prev // self.every_n_train_steps):
             self.save(state, trainer)
 
     def on_fit_end(self, trainer: Any, state: Any) -> None:

@@ -166,3 +166,9 @@ def test_ladder_raises_when_every_rung_ooms():
 
     with pytest.raises(RuntimeError, match="every ladder rung OOM"):
         bench._ladder_of_rungs([{"BENCH_BATCH": 28}], "t", spawn=spawn)
+
+
+def test_bench_sharded_steps_per_exec(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_CONFIG": "sharded",
+                                   "BENCH_STEPS_PER_EXEC": "3"})
+    assert row["metric"] == "llama300m_sharded_step_tokens_per_sec_per_chip"

@@ -214,8 +214,9 @@ def test_backbone_import_from_hf_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("extra", [[], ["--offload_optimizer"]],
-                         ids=["plain", "offload"])
+@pytest.mark.parametrize("extra", [[], ["--offload_optimizer"],
+                                   ["--steps_per_execution", "2"]],
+                         ids=["plain", "offload", "multistep"])
 def test_finetune_classification_e2e(tmp_path, mesh8, extra, monkeypatch):
     """fit → predict → save_test on a tiny huggingface-auto (bert) config;
     the offload variant is the 7 GB demo recipe path."""

@@ -506,3 +506,104 @@ def test_async_checkpoint_save_and_resume(tmp_path, mesh8):
     leaves2 = jax.tree_util.tree_leaves(state2.params)
     np.testing.assert_allclose(np.asarray(leaves1[0]),
                                np.asarray(leaves2[0]), rtol=1e-6)
+
+
+def _fit_tiny(tmp_path, extra_args, seed_data=7):
+    """Shared driver for the steps_per_execution parity test."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    cfg = LlamaConfig.small_test_config(dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(seed_data)
+    data = [{"input_ids": rng.randint(0, 255, 16).tolist()}
+            for _ in range(64)]
+
+    class ListDS:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    args = _parse(["--max_steps", "4", "--train_batchsize", "8",
+                   "--learning_rate", "1e-3", "--warmup_steps", "1",
+                   "--log_every_n_steps", "1",
+                   "--default_root_dir", str(tmp_path)] + extra_args)
+    module = CausalLMModule(args, model, cfg)
+    dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+    state = Trainer(args).fit(module, dm)
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "metrics.jsonl"))]
+    losses = [l["loss"] for l in lines if "loss" in l]
+    return state, losses
+
+
+def test_steps_per_execution_parity(mesh8, tmp_path):
+    """--steps_per_execution K runs K optimizer steps per jitted
+    dispatch (lax.scan over stacked batches) and must match the K=1
+    run step for step: the rng fold_in(step) keeps substep dropout
+    identical, so final params agree to float tolerance and the
+    windowed loss logs are the per-window means of the K=1 losses."""
+    state1, losses1 = _fit_tiny(tmp_path / "a", [])
+    state2, losses2 = _fit_tiny(
+        tmp_path / "b", ["--steps_per_execution", "2"])
+
+    assert int(state1.step) == int(state2.step) == 4
+    # spe=2 logs once per execution (steps 2 and 4), each the mean of
+    # its two substeps
+    assert len(losses1) == 4 and len(losses2) == 2
+    np.testing.assert_allclose(
+        losses2, [np.mean(losses1[:2]), np.mean(losses1[2:])],
+        rtol=2e-5)
+    flat1 = jax.tree_util.tree_leaves(state1.params)
+    flat2 = jax.tree_util.tree_leaves(state2.params)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-4)
+
+
+def test_grouped_prefetch_drops_partial_tail(capsys):
+    from fengshen_tpu.trainer.trainer import _prefetch_grouped
+
+    batches = [{"x": np.full((2,), i)} for i in range(5)]
+    dev = jax.devices("cpu")[0]
+    sh = jax.tree_util.tree_map(
+        lambda _: jax.sharding.SingleDeviceSharding(dev), {"x": 0})
+    out = list(_prefetch_grouped(iter(batches), sh["x"], 2))
+    assert len(out) == 2
+    group, stacked = out[0]
+    assert len(group) == 2 and stacked["x"].shape == (2, 2)
+    assert "dropping 1 tail batch" in capsys.readouterr().out
+
+
+def test_every_n_checkpoint_fires_on_crossed_boundary():
+    """Under steps_per_execution the global step jumps K at a time;
+    every-n checkpointing must fire when a multiple of n falls INSIDE
+    the execution span, not only on exact hits."""
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    class _T:
+        pass
+
+    cb = UniversalCheckpoint.__new__(UniversalCheckpoint)
+    cb.every_n_train_steps = 8
+    saved = []
+    cb.save = lambda state, trainer, **kw: saved.append(
+        trainer.global_step)
+
+    t = _T()
+    for prev, cur in [(0, 5), (5, 10), (10, 15), (15, 20), (20, 25)]:
+        t.prev_global_step, t.global_step = prev, cur
+        cb.on_train_step_end(t, state=None)
+    # multiples of 8 (8, 16, 24) fall inside spans (5,10], (15,20],
+    # (20,25] -> saves at global steps 10, 20, 25
+    assert saved == [10, 20, 25]
+
+    # K=1 semantics unchanged: exact-multiple steps save, others don't
+    saved.clear()
+    for cur in range(1, 17):
+        t.prev_global_step, t.global_step = cur - 1, cur
+        cb.on_train_step_end(t, state=None)
+    assert saved == [8, 16]

@@ -31,6 +31,9 @@ BENCH_FUSED_CE=8 BENCH_BATCH=32 python bench.py | tee /tmp/bench_fused_ce_b32.js
 echo "== headroom lever: int8 LM-head (train)"
 BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
 
+echo "== dispatch-latency A/B: 5 steps per jitted execution (vs banked 25,760 sharded row)"
+BENCH_CONFIG=sharded BENCH_STEPS_PER_EXEC=5 python bench.py | tee /tmp/bench_sharded_spe5.json
+
 echo "== probe"; probe
 
 echo "== measured 7GB claim: 1.3B AFQMC shape with param streaming"

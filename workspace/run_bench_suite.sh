@@ -28,6 +28,8 @@ python bench.py | tee /tmp/bench_default.json
 
 echo "== sharded-step bench"
 BENCH_CONFIG=sharded python bench.py | tee /tmp/bench_sharded.json
+echo "== dispatch-latency A/B: 5 steps per jitted execution"
+BENCH_CONFIG=sharded BENCH_STEPS_PER_EXEC=5 python bench.py | tee /tmp/bench_sharded_spe5.json
 
 echo "== probe"; probe
 
