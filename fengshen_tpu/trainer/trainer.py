@@ -70,8 +70,20 @@ def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
         group.append(batch)
         if len(group) < k:
             continue
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *group)
+        try:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *group)
+        except ValueError:
+            # ragged group (e.g. a loader's short final batch): the
+            # K-step program needs uniform shapes — degrade by dropping
+            # the group LOUDLY, like the K=1 path degrades shardings
+            # instead of erroring
+            print(f"[fengshen-tpu] steps_per_execution={k}: dropping a "
+                  "group with mismatched batch shapes (short final "
+                  "batch?)", flush=True)
+            group = []
+            continue
         queue.append((group, jax.device_put(stacked, shardings)))
         group = []
         if len(queue) >= depth:
@@ -471,6 +483,17 @@ class Trainer:
         ckpt_cb = self._restore_callback()
         if ckpt_cb is not None:
             state = ckpt_cb.maybe_restore(state, self)
+        if spe > 1 and (max_steps - self.global_step) % spe:
+            # resumed at a step that is not K-aligned: re-round so the
+            # REMAINING budget is a multiple of K (the rounding above
+            # only aligned from step 0) — never overshoot the schedule
+            new_max = self.global_step + \
+                ((max_steps - self.global_step) // spe) * spe
+            self._log({"event": "max_steps_rounded_down",
+                       "from": int(max_steps), "to": int(new_max),
+                       "steps_per_execution": spe,
+                       "resumed_at": int(self.global_step)})
+            max_steps = new_max
         # (re)create the train loader AFTER restore so the resumable
         # sampler starts from the restored consumed_samples
         train_loader = datamodule.train_dataloader()

@@ -607,3 +607,17 @@ def test_every_n_checkpoint_fires_on_crossed_boundary():
         t.prev_global_step, t.global_step = cur - 1, cur
         cb.on_train_step_end(t, state=None)
     assert saved == [8, 16]
+
+
+def test_grouped_prefetch_drops_ragged_group(capsys):
+    """A loader's short final batch inside a full K-group must degrade
+    (loud drop), not crash the run mid-epoch."""
+    from fengshen_tpu.trainer.trainer import _prefetch_grouped
+
+    batches = [{"x": np.zeros((2,))}, {"x": np.zeros((2,))},
+               {"x": np.zeros((2,))}, {"x": np.zeros((1,))}]  # ragged
+    dev = jax.devices("cpu")[0]
+    sh = jax.sharding.SingleDeviceSharding(dev)
+    out = list(_prefetch_grouped(iter(batches), sh, 2))
+    assert len(out) == 1  # first group ok, ragged second group dropped
+    assert "mismatched batch shapes" in capsys.readouterr().out

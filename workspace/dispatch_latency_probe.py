@@ -1,0 +1,82 @@
+"""Per-dispatch latency probe for the axon relay (round-5 diagnostic).
+
+The round-5 window measured trainer rows ~3x below round-2 on identical
+programs; one hypothesis is per-dispatch round-trip latency through the
+relay tunnel. This probe separates the two costs directly:
+
+- sync:   N tiny matmuls, each dispatched and blocked on individually —
+          time/N ≈ dispatch RTT + op time.
+- async:  the same N dispatched back-to-back, one final block — measures
+          whether the client pipelines dispatches.
+- fused:  one jitted lax.fori_loop of N matmuls — a single dispatch;
+          time/N ≈ pure op time.
+
+sync/fused ratio ≈ the per-dispatch tax a train step pays when host
+code syncs every step; async vs sync shows whether enqueueing hides it.
+Run ONLY after the 256x256 probe succeeds; self-watchdogged (no
+external timeouts — see NOTES.md wedge protocol).
+"""
+
+import json
+import os
+import threading
+import time
+
+_done = threading.Event()
+DEADLINE = float(os.environ.get("PROBE_DEADLINE", "300"))
+
+
+def _watch():
+    if not _done.wait(DEADLINE):
+        import sys
+        sys.stderr.write("dispatch_latency_probe: WEDGED, aborting\n")
+        sys.stderr.flush()
+        os._exit(3)
+
+
+threading.Thread(target=_watch, daemon=True).start()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+N = int(os.environ.get("PROBE_N", "50"))
+D = int(os.environ.get("PROBE_DIM", "512"))
+
+x = jnp.ones((D, D), jnp.bfloat16)
+mm = jax.jit(lambda a: a @ a)
+mm(x).block_until_ready()  # compile + warm
+
+
+@jax.jit
+def fused(a):
+    return lax.fori_loop(0, N, lambda _, c: c @ c, a)
+
+
+fused(x).block_until_ready()  # compile + warm
+
+t0 = time.perf_counter()
+for _ in range(N):
+    mm(x).block_until_ready()
+sync_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+y = x
+for _ in range(N):
+    y = mm(y)
+y.block_until_ready()
+async_s = time.perf_counter() - t0
+
+t0 = time.perf_counter()
+fused(x).block_until_ready()
+fused_s = time.perf_counter() - t0
+
+_done.set()
+print(json.dumps({
+    "n": N, "dim": D,
+    "sync_ms_per_dispatch": round(1e3 * sync_s / N, 3),
+    "async_ms_per_dispatch": round(1e3 * async_s / N, 3),
+    "fused_ms_per_op": round(1e3 * fused_s / N, 3),
+    "dispatch_tax_ratio_sync_vs_fused": round(sync_s / max(fused_s, 1e-9),
+                                              2),
+}))

@@ -15,6 +15,9 @@ probe() {
 
 echo "== probe"; probe
 
+echo "== dispatch-latency probe (quantifies the relay per-dispatch tax)"
+python workspace/dispatch_latency_probe.py | tee /tmp/bench_dispatch_latency.json
+
 echo "== 13B-shape bench (north star; fresh-process rung ladder)"
 BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json
 
