@@ -178,6 +178,15 @@ def _probe_and_arm() -> None:
 def _main() -> None:
     import os
 
+    # Arm the watchdog BEFORE anything can touch the backend: mode
+    # entry points call jax.devices() for their shape math, and backend
+    # init through a wedged relay hangs forever with no armed deadline
+    # (round-5: the sharded A/B row sat 15+ min inside jax.devices()
+    # after the int8 row wedged the relay — no probe had run yet, so
+    # nothing could abort it). Ladder parents disarm in
+    # _ladder_of_rungs; leaf paths re-arm with their own budgets.
+    _watchdog()
+
     mode = os.environ.get("BENCH_CONFIG", "default")
     if mode == "large":
         return _run_large()

@@ -75,10 +75,24 @@ def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
                 lambda *xs: np.stack([np.asarray(x) for x in xs]),
                 *group)
         except ValueError:
-            # ragged group (e.g. a loader's short final batch): the
-            # K-step program needs uniform shapes — degrade by dropping
-            # the group LOUDLY, like the K=1 path degrades shardings
-            # instead of erroring
+            # only a genuinely RAGGED group (same tree structure,
+            # mismatched leaf shapes — a loader's short final batch)
+            # degrades by dropping the group loudly, like the K=1 path
+            # degrades shardings. Any other ValueError (tree-structure
+            # mismatch, inhomogeneous field) is a loader bug: dropping
+            # every group would turn a crash into a "successful"
+            # zero-step run, so it must surface.
+            try:
+                structs = {jax.tree_util.tree_structure(b)
+                           for b in group}
+                ragged = len(structs) == 1 and len(
+                    {tuple(np.asarray(x).shape for x in
+                           jax.tree_util.tree_leaves(b))
+                     for b in group}) > 1
+            except Exception:  # noqa: BLE001 — re-raise the original
+                ragged = False
+            if not ragged:
+                raise
             print(f"[fengshen-tpu] steps_per_execution={k}: dropping a "
                   "group with mismatched batch shapes (short final "
                   "batch?)", flush=True)
@@ -116,8 +130,10 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
              "latency is comparable to step compute. Checkpoint, "
              "validation, and preemption checks run between "
              "executions; a tail short of K batches is dropped loudly; "
-             "max_steps is rounded DOWN to a multiple of K; ignored "
-             "(with a warning) under --offload_optimizer")
+             "the remaining step budget (after any checkpoint restore) "
+             "is rounded DOWN to a multiple of K, and K shrinks to the "
+             "remainder when fewer steps than one group are left; "
+             "ignored (with a warning) under --offload_optimizer")
     parser.add_argument("--accumulate_grad_batches", default=1, type=int)
     parser.add_argument("--gradient_clip_val", default=0.0, type=float)
     parser.add_argument("--precision", default="bf16", type=str,
@@ -449,22 +465,6 @@ class Trainer:
             max_steps = total_steps
         spe = 1 if getattr(args, "offload_optimizer", False) else \
             max(int(getattr(args, "steps_per_execution", 1)), 1)
-        if spe > 1:
-            # a K-step program only stops on execution boundaries, so
-            # the step budget must be a multiple of K — clamp/round
-            # DOWN and say so rather than silently overshooting the LR
-            # schedule (parity contract with the K=1 run)
-            if spe > max_steps:
-                self._log({"event": "steps_per_execution_clamped",
-                           "from": spe, "to": int(max_steps)})
-                spe = int(max_steps)
-                args.steps_per_execution = spe
-            if max_steps % spe:
-                self._log({"event": "max_steps_rounded_down",
-                           "from": int(max_steps),
-                           "to": int(max_steps - max_steps % spe),
-                           "steps_per_execution": spe})
-                max_steps -= max_steps % spe
 
         # build sharded state (peek never advances the stateful sampler)
         sample_batch = meta_loader.peek() if hasattr(meta_loader, "peek") \
@@ -483,12 +483,28 @@ class Trainer:
         ckpt_cb = self._restore_callback()
         if ckpt_cb is not None:
             state = ckpt_cb.maybe_restore(state, self)
-        if spe > 1 and (max_steps - self.global_step) % spe:
-            # resumed at a step that is not K-aligned: re-round so the
-            # REMAINING budget is a multiple of K (the rounding above
-            # only aligned from step 0) — never overshoot the schedule
-            new_max = self.global_step + \
-                ((max_steps - self.global_step) // spe) * spe
+        # K-step programs only stop on execution boundaries, so the
+        # REMAINING budget (after any restore — a fresh run resumes at
+        # 0) must be a multiple of K. Align ONCE, here, from the
+        # original max_steps: aligning before restore and again after
+        # double-rounds and can silently lose up to 2(K-1) steps.
+        # Clamp/round DOWN and say so rather than overshooting the LR
+        # schedule (parity contract with the K=1 run); the step program
+        # is built below, after this point, so a clamped K takes effect.
+        remaining = max_steps - self.global_step
+        if spe > 1 and 0 < remaining < spe:
+            # fewer steps left than one K-group: shrink K to the
+            # remainder rather than either overshooting the schedule by
+            # a full group or rounding the tail steps away
+            self._log({"event": "steps_per_execution_clamped",
+                       "from": spe, "to": int(remaining),
+                       "resumed_at": int(self.global_step)})
+            spe = int(remaining)
+            args.steps_per_execution = spe
+        elif spe > 1 and remaining > 0 and remaining % spe:
+            # not K-aligned: round the budget down to a whole number of
+            # K-groups past the current step
+            new_max = self.global_step + (remaining // spe) * spe
             self._log({"event": "max_steps_rounded_down",
                        "from": int(max_steps), "to": int(new_max),
                        "steps_per_execution": spe,
@@ -533,7 +549,10 @@ class Trainer:
         t_last = time.perf_counter()
         tokens_since = 0
         epoch = 0
-        done = False
+        # a run restored at (or past) its step budget must not execute
+        # even one group — the loop body only checks max_steps AFTER an
+        # execution, which would overshoot the LR schedule
+        done = self.global_step >= max_steps
         while not done:
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(epoch)

@@ -564,6 +564,72 @@ def test_steps_per_execution_parity(mesh8, tmp_path):
                                    atol=2e-5, rtol=2e-4)
 
 
+def test_steps_per_execution_resume_clamps_to_remaining(mesh8, tmp_path):
+    """Resuming with fewer steps left than one K-group must shrink K to
+    the remainder (finishing the schedule exactly), and resuming at or
+    past the budget must run ZERO steps — the loop body only checks
+    max_steps after an execution, so without the pre-loop guard a
+    restored run overshoots the LR schedule by a whole group."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2,
+                      max_position_embeddings=32, dtype="float32")
+    rng = np.random.RandomState(11)
+    data = [{"input_ids": rng.randint(0, 63, 16).tolist()}
+            for _ in range(64)]
+
+    class ListDS:
+        def __len__(self):
+            return len(data)
+
+        def __getitem__(self, i):
+            return data[i]
+
+    ckpt_dir = tmp_path / "ckpt"
+
+    def fit(argv):
+        args = _parse([
+            "--train_batchsize", "4", "--learning_rate", "1e-3",
+            "--warmup_steps", "1", "--log_every_n_steps", "1",
+            "--every_n_train_steps", "3",
+            "--save_ckpt_path", str(ckpt_dir),
+            "--load_ckpt_path", str(ckpt_dir),
+            "--default_root_dir", str(tmp_path)] + argv)
+        trainer = Trainer(args)
+        trainer.callbacks.append(UniversalCheckpoint(args))
+        module = CausalLMModule(args, LlamaForCausalLM(cfg), cfg)
+        dm = UniversalDataModule(args=args, datasets={"train": ListDS()})
+        state = trainer.fit(module, dm)
+        return trainer, state
+
+    # leg 1: plain 3-step run, checkpoint lands at step 3
+    t1, s1 = fit(["--max_steps", "3"])
+    assert t1.global_step == 3 and int(s1.step) == 3
+
+    # leg 2: resume at step 3 with budget 4 and K=5: K shrinks to the
+    # single remaining step — exactly one more optimizer step, never
+    # 4 or 5 more
+    t2, s2 = fit(["--max_steps", "4", "--steps_per_execution", "5"])
+    assert t2.global_step == 4 and int(s2.step) == 4
+
+    # leg 3: resume at step 3 with K=2 and budget 3 (K-rounding would
+    # push the effective budget BELOW the restored step): zero steps
+    t3, s3 = fit(["--max_steps", "3", "--steps_per_execution", "2"])
+    assert t3.global_step == 3 and int(s3.step) == 3
+
+    # leg 4: resume at step 3 with budget 5 and K=2 — the remaining 2
+    # steps are exactly one K-group, so the run must reach the full
+    # budget. Double-rounding (align from step 0 before restore, then
+    # re-align after) would trim 5->4 and finish a step short
+    t4, s4 = fit(["--max_steps", "5", "--steps_per_execution", "2"])
+    assert t4.global_step == 5 and int(s4.step) == 5
+
+
 def test_grouped_prefetch_drops_partial_tail(capsys):
     from fengshen_tpu.trainer.trainer import _prefetch_grouped
 
@@ -576,6 +642,26 @@ def test_grouped_prefetch_drops_partial_tail(capsys):
     group, stacked = out[0]
     assert len(group) == 2 and stacked["x"].shape == (2, 2)
     assert "dropping 1 tail batch" in capsys.readouterr().out
+
+
+def test_grouped_prefetch_ragged_drops_but_loader_bugs_raise(capsys):
+    """A ragged group (short final batch) drops loudly; a tree-structure
+    mismatch (loader bug) must RAISE — swallowing it would turn a crash
+    into a zero-step 'successful' run."""
+    from fengshen_tpu.trainer.trainer import _prefetch_grouped
+
+    dev = jax.devices("cpu")[0]
+    sh = jax.sharding.SingleDeviceSharding(dev)
+
+    # ragged shapes, same structure: dropped with the loud message
+    ragged = [{"x": np.zeros((2,))}, {"x": np.zeros((3,))}]
+    assert list(_prefetch_grouped(iter(ragged), {"x": sh}, 2)) == []
+    assert "mismatched batch shapes" in capsys.readouterr().out
+
+    # structure mismatch (missing key): surfaces, never swallowed
+    bad = [{"x": np.zeros((2,))}, {"y": np.zeros((2,))}]
+    with pytest.raises(ValueError):
+        list(_prefetch_grouped(iter(bad), {"x": sh}, 2))
 
 
 def test_every_n_checkpoint_fires_on_crossed_boundary():

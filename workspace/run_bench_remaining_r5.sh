@@ -16,7 +16,7 @@ probe() {
 echo "== probe"; probe
 
 echo "== dispatch-latency probe (quantifies the relay per-dispatch tax)"
-python workspace/dispatch_latency_probe.py | tee /tmp/bench_dispatch_latency.json
+python workspace/dispatch_latency_probe.py | tee /tmp/bench_dispatch_latency.json || exit 1
 
 echo "== 13B-shape bench (north star; fresh-process rung ladder)"
 BENCH_CONFIG=large python bench.py | tee /tmp/bench_large.json

@@ -1,0 +1,55 @@
+#!/bin/bash
+# Rows still pending after the SECOND round-5 hardware window
+# (2026-07-31 06:26-06:39 UTC; the int8 LM-head TRAIN row wedged the
+# relay — the second wedge attributable to an int8 row, so BOTH int8
+# rows now sit at the wedge-suspect end with block-sparse). Banked in
+# that window: 13B-shape l4xb1 211.1 tok/s/chip (first 13B-shape
+# hardware row), default 300M 25,410 tok/s/chip, dispatch-latency
+# probe 0.17/0.058 ms (docs/performance.md "Round-5 second window").
+# Same rules as ever: NEVER wrap any row in `timeout`; every script
+# self-aborts via an in-process watchdog.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+  python workspace/probe.py || exit 1
+}
+
+echo "== probe"; probe
+
+echo "== dispatch-latency A/B: 5 steps per jitted execution (vs banked 25,760 sharded row)"
+BENCH_CONFIG=sharded BENCH_STEPS_PER_EXEC=5 python bench.py | tee /tmp/bench_sharded_spe5.json
+
+echo "== probe"; probe
+
+echo "== measured 7GB claim: 1.3B AFQMC shape with param streaming"
+python workspace/offload_7gb_check.py | tee /tmp/bench_offload_7gb.json
+
+echo "== probe"; probe
+
+echo "== decode throughput: seq2seq beam-4 (T5-base shape)"
+BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_beam.json
+
+echo "== probe"; probe
+
+echo "== 13B-shape l8xb4 retry (died in the remote-compile helper last window, HTTP 500 — terminal-side)"
+BENCH_CONFIG=large BENCH_LAYERS=8 BENCH_BATCH=4 BENCH_FUSED_CE=8 python bench.py | tee /tmp/bench_large_l8b4.json || true
+
+echo "== probe"; probe
+
+echo "== WEDGE-SUSPECT ROWS LAST =="
+echo "== headroom lever: int8 LM-head train (wedged the relay in window 2)"
+BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
+
+echo "== probe"; probe
+
+echo "== decode throughput: int8 LM head (wedged the relay in window 1)"
+BENCH_CONFIG=decode BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_decode_int8.json
+
+echo "== probe"; probe
+
+echo "== block-sparse vs dense flash timing (wedged r3)"
+python workspace/bs_hw_bench.py | tee /tmp/bench_block_sparse.txt
+
+echo "== probe"; probe
+echo "ALL DONE"
