@@ -172,6 +172,16 @@ class Llama(CausalLMModule):
                  "(trainer/param_streaming.py) — for models whose "
                  "params+moments dwarf one chip's HBM (the 13B "
                  "finetune). Incompatible with --packed.")
+        parser.add_argument(
+            "--offload_moments_dtype", default="param", type=str,
+            choices=["param", "float32", "bfloat16"],
+            help="host-resident adam moment storage dtype under "
+                 "--offload_params. 'param' (default) = bit-parity "
+                 "with the monolithic optax step; 'bfloat16' halves "
+                 "the moment memory (fp32 m+v for 13B is 104 GB — "
+                 "more than many hosts; bf16 is 52 GB) with update "
+                 "math in fp32. fp16 is deliberately NOT offered "
+                 "(second-moment underflow diverges).")
         return parent_parser
 
     def setup(self, stage: str = "fit") -> None:

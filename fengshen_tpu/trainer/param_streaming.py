@@ -66,9 +66,10 @@ def _host(tree):
     return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
-def _zeros_like_host(tree):
+def _zeros_like_host(tree, dtype=None):
     return jax.tree_util.tree_map(
-        lambda x: np.zeros(x.shape, x.dtype), tree)
+        lambda x: np.zeros(x.shape, dtype if dtype is not None
+                           else x.dtype), tree)
 
 
 def _sq_norm_host(tree) -> float:
@@ -87,18 +88,29 @@ class StreamedAdamW:
                  b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                  weight_decay: float = 0.0, clip_norm: float = 1.0,
                  lr_schedule: Optional[Callable[[int], float]] = None,
-                 use_decay_mask: bool = False):
+                 use_decay_mask: bool = False,
+                 moments_dtype: Optional[Any] = None):
         self.spec = spec
         self.hparams = (b1, b2, eps, weight_decay)
         self.learning_rate = learning_rate
         self.lr_schedule = lr_schedule
         self.clip_norm = clip_norm
         self.count = 0
+        # moments_dtype=None keeps the adam moments in each param's own
+        # dtype with update math in that dtype — bit-parity with the
+        # monolithic optax step (optax mu_dtype default). Setting e.g.
+        # 'bfloat16' halves the host-resident moment memory (the term
+        # that decides whether a 13B stream fits host RAM: fp32 m+v is
+        # 104 GB, bf16 is 52 GB) while the update math runs in fp32.
+        self.moments_dtype = None if moments_dtype is None else \
+            jnp.dtype(moments_dtype)
         # host-resident master copies: params + adam moments per part
         self.parts = [_host(spec.bottom)] + \
             [_host(p) for p in spec.layers] + [_host(spec.top)]
-        self.m = [_zeros_like_host(p) for p in self.parts]
-        self.v = [_zeros_like_host(p) for p in self.parts]
+        self.m = [_zeros_like_host(p, self.moments_dtype)
+                  for p in self.parts]
+        self.v = [_zeros_like_host(p, self.moments_dtype)
+                  for p in self.parts]
         if use_decay_mask:
             # the recipe's no-decay grouping: biases/LayerNorm excluded
             # (model_utils.decay_mask_fn parity)
@@ -157,15 +169,29 @@ class StreamedAdamW:
         if "up" not in self._jits:
             b1, b2, eps, wd = self.hparams
 
+            reduced = self.moments_dtype is not None
+
             def run(p, g, m, v, mask, scale, lr, count):
                 def leaf(p, g, m, v, mask):
-                    g = (g * scale).astype(m.dtype)
+                    if reduced:
+                        # reduced-precision moment STORAGE, fp32 math:
+                        # bf16 accumulation would lose small updates
+                        # (1 + x == 1 for x < 2^-8)
+                        store_m, store_v = m.dtype, v.dtype
+                        m, v = (m.astype(jnp.float32),
+                                v.astype(jnp.float32))
+                        g = (g * scale).astype(jnp.float32)
+                    else:
+                        # param-dtype math — bit-parity with optax
+                        store_m = store_v = m.dtype
+                        g = (g * scale).astype(m.dtype)
                     m2 = b1 * m + (1 - b1) * g
                     v2 = b2 * v + (1 - b2) * g * g
                     mhat = m2 / (1 - b1 ** count)
                     vhat = v2 / (1 - b2 ** count)
                     upd = mhat / (jnp.sqrt(vhat) + eps) + wd * mask * p
-                    return (p - lr * upd).astype(p.dtype), m2, v2
+                    return ((p - lr * upd).astype(p.dtype),
+                            m2.astype(store_m), v2.astype(store_v))
                 out = jax.tree_util.tree_map(leaf, p, g, m, v, mask)
                 new_p = jax.tree_util.tree_map(lambda t: t[0], out,
                                                is_leaf=lambda t:
@@ -432,7 +458,10 @@ def run_streamed_fit(args, spec: StreamSpec, loader, apply_fn,
         eps=getattr(args, "adam_epsilon", 1e-8),
         weight_decay=getattr(args, "weight_decay", 0.01),
         clip_norm=getattr(args, "gradient_clip_val", 0.0) or None,
-        use_decay_mask=True)
+        use_decay_mask=True,
+        moments_dtype=(None if getattr(args, "offload_moments_dtype",
+                                       "param") == "param"
+                       else args.offload_moments_dtype))
 
     class _TrainerView:
         global_step = 0

@@ -116,6 +116,50 @@ def test_megatron_classifier_streamed_step_matches_monolithic():
     assert "acc" in metrics and "grad_norm" in metrics
 
 
+def test_streamed_reduced_moments_close_to_fp32():
+    """moments_dtype='bfloat16' stores the adam moments reduced (the
+    host-memory term that bounds streamable model size) with fp32
+    update math: a few steps must track the fp32-moment run closely,
+    and the host arrays must actually BE bf16."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
+
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=32, dtype="float32",
+                      param_dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(1, 96, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+    batch = {"input_ids": ids}
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["input_ids"])
+        return stable_cross_entropy(logits[:, :-1],
+                                    b["input_ids"][:, 1:])[0]
+
+    ref_params, ref_losses = _ref_update(loss_fn, params, batch, steps=3)
+
+    eng = make_streamed(llama_stream_spec(cfg, params), **HP,
+                        moments_dtype="bfloat16")
+    for part_m, part_v in zip(eng.m, eng.v):
+        for leaf in (jax.tree_util.tree_leaves(part_m) +
+                     jax.tree_util.tree_leaves(part_v)):
+            assert leaf.dtype == jnp.bfloat16
+    losses = [eng.step(batch)[0] for _ in range(3)]
+    # bf16 moment storage perturbs the trajectory slightly; it must
+    # stay close to the fp32 run, not bit-equal
+    np.testing.assert_allclose(losses, ref_losses, atol=5e-3)
+    _assert_tree_close(eng.params(), ref_params, atol=5e-3)
+    # still bf16 after updates round-tripped (both moments: dropping
+    # the v cast-back would silently restore the fp32 memory blow-up)
+    for part_m, part_v in zip(eng.m, eng.v):
+        for leaf in (jax.tree_util.tree_leaves(part_m) +
+                     jax.tree_util.tree_leaves(part_v)):
+            assert leaf.dtype == jnp.bfloat16
+
+
 def test_streamed_clip_engages():
     """With a tiny clip threshold the streamed update must scale exactly
     like optax.clip_by_global_norm."""
@@ -227,6 +271,7 @@ def test_ziya_offload_params_e2e(tmp_path, mesh8, capsys):
             "--train_batchsize", "4", "--max_steps", "2",
             "--max_seq_length", "32", "--log_every_n_steps", "1",
             "--warmup_steps", "1", "--offload_params",
+            "--offload_moments_dtype", "bfloat16",
             "--default_root_dir", str(tmp_path / "runs"),
             "--save_ckpt_path", str(tmp_path / "ckpt"),
             "--load_ckpt_path", str(tmp_path / "ckpt"),
