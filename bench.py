@@ -396,6 +396,7 @@ def _run_sharded() -> None:
 
     from fengshen_tpu.models.llama import LlamaConfig
 
+    _probe_and_arm()  # fast wedge diagnostic before any heavy work
     n_dev = len(jax.devices())
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     per_chip = int(os.environ.get("BENCH_BATCH", "16"))
@@ -446,6 +447,7 @@ def _run_decode() -> None:
 
     from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
 
+    _probe_and_arm()  # fast wedge diagnostic before any heavy work
     n_dev = len(jax.devices())
     batch = int(os.environ.get("BENCH_BATCH", "8")) * n_dev
     prompt = int(os.environ.get("BENCH_PROMPT", "128"))
@@ -494,6 +496,7 @@ def _run_decode() -> None:
         def decode():
             return _gen(params, src)
         metric = "t5beam4_decode_tokens_per_sec_per_chip"
+        compile_budget = 1800  # beam-search programs compile slowly
     else:
         from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         from fengshen_tpu.utils.generate import generate
@@ -529,7 +532,16 @@ def _run_decode() -> None:
         metric = ("llama300m_int8_decode_tokens_per_sec_per_chip"
                   if config.int8_lm_head else
                   "llama300m_decode_tokens_per_sec_per_chip")
+        compile_budget = 1800 if config.int8_lm_head else 900
 
+    # Compile under a GENEROUS budget: both relay wedges this round
+    # followed a 540s watchdog abort on an int8 row — the likely
+    # mechanism is the abort itself, killing the process with an
+    # in-flight remote compile (the one thing the wedge protocol says
+    # never to do). A slow-but-alive compile must be allowed to finish;
+    # the probe at the top of this function already proved the relay
+    # responsive, so a hang here is a slow compile, not a dead relay.
+    _watchdog(compile_budget)
     jax.block_until_ready(decode())  # compile
     _watchdog()
     t0 = time.perf_counter()
@@ -618,9 +630,14 @@ def _run(per_chip_batch: int) -> None:
         p = optax.apply_updates(p, updates)
         return p, o, loss
 
-    # warmup / compile
+    # warmup / compile — generous budget for the int8 path (see
+    # _run_decode: a watchdog abort mid-remote-compile is the wedge
+    # mechanism; slow compiles must finish, hangs still die at 30 min)
+    if config.int8_lm_head:
+        _watchdog(1800)
     params, opt_state, loss = step(params, opt_state, ids)
     jax.block_until_ready(loss)
+    _watchdog()
 
     n_steps = 20
     t0 = time.perf_counter()
@@ -639,7 +656,12 @@ def _run(per_chip_batch: int) -> None:
     mfu = tps * flops_per_token / (peak * n_dev)
 
     print(json.dumps({
-        "metric": "llama300m_train_tokens_per_sec_per_chip",
+        # the int8 LM-head lever changes numerics, not just memory
+        # strategy — its row must be distinguishable in the BENCH file
+        # (same 'int8' tag as the decode row)
+        "metric": ("llama300m_int8_train_tokens_per_sec_per_chip"
+                   if config.int8_lm_head else
+                   "llama300m_train_tokens_per_sec_per_chip"),
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
