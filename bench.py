@@ -499,7 +499,8 @@ def _run_decode() -> None:
         compile_budget = 1800  # beam-search programs compile slowly
     else:
         from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-        from fengshen_tpu.utils.generate import generate
+        from fengshen_tpu.utils.generate import (generate,
+                                                 speculative_generate)
 
         config = LlamaConfig(
             vocab_size=int(os.environ.get("BENCH_VOCAB", "32000")),
@@ -521,18 +522,54 @@ def _run_decode() -> None:
             r, jnp.zeros((1, 8), jnp.int32))["params"])(
             jax.random.PRNGKey(0))
 
-        @jax.jit
-        def _gen(params, ids):
-            return generate(model, params, ids,
-                            max_new_tokens=new_tokens,
-                            eos_token_id=None, pad_token_id=0)
+        if os.environ.get("BENCH_DECODE") == "spec":
+            # speculative decoding: token-exact greedy via a shallow
+            # draft of the same width (BENCH_DRAFT_LAYERS deep). The
+            # row measures COMMITTED tokens/sec — acceptance rate on
+            # random-init weights is pessimal, so this row is a lower
+            # bound on the mechanism's overhead, not a realistic
+            # speedup (that needs a trained draft/target pair)
+            import dataclasses
+            gamma = int(os.environ.get("BENCH_SPEC_GAMMA", "4"))
+            # the speculation window needs gamma extra cache slots
+            # (speculative_generate refuses loudly without them);
+            # params are RoPE so the rebuilt model reuses them as-is
+            config = dataclasses.replace(
+                config,
+                max_position_embeddings=prompt + new_tokens + gamma)
+            model = LlamaForCausalLM(config)
+            draft_cfg = dataclasses.replace(
+                config, num_hidden_layers=int(
+                    os.environ.get("BENCH_DRAFT_LAYERS", "2")))
+            draft = LlamaForCausalLM(draft_cfg)
+            draft_params = jax.jit(lambda r: draft.init(
+                r, jnp.zeros((1, 8), jnp.int32))["params"])(
+                jax.random.PRNGKey(1))
 
-        def decode():
-            return _gen(params, ids)
-        metric = ("llama300m_int8_decode_tokens_per_sec_per_chip"
-                  if config.int8_lm_head else
-                  "llama300m_decode_tokens_per_sec_per_chip")
-        compile_budget = 1800 if config.int8_lm_head else 900
+            @jax.jit
+            def _gen(params, draft_params, ids):
+                return speculative_generate(
+                    model, params, draft, draft_params, ids,
+                    max_new_tokens=new_tokens, gamma=gamma,
+                    eos_token_id=None, pad_token_id=0)
+
+            def decode():
+                return _gen(params, draft_params, ids)
+            metric = "llama300m_spec_decode_tokens_per_sec_per_chip"
+            compile_budget = 1800  # two models + while_loop program
+        else:
+            @jax.jit
+            def _gen(params, ids):
+                return generate(model, params, ids,
+                                max_new_tokens=new_tokens,
+                                eos_token_id=None, pad_token_id=0)
+
+            def decode():
+                return _gen(params, ids)
+            metric = ("llama300m_int8_decode_tokens_per_sec_per_chip"
+                      if config.int8_lm_head else
+                      "llama300m_decode_tokens_per_sec_per_chip")
+            compile_budget = 1800 if config.int8_lm_head else 900
 
     # Compile under a GENEROUS budget: both relay wedges this round
     # followed a 540s watchdog abort on an int8 row — the likely

@@ -180,21 +180,8 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
     # reference: modeling_llama.py:353-375)
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
 
-    # cache built from abstract shapes only — a real init would materialize
-    # a full-precision param tree (fatal for the int8 serving path on
-    # models sized to barely fit)
-    abstract = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((batch, 1), jnp.int32),
-                           init_cache=True))
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
-
-    logits, mutated = model.apply(
-        {"params": params, "cache": cache}, input_ids,
-        attention_mask=attention_mask, position_ids=position_ids,
-        init_cache=True, mutable=["cache"])
-    cache = mutated["cache"]
+    logits, cache = _prefill_cache(model, params, input_ids,
+                                   attention_mask, position_ids)
 
     buf = jnp.concatenate(
         [input_ids.astype(jnp.int32),
@@ -232,6 +219,191 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
     (_, buf, _, _, _), _ = jax.lax.scan(
         step, (cache, buf, next_token, pos0, finished), (ts, step_rngs))
     return buf
+
+
+def _rollback_cache(cache, delta):
+    """Lower every `cache_index` leaf by `delta` (traced scalar).
+
+    Sound for this repo's cache design (modeling_llama.py _update_cache
+    and its siblings): entries are written with dynamic_update_slice AT
+    the index, and attention validity is `key_pos <= idx + t` per
+    query — so after lowering the index, stale tail entries are masked
+    out and later overwritten in place."""
+    def fix(path, leaf):
+        if any(getattr(k, "key", None) == "cache_index" for k in path):
+            return leaf - jnp.asarray(delta, leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _prefill_cache(model, params, input_ids, attention_mask,
+                   position_ids):
+    """Abstract-init a decode cache and run the prompt through it.
+    Returns (prompt logits, primed cache).
+
+    The cache is built from abstract shapes only — a real init would
+    materialize a full-precision param tree (fatal for the int8 serving
+    path on models sized to barely fit)."""
+    batch = input_ids.shape[0]
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((batch, 1), jnp.int32),
+                           init_cache=True))
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), abstract["cache"])
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, input_ids,
+        attention_mask=attention_mask, position_ids=position_ids,
+        init_cache=True, mutable=["cache"])
+    return logits, mutated["cache"]
+
+
+def speculative_generate(model: Any, params: Any,
+                         draft_model: Any, draft_params: Any,
+                         input_ids: jax.Array,
+                         attention_mask: Optional[jax.Array] = None,
+                         max_new_tokens: int = 32,
+                         gamma: int = 4,
+                         eos_token_id: Optional[int] = None,
+                         pad_token_id: int = 0,
+                         return_stats: bool = False):
+    """Greedy speculative decoding: TOKEN-EXACT `generate(...,
+    do_sample=False)` output at a fraction of the target-model
+    dispatches (beyond-reference serving capability; the reference's
+    serving path is plain per-token decode,
+    fengshen/examples/ziya_llama/llama_generate.py:17-58).
+
+    Each round the small draft model proposes `gamma` greedy tokens
+    autoregressively; the target model scores `[last, d_1..d_gamma]` in
+    ONE forward, the longest prefix where the draft agreed with the
+    target's own greedy choice is accepted, and the first disagreement
+    is replaced by the target's token — so every committed token is the
+    target's greedy token and the output is bit-identical to plain
+    greedy decode. Per round the target runs once for 1..gamma+1
+    committed tokens instead of once per token.
+
+    Batched: rows advance together by the MINIMUM accepted length
+    across unfinished rows (a shared cache index keeps positions
+    aligned); over-accepted rows simply re-derive the same tokens next
+    round, preserving exactness. Both KV caches roll back via
+    `_rollback_cache` — sound because stale entries past the index are
+    masked and overwritten (see that helper's docstring).
+
+    The whole loop is one `lax.while_loop` under jit: static shapes,
+    `gamma` static, dynamic trip count with >=1 committed token per
+    round. `return_stats` also returns
+    {"rounds", "drafted", "accepted"} for acceptance-rate tuning.
+    """
+    assert gamma >= 1, "speculative decoding needs gamma >= 1"
+    batch, prompt_len = input_ids.shape
+    if max_new_tokens <= 0:
+        return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
+            if return_stats else input_ids
+    if attention_mask is None:
+        attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
+    total_len = prompt_len + max_new_tokens
+    # the verify forward near the end writes cache entries up to index
+    # total_len + gamma - 1; a too-small preallocated cache would CLAMP
+    # the dynamic_update_slice start and silently corrupt committed
+    # entries (breaking exactness), so refuse loudly instead
+    for name, m in (("model", model), ("draft_model", draft_model)):
+        max_len = getattr(getattr(m, "config", None),
+                          "max_position_embeddings", None)
+        if max_len is not None and max_len < total_len + gamma:
+            raise ValueError(
+                f"speculative_generate: {name}.config."
+                f"max_position_embeddings={max_len} < prompt+"
+                f"max_new_tokens+gamma={total_len + gamma}; the "
+                "speculation window needs gamma extra cache slots")
+    position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
+
+    t_logits, t_cache = _prefill_cache(model, params, input_ids,
+                                       attention_mask, position_ids)
+    _, d_cache = _prefill_cache(draft_model, draft_params, input_ids,
+                                attention_mask, position_ids)
+
+    # slack columns keep the fixed-width window write in-bounds near
+    # the end (dynamic_update_slice CLAMPS the start index, which would
+    # silently mis-place the window)
+    buf = jnp.concatenate(
+        [input_ids.astype(jnp.int32),
+         jnp.full((batch, max_new_tokens + gamma + 1), pad_token_id,
+                  jnp.int32)], axis=1)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+    buf = buf.at[:, prompt_len].set(first)
+    finished = (first == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((batch,), bool)
+    last = jnp.where(finished, pad_token_id, first).astype(jnp.int32)
+    pos0 = position_ids[:, -1] + 1
+
+    def draft_step(carry, _):
+        cache, tok, pos = carry
+        logits, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache}, tok[:, None],
+            attention_mask=attention_mask, position_ids=pos[:, None],
+            init_cache=True, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    def body(carry):
+        (t_cache, d_cache, buf, t, pos, last, finished,
+         rounds, accepted) = carry
+        prev_finished = finished
+        # draft gamma proposals (one extra feed keeps the draft cache
+        # aligned with the target on full acceptance)
+        (d_cache, _, _), drafts = jax.lax.scan(
+            draft_step, (d_cache, last, pos),
+            None, length=gamma + 1)
+        d = jnp.moveaxis(drafts, 0, 1)[:, :gamma]  # [B, gamma]
+
+        verify = jnp.concatenate([last[:, None], d], axis=1)
+        v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
+        logits, mut = model.apply(
+            {"params": params, "cache": t_cache}, verify,
+            attention_mask=attention_mask, position_ids=v_pos,
+            init_cache=True, mutable=["cache"])
+        t_cache = mut["cache"]
+        y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, g+1]
+
+        m = (d == y[:, :gamma])
+        n_r = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+        n_r = jnp.where(finished, gamma, n_r)
+        n = jnp.min(n_r)
+        c = n + 1  # committed this round (1..gamma+1)
+
+        w = y
+        if eos_token_id is not None:
+            is_eos = w == eos_token_id
+            after = jnp.pad(jnp.cumsum(is_eos, axis=1)[:, :-1],
+                            ((0, 0), (1, 0))) > 0
+            w = jnp.where(after, pad_token_id, w)
+            in_window = jnp.arange(gamma + 1)[None] < c
+            finished = finished | jnp.any(is_eos & in_window, axis=1)
+        w = jnp.where(prev_finished[:, None], pad_token_id, w)
+        w = jnp.where(jnp.arange(gamma + 1)[None] < c, w, pad_token_id)
+
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, w, t, axis=1)
+        new_last = jax.lax.dynamic_slice_in_dim(w, c - 1, 1, axis=1)[:, 0]
+        # the committed count is c; caches advanced gamma+1 -> valid
+        # through the second-newest committed token, index t'-1
+        t_cache = _rollback_cache(t_cache, gamma - n)
+        d_cache = _rollback_cache(d_cache, gamma - n)
+        return (t_cache, d_cache, buf, t + c, pos + c, new_last,
+                finished, rounds + 1, accepted + n)
+
+    def cond(carry):
+        t, finished = carry[3], carry[6]
+        return (t < total_len) & ~jnp.all(finished)
+
+    init = (t_cache, d_cache, buf, jnp.int32(prompt_len + 1), pos0,
+            last, finished, jnp.int32(0), jnp.int32(0))
+    (_, _, buf, _, _, _, _, rounds, accepted) = \
+        jax.lax.while_loop(cond, body, init)
+    out = buf[:, :total_len]
+    if return_stats:
+        return out, {"rounds": rounds, "drafted": rounds * gamma,
+                     "accepted": accepted}
+    return out
 
 
 def _make_seq2seq_logits_fn(model, params, input_ids, attention_mask,

@@ -1,0 +1,112 @@
+"""Speculative decoding (utils/generate.py speculative_generate).
+
+The contract is TOKEN-EXACTNESS: whatever the draft model proposes, the
+committed output must be bit-identical to plain greedy `generate` on the
+target — the draft only changes how many target dispatches it takes.
+(Beyond-reference serving capability; the reference decodes per-token:
+fengshen/examples/ziya_llama/llama_generate.py:17-58.)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from fengshen_tpu.utils.generate import generate, speculative_generate
+
+
+def _models():
+    tgt_cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=4,
+                          num_attention_heads=4,
+                          max_position_embeddings=128, dtype="float32")
+    drf_cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=128, dtype="float32")
+    tgt, drf = LlamaForCausalLM(tgt_cfg), LlamaForCausalLM(drf_cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(3, 96, (3, 12)),
+                      jnp.int32)
+    mask = jnp.asarray([[1] * 12, [0] * 4 + [1] * 8, [0] * 7 + [1] * 5],
+                       jnp.int32)
+    ids = ids * mask
+    tp = tgt.init(jax.random.PRNGKey(0), ids[:, :4])["params"]
+    dp = drf.init(jax.random.PRNGKey(1), ids[:, :4])["params"]
+    return tgt, tp, drf, dp, ids, mask
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 4])
+def test_speculative_exact_vs_greedy(gamma):
+    """An unrelated random draft must not change a single output token
+    (worst case: zero acceptance, still exact)."""
+    tgt, tp, drf, dp, ids, mask = _models()
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=24)
+    out = speculative_generate(tgt, tp, drf, dp, ids,
+                               attention_mask=mask, max_new_tokens=24,
+                               gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_self_draft_accepts_everything():
+    """draft == target: every proposal accepted, so 24 tokens commit in
+    ceil(23 / (gamma+1)) rounds — the mechanism that buys the speedup."""
+    tgt, tp, _, _, ids, mask = _models()
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=24)
+    out, stats = speculative_generate(
+        tgt, tp, tgt, tp, ids, attention_mask=mask, max_new_tokens=24,
+        gamma=4, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert int(stats["rounds"]) == 5  # ceil(23 / 5)
+    assert int(stats["accepted"]) == int(stats["rounds"]) * 4
+
+
+def test_speculative_eos_exact():
+    """Early stopping on eos must cut and pad exactly like generate —
+    pick an eos that actually occurs mid-generation in the reference
+    output so the cut happens inside a speculation window."""
+    tgt, tp, drf, dp, ids, mask = _models()
+    ref_free = generate(tgt, tp, ids, attention_mask=mask,
+                        max_new_tokens=24)
+    gen_part = np.asarray(ref_free[:, ids.shape[1]:])
+    eos = int(gen_part[0, gen_part.shape[1] // 2])  # mid-stream token
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=24,
+                   eos_token_id=eos, pad_token_id=0)
+    out = speculative_generate(tgt, tp, drf, dp, ids,
+                               attention_mask=mask, max_new_tokens=24,
+                               gamma=4, eos_token_id=eos, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # and with a perfectly-agreeing draft (window commits are longest)
+    out2 = speculative_generate(tgt, tp, tgt, tp, ids,
+                                attention_mask=mask, max_new_tokens=24,
+                                gamma=4, eos_token_id=eos, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+
+def test_speculative_refuses_undersized_cache():
+    """The verify window writes gamma extra cache entries past
+    total_len; a cache without that headroom would silently clamp the
+    write and corrupt committed entries — must refuse loudly."""
+    tgt, tp, drf, dp, ids, mask = _models()
+    room = 128 - ids.shape[1]  # max_position_embeddings - prompt
+    with pytest.raises(ValueError, match="gamma extra cache slots"):
+        speculative_generate(tgt, tp, drf, dp, ids,
+                             attention_mask=mask,
+                             max_new_tokens=room - 1, gamma=4)
+
+
+def test_speculative_jits():
+    """The whole loop (prefill + while_loop of draft-scan/verify/
+    rollback) must compile into one jitted program."""
+    tgt, tp, drf, dp, ids, mask = _models()
+
+    @jax.jit
+    def run(tp, dp, ids, mask):
+        return speculative_generate(tgt, tp, drf, dp, ids,
+                                    attention_mask=mask,
+                                    max_new_tokens=16, gamma=3)
+
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=16)
+    np.testing.assert_array_equal(np.asarray(run(tp, dp, ids, mask)),
+                                  np.asarray(ref))

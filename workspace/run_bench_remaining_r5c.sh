@@ -32,6 +32,11 @@ BENCH_CONFIG=decode BENCH_DECODE=beam python bench.py | tee /tmp/bench_decode_be
 
 echo "== probe"; probe
 
+echo "== decode throughput: speculative (2-layer draft, gamma 4; mechanism-overhead row on random weights)"
+BENCH_CONFIG=decode BENCH_DECODE=spec python bench.py | tee /tmp/bench_decode_spec.json || true
+
+echo "== probe"; probe
+
 echo "== 13B-shape l8xb4 retry (died in the remote-compile helper last window, HTTP 500 — terminal-side)"
 BENCH_CONFIG=large BENCH_LAYERS=8 BENCH_BATCH=4 BENCH_FUSED_CE=8 python bench.py | tee /tmp/bench_large_l8b4.json || true
 
