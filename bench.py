@@ -555,7 +555,11 @@ def _run_decode() -> None:
 
             def decode():
                 return _gen(params, draft_params, ids)
-            metric = "llama300m_spec_decode_tokens_per_sec_per_chip"
+            # the int8 lever composes with spec decode (the verify
+            # forward just uses the int8 head) — keep the rows apart
+            metric = ("llama300m_int8_spec_decode_tokens_per_sec_per_chip"
+                      if config.int8_lm_head else
+                      "llama300m_spec_decode_tokens_per_sec_per_chip")
             compile_budget = 1800  # two models + while_loop program
         else:
             @jax.jit

@@ -21,7 +21,8 @@ def main(argv=None):
 
     from fengshen_tpu.models.llama import LlamaForCausalLM
     from fengshen_tpu.models.llama.convert import load_hf_pretrained
-    from fengshen_tpu.utils.generate import generate
+    from fengshen_tpu.utils.generate import (generate,
+                                             speculative_generate)
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--model_path", required=True, type=str)
@@ -32,6 +33,14 @@ def main(argv=None):
     parser.add_argument("--top_k", default=0, type=int)
     parser.add_argument("--top_p", default=0.85, type=float)
     parser.add_argument("--seed", default=42, type=int)
+    parser.add_argument(
+        "--draft_model_path", default=None, type=str,
+        help="HF llama dir of a SMALL same-tokenizer draft model: "
+             "switches to speculative decoding (greedy, token-exact vs "
+             "plain greedy — sampling flags are ignored with a note); "
+             "the target runs once per 1..gamma+1 tokens")
+    parser.add_argument("--gamma", default=4, type=int,
+                        help="draft tokens proposed per verify forward")
     args = parser.parse_args(argv)
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
@@ -40,13 +49,29 @@ def main(argv=None):
 
     prompt = f"<human>:{args.query.strip()}\n<bot>:"
     ids = tokenizer.encode(prompt)
-    out = generate(model, params, jnp.asarray([ids], jnp.int32),
-                   max_new_tokens=args.max_new_tokens,
-                   do_sample=args.do_sample, temperature=args.temperature,
-                   top_k=args.top_k, top_p=args.top_p,
-                   eos_token_id=config.eos_token_id,
-                   pad_token_id=config.pad_token_id,
-                   rng=jax.random.PRNGKey(args.seed))
+    if args.draft_model_path:
+        if args.do_sample:
+            print("[speculative] greedy-only: ignoring sampling flags")
+        d_config, d_params = load_hf_pretrained(args.draft_model_path)
+        draft = LlamaForCausalLM(d_config)
+        out, stats = speculative_generate(
+            model, params, draft, d_params,
+            jnp.asarray([ids], jnp.int32),
+            max_new_tokens=args.max_new_tokens, gamma=args.gamma,
+            eos_token_id=config.eos_token_id,
+            pad_token_id=config.pad_token_id, return_stats=True)
+        print(f"[speculative] rounds={int(stats['rounds'])} "
+              f"accepted={int(stats['accepted'])}/"
+              f"{int(stats['drafted'])} drafted")
+    else:
+        out = generate(model, params, jnp.asarray([ids], jnp.int32),
+                       max_new_tokens=args.max_new_tokens,
+                       do_sample=args.do_sample,
+                       temperature=args.temperature,
+                       top_k=args.top_k, top_p=args.top_p,
+                       eos_token_id=config.eos_token_id,
+                       pad_token_id=config.pad_token_id,
+                       rng=jax.random.PRNGKey(args.seed))
     text = tokenizer.decode(list(out[0][len(ids):]),
                             skip_special_tokens=True)
     print(text.strip())

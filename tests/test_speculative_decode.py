@@ -96,6 +96,65 @@ def test_speculative_refuses_undersized_cache():
                              max_new_tokens=room - 1, gamma=4)
 
 
+def test_ziya_inference_speculative_cli(tmp_path, capsys):
+    """The serving demo's --draft_model_path switch: two tiny HF-format
+    llama dirs (export round-trip), a char tokenizer, and the CLI must
+    print the target's exact greedy continuation plus acceptance stats."""
+    import unittest.mock as mock
+
+    import torch
+
+    from fengshen_tpu.examples.ziya_inference import generate_ziya
+    from fengshen_tpu.models.llama.convert import params_to_torch_state
+
+    def write_hf_dir(path, n_layers, seed):
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=n_layers,
+                          num_attention_heads=4,
+                          max_position_embeddings=128, dtype="float32")
+        m = LlamaForCausalLM(cfg)
+        p = m.init(jax.random.PRNGKey(seed),
+                   jnp.zeros((1, 4), jnp.int32))["params"]
+        path.mkdir()
+        cfg.save_pretrained(str(path))
+        state = {k: torch.as_tensor(np.asarray(v))
+                 for k, v in params_to_torch_state(p, cfg).items()}
+        torch.save(state, str(path / "pytorch_model.bin"))
+        return cfg, m, p
+
+    tgt_dir, drf_dir = tmp_path / "target", tmp_path / "draft"
+    cfg, m, p = write_hf_dir(tgt_dir, 3, 0)
+    write_hf_dir(drf_dir, 1, 1)
+
+    class CharTok:
+        def encode(self, text):
+            return [1] + [3 + (ord(c) % 120) for c in text]
+
+        def decode(self, ids, skip_special_tokens=True):
+            return " ".join(str(i) for i in ids)
+
+        @classmethod
+        def from_pretrained(cls, path):
+            return cls()
+
+    with mock.patch("transformers.AutoTokenizer.from_pretrained",
+                    CharTok.from_pretrained):
+        generate_ziya.main([
+            "--model_path", str(tgt_dir), "--query", "hi",
+            "--draft_model_path", str(drf_dir), "--gamma", "3",
+            "--max_new_tokens", "12"])
+    out = capsys.readouterr().out
+    assert "[speculative] rounds=" in out
+
+    tok = CharTok()
+    ids = tok.encode("<human>:hi\n<bot>:")
+    ref = generate(m, p, jnp.asarray([ids], jnp.int32), max_new_tokens=12,
+                   eos_token_id=cfg.eos_token_id,
+                   pad_token_id=cfg.pad_token_id)
+    expected = tok.decode(list(ref[0][len(ids):])).strip()
+    assert expected in out
+
+
 def test_speculative_jits():
     """The whole loop (prefill + while_loop of draft-scan/verify/
     rollback) must compile into one jitted program."""
