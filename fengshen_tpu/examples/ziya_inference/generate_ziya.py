@@ -29,6 +29,10 @@ def main(argv=None):
     parser.add_argument("--query", required=True, type=str)
     parser.add_argument("--max_new_tokens", default=128, type=int)
     parser.add_argument("--do_sample", action="store_true", default=True)
+    parser.add_argument("--greedy", action="store_true", default=False,
+                        help="force greedy decode (--do_sample defaults "
+                             "on for reference parity and store_true "
+                             "can't turn it off)")
     parser.add_argument("--temperature", default=0.8, type=float)
     parser.add_argument("--top_k", default=0, type=int)
     parser.add_argument("--top_p", default=0.85, type=float)
@@ -36,12 +40,15 @@ def main(argv=None):
     parser.add_argument(
         "--draft_model_path", default=None, type=str,
         help="HF llama dir of a SMALL same-tokenizer draft model: "
-             "switches to speculative decoding (greedy, token-exact vs "
-             "plain greedy — sampling flags are ignored with a note); "
-             "the target runs once per 1..gamma+1 tokens")
+             "switches to speculative decoding — greedy is token-exact "
+             "vs plain greedy; with --do_sample the rejection scheme "
+             "makes every token distributed exactly as plain sampling. "
+             "The target runs once per 1..gamma+1 tokens")
     parser.add_argument("--gamma", default=4, type=int,
                         help="draft tokens proposed per verify forward")
     args = parser.parse_args(argv)
+    if args.greedy:
+        args.do_sample = False
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     config, params = load_hf_pretrained(args.model_path)
@@ -50,16 +57,17 @@ def main(argv=None):
     prompt = f"<human>:{args.query.strip()}\n<bot>:"
     ids = tokenizer.encode(prompt)
     if args.draft_model_path:
-        if args.do_sample:
-            print("[speculative] greedy-only: ignoring sampling flags")
         d_config, d_params = load_hf_pretrained(args.draft_model_path)
         draft = LlamaForCausalLM(d_config)
         out, stats = speculative_generate(
             model, params, draft, d_params,
             jnp.asarray([ids], jnp.int32),
             max_new_tokens=args.max_new_tokens, gamma=args.gamma,
+            do_sample=args.do_sample, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
             eos_token_id=config.eos_token_id,
-            pad_token_id=config.pad_token_id, return_stats=True)
+            pad_token_id=config.pad_token_id,
+            rng=jax.random.PRNGKey(args.seed), return_stats=True)
         print(f"[speculative] rounds={int(stats['rounds'])} "
               f"accepted={int(stats['accepted'])}/"
               f"{int(stats['drafted'])} drafted")

@@ -131,13 +131,22 @@ def _make_control(control_kw: dict, history_mask=None):
                    **control_kw)
 
 
+def _filtered_logits(logits, temperature, top_k, top_p):
+    """THE sampling filter pipeline (fp32, temperature, combined
+    top-k/top-p). Shared by `_select_token` and `_spec_dist`: the
+    speculative rejection scheme is distribution-exact only if the p/q
+    it compares are exactly the distribution draft proposals are
+    sampled from — one implementation keeps them from drifting."""
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    return top_k_logits(logits, k=top_k, p=top_p)
+
+
 def _select_token(logits, rng, do_sample, temperature, top_k, top_p):
-    logits = logits.astype(jnp.float32)
     if not do_sample:
-        return logits.argmax(-1)
-    logits = logits / jnp.maximum(temperature, 1e-6)
-    logits = top_k_logits(logits, k=top_k, p=top_p)
-    return jax.random.categorical(rng, logits, axis=-1)
+        return logits.astype(jnp.float32).argmax(-1)
+    return jax.random.categorical(
+        rng, _filtered_logits(logits, temperature, top_k, top_p),
+        axis=-1)
 
 
 def generate(model: Any, params: Any, input_ids: jax.Array,
@@ -258,28 +267,98 @@ def _prefill_cache(model, params, input_ids, attention_mask,
     return logits, mutated["cache"]
 
 
+def _spec_dist(logits, temperature, top_k, top_p):
+    """The filtered sampling distribution `_select_token` draws from,
+    as fp32 probabilities (same `_filtered_logits` pipeline)."""
+    return jax.nn.softmax(
+        _filtered_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _spec_round_tokens(t_logits, d_logits, d, rng, *, do_sample,
+                       temperature=1.0, top_k=0, top_p=0.0):
+    """One speculative round's accept/commit math (pure — the
+    distributional correctness of the sampling scheme is unit-tested
+    directly against analytic probabilities).
+
+    `t_logits` [B, g+1, V]: target logits over `[last, d_1..d_g]`;
+    `d_logits` [B, g, V] or None (greedy): draft logits for the
+    proposals `d` [B, g]. Returns `(n_r, w)`: per-row accepted-prefix
+    length and the [B, g+1] window tokens — accepted proposals, then
+    the correction/resample at the first rejection, then (meaningful
+    only on full acceptance) the bonus token.
+
+    Greedy: accept while the draft equals the target argmax; the
+    correction IS the target argmax, so w is argmax(t_logits).
+    Sampling (the standard speculative rejection scheme): accept d_i
+    with prob min(1, p_i(d_i)/q_i(d_i)); at the first rejection
+    resample from norm(max(0, p_i - q_i)); on full acceptance sample
+    the bonus from p_{g+1}. Every committed token is then distributed
+    EXACTLY as a plain sample from the target's filtered distribution
+    conditioned on the committed prefix — the draft changes only how
+    many target dispatches it takes.
+    """
+    gamma = d.shape[1]
+    if not do_sample:
+        y = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        m = (d == y[:, :gamma])
+        n_r = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+        return n_r, y
+    p = _spec_dist(t_logits, temperature, top_k, top_p)  # [B, g+1, V]
+    q = _spec_dist(d_logits, temperature, top_k, top_p)  # [B, g, V]
+    p_d = jnp.take_along_axis(p[:, :gamma], d[..., None], -1)[..., 0]
+    q_d = jnp.take_along_axis(q, d[..., None], -1)[..., 0]
+    r_accept, r_resid, r_bonus = jax.random.split(rng, 3)
+    # u < p/q without the division (q_d > 0: d was sampled from q)
+    u = jax.random.uniform(r_accept, d.shape)
+    accept = u * q_d < p_d
+    n_r = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    resid = jnp.maximum(p[:, :gamma] - q, 0.0)
+    norm = resid.sum(-1, keepdims=True)
+    # p == q makes the residual empty; any sample from p is then
+    # already correct (rejection can't occur with prob > 0, but guard
+    # the categorical against log(0) rows anyway)
+    resid = jnp.where(norm > 0, resid / jnp.maximum(norm, 1e-20),
+                      p[:, :gamma])
+    resample = jax.random.categorical(
+        r_resid, jnp.log(resid + 1e-20), axis=-1).astype(jnp.int32)
+    bonus = jax.random.categorical(
+        r_bonus, jnp.log(p[:, gamma] + 1e-20), axis=-1).astype(jnp.int32)
+    w = jnp.concatenate(
+        [jnp.where(jnp.arange(gamma)[None] < n_r[:, None], d, resample),
+         bonus[:, None]], axis=1)
+    return n_r, w
+
+
 def speculative_generate(model: Any, params: Any,
                          draft_model: Any, draft_params: Any,
                          input_ids: jax.Array,
                          attention_mask: Optional[jax.Array] = None,
                          max_new_tokens: int = 32,
                          gamma: int = 4,
+                         do_sample: bool = False,
+                         temperature: float = 1.0,
+                         top_k: int = 0, top_p: float = 0.0,
                          eos_token_id: Optional[int] = None,
                          pad_token_id: int = 0,
+                         rng: Optional[jax.Array] = None,
                          return_stats: bool = False):
-    """Greedy speculative decoding: TOKEN-EXACT `generate(...,
-    do_sample=False)` output at a fraction of the target-model
-    dispatches (beyond-reference serving capability; the reference's
-    serving path is plain per-token decode,
+    """Speculative decoding: the output law of plain `generate` at a
+    fraction of the target-model dispatches (beyond-reference serving
+    capability; the reference's serving path is plain per-token decode,
     fengshen/examples/ziya_llama/llama_generate.py:17-58).
 
-    Each round the small draft model proposes `gamma` greedy tokens
+    Each round the small draft model proposes `gamma` tokens
     autoregressively; the target model scores `[last, d_1..d_gamma]` in
-    ONE forward, the longest prefix where the draft agreed with the
-    target's own greedy choice is accepted, and the first disagreement
-    is replaced by the target's token — so every committed token is the
-    target's greedy token and the output is bit-identical to plain
-    greedy decode. Per round the target runs once for 1..gamma+1
+    ONE forward; the longest acceptable prefix is committed plus one
+    correction token. Greedy (`do_sample=False`): acceptance is
+    draft==target-argmax and the output is TOKEN-EXACT vs plain greedy
+    decode. Sampling (`do_sample=True`): the draft samples from its
+    filtered distribution q, acceptance is the standard rejection rule
+    min(1, p/q) with residual resampling (see `_spec_round_tokens`), so
+    every committed token is distributed exactly as a plain sample from
+    the target's filtered distribution — same law as `generate(...,
+    do_sample=True)`, not token-identical (randomness is consumed
+    differently). Per round the target runs once for 1..gamma+1
     committed tokens instead of once per token.
 
     Batched: rows advance together by the MINIMUM accepted length
@@ -316,6 +395,8 @@ def speculative_generate(model: Any, params: Any,
                 f"max_new_tokens+gamma={total_len + gamma}; the "
                 "speculation window needs gamma extra cache slots")
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     t_logits, t_cache = _prefill_cache(model, params, input_ids,
                                        attention_mask, position_ids)
@@ -329,32 +410,42 @@ def speculative_generate(model: Any, params: Any,
         [input_ids.astype(jnp.int32),
          jnp.full((batch, max_new_tokens + gamma + 1), pad_token_id,
                   jnp.int32)], axis=1)
-    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+    rng, r_first = jax.random.split(rng)
+    first = _select_token(t_logits[:, -1], r_first, do_sample,
+                          temperature, top_k, top_p).astype(jnp.int32)
     buf = buf.at[:, prompt_len].set(first)
     finished = (first == eos_token_id) if eos_token_id is not None \
         else jnp.zeros((batch,), bool)
     last = jnp.where(finished, pad_token_id, first).astype(jnp.int32)
     pos0 = position_ids[:, -1] + 1
 
-    def draft_step(carry, _):
+    def draft_step(carry, step_rng):
         cache, tok, pos = carry
         logits, mut = draft_model.apply(
             {"params": draft_params, "cache": cache}, tok[:, None],
             attention_mask=attention_mask, position_ids=pos[:, None],
             init_cache=True, mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return (mut["cache"], nxt, pos + 1), nxt
+        nxt = _select_token(logits[:, -1], step_rng, do_sample,
+                            temperature, top_k, top_p).astype(jnp.int32)
+        ys = (nxt, logits[:, -1]) if do_sample else nxt
+        return (mut["cache"], nxt, pos + 1), ys
 
     def body(carry):
         (t_cache, d_cache, buf, t, pos, last, finished,
-         rounds, accepted) = carry
+         rng, rounds, accepted) = carry
         prev_finished = finished
+        rng, r_draft, r_round = jax.random.split(rng, 3)
         # draft gamma proposals (one extra feed keeps the draft cache
         # aligned with the target on full acceptance)
         (d_cache, _, _), drafts = jax.lax.scan(
             draft_step, (d_cache, last, pos),
-            None, length=gamma + 1)
-        d = jnp.moveaxis(drafts, 0, 1)[:, :gamma]  # [B, gamma]
+            jax.random.split(r_draft, gamma + 1))
+        if do_sample:
+            d = jnp.moveaxis(drafts[0], 0, 1)[:, :gamma]  # [B, gamma]
+            d_logits = jnp.moveaxis(drafts[1], 0, 1)[:, :gamma]
+        else:
+            d = jnp.moveaxis(drafts, 0, 1)[:, :gamma]
+            d_logits = None
 
         verify = jnp.concatenate([last[:, None], d], axis=1)
         v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
@@ -363,15 +454,14 @@ def speculative_generate(model: Any, params: Any,
             attention_mask=attention_mask, position_ids=v_pos,
             init_cache=True, mutable=["cache"])
         t_cache = mut["cache"]
-        y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, g+1]
 
-        m = (d == y[:, :gamma])
-        n_r = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+        n_r, w = _spec_round_tokens(
+            logits, d_logits, d, r_round, do_sample=do_sample,
+            temperature=temperature, top_k=top_k, top_p=top_p)
         n_r = jnp.where(finished, gamma, n_r)
         n = jnp.min(n_r)
         c = n + 1  # committed this round (1..gamma+1)
 
-        w = y
         if eos_token_id is not None:
             is_eos = w == eos_token_id
             after = jnp.pad(jnp.cumsum(is_eos, axis=1)[:, :-1],
@@ -389,15 +479,15 @@ def speculative_generate(model: Any, params: Any,
         t_cache = _rollback_cache(t_cache, gamma - n)
         d_cache = _rollback_cache(d_cache, gamma - n)
         return (t_cache, d_cache, buf, t + c, pos + c, new_last,
-                finished, rounds + 1, accepted + n)
+                finished, rng, rounds + 1, accepted + n)
 
     def cond(carry):
         t, finished = carry[3], carry[6]
         return (t < total_len) & ~jnp.all(finished)
 
     init = (t_cache, d_cache, buf, jnp.int32(prompt_len + 1), pos0,
-            last, finished, jnp.int32(0), jnp.int32(0))
-    (_, _, buf, _, _, _, _, rounds, accepted) = \
+            last, finished, rng, jnp.int32(0), jnp.int32(0))
+    (_, _, buf, _, _, _, _, _, rounds, accepted) = \
         jax.lax.while_loop(cond, body, init)
     out = buf[:, :total_len]
     if return_stats:

@@ -1,10 +1,13 @@
 """Speculative decoding (utils/generate.py speculative_generate).
 
-The contract is TOKEN-EXACTNESS: whatever the draft model proposes, the
-committed output must be bit-identical to plain greedy `generate` on the
-target — the draft only changes how many target dispatches it takes.
-(Beyond-reference serving capability; the reference decodes per-token:
-fengshen/examples/ziya_llama/llama_generate.py:17-58.)
+Two contracts, both asserted here: GREEDY mode is token-exact — whatever
+the draft proposes, the committed output is bit-identical to plain
+greedy `generate` on the target; SAMPLING mode is distribution-exact —
+the rejection scheme's committed tokens follow the target's filtered
+distribution, checked empirically against analytic softmax
+probabilities. The draft only changes how many target dispatches it
+takes. (Beyond-reference serving capability; the reference decodes
+per-token: fengshen/examples/ziya_llama/llama_generate.py:17-58.)
 """
 
 import numpy as np
@@ -84,6 +87,97 @@ def test_speculative_eos_exact():
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
 
 
+def test_spec_round_sampling_distribution_exact():
+    """The rejection scheme's committed tokens must be distributed
+    EXACTLY as the target's filtered distribution — checked empirically
+    against analytic softmax probabilities over 40k i.i.d. rows sharing
+    one (p, q) pair: accept d~q with prob min(1, p/q), else resample
+    from norm(max(0, p-q)). Any bias in accept, residual, or bonus math
+    shifts the histogram by more than the 4-sigma tolerance."""
+    from fengshen_tpu.utils.generate import _spec_round_tokens
+
+    B, V, gamma = 40000, 8, 2
+    key = jax.random.PRNGKey(7)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    # one shared, deliberately mismatched (p, q) pair (scale 1.0 keeps
+    # enough overlap that thousands of rows survive acceptance at each
+    # position, powering the conditional histograms)
+    t_log = jax.random.normal(k1, (1, gamma + 1, V))
+    d_log = jax.random.normal(k2, (1, gamma, V))
+    t_logits = jnp.broadcast_to(t_log, (B, gamma + 1, V))
+    d_logits = jnp.broadcast_to(d_log, (B, gamma, V))
+    # draft proposals ~ q, independently per row/position
+    q = jax.nn.softmax(d_log.astype(jnp.float32), axis=-1)
+    d = jax.random.categorical(
+        k3, jnp.broadcast_to(jnp.log(q), (B, gamma, V)), axis=-1)
+
+    n_r, w = _spec_round_tokens(t_logits, d_logits, d.astype(jnp.int32),
+                                k4, do_sample=True)
+    p = np.asarray(jax.nn.softmax(t_log.astype(jnp.float32), -1))[0]
+
+    # position 0 commits for every row: histogram == p_0
+    hist0 = np.bincount(np.asarray(w[:, 0]), minlength=V) / B
+    np.testing.assert_allclose(hist0, p[0], atol=4 * np.sqrt(0.25 / B))
+
+    # position 1 commits when position 0 accepted: conditional
+    # histogram == p_1 (independent draws, shared fixed p/q)
+    sel = np.asarray(n_r) >= 1
+    assert sel.sum() > 3000
+    hist1 = np.bincount(np.asarray(w[sel, 1]),
+                        minlength=V) / sel.sum()
+    np.testing.assert_allclose(hist1, p[1],
+                               atol=4 * np.sqrt(0.25 / sel.sum()))
+
+    # full acceptance -> bonus position sampled from p_2
+    sel2 = np.asarray(n_r) == gamma
+    if sel2.sum() > 1000:
+        hist2 = np.bincount(np.asarray(w[sel2, 2]),
+                            minlength=V) / sel2.sum()
+        np.testing.assert_allclose(hist2, p[2],
+                                   atol=4 * np.sqrt(0.25 / sel2.sum()))
+
+
+def test_speculative_sampling_e2e_properties():
+    """Sampled speculative decode: deterministic under a fixed rng,
+    full acceptance when draft == target (p == q makes the rejection
+    test always pass), and eos cuts with pad like plain generate."""
+    tgt, tp, drf, dp, ids, mask = _models()
+
+    out1, st1 = speculative_generate(
+        tgt, tp, drf, dp, ids, attention_mask=mask, max_new_tokens=20,
+        gamma=4, do_sample=True, temperature=0.9, top_p=0.9,
+        rng=jax.random.PRNGKey(5), return_stats=True)
+    out2 = speculative_generate(
+        tgt, tp, drf, dp, ids, attention_mask=mask, max_new_tokens=20,
+        gamma=4, do_sample=True, temperature=0.9, top_p=0.9,
+        rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    out3 = speculative_generate(
+        tgt, tp, drf, dp, ids, attention_mask=mask, max_new_tokens=20,
+        gamma=4, do_sample=True, temperature=0.9, top_p=0.9,
+        rng=jax.random.PRNGKey(6))
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+
+    # draft == target: p == q, min(1, p/q) == 1, every proposal accepted
+    _, st = speculative_generate(
+        tgt, tp, tgt, tp, ids, attention_mask=mask, max_new_tokens=20,
+        gamma=4, do_sample=True, rng=jax.random.PRNGKey(8),
+        return_stats=True)
+    assert int(st["accepted"]) == int(st["rounds"]) * 4
+
+    # eos inside the stream: everything after the first eos is pad
+    gen = np.asarray(out1[:, ids.shape[1]:])
+    eos = int(gen[0, gen.shape[1] // 2])
+    out4 = np.asarray(speculative_generate(
+        tgt, tp, drf, dp, ids, attention_mask=mask, max_new_tokens=20,
+        gamma=4, do_sample=True, temperature=0.9, top_p=0.9,
+        eos_token_id=eos, pad_token_id=0, rng=jax.random.PRNGKey(5)))
+    for row in out4[:, ids.shape[1]:]:
+        hits = np.where(row == eos)[0]
+        if hits.size:
+            assert (row[hits[0] + 1:] == 0).all()
+
+
 def test_speculative_refuses_undersized_cache():
     """The verify window writes gamma extra cache entries past
     total_len; a cache without that headroom would silently clamp the
@@ -142,7 +236,7 @@ def test_ziya_inference_speculative_cli(tmp_path, capsys):
         generate_ziya.main([
             "--model_path", str(tgt_dir), "--query", "hi",
             "--draft_model_path", str(drf_dir), "--gamma", "3",
-            "--max_new_tokens", "12"])
+            "--greedy", "--max_new_tokens", "12"])
     out = capsys.readouterr().out
     assert "[speculative] rounds=" in out
 
