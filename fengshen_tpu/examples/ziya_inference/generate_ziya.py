@@ -46,9 +46,18 @@ def main(argv=None):
              "The target runs once per 1..gamma+1 tokens")
     parser.add_argument("--gamma", default=4, type=int,
                         help="draft tokens proposed per verify forward")
+    parser.add_argument(
+        "--self_draft_layers", default=0, type=int,
+        help="speculative decoding WITHOUT a second checkpoint: use the "
+             "target's own first N layers (+ shared embeddings/norm/"
+             "head) as the draft. Mutually exclusive with "
+             "--draft_model_path")
     args = parser.parse_args(argv)
     if args.greedy:
         args.do_sample = False
+    if args.draft_model_path and args.self_draft_layers:
+        raise SystemExit("--draft_model_path and --self_draft_layers "
+                         "are mutually exclusive")
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     config, params = load_hf_pretrained(args.model_path)
@@ -56,8 +65,14 @@ def main(argv=None):
 
     prompt = f"<human>:{args.query.strip()}\n<bot>:"
     ids = tokenizer.encode(prompt)
-    if args.draft_model_path:
-        d_config, d_params = load_hf_pretrained(args.draft_model_path)
+    if args.draft_model_path or args.self_draft_layers:
+        if args.self_draft_layers:
+            from fengshen_tpu.models.llama import make_self_draft
+            d_config, d_params = make_self_draft(
+                config, params, args.self_draft_layers)
+        else:
+            d_config, d_params = load_hf_pretrained(
+                args.draft_model_path)
         draft = LlamaForCausalLM(d_config)
         out, stats = speculative_generate(
             model, params, draft, d_params,

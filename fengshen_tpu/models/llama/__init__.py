@@ -4,7 +4,8 @@ only tensor-parallel model, SURVEY.md §2.5)."""
 from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
 from fengshen_tpu.models.llama.modeling_llama import (LlamaModel,
                                                       LlamaForCausalLM,
+                                                      make_self_draft,
                                                       resize_token_embeddings)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "resize_token_embeddings"]
+           "make_self_draft", "resize_token_embeddings"]

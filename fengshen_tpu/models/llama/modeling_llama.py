@@ -429,3 +429,34 @@ def resize_token_embeddings(params: dict, config, new_num_tokens: int,
             "kernel": _resize_rows(kernel.T, k_head).T}
     return new_params, dataclasses.replace(config,
                                            vocab_size=new_num_tokens)
+
+
+def make_self_draft(config: LlamaConfig, params: dict, n_layers: int):
+    """Early-exit draft for SELF-speculative decoding: the target's own
+    first `n_layers` decoder layers plus its shared embeddings, final
+    norm, and LM head form the draft model — no second checkpoint
+    needed (`utils/generate.py speculative_generate` stays exact
+    regardless of draft quality, so the truncated tower only affects
+    the acceptance rate, never the output law).
+
+    Returns `(draft_config, draft_params)`. Shared leaves alias the
+    target's arrays (no copy); under `scan_layers` the stacked layer
+    leaves are sliced to the first `n_layers`.
+    """
+    import dataclasses
+
+    if not 0 < n_layers < config.num_hidden_layers:
+        raise ValueError(
+            f"make_self_draft: n_layers={n_layers} must be in "
+            f"(0, {config.num_hidden_layers})")
+    model_p = dict(params["model"])
+    if config.scan_layers:
+        model_p["layers"] = jax.tree_util.tree_map(
+            lambda x: x[:n_layers], params["model"]["layers"])
+    else:
+        kept = {f"layers_{i}" for i in range(n_layers)}
+        model_p = {k: v for k, v in model_p.items()
+                   if not k.startswith("layers_") or k in kept}
+    draft_params = {**params, "model": model_p}
+    return dataclasses.replace(config, num_hidden_layers=n_layers), \
+        draft_params

@@ -178,6 +178,46 @@ def test_speculative_sampling_e2e_properties():
             assert (row[hits[0] + 1:] == 0).all()
 
 
+@pytest.mark.parametrize("scan", [False, True])
+def test_self_draft_exact_and_aliased(scan):
+    """make_self_draft: the target's own first-K-layer tower as the
+    draft — output stays token-exact vs plain greedy, shared leaves
+    alias the target's arrays (no copy), and under scan_layers the
+    stacked leaves slice to K."""
+    from fengshen_tpu.models.llama import make_self_draft
+
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=4,
+                      num_attention_heads=4,
+                      max_position_embeddings=128, dtype="float32",
+                      scan_layers=scan)
+    tgt = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(2).randint(3, 96, (2, 10)),
+                      jnp.int32)
+    tp = tgt.init(jax.random.PRNGKey(0), ids[:, :4])["params"]
+
+    d_cfg, d_params = make_self_draft(cfg, tp, 2)
+    assert d_cfg.num_hidden_layers == 2
+    assert d_params["model"]["embed_tokens"]["embedding"] is \
+        tp["model"]["embed_tokens"]["embedding"]
+    if scan:
+        leaf = jax.tree_util.tree_leaves(d_params["model"]["layers"])[0]
+        assert leaf.shape[0] == 2
+    else:
+        assert "layers_2" not in d_params["model"]
+        assert d_params["model"]["layers_1"] is tp["model"]["layers_1"]
+    draft = LlamaForCausalLM(d_cfg)
+
+    ref = generate(tgt, tp, ids, max_new_tokens=16)
+    out, stats = speculative_generate(
+        tgt, tp, draft, d_params, ids, max_new_tokens=16, gamma=4,
+        return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    with pytest.raises(ValueError, match="must be in"):
+        make_self_draft(cfg, tp, 4)
+
+
 def test_speculative_refuses_undersized_cache():
     """The verify window writes gamma extra cache entries past
     total_len; a cache without that headroom would silently clamp the
@@ -247,6 +287,22 @@ def test_ziya_inference_speculative_cli(tmp_path, capsys):
                    pad_token_id=cfg.pad_token_id)
     expected = tok.decode(list(ref[0][len(ids):])).strip()
     assert expected in out
+
+    # the sampled draft flow (default --do_sample) must run too
+    with mock.patch("transformers.AutoTokenizer.from_pretrained",
+                    CharTok.from_pretrained):
+        generate_ziya.main([
+            "--model_path", str(tgt_dir), "--query", "hi",
+            "--self_draft_layers", "1", "--gamma", "3",
+            "--top_p", "0.9", "--max_new_tokens", "12"])
+    assert "[speculative] rounds=" in capsys.readouterr().out
+
+    # conflicting draft flags fail fast, before any checkpoint load
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        generate_ziya.main([
+            "--model_path", str(tgt_dir), "--query", "hi",
+            "--draft_model_path", str(drf_dir),
+            "--self_draft_layers", "1"])
 
 
 def test_speculative_jits():
