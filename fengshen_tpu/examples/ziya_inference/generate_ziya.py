@@ -52,12 +52,21 @@ def main(argv=None):
              "target's own first N layers (+ shared embeddings/norm/"
              "head) as the draft. Mutually exclusive with "
              "--draft_model_path")
+    parser.add_argument(
+        "--prompt_lookup", default=0, type=int,
+        help="DRAFT-FREE speculation: propose the continuation of the "
+             "latest earlier occurrence of the current N-gram suffix "
+             "and verify with one target forward (token-exact greedy; "
+             "big wins on extractive/repetitive outputs). Mutually "
+             "exclusive with the draft flags")
     args = parser.parse_args(argv)
     if args.greedy:
         args.do_sample = False
-    if args.draft_model_path and args.self_draft_layers:
-        raise SystemExit("--draft_model_path and --self_draft_layers "
-                         "are mutually exclusive")
+    if sum(bool(x) for x in (args.draft_model_path,
+                             args.self_draft_layers,
+                             args.prompt_lookup)) > 1:
+        raise SystemExit("--draft_model_path, --self_draft_layers and "
+                         "--prompt_lookup are mutually exclusive")
 
     tokenizer = AutoTokenizer.from_pretrained(args.model_path)
     config, params = load_hf_pretrained(args.model_path)
@@ -84,6 +93,20 @@ def main(argv=None):
             pad_token_id=config.pad_token_id,
             rng=jax.random.PRNGKey(args.seed), return_stats=True)
         print(f"[speculative] rounds={int(stats['rounds'])} "
+              f"accepted={int(stats['accepted'])}/"
+              f"{int(stats['drafted'])} drafted")
+    elif args.prompt_lookup:
+        from fengshen_tpu.utils.generate import prompt_lookup_generate
+        if args.do_sample:
+            print("[prompt-lookup] greedy-only (no draft distribution "
+                  "to reject against): ignoring sampling flags")
+        out, stats = prompt_lookup_generate(
+            model, params, jnp.asarray([ids], jnp.int32),
+            max_new_tokens=args.max_new_tokens, gamma=args.gamma,
+            ngram=args.prompt_lookup,
+            eos_token_id=config.eos_token_id,
+            pad_token_id=config.pad_token_id, return_stats=True)
+        print(f"[prompt-lookup] rounds={int(stats['rounds'])} "
               f"accepted={int(stats['accepted'])}/"
               f"{int(stats['drafted'])} drafted")
     else:

@@ -329,6 +329,102 @@ def _spec_round_tokens(t_logits, d_logits, d, rng, *, do_sample,
     return n_r, w
 
 
+def _speculative_loop(model, params, input_ids, attention_mask,
+                      max_new_tokens, gamma, *, do_sample, temperature,
+                      top_k, top_p, eos_token_id, pad_token_id, rng,
+                      return_stats, propose, post_commit, extra_init):
+    """The ONE copy of the propose→verify→commit speculative machinery
+    (shared by `speculative_generate` and `prompt_lookup_generate` —
+    the eos-masking, min-advance commit, and cache-rollback bookkeeping
+    are subtle enough that two copies would silently diverge).
+
+    `propose(extra, buf, t, pos, last, r_draft) -> (extra, d, d_logits)`
+    supplies each round's [B, gamma] proposals (d_logits None in greedy
+    modes); `post_commit(extra, n) -> extra` runs after the commit
+    (e.g. draft-cache rollback); `extra` is any pytree carried through
+    the while_loop (a draft KV cache, or () for draft-free lookup).
+    """
+    batch, prompt_len = input_ids.shape
+    total_len = prompt_len + max_new_tokens
+    position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    t_logits, t_cache = _prefill_cache(model, params, input_ids,
+                                       attention_mask, position_ids)
+
+    # slack columns keep the fixed-width window write in-bounds near
+    # the end (dynamic_update_slice CLAMPS the start index, which would
+    # silently mis-place the window)
+    buf = jnp.concatenate(
+        [input_ids.astype(jnp.int32),
+         jnp.full((batch, max_new_tokens + gamma + 1), pad_token_id,
+                  jnp.int32)], axis=1)
+    rng, r_first = jax.random.split(rng)
+    first = _select_token(t_logits[:, -1], r_first, do_sample,
+                          temperature, top_k, top_p).astype(jnp.int32)
+    buf = buf.at[:, prompt_len].set(first)
+    finished = (first == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((batch,), bool)
+    last = jnp.where(finished, pad_token_id, first).astype(jnp.int32)
+    pos0 = position_ids[:, -1] + 1
+
+    def body(carry):
+        (extra, t_cache, buf, t, pos, last, finished,
+         rng, rounds, accepted) = carry
+        prev_finished = finished
+        rng, r_draft, r_round = jax.random.split(rng, 3)
+        extra, d, d_logits = propose(extra, buf, t, pos, last, r_draft)
+
+        verify = jnp.concatenate([last[:, None], d], axis=1)
+        v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
+        logits, mut = model.apply(
+            {"params": params, "cache": t_cache}, verify,
+            attention_mask=attention_mask, position_ids=v_pos,
+            init_cache=True, mutable=["cache"])
+        t_cache = mut["cache"]
+
+        n_r, w = _spec_round_tokens(
+            logits, d_logits, d, r_round, do_sample=do_sample,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+        n_r = jnp.where(finished, gamma, n_r)
+        n = jnp.min(n_r)
+        c = n + 1  # committed this round (1..gamma+1)
+
+        if eos_token_id is not None:
+            is_eos = w == eos_token_id
+            after = jnp.pad(jnp.cumsum(is_eos, axis=1)[:, :-1],
+                            ((0, 0), (1, 0))) > 0
+            w = jnp.where(after, pad_token_id, w)
+            in_window = jnp.arange(gamma + 1)[None] < c
+            finished = finished | jnp.any(is_eos & in_window, axis=1)
+        w = jnp.where(prev_finished[:, None], pad_token_id, w)
+        w = jnp.where(jnp.arange(gamma + 1)[None] < c, w, pad_token_id)
+
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, w, t, axis=1)
+        new_last = jax.lax.dynamic_slice_in_dim(w, c - 1, 1, axis=1)[:, 0]
+        # the committed count is c; the target cache advanced gamma+1
+        # -> valid through the second-newest committed token, t'-1
+        t_cache = _rollback_cache(t_cache, gamma - n)
+        extra = post_commit(extra, n)
+        return (extra, t_cache, buf, t + c, pos + c, new_last,
+                finished, rng, rounds + 1, accepted + n)
+
+    def cond(carry):
+        t, finished = carry[3], carry[6]
+        return (t < total_len) & ~jnp.all(finished)
+
+    init = (extra_init, t_cache, buf, jnp.int32(prompt_len + 1), pos0,
+            last, finished, rng, jnp.int32(0), jnp.int32(0))
+    (_, _, buf, _, _, _, _, _, rounds, accepted) = \
+        jax.lax.while_loop(cond, body, init)
+    out = buf[:, :total_len]
+    if return_stats:
+        return out, {"rounds": rounds, "drafted": rounds * gamma,
+                     "accepted": accepted}
+    return out
+
+
 def speculative_generate(model: Any, params: Any,
                          draft_model: Any, draft_params: Any,
                          input_ids: jax.Array,
@@ -363,10 +459,14 @@ def speculative_generate(model: Any, params: Any,
 
     Batched: rows advance together by the MINIMUM accepted length
     across unfinished rows (a shared cache index keeps positions
-    aligned); over-accepted rows simply re-derive the same tokens next
-    round, preserving exactness. Both KV caches roll back via
-    `_rollback_cache` — sound because stale entries past the index are
-    masked and overwritten (see that helper's docstring).
+    aligned). An over-accepted row's discarded tail is re-derived next
+    round: greedily that reproduces the identical tokens (exactness by
+    determinism); under sampling the redo draws fresh randomness, and
+    exactness holds in DISTRIBUTION — the fresh round conditions only
+    on the committed prefix, so each committed token is still
+    ~ p(.|prefix). Both KV caches roll back via `_rollback_cache` —
+    sound because stale entries past the index are masked and
+    overwritten (see that helper's docstring).
 
     The whole loop is one `lax.while_loop` under jit: static shapes,
     `gamma` static, dynamic trip count with >=1 committed token per
@@ -395,29 +495,8 @@ def speculative_generate(model: Any, params: Any,
                 f"max_new_tokens+gamma={total_len + gamma}; the "
                 "speculation window needs gamma extra cache slots")
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
-
-    t_logits, t_cache = _prefill_cache(model, params, input_ids,
-                                       attention_mask, position_ids)
     _, d_cache = _prefill_cache(draft_model, draft_params, input_ids,
                                 attention_mask, position_ids)
-
-    # slack columns keep the fixed-width window write in-bounds near
-    # the end (dynamic_update_slice CLAMPS the start index, which would
-    # silently mis-place the window)
-    buf = jnp.concatenate(
-        [input_ids.astype(jnp.int32),
-         jnp.full((batch, max_new_tokens + gamma + 1), pad_token_id,
-                  jnp.int32)], axis=1)
-    rng, r_first = jax.random.split(rng)
-    first = _select_token(t_logits[:, -1], r_first, do_sample,
-                          temperature, top_k, top_p).astype(jnp.int32)
-    buf = buf.at[:, prompt_len].set(first)
-    finished = (first == eos_token_id) if eos_token_id is not None \
-        else jnp.zeros((batch,), bool)
-    last = jnp.where(finished, pad_token_id, first).astype(jnp.int32)
-    pos0 = position_ids[:, -1] + 1
 
     def draft_step(carry, step_rng):
         cache, tok, pos = carry
@@ -430,11 +509,7 @@ def speculative_generate(model: Any, params: Any,
         ys = (nxt, logits[:, -1]) if do_sample else nxt
         return (mut["cache"], nxt, pos + 1), ys
 
-    def body(carry):
-        (t_cache, d_cache, buf, t, pos, last, finished,
-         rng, rounds, accepted) = carry
-        prev_finished = finished
-        rng, r_draft, r_round = jax.random.split(rng, 3)
+    def propose(d_cache, buf, t, pos, last, r_draft):
         # draft gamma proposals (one extra feed keeps the draft cache
         # aligned with the target on full acceptance)
         (d_cache, _, _), drafts = jax.lax.scan(
@@ -446,54 +521,97 @@ def speculative_generate(model: Any, params: Any,
         else:
             d = jnp.moveaxis(drafts, 0, 1)[:, :gamma]
             d_logits = None
+        return d_cache, d, d_logits
 
-        verify = jnp.concatenate([last[:, None], d], axis=1)
-        v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
-        logits, mut = model.apply(
-            {"params": params, "cache": t_cache}, verify,
-            attention_mask=attention_mask, position_ids=v_pos,
-            init_cache=True, mutable=["cache"])
-        t_cache = mut["cache"]
+    return _speculative_loop(
+        model, params, input_ids, attention_mask, max_new_tokens,
+        gamma, do_sample=do_sample, temperature=temperature,
+        top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id, rng=rng, return_stats=return_stats,
+        propose=propose,
+        post_commit=lambda d_cache, n: _rollback_cache(d_cache,
+                                                       gamma - n),
+        extra_init=d_cache)
 
-        n_r, w = _spec_round_tokens(
-            logits, d_logits, d, r_round, do_sample=do_sample,
-            temperature=temperature, top_k=top_k, top_p=top_p)
-        n_r = jnp.where(finished, gamma, n_r)
-        n = jnp.min(n_r)
-        c = n + 1  # committed this round (1..gamma+1)
 
-        if eos_token_id is not None:
-            is_eos = w == eos_token_id
-            after = jnp.pad(jnp.cumsum(is_eos, axis=1)[:, :-1],
-                            ((0, 0), (1, 0))) > 0
-            w = jnp.where(after, pad_token_id, w)
-            in_window = jnp.arange(gamma + 1)[None] < c
-            finished = finished | jnp.any(is_eos & in_window, axis=1)
-        w = jnp.where(prev_finished[:, None], pad_token_id, w)
-        w = jnp.where(jnp.arange(gamma + 1)[None] < c, w, pad_token_id)
+def _ngram_propose(buf, t, ngram, gamma, pad_token_id):
+    """Prompt-lookup proposals: find an earlier occurrence of the
+    `ngram`-token suffix ending at position t (exclusive) in each row
+    of `buf`, and propose the `gamma` tokens that followed it. Prefers
+    the LATEST match whose whole gamma-token continuation lies inside
+    the committed region — the very latest match's continuation can run
+    into uncommitted pads, capping acceptance on exactly the periodic
+    outputs lookup targets — falling back to the latest partial match.
+    Rows with no match propose pads (they'll be rejected and the round
+    degrades to plain one-token decode). Pure + static shapes; `t` may
+    be traced."""
+    batch, width = buf.shape
+    suffix = jax.lax.dynamic_slice_in_dim(buf, t - ngram, ngram, axis=1)
+    # windows[b, j] == buf[b, j:j+ngram]
+    windows = jnp.stack(
+        [buf[:, k:width - ngram + 1 + k] for k in range(ngram)], axis=-1)
+    match = jnp.all(windows == suffix[:, None, :], axis=-1)
+    pos = jnp.arange(width - ngram + 1)[None]
+    # continuation must start strictly inside the committed region
+    match = match & (pos + ngram < t)
+    fits = match & (pos + ngram + gamma <= t)
+    j_fit = jnp.max(jnp.where(fits, pos, -1), axis=1)
+    j_any = jnp.max(jnp.where(match, pos, -1), axis=1)
+    j = jnp.where(j_fit >= 0, j_fit, j_any)  # [B], -1 = none
+    idx = jnp.clip(j[:, None] + ngram + jnp.arange(gamma)[None], 0,
+                   width - 1)
+    d = jnp.take_along_axis(buf, idx, axis=1)
+    return jnp.where((j >= 0)[:, None], d, pad_token_id).astype(jnp.int32)
 
-        buf = jax.lax.dynamic_update_slice_in_dim(buf, w, t, axis=1)
-        new_last = jax.lax.dynamic_slice_in_dim(w, c - 1, 1, axis=1)[:, 0]
-        # the committed count is c; caches advanced gamma+1 -> valid
-        # through the second-newest committed token, index t'-1
-        t_cache = _rollback_cache(t_cache, gamma - n)
-        d_cache = _rollback_cache(d_cache, gamma - n)
-        return (t_cache, d_cache, buf, t + c, pos + c, new_last,
-                finished, rng, rounds + 1, accepted + n)
 
-    def cond(carry):
-        t, finished = carry[3], carry[6]
-        return (t < total_len) & ~jnp.all(finished)
+def prompt_lookup_generate(model: Any, params: Any,
+                           input_ids: jax.Array,
+                           attention_mask: Optional[jax.Array] = None,
+                           max_new_tokens: int = 32,
+                           gamma: int = 4, ngram: int = 2,
+                           eos_token_id: Optional[int] = None,
+                           pad_token_id: int = 0,
+                           return_stats: bool = False):
+    """DRAFT-FREE speculative decoding (prompt lookup): propose the
+    continuation of the latest earlier occurrence of the current
+    `ngram`-token suffix, verify all `gamma` proposals with one target
+    forward, commit the accepted prefix + 1 correction. TOKEN-EXACT vs
+    plain greedy `generate` — the lookup only changes how many target
+    dispatches it takes. Big wins on extractive/repetitive workloads
+    (summarisation, QA over a context, code) where the continuation
+    often already appears verbatim in the prompt or the generation.
 
-    init = (t_cache, d_cache, buf, jnp.int32(prompt_len + 1), pos0,
-            last, finished, rng, jnp.int32(0), jnp.int32(0))
-    (_, _, buf, _, _, _, _, _, rounds, accepted) = \
-        jax.lax.while_loop(cond, body, init)
-    out = buf[:, :total_len]
-    if return_stats:
-        return out, {"rounds": rounds, "drafted": rounds * gamma,
-                     "accepted": accepted}
-    return out
+    Same loop/cache machinery as `speculative_generate` minus the
+    draft model: one `lax.while_loop`, KV rollback via `_rollback_cache`,
+    batched min-advance (see that function's docstring).
+    """
+    assert gamma >= 1 and ngram >= 1
+    batch, prompt_len = input_ids.shape
+    if max_new_tokens <= 0:
+        return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
+            if return_stats else input_ids
+    if attention_mask is None:
+        attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
+    total_len = prompt_len + max_new_tokens
+    max_len = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+    if max_len is not None and max_len < total_len + gamma:
+        raise ValueError(
+            f"prompt_lookup_generate: model.config."
+            f"max_position_embeddings={max_len} < prompt+"
+            f"max_new_tokens+gamma={total_len + gamma}; the "
+            "speculation window needs gamma extra cache slots")
+
+    def propose(extra, buf, t, pos, last, r_draft):
+        return extra, _ngram_propose(buf, t, ngram, gamma,
+                                     pad_token_id), None
+
+    return _speculative_loop(
+        model, params, input_ids, attention_mask, max_new_tokens,
+        gamma, do_sample=False, temperature=1.0, top_k=0, top_p=0.0,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, rng=None,
+        return_stats=return_stats, propose=propose,
+        post_commit=lambda extra, n: extra, extra_init=())
 
 
 def _make_seq2seq_logits_fn(model, params, input_ids, attention_mask,

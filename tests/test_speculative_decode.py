@@ -218,6 +218,57 @@ def test_self_draft_exact_and_aliased(scan):
         make_self_draft(cfg, tp, 4)
 
 
+def test_ngram_propose_mechanics():
+    """The proposal search: latest earlier occurrence wins, continuation
+    is what followed it, no-match rows propose pads, and matches whose
+    continuation would start at/after t are excluded."""
+    from fengshen_tpu.utils.generate import _ngram_propose
+
+    #        0  1  2  3  4  5  6  7  8 (t) ...
+    buf = jnp.asarray([
+        [5, 6, 9, 5, 6, 7, 5, 6, 0, 0, 0, 0],   # suffix [5,6] at 6..7
+        [1, 2, 3, 4, 5, 6, 7, 8, 0, 0, 0, 0],   # no earlier [7,8]
+    ], jnp.int32)
+    d = _ngram_propose(buf, jnp.int32(8), ngram=2, gamma=3,
+                       pad_token_id=0)
+    # row 0: latest earlier [5,6] is at 3..4 (6..7 is the suffix
+    # itself; its continuation starts at t and is excluded) -> the
+    # tokens that followed: 7, 5, 6
+    np.testing.assert_array_equal(np.asarray(d[0]), [7, 5, 6])
+    # row 1: [7,8] never occurred before -> pads
+    np.testing.assert_array_equal(np.asarray(d[1]), [0, 0, 0])
+
+    # fit preference: on a period-1 loop the LATEST match's window runs
+    # into the uncommitted pad region (capping acceptance); an earlier
+    # match whose whole continuation lies in committed text must win
+    loop = jnp.asarray([[9, 4, 4, 4, 4, 4, 4, 0, 0, 0, 0, 0]], jnp.int32)
+    d2 = _ngram_propose(loop, jnp.int32(7), ngram=2, gamma=3,
+                        pad_token_id=0)
+    # matches of suffix [4,4] at j=1..4; j=4's continuation (5,6,7)
+    # reads one real token then... j+ngram+gamma<=t selects j<=2 ->
+    # j=2, continuation buf[4:7] = [4,4,4], all real committed tokens
+    np.testing.assert_array_equal(np.asarray(d2[0]), [4, 4, 4])
+
+
+@pytest.mark.parametrize("ngram", [1, 2])
+def test_prompt_lookup_exact_vs_greedy(ngram):
+    """Draft-free prompt lookup must be token-exact vs plain greedy,
+    and on this looping tiny model actually accept proposals (the
+    greedy continuation repeats, so the lookup finds it)."""
+    from fengshen_tpu.utils.generate import prompt_lookup_generate
+
+    tgt, tp, _, _, ids, mask = _models()
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=24)
+    out, stats = prompt_lookup_generate(
+        tgt, tp, ids, attention_mask=mask, max_new_tokens=24,
+        gamma=4, ngram=ngram, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # the random 4-layer model's greedy continuation loops (repeated
+    # n-grams), so lookup acceptance must be non-trivial
+    assert int(stats["accepted"]) > 0
+    assert int(stats["rounds"]) < 23  # strictly fewer target passes
+
+
 def test_speculative_refuses_undersized_cache():
     """The verify window writes gamma extra cache entries past
     total_len; a cache without that headroom would silently clamp the
