@@ -522,7 +522,32 @@ def _run_decode() -> None:
             r, jnp.zeros((1, 8), jnp.int32))["params"])(
             jax.random.PRNGKey(0))
 
-        if os.environ.get("BENCH_DECODE") == "spec":
+        if os.environ.get("BENCH_DECODE") == "lookup":
+            # draft-free prompt-lookup speculation (token-exact greedy;
+            # wins scale with output repetitiveness)
+            from fengshen_tpu.utils.generate import prompt_lookup_generate
+            import dataclasses
+            gamma = int(os.environ.get("BENCH_SPEC_GAMMA", "4"))
+            config = dataclasses.replace(
+                config,
+                max_position_embeddings=prompt + new_tokens + gamma)
+            model = LlamaForCausalLM(config)
+
+            @jax.jit
+            def _gen(params, ids):
+                return prompt_lookup_generate(
+                    model, params, ids, max_new_tokens=new_tokens,
+                    gamma=gamma,
+                    ngram=int(os.environ.get("BENCH_LOOKUP_NGRAM", "2")),
+                    eos_token_id=None, pad_token_id=0)
+
+            def decode():
+                return _gen(params, ids)
+            metric = ("llama300m_int8_lookup_decode_tokens_per_sec_per_chip"
+                      if config.int8_lm_head else
+                      "llama300m_lookup_decode_tokens_per_sec_per_chip")
+            compile_budget = 1800 if config.int8_lm_head else 900
+        elif os.environ.get("BENCH_DECODE") == "spec":
             # speculative decoding: token-exact greedy via a shallow
             # draft of the same width (BENCH_DRAFT_LAYERS deep). The
             # row measures COMMITTED tokens/sec — acceptance rate on

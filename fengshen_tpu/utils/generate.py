@@ -329,6 +329,31 @@ def _spec_round_tokens(t_logits, d_logits, d, rng, *, do_sample,
     return n_r, w
 
 
+def _spec_early_return(input_ids, max_new_tokens, return_stats):
+    """Shared no-op path for max_new_tokens <= 0 (None = proceed)."""
+    if max_new_tokens > 0:
+        return None
+    return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
+        if return_stats else input_ids
+
+
+def _check_spec_cache_headroom(models, total_len, gamma, fn_name):
+    """The verify forward near the end writes cache entries up to index
+    total_len + gamma - 1; a too-small preallocated cache would CLAMP
+    the dynamic_update_slice start and silently corrupt committed
+    entries (breaking exactness), so refuse loudly. `models` is
+    (name, module) pairs."""
+    for name, m in models:
+        max_len = getattr(getattr(m, "config", None),
+                          "max_position_embeddings", None)
+        if max_len is not None and max_len < total_len + gamma:
+            raise ValueError(
+                f"{fn_name}: {name}.config.max_position_embeddings="
+                f"{max_len} < prompt+max_new_tokens+gamma="
+                f"{total_len + gamma}; the speculation window needs "
+                "gamma extra cache slots")
+
+
 def _speculative_loop(model, params, input_ids, attention_mask,
                       max_new_tokens, gamma, *, do_sample, temperature,
                       top_k, top_p, eos_token_id, pad_token_id, rng,
@@ -343,8 +368,12 @@ def _speculative_loop(model, params, input_ids, attention_mask,
     modes); `post_commit(extra, n) -> extra` runs after the commit
     (e.g. draft-cache rollback); `extra` is any pytree carried through
     the while_loop (a draft KV cache, or () for draft-free lookup).
+    `attention_mask` may be None (defaults to all-ones); the shared
+    cache-headroom guard lives in `_check_spec_cache_headroom`.
     """
     batch, prompt_len = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
     total_len = prompt_len + max_new_tokens
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
     if rng is None:
@@ -475,25 +504,14 @@ def speculative_generate(model: Any, params: Any,
     """
     assert gamma >= 1, "speculative decoding needs gamma >= 1"
     batch, prompt_len = input_ids.shape
-    if max_new_tokens <= 0:
-        return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
-            if return_stats else input_ids
+    early = _spec_early_return(input_ids, max_new_tokens, return_stats)
+    if early is not None:
+        return early
     if attention_mask is None:
         attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
-    total_len = prompt_len + max_new_tokens
-    # the verify forward near the end writes cache entries up to index
-    # total_len + gamma - 1; a too-small preallocated cache would CLAMP
-    # the dynamic_update_slice start and silently corrupt committed
-    # entries (breaking exactness), so refuse loudly instead
-    for name, m in (("model", model), ("draft_model", draft_model)):
-        max_len = getattr(getattr(m, "config", None),
-                          "max_position_embeddings", None)
-        if max_len is not None and max_len < total_len + gamma:
-            raise ValueError(
-                f"speculative_generate: {name}.config."
-                f"max_position_embeddings={max_len} < prompt+"
-                f"max_new_tokens+gamma={total_len + gamma}; the "
-                "speculation window needs gamma extra cache slots")
+    _check_spec_cache_headroom(
+        (("model", model), ("draft_model", draft_model)),
+        prompt_len + max_new_tokens, gamma, "speculative_generate")
     position_ids = jnp.clip(attention_mask.cumsum(-1) - 1, 0, None)
     _, d_cache = _prefill_cache(draft_model, draft_params, input_ids,
                                 attention_mask, position_ids)
@@ -586,21 +604,13 @@ def prompt_lookup_generate(model: Any, params: Any,
     batched min-advance (see that function's docstring).
     """
     assert gamma >= 1 and ngram >= 1
-    batch, prompt_len = input_ids.shape
-    if max_new_tokens <= 0:
-        return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
-            if return_stats else input_ids
-    if attention_mask is None:
-        attention_mask = jnp.ones((batch, prompt_len), jnp.int32)
-    total_len = prompt_len + max_new_tokens
-    max_len = getattr(getattr(model, "config", None),
-                      "max_position_embeddings", None)
-    if max_len is not None and max_len < total_len + gamma:
-        raise ValueError(
-            f"prompt_lookup_generate: model.config."
-            f"max_position_embeddings={max_len} < prompt+"
-            f"max_new_tokens+gamma={total_len + gamma}; the "
-            "speculation window needs gamma extra cache slots")
+    prompt_len = input_ids.shape[1]
+    early = _spec_early_return(input_ids, max_new_tokens, return_stats)
+    if early is not None:
+        return early
+    _check_spec_cache_headroom(
+        (("model", model),), prompt_len + max_new_tokens, gamma,
+        "prompt_lookup_generate")
 
     def propose(extra, buf, t, pos, last, r_draft):
         return extra, _ngram_propose(buf, t, ngram, gamma,

@@ -99,6 +99,17 @@ def test_bench_decode_spec(monkeypatch):
         "llama300m_spec_decode_tokens_per_sec_per_chip"
 
 
+def test_bench_decode_lookup(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_CONFIG": "decode",
+                                   "BENCH_DECODE": "lookup",
+                                   "BENCH_SPEC_GAMMA": "2",
+                                   "BENCH_PROMPT": "16",
+                                   "BENCH_NEW_TOKENS": "16",
+                                   "BENCH_DECODE_RUNS": "1"})
+    assert row["metric"] == \
+        "llama300m_lookup_decode_tokens_per_sec_per_chip"
+
+
 def test_bench_decode_beam(monkeypatch):
     row = _run_bench(monkeypatch, {"BENCH_CONFIG": "decode",
                                    "BENCH_DECODE": "beam",

@@ -37,6 +37,11 @@ BENCH_CONFIG=decode BENCH_DECODE=spec python bench.py | tee /tmp/bench_decode_sp
 
 echo "== probe"; probe
 
+echo "== decode throughput: draft-free prompt-lookup (random weights loop, so lookup accepts for real)"
+BENCH_CONFIG=decode BENCH_DECODE=lookup python bench.py | tee /tmp/bench_decode_lookup.json || true
+
+echo "== probe"; probe
+
 echo "== 13B-shape l8xb4 retry (died in the remote-compile helper last window, HTTP 500 — terminal-side)"
 BENCH_CONFIG=large BENCH_LAYERS=8 BENCH_BATCH=4 BENCH_FUSED_CE=8 python bench.py | tee /tmp/bench_large_l8b4.json || true
 
