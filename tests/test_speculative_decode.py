@@ -269,6 +269,47 @@ def test_prompt_lookup_exact_vs_greedy(ngram):
     assert int(stats["rounds"]) < 23  # strictly fewer target passes
 
 
+def test_speculative_int8_lm_head_exact():
+    """The bench composes BENCH_INT8_LMHEAD with spec/lookup decode;
+    with the int8 head on BOTH the reference and speculative paths the
+    outputs must still be token-exact (the head changes logits, not
+    the speculation contract)."""
+    from fengshen_tpu.utils.generate import prompt_lookup_generate
+
+    import dataclasses
+
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=3,
+                      num_attention_heads=4,
+                      max_position_embeddings=128, dtype="float32",
+                      int8_lm_head=True)
+    tgt = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(4).randint(3, 96, (2, 8)),
+                      jnp.int32)
+    tp = tgt.init(jax.random.PRNGKey(0), ids[:, :4])["params"]
+    drf_cfg = dataclasses.replace(cfg, num_hidden_layers=1,
+                                  int8_lm_head=False)
+    drf = LlamaForCausalLM(drf_cfg)
+    dp = drf.init(jax.random.PRNGKey(1), ids[:, :4])["params"]
+
+    ref = generate(tgt, tp, ids, max_new_tokens=16)
+    # unrelated draft: zero acceptance, correction path only
+    out = speculative_generate(tgt, tp, drf, dp, ids,
+                               max_new_tokens=16, gamma=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # self-draft: FULL acceptance, so the int8 logits must agree
+    # between the multi-token verify pass and the per-token draft pass
+    # for ACCEPTED tokens too (non-vacuous accept-path coverage)
+    out_sd, st = speculative_generate(tgt, tp, tgt, tp, ids,
+                                      max_new_tokens=16, gamma=3,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out_sd), np.asarray(ref))
+    assert int(st["accepted"]) == int(st["rounds"]) * 3
+    out2 = prompt_lookup_generate(tgt, tp, ids, max_new_tokens=16,
+                                  gamma=3, ngram=2)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+
 def test_speculative_refuses_undersized_cache():
     """The verify window writes gamma extra cache entries past
     total_len; a cache without that headroom would silently clamp the
