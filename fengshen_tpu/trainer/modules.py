@@ -230,3 +230,94 @@ class PipelinedCausalLMModule(TrainModule):
                                    4 * cfg.hidden_size)
         return 6.0 * (cfg.num_hidden_layers * per_layer +
                       cfg.hidden_size * cfg.vocab_size)
+
+
+class LoraTrainModule(TrainModule):
+    """Wrap ANY TrainModule for LoRA finetuning (reference's roadmap
+    item, ziya_llama/README.md:59; merge tool fs_merge_weight.py).
+
+    params become the two-tree {'base': inner params, 'lora': adapters}
+    (`ops/lora.py`): the loss runs the inner module over
+    `apply_lora(base, lora)` — merged INSIDE the jitted step, no model
+    changes — and the optimizer is a multi_transform that trains only
+    lora_a/lora_b (base and the stored scales get set_to_zero, and
+    adam moments exist only for the adapters — the memory win).
+    Checkpoints carry the two-tree; `python -m fengshen_tpu.ops.lora`
+    merges one into a plain servable checkpoint.
+    """
+
+    def __init__(self, inner: TrainModule, rank: int,
+                 alpha: Optional[float] = None,
+                 target_regex: str =
+                 r"(q_proj|k_proj|v_proj|o_proj)"):
+        super().__init__(inner.args)
+        self.inner = inner
+        self.rank, self.alpha, self.target_regex = rank, alpha, \
+            target_regex
+        # the inner's model/config stay reachable for trainer hooks
+        self.model = getattr(inner, "model", None)
+        self.config = getattr(inner, "config", None)
+
+    def setup(self, stage: str = "fit") -> None:
+        self.inner.setup(stage)
+
+    def init_params(self, rng):
+        from fengshen_tpu.ops.lora import init_lora
+        base = self.inner.init_params(rng)
+        lora = init_lora(base, jax.random.fold_in(rng, 1), self.rank,
+                         self.target_regex, alpha=self.alpha)
+        return {"base": base, "lora": lora}
+
+    def _merged(self, params):
+        from fengshen_tpu.ops.lora import apply_lora
+        # stop_gradient on the frozen base: XLA then dead-code-
+        # eliminates the full-size base weight-grad computation (the
+        # LoRA memory/compute win — without it a full grad tree is
+        # materialized and merely discarded by the optimizer mask) and
+        # the logged grad_norm reflects the adapters actually training
+        return apply_lora(jax.lax.stop_gradient(params["base"]),
+                          params["lora"])
+
+    def training_loss(self, params, batch, rng):
+        return self.inner.training_loss(self._merged(params), batch, rng)
+
+    def validation_loss(self, params, batch, rng):
+        return self.inner.validation_loss(self._merged(params), batch,
+                                          rng)
+
+    def configure_optimizers(self, total_steps: int, params=None):
+        import optax
+
+        from fengshen_tpu.models import model_utils
+        from fengshen_tpu.ops.lora import lora_param_labels
+
+        # the standard factory, decay-mask-free (the inner transform
+        # sees only the adapters — plain matrices — and the base is
+        # frozen, so the no-decay mask is moot)
+        tx, schedule = model_utils.configure_optimizers(
+            self.args, total_steps, params=None)
+        tx = optax.multi_transform(
+            {"lora": tx, "freeze": optax.set_to_zero()},
+            lora_param_labels)
+        return tx, schedule
+
+    def predict_step(self, params, batch, *args, **kw):
+        hook = getattr(self.inner, "predict_step", None)
+        if hook is None:
+            raise AttributeError(
+                f"{type(self.inner).__name__} defines no predict_step")
+        return hook(self._merged(params), batch, *args, **kw)
+
+    def partition_rules(self):
+        # inner rules still re.search-match under the 'base/' prefix;
+        # adapters fall to the catch-all (replicated — they're small)
+        return self.inner.partition_rules()
+
+    def batch_spec(self, batch):
+        return self.inner.batch_spec(batch)
+
+    def flops_per_token(self):
+        return self.inner.flops_per_token()
+
+    def tokens_in_batch(self, batch):
+        return self.inner.tokens_in_batch(batch)
