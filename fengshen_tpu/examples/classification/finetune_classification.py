@@ -421,6 +421,13 @@ class ClassificationModule(TrainModule):
                  "offload_param; the 7GB AFQMC recipe). MegatronBert "
                  "backbone only; composes the optimizer offload "
                  "automatically.")
+        from fengshen_tpu.trainer.modules import add_lora_args
+        add_lora_args(
+            parser,
+            targets_default=(
+                r"(self/(query|key|value)|attention_output_dense)"),
+            # the task head is random init — it must train fully
+            train_default=r"cls_layer")
         parser.add_argument(
             "--offload_moments_dtype", default="param", type=str,
             choices=["param", "float32", "bfloat16"],
@@ -688,6 +695,8 @@ def main(argv=None):
 
     data_model = TaskDataModel(args)
     module = ClassificationModule(args)
+    from fengshen_tpu.trainer.modules import maybe_wrap_lora
+    module = maybe_wrap_lora(module, args)
     trainer = Trainer(args)
     ckpt = TaskModelCheckpoint(args)
     trainer.callbacks.append(ckpt.callbacks)

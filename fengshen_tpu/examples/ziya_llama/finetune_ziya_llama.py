@@ -172,19 +172,9 @@ class Llama(CausalLMModule):
                  "(trainer/param_streaming.py) — for models whose "
                  "params+moments dwarf one chip's HBM (the 13B "
                  "finetune). Incompatible with --packed.")
-        parser.add_argument(
-            "--lora_rank", default=0, type=int,
-            help="LoRA finetuning: freeze the base model and train "
-                 "rank-r adapters on the attention projections "
-                 "(reference roadmap, ziya_llama/README.md:59; merge "
-                 "back with `python -m fengshen_tpu.ops.lora`). 0 = "
-                 "full finetune")
-        parser.add_argument("--lora_alpha", default=None, type=float,
-                            help="LoRA scale numerator (default 2*rank)")
-        parser.add_argument(
-            "--lora_targets", default="(q_proj|k_proj|v_proj|o_proj)",
-            type=str, help="regex over param paths selecting the "
-                           "kernels that get adapters")
+        from fengshen_tpu.trainer.modules import add_lora_args
+        add_lora_args(parser,
+                      targets_default=r"(q_proj|k_proj|v_proj|o_proj)")
         parser.add_argument(
             "--offload_moments_dtype", default="param", type=str,
             choices=["param", "float32", "bfloat16"],
@@ -265,16 +255,8 @@ def main(argv=None):
     module = Llama(args)
     if args.packed:
         module.config.packed_sequences = True
-    if getattr(args, "lora_rank", 0):
-        if getattr(args, "offload_params", False):
-            raise ValueError("--lora_rank already shrinks optimizer "
-                             "state to the adapters; combine with "
-                             "--offload_optimizer if needed, not "
-                             "--offload_params")
-        from fengshen_tpu.trainer.modules import LoraTrainModule
-        module = LoraTrainModule(module, rank=args.lora_rank,
-                                 alpha=args.lora_alpha,
-                                 target_regex=args.lora_targets)
+    from fengshen_tpu.trainer.modules import maybe_wrap_lora
+    module = maybe_wrap_lora(module, args)
     # Trainer.__init__ installs the process-global mesh the datamodule's
     # DP sharding reads — load-bearing in BOTH branches
     trainer = Trainer(args)

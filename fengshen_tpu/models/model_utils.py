@@ -129,7 +129,12 @@ def configure_optimizers(args, total_steps: int,
     decay applies everywhere (callers should pass params).
     """
     schedule = get_scheduler(args, total_steps)
-    mask = decay_mask_fn(params) if params is not None else None
+    # the mask goes in as a CALLABLE so optax evaluates it on whatever
+    # tree the transform actually sees — identical for plain training,
+    # and under optax.masked / multi_transform (the LoRA path) it
+    # adapts to the masked subtree instead of relying on optax to line
+    # up an eagerly-built full-tree mask
+    mask = decay_mask_fn if params is not None else None
     tx = optax.adamw(
         learning_rate=schedule,
         b1=getattr(args, "adam_beta1", 0.9),

@@ -95,14 +95,31 @@ def apply_lora(params, lora):
 merge_lora = apply_lora
 
 
-def lora_param_labels(params):
+def train_path_matches(path, train_regex: str | None) -> bool:
+    """Does this path WITHIN the base subtree match the fully-trained
+    (`modules_to_save`) regex? The ONE predicate both the optimizer
+    labels and the stop_gradient masking use — if they disagreed, a
+    leaf could get adamw updates from zeroed gradients (or real
+    gradients the mask then discards)."""
+    return bool(train_regex) and bool(
+        re.search(train_regex, "/".join(_path_keys(path))))
+
+
+def lora_param_labels(params, train_regex: str | None = None):
     """Label tree for optax.multi_transform over a {'base','lora'}
-    two-tree: only lora_a/lora_b train; base AND the stored scales
-    freeze."""
+    two-tree: lora_a/lora_b train, base and the stored scales freeze —
+    EXCEPT base leaves matching `train_regex`, which train fully (the
+    `modules_to_save` of standard LoRA: task heads are random init, so
+    freezing them would leave logits a fixed random projection)."""
     def label(path, _leaf):
         keys = _path_keys(path)
-        return "lora" if (keys and keys[0] == "lora" and
-                          keys[-1] in ("lora_a", "lora_b")) else "freeze"
+        if keys and keys[0] == "lora":
+            return "lora" if keys[-1] in ("lora_a", "lora_b") \
+                else "freeze"
+        if keys and keys[0] == "base" and \
+                train_path_matches(path[1:], train_regex):
+            return "lora"
+        return "freeze"
     return jax.tree_util.tree_map_with_path(label, params)
 
 

@@ -249,11 +249,16 @@ class LoraTrainModule(TrainModule):
     def __init__(self, inner: TrainModule, rank: int,
                  alpha: Optional[float] = None,
                  target_regex: str =
-                 r"(q_proj|k_proj|v_proj|o_proj)"):
+                 r"(q_proj|k_proj|v_proj|o_proj)",
+                 train_regex: Optional[str] = None):
         super().__init__(inner.args)
         self.inner = inner
         self.rank, self.alpha, self.target_regex = rank, alpha, \
             target_regex
+        # modules_to_save analog: base paths matching this regex train
+        # FULLY (task heads are random init — frozen they would leave
+        # logits a fixed random projection)
+        self.train_regex = train_regex
         # the inner's model/config stay reachable for trainer hooks
         self.model = getattr(inner, "model", None)
         self.config = getattr(inner, "config", None)
@@ -262,21 +267,36 @@ class LoraTrainModule(TrainModule):
         self.inner.setup(stage)
 
     def init_params(self, rng):
-        from fengshen_tpu.ops.lora import init_lora
+        from fengshen_tpu.ops.lora import init_lora, train_path_matches
         base = self.inner.init_params(rng)
         lora = init_lora(base, jax.random.fold_in(rng, 1), self.rank,
                          self.target_regex, alpha=self.alpha)
+        if self.train_regex and not any(
+                train_path_matches(p, self.train_regex) for p, _ in
+                jax.tree_util.tree_flatten_with_path(base)[0]):
+            # a typo'd head regex would silently leave a random-init
+            # head frozen — chance-level logits with no error signal
+            raise ValueError(
+                f"lora train_regex {self.train_regex!r} matches no "
+                "base parameter (--lora_train_modules typo?)")
         return {"base": base, "lora": lora}
 
     def _merged(self, params):
-        from fengshen_tpu.ops.lora import apply_lora
+        from fengshen_tpu.ops.lora import apply_lora, train_path_matches
         # stop_gradient on the frozen base: XLA then dead-code-
         # eliminates the full-size base weight-grad computation (the
         # LoRA memory/compute win — without it a full grad tree is
         # materialized and merely discarded by the optimizer mask) and
-        # the logged grad_norm reflects the adapters actually training
-        return apply_lora(jax.lax.stop_gradient(params["base"]),
-                          params["lora"])
+        # the logged grad_norm reflects the params actually training.
+        # Leaves matching train_regex (fully-trained heads) must NOT be
+        # stopped or their adamw updates would receive zero gradients —
+        # the shared train_path_matches predicate keeps this in exact
+        # agreement with the optimizer labels.
+        base = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: leaf
+            if train_path_matches(path, self.train_regex)
+            else jax.lax.stop_gradient(leaf), params["base"])
+        return apply_lora(base, params["lora"])
 
     def training_loss(self, params, batch, rng):
         return self.inner.training_loss(self._merged(params), batch, rng)
@@ -291,14 +311,16 @@ class LoraTrainModule(TrainModule):
         from fengshen_tpu.models import model_utils
         from fengshen_tpu.ops.lora import lora_param_labels
 
-        # the standard factory, decay-mask-free (the inner transform
-        # sees only the adapters — plain matrices — and the base is
-        # frozen, so the no-decay mask is moot)
+        from functools import partial
+
+        # the standard factory WITH the no-decay mask (built over the
+        # two-tree, so train_regex head biases/LayerNorms keep their
+        # full-finetune no-decay treatment; adapter matrices decay)
         tx, schedule = model_utils.configure_optimizers(
-            self.args, total_steps, params=None)
+            self.args, total_steps, params=params)
         tx = optax.multi_transform(
             {"lora": tx, "freeze": optax.set_to_zero()},
-            lora_param_labels)
+            partial(lora_param_labels, train_regex=self.train_regex))
         return tx, schedule
 
     def predict_step(self, params, batch, *args, **kw):
@@ -321,3 +343,43 @@ class LoraTrainModule(TrainModule):
 
     def tokens_in_batch(self, batch):
         return self.inner.tokens_in_batch(batch)
+
+
+def add_lora_args(parser, targets_default: str,
+                  train_default: "Optional[str]" = None):
+    """The shared --lora_* flag block (family-specific defaults)."""
+    parser.add_argument(
+        "--lora_rank", default=0, type=int,
+        help="LoRA finetuning: freeze the base model and train rank-r "
+             "adapters (merge back with `python -m "
+             "fengshen_tpu.ops.lora`). 0 = full finetune")
+    parser.add_argument("--lora_alpha", default=None, type=float,
+                        help="LoRA scale numerator (default 2*rank)")
+    parser.add_argument(
+        "--lora_targets", default=targets_default, type=str,
+        help="regex over param paths selecting the kernels that get "
+             "adapters")
+    parser.add_argument(
+        "--lora_train_modules", default=train_default, type=str,
+        help="regex of base modules to train FULLY alongside the "
+             "adapters (modules_to_save analog — task heads are "
+             "random init and must not freeze)")
+    return parser
+
+
+def maybe_wrap_lora(module: TrainModule, args) -> TrainModule:
+    """Wrap `module` in LoraTrainModule when --lora_rank is set (the
+    shared driver wiring, incl. the offload_params conflict guard)."""
+    if not getattr(args, "lora_rank", 0):
+        return module
+    if getattr(args, "offload_params", False):
+        raise ValueError("--lora_rank already shrinks optimizer state "
+                         "to the adapters; combine with "
+                         "--offload_optimizer if needed, not "
+                         "--offload_params")
+    return LoraTrainModule(module, rank=args.lora_rank,
+                           alpha=getattr(args, "lora_alpha", None),
+                           target_regex=args.lora_targets,
+                           train_regex=getattr(args,
+                                               "lora_train_modules",
+                                               None))
