@@ -269,6 +269,45 @@ def test_prompt_lookup_exact_vs_greedy(ngram):
     assert int(stats["rounds"]) < 23  # strictly fewer target passes
 
 
+def test_speculative_edge_shapes_exact():
+    """Edge interactions stay token-exact: gamma larger than the whole
+    budget (first round over-commits, final slice trims), batch of one,
+    and eos on the very first token (the loop must run zero rounds)."""
+    tgt, tp, drf, dp, ids, mask = _models()
+
+    # gamma > max_new_tokens
+    ref = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=4)
+    out = speculative_generate(tgt, tp, drf, dp, ids,
+                               attention_mask=mask, max_new_tokens=4,
+                               gamma=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # batch of one
+    one, m1 = ids[:1], mask[:1]
+    ref1 = generate(tgt, tp, one, attention_mask=m1, max_new_tokens=12)
+    out1 = speculative_generate(tgt, tp, drf, dp, one,
+                                attention_mask=m1, max_new_tokens=12,
+                                gamma=3)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(ref1))
+
+    # eos == the first generated token: per-row early finish stays
+    # exact on the mixed batch, and on the single-row batch the loop
+    # runs ZERO speculation rounds (finished before the first round)
+    eos = int(np.asarray(ref)[0, ids.shape[1]])  # row 0's first token
+    ref_e = generate(tgt, tp, ids, attention_mask=mask, max_new_tokens=8,
+                     eos_token_id=eos, pad_token_id=0)
+    out_e = speculative_generate(tgt, tp, drf, dp, ids,
+                                 attention_mask=mask, max_new_tokens=8,
+                                 gamma=4, eos_token_id=eos,
+                                 pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out_e), np.asarray(ref_e))
+    _, st = speculative_generate(tgt, tp, drf, dp, one,
+                                 attention_mask=m1, max_new_tokens=8,
+                                 gamma=4, eos_token_id=eos,
+                                 pad_token_id=0, return_stats=True)
+    assert int(st["rounds"]) == 0
+
+
 def test_speculative_int8_lm_head_exact():
     """The bench composes BENCH_INT8_LMHEAD with spec/lookup decode;
     with the int8 head on BOTH the reference and speculative paths the
