@@ -165,6 +165,13 @@ def main(argv=None):
                        help="alias of --max_src_length (reference name)")
     group.add_argument("--max_dec_length", default=None, type=int,
                        help="alias of --max_tgt_length (reference name)")
+    from fengshen_tpu.trainer.modules import add_lora_args
+    add_lora_args(
+        parser,
+        # T5/BART/Pegasus attention projections (both self and cross)
+        targets_default=(
+            r"(self_attention|cross_attention|self_attn|encoder_attn)"
+            r"/(q|k|v|o|q_proj|k_proj|v_proj|out_proj)/kernel"))
     args = parser.parse_args(argv)
     if args.pretrained_model_path:
         args.model_path = args.pretrained_model_path
@@ -183,6 +190,8 @@ def main(argv=None):
     datamodule = UniversalDataModule(tokenizer=tokenizer,
                                      collate_fn=collator, args=args)
     module = Seq2SeqModule(args, model, config)
+    from fengshen_tpu.trainer.modules import maybe_wrap_lora
+    module = maybe_wrap_lora(module, args)
     trainer = Trainer(args)
     trainer.callbacks.append(UniversalCheckpoint(args))
     if args.do_eval_only:

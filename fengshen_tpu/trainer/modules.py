@@ -259,9 +259,14 @@ class LoraTrainModule(TrainModule):
         # FULLY (task heads are random init — frozen they would leave
         # logits a fixed random projection)
         self.train_regex = train_regex
-        # the inner's model/config stay reachable for trainer hooks
+        # the inner's model/config stay reachable for trainer hooks,
+        # and the jit_predict opt-in carries through (without it the
+        # predict path runs eagerly, re-materializing the merged base
+        # tree per batch instead of letting XLA fuse the adapters into
+        # the consumer matmuls)
         self.model = getattr(inner, "model", None)
         self.config = getattr(inner, "config", None)
+        self.jit_predict = getattr(inner, "jit_predict", False)
 
     def setup(self, stage: str = "fit") -> None:
         self.inner.setup(stage)

@@ -183,6 +183,42 @@ def test_lora_classification_e2e(tmp_path, mesh8):
     assert sorted(l["id"] for l in lines) == list(range(6))
 
 
+def test_lora_summary_seq2seq_e2e(tmp_path, mesh8):
+    """Third archetype — encoder-decoder (T5) through the summary
+    driver: --lora_rank trains, then the rouge predict path decodes
+    through the wrapper's predict_step."""
+    import json as _json
+
+    from tests.test_examples_batch2 import (_bert_tokenizer_dir,
+                                            _write_jsonl)
+    from fengshen_tpu.examples.summary import seq2seq_summary
+    from fengshen_tpu.models.t5 import T5Config
+
+    tok, model_dir = _bert_tokenizer_dir(tmp_path)
+    T5Config.small_test_config(vocab_size=len(tok)).save_pretrained(
+        str(model_dir))
+    rows = [{"text": "今天天气很好我们去公园吧然后回家",
+             "summary": "天气很好"}] * 8
+    _write_jsonl(tmp_path / "train.json", rows)
+    _write_jsonl(tmp_path / "test.json", rows[:4])
+    out = tmp_path / "predict.json"
+    seq2seq_summary.main([
+        "--model_type", "t5", "--model_path", str(model_dir),
+        "--train_file", str(tmp_path / "train.json"),
+        "--test_file", str(tmp_path / "test.json"),
+        "--train_batchsize", "4", "--test_batchsize", "2",
+        "--max_steps", "2", "--max_enc_length", "16",
+        "--max_dec_length", "8", "--learning_rate", "1e-3",
+        "--warmup_steps", "1", "--lora_rank", "2",
+        "--output_save_path", str(out),
+        "--default_root_dir", str(tmp_path / "runs"),
+        "--save_ckpt_path", str(tmp_path / "ckpt"),
+        "--load_ckpt_path", str(tmp_path / "ckpt"),
+        "--precision", "fp32"])
+    lines = [_json.loads(x) for x in open(out, encoding="utf-8")]
+    assert len(lines) == 4 and all("pred" in r for r in lines)
+
+
 def test_lora_trainer_e2e_and_merge_cli(tmp_path, mesh8):
     """finetune_ziya_llama --lora_rank: the base stays FROZEN, the
     adapters move, adam moments exist only for the adapters, and the
