@@ -206,8 +206,9 @@ def _main() -> None:
     # config is the honest best config (round-5: fused-CE batch 28 ran
     # where materialized 28/24 OOM'd).
     fce_env = os.environ.get("BENCH_FUSED_CE")
-    if fce_env or os.environ.get("BENCH_INT8_LMHEAD", "0") != "0":
-        # a lever row (explicit fused-CE chunking and/or int8 head)
+    if fce_env or os.environ.get("BENCH_INT8_LMHEAD", "0") != "0" \
+            or os.environ.get("BENCH_LORA", "0") != "0":
+        # a lever row (explicit fused-CE chunking, int8 head, or LoRA)
         # must not silently mix IN the other lever on fallback — the
         # row would be incomparable to its baseline. Pure batch ladder.
         rungs = [{"BENCH_BATCH": b, "BENCH_FUSED_CE": fce_env or 0}
@@ -668,7 +669,26 @@ def _run(per_chip_batch: int) -> None:
     rng = jax.random.PRNGKey(0)
     params = jax.jit(
         lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"])(rng)
-    tx = optax.adamw(1e-4, weight_decay=0.1)
+    lora_rank = int(os.environ.get("BENCH_LORA", "0"))
+    if lora_rank:
+        # LoRA lever row: frozen base + rank-r adapters on the
+        # attention projections — measures the stop_gradient DCE win
+        # (no base weight grads, adam only on adapters) vs the full-
+        # finetune row at the same shape
+        from functools import partial
+
+        from fengshen_tpu.ops.lora import (apply_lora, init_lora,
+                                           lora_param_labels)
+        params = {"base": params,
+                  "lora": init_lora(params, jax.random.PRNGKey(1),
+                                    lora_rank,
+                                    r"(q_proj|k_proj|v_proj|o_proj)")}
+        tx = optax.multi_transform(
+            {"lora": optax.adamw(1e-4, weight_decay=0.1),
+             "freeze": optax.set_to_zero()},
+            partial(lora_param_labels, train_regex=None))
+    else:
+        tx = optax.adamw(1e-4, weight_decay=0.1)
     opt_state = jax.jit(tx.init)(params)
 
     ids = jnp.asarray(np.random.RandomState(0).randint(
@@ -688,6 +708,14 @@ def _run(per_chip_batch: int) -> None:
             logits = model.apply({"params": p}, ids)
             loss, _ = stable_cross_entropy(logits[:, :-1], ids[:, 1:])
             return loss
+
+    if lora_rank:
+        inner_loss = loss_fn
+
+        def loss_fn(p, ids):  # noqa: F811 — merged-view wrapper
+            merged = apply_lora(jax.lax.stop_gradient(p["base"]),
+                                p["lora"])
+            return inner_loss(merged, ids)
 
     @jax.jit
     def step(p, o, ids):
@@ -722,10 +750,11 @@ def _run(per_chip_batch: int) -> None:
     mfu = tps * flops_per_token / (peak * n_dev)
 
     print(json.dumps({
-        # the int8 LM-head lever changes numerics, not just memory
-        # strategy — its row must be distinguishable in the BENCH file
-        # (same 'int8' tag as the decode row)
-        "metric": ("llama300m_int8_train_tokens_per_sec_per_chip"
+        # lever rows must be distinguishable in the BENCH file (the
+        # int8 head changes numerics; LoRA changes what trains)
+        "metric": ("llama300m_lora_train_tokens_per_sec_per_chip"
+                   if lora_rank else
+                   "llama300m_int8_train_tokens_per_sec_per_chip"
                    if config.int8_lm_head else
                    "llama300m_train_tokens_per_sec_per_chip"),
         "value": round(tps / n_dev, 1),

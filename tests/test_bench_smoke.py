@@ -54,6 +54,11 @@ def test_bench_default_levers(monkeypatch):
         "llama300m_int8_train_tokens_per_sec_per_chip"
 
 
+def test_bench_lora_lever(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_LORA": "2"})
+    assert row["metric"] == "llama300m_lora_train_tokens_per_sec_per_chip"
+
+
 def test_bench_sharded_and_offload(monkeypatch):
     row = _run_bench(monkeypatch, {"BENCH_CONFIG": "sharded",
                                    "BENCH_FSDP": "2", "BENCH_TP": "2",

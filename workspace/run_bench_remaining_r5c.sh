@@ -47,6 +47,11 @@ BENCH_CONFIG=large BENCH_LAYERS=8 BENCH_BATCH=4 BENCH_FUSED_CE=8 python bench.py
 
 echo "== probe"; probe
 
+echo "== headroom lever: LoRA training (stop_gradient DCE vs the full-finetune row)"
+BENCH_LORA=8 python bench.py | tee /tmp/bench_lora.json || true
+
+echo "== probe"; probe
+
 echo "== WEDGE-SUSPECT ROWS LAST =="
 echo "== headroom lever: int8 LM-head train (wedged the relay in window 2)"
 BENCH_INT8_LMHEAD=1 python bench.py | tee /tmp/bench_int8_lmhead.json
