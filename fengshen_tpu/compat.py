@@ -1,0 +1,63 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The code is written against the current jax API (`jax.shard_map`,
+`check_vma=`); older jax (<0.5) ships the same functionality as
+`jax.experimental.shard_map.shard_map` with the replication check
+spelled `check_rep=`. Routing every use through this module keeps the
+call sites modern and the version fallback in one place.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _LEGACY = False
+except ImportError:  # jax<0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY = True
+
+
+def shard_map(f, /, *args, **kwargs):
+    if _LEGACY:
+        if "check_vma" in kwargs:
+            # the vma type system doesn't exist pre-0.5; the legacy
+            # check_rep checker is NOT equivalent (it rejects modern
+            # primitives like sharding_constraint), so drop checking
+            kwargs.pop("check_vma")
+            kwargs["check_rep"] = False
+        if "axis_names" in kwargs:
+            # the legacy API takes the complement: axes left AUTO
+            # instead of axes made manual
+            manual = frozenset(kwargs.pop("axis_names"))
+            mesh = kwargs.get("mesh")
+            if mesh is not None:
+                kwargs["auto"] = frozenset(mesh.axis_names) - manual
+    return _shard_map(f, *args, **kwargs)
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size`, or the psum(1) idiom where it predates."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pvary(x, axis_name):
+    """Mark `x` varying over `axis_name` for the vma type system; no-op
+    when already varying (pvary rejects re-application) or on jax
+    builds that predate vma typing entirely."""
+    import jax
+    try:
+        if axis_name in jax.typeof(x).vma:
+            return x
+    except Exception:  # pragma: no cover - non-traced values / no vma
+        pass
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    pvary_fn = getattr(jax.lax, "pvary", None)
+    if pvary_fn is not None:
+        return pvary_fn(x, axis_name)
+    # jax<0.5 has no vma type system at all — nothing to mark
+    return x

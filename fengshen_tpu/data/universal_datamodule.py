@@ -57,7 +57,25 @@ class DataLoader:
 
     def __iter__(self):
         for indices in self.sampler:
-            yield self.collate_fn([self.dataset[int(i)] for i in indices])
+            try:
+                batch = self.collate_fn([self.dataset[int(i)]
+                                         for i in indices])
+            except Exception:  # noqa: BLE001 — always re-raised
+                # the stateful sampler already counted these indices as
+                # consumed; roll it back so a retry (ResilientLoader
+                # re-entry) sees the same batch, not the next one
+                unconsume = getattr(self.sampler, "unconsume", None)
+                if callable(unconsume):
+                    unconsume()
+                raise
+            yield batch
+
+    def skip_next(self) -> None:
+        """ResilientLoader's cooperative skip protocol: advance the
+        sampler past the next (poison) batch without fetching it —
+        the escape hatch when a batch fails deterministically and the
+        `unconsume` rollback would otherwise pin retries onto it."""
+        next(iter(self.sampler), None)
 
     def peek(self):
         """A shape-representative batch WITHOUT advancing the (stateful)

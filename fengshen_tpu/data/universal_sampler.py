@@ -20,6 +20,10 @@ import numpy as np
 
 
 class PretrainingSampler:
+    #: iteration does NOT mutate consumed_samples — re-entering restarts
+    #: from the construction-time position (see ResilientLoader)
+    resumes_mid_epoch = False
+
     def __init__(self, total_samples: int, consumed_samples: int,
                  micro_batch_size: int, data_parallel_rank: int,
                  data_parallel_size: int, drop_last: bool = True):
@@ -58,6 +62,11 @@ class PretrainingSampler:
 
 
 class PretrainingRandomSampler:
+    #: consumed_samples advances as batches are yielded, so re-entering
+    #: (`iter()` again) resumes mid-epoch — the property ResilientLoader
+    #: keys its retry semantics on
+    resumes_mid_epoch = True
+
     def __init__(self, total_samples: int, consumed_samples: int,
                  micro_batch_size: int, data_parallel_rank: int,
                  data_parallel_size: int, epoch_seed: int = 0):
@@ -106,3 +115,11 @@ class PretrainingRandomSampler:
                 self.consumed_samples += self.global_batch
                 yield batch
                 batch = []
+
+    def unconsume(self) -> None:
+        """Roll the cursor back one global batch: the DataLoader calls
+        this when fetching the just-yielded indices fails, so a
+        ResilientLoader re-entry retries the SAME batch instead of
+        silently dropping it."""
+        self.consumed_samples = max(0, self.consumed_samples -
+                                    self.global_batch)

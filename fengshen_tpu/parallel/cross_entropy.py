@@ -17,7 +17,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from fengshen_tpu.compat import shard_map
 
 from fengshen_tpu.parallel.mesh import (BATCH_AXES, SEQUENCE_AXIS,
                                         TENSOR_AXIS, get_mesh)

@@ -119,8 +119,15 @@ def make_shardings(rules_or_specs: Any,
     mesh = mesh or get_mesh()
     if mesh is None:
         raise ValueError("no mesh installed; call make_mesh()/set_mesh() first")
-    if isinstance(rules_or_specs, (list, tuple)) and rules_or_specs and isinstance(
-            rules_or_specs[0], tuple):
+    # a bare PartitionSpec must not be mistaken for a rules table: on
+    # jax<0.6 PartitionSpec subclasses tuple, so the isinstance probe
+    # below would otherwise "match" a multi-axis spec like P(("data",
+    # "fsdp"), "sequence")
+    if isinstance(rules_or_specs, P):
+        specs = rules_or_specs
+    elif isinstance(rules_or_specs, (list, tuple)) and rules_or_specs \
+            and isinstance(rules_or_specs[0], tuple) \
+            and not isinstance(rules_or_specs[0], P):
         specs = match_partition_rules(rules_or_specs, tree)
     else:
         specs = rules_or_specs

@@ -26,22 +26,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from fengshen_tpu.compat import (axis_size as _axis_size,
+                                 pvary as _pvary, shard_map)
 from jax.sharding import Mesh, PartitionSpec as P
-
-
-def _pvary(x, axis_name):
-    """pvary that is a no-op when `x` is already varying over `axis_name`
-    (pvary itself rejects invariant->variant re-application)."""
-    try:
-        if axis_name in jax.typeof(x).vma:
-            return x
-    except Exception:  # pragma: no cover - non-traced values
-        pass
-    pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)  # pragma: no cover - older jax
 
 
 def _pipeline_body(stage_params: Any, microbatches: jax.Array,
@@ -50,7 +37,7 @@ def _pipeline_body(stage_params: Any, microbatches: jax.Array,
     """shard_map body. stage_params: this stage's params (leading stage dim
     already split away by sharding). microbatches: [M, mb, ...] replicated.
     Returns [M, mb, ...] outputs valid on the LAST stage."""
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
     is_first = stage_idx == 0
     is_last = stage_idx == n_stages - 1
@@ -141,7 +128,7 @@ def _1f1b_body(stage_params: Any, micro_inputs: jax.Array,
     m + 2S-1 - s. The backward recomputes the stage forward from the stored
     input (activation recompute, the standard TPU memory/flop trade).
     """
-    S = jax.lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     sid = jax.lax.axis_index(axis_name)
     is_first = sid == 0
     is_last = sid == S - 1

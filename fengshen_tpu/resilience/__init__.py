@@ -1,0 +1,19 @@
+"""Resilience subsystem: step guards, retrying loaders, fault injection.
+
+Wired through the trainer (in-graph NaN/spike step guards +
+rewind-on-divergence), the data layer (`ResilientLoader` retry/backoff
+wrapper), and the checkpoint layer (corrupt-step fallback in
+`UniversalCheckpoint.maybe_restore`). `FaultPlan` is the deterministic
+fault-injection harness that drives all of it from fast CPU tests —
+see docs/fault_tolerance.md.
+"""
+
+from fengshen_tpu.resilience.guards import guarded_apply, step_ok
+from fengshen_tpu.resilience.loader import ResilientLoader
+from fengshen_tpu.resilience.faults import (FaultPlan, FaultyLoader,
+                                            InjectedLoaderFault,
+                                            truncate_checkpoint_step)
+
+__all__ = ["guarded_apply", "step_ok", "ResilientLoader", "FaultPlan",
+           "FaultyLoader", "InjectedLoaderFault",
+           "truncate_checkpoint_step"]

@@ -1,0 +1,136 @@
+"""ResilientLoader — bounded retry around any train/val dataloader.
+
+TB-scale corpora live on network storage; a transient read error
+mid-epoch should cost a backoff sleep, not the run. The wrapper
+re-enters the wrapped loader (`iter(loader)`) after a failure. Loaders
+driven by the stateful resumable samplers (the trainer's train path —
+`PretrainingRandomSampler` advances `consumed_samples` as it yields,
+and advertises it with `resumes_mid_epoch`) resume mid-epoch; for
+every other (deterministic) loader the wrapper fast-forwards past the
+batches it already delivered, so a retry never re-yields — and never
+double-counts — earlier batches. `resumable` overrides the
+auto-detection for custom loaders that keep their own cursor.
+
+Semantics per failure:
+- retry up to `max_retries` times with exponential backoff
+  (`backoff_base * 2**attempt`) plus deterministic jitter;
+- once retries are exhausted, consume one unit of the per-epoch
+  `skip_batch_budget`: the loader advances past the poison batch via
+  the cooperative `skip_next()` protocol (`DataLoader` implements it
+  by pulling one batch of indices from its sampler without fetching).
+  The budget applies only to resumable loaders — a restart-on-iter
+  loader re-produces the poison batch on every re-entry, so no
+  wrapper can skip it and pretending otherwise would burn the budget
+  on one batch while logging skips that never happened;
+- with the budget exhausted too, re-raise the last error — a loader
+  that is down stays an error, never a silent zero-step epoch.
+
+Counters (`retries_total`, `skipped_total`) and per-event structured
+log entries (`loader_retry` / `loader_skip_batch`) make the noise
+visible in metrics.jsonl.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional
+
+
+class ResilientLoader:
+    def __init__(self, loader: Any, max_retries: int = 3,
+                 backoff_base: float = 0.5, skip_batch_budget: int = 0,
+                 log: Optional[Callable[[dict], None]] = None,
+                 stage: str = "train",
+                 sleep: Callable[[float], None] = time.sleep,
+                 jitter_seed: int = 0,
+                 resumable: Optional[bool] = None):
+        self.loader = loader
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.skip_batch_budget = int(skip_batch_budget)
+        self._log = log or (lambda entry: None)
+        self.stage = stage
+        self._sleep = sleep
+        self._jitter = random.Random(jitter_seed)
+        self.retries_total = 0
+        #: cumulative skipped batches — the trainer snapshots this at
+        #: fetch time (see _prefetch) to fold skipped stream positions
+        #: into consumed_samples exactly at the training frontier
+        self.skipped_total = 0
+        if resumable is None:
+            # stateful samplers advertise mid-epoch resume; anything
+            # else is assumed deterministic-from-iter() and gets the
+            # fast-forward treatment after a re-entry
+            resumable = bool(getattr(getattr(loader, "sampler", None),
+                                     "resumes_mid_epoch", False))
+        self.resumable = bool(resumable)
+
+    # -- passthrough surface (len / peek / num_samples / ...) ----------
+    def __getattr__(self, name: str):
+        return getattr(self.loader, name)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    # -- iteration -----------------------------------------------------
+    def __iter__(self):
+        skipped_this_epoch = 0
+        yielded = 0  # batches delivered downstream this epoch
+        fast_forward = 0  # batches to discard after a re-entry
+        it = iter(self.loader)
+        while True:
+            attempt = 0
+            while True:
+                try:
+                    while fast_forward:
+                        next(it)
+                        fast_forward -= 1
+                    batch = next(it)
+                    break
+                except StopIteration:
+                    return
+                except Exception as e:  # noqa: BLE001 — bounded retry;
+                    # re-raised below once retries + skip budget exhaust
+                    attempt += 1
+                    self.retries_total += 1
+                    if attempt > self.max_retries:
+                        if self.resumable and \
+                                skipped_this_epoch < self.skip_batch_budget:
+                            skipped_this_epoch += 1
+                            self.skipped_total += 1
+                            self._log({"event": "loader_skip_batch",
+                                       "stage": self.stage,
+                                       "skipped_this_epoch":
+                                           skipped_this_epoch,
+                                       "error": repr(e)[:200]})
+                            it = self._reenter(yielded, skip=True)
+                            attempt = 0
+                            continue
+                        raise
+                    delay = self.backoff_base * (2 ** (attempt - 1))
+                    delay *= 1.0 + 0.25 * self._jitter.random()
+                    self._log({"event": "loader_retry",
+                               "stage": self.stage, "attempt": attempt,
+                               "delay_s": round(delay, 4),
+                               "error": repr(e)[:200]})
+                    self._sleep(delay)
+                    it = self._reenter(yielded)
+                    fast_forward = 0 if self.resumable else yielded
+            yielded += 1
+            yield batch
+
+    def _reenter(self, yielded: int, skip: bool = False):
+        """A generator that raised is dead: re-enter the loader.
+        Resumable samplers continue mid-epoch on their own; for a skip,
+        cooperative loaders advance past the poison batch via
+        `skip_next()`."""
+        if skip:
+            skip_fn = getattr(self.loader, "skip_next", None)
+            if callable(skip_fn):
+                skip_fn()
+        return iter(self.loader)

@@ -27,6 +27,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    #: updates skipped by the in-graph step guard (non-finite loss/grads
+    #: or a grad-norm spike); carried in-state so it survives
+    #: steps_per_execution scans and surfaces in metrics.jsonl
+    bad_step_count: jax.Array = struct.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32))
 
     def apply_gradients(self, grads) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state,

@@ -29,6 +29,10 @@ from fengshen_tpu.trainer.train_state import (TrainState,
                                               create_sharded_state,
                                               state_shardings)
 
+#: process-wide SIGTERM plumbing (see _install_preemption_handler):
+#: one handler, re-pointed at the latest Trainer via weakref
+_SIGTERM_STATE: dict = {"handler": None, "prev": None, "ref": None}
+
 #: peak bf16 FLOP/s per chip, for MFU (the metric BASELINE.md demands and
 #: the reference never measured)
 PEAK_FLOPS = {
@@ -47,11 +51,18 @@ def _prefetch(loader, shardings, depth: int = 2):
     """Double-buffered host→device transfer: the next batch's device_put is
     issued while the current step computes (the device-prefetch contract of
     SURVEY.md §7 step 1; jax transfers are async, so holding `depth`
-    in-flight batches overlaps H2D with compute)."""
+    in-flight batches overlaps H2D with compute).
+
+    Yields (host_batches, device_batch, skips_at_fetch): the third
+    element snapshots the loader's cumulative skipped-batch counter
+    (ResilientLoader) at the moment THIS batch was fetched, so the
+    consumer can credit skipped stream positions exactly when its
+    training frontier passes them — not `depth` batches early."""
     import collections
     queue = collections.deque()
     for batch in loader:
-        queue.append(([batch], jax.device_put(batch, shardings)))
+        skips = getattr(loader, "skipped_total", 0)
+        queue.append(([batch], jax.device_put(batch, shardings), skips))
         if len(queue) >= depth:
             yield queue.popleft()
     while queue:
@@ -62,7 +73,8 @@ def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
     """K-step grouping for --steps_per_execution: stack K host batches on
     a new leading axis and issue ONE device_put; the scan-based K-step
     program then runs K optimizer steps per dispatch. Yields
-    (list_of_k_host_batches, stacked_device_batch)."""
+    (list_of_k_host_batches, stacked_device_batch, skips_at_fetch) —
+    see _prefetch for the skip-snapshot contract."""
     import collections
     queue = collections.deque()
     group = []
@@ -98,7 +110,8 @@ def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
                   "batch?)", flush=True)
             group = []
             continue
-        queue.append((group, jax.device_put(stacked, shardings)))
+        queue.append((group, jax.device_put(stacked, shardings),
+                      getattr(loader, "skipped_total", 0)))
         group = []
         if len(queue) >= depth:
             yield queue.popleft()
@@ -148,6 +161,39 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
              "(saved under default_root_dir/profile; SURVEY.md §5.1)")
     parser.add_argument("--seed", default=42, type=int)
     parser.add_argument("--default_root_dir", default="./runs", type=str)
+    # resilience (docs/fault_tolerance.md)
+    resil = parent_parser.add_argument_group("resilience")
+    resil.add_argument(
+        "--disable_step_guards", action="store_true", default=False,
+        help="apply optimizer updates unconditionally; default is the "
+             "in-graph guard that skips steps with a non-finite "
+             "loss/grad norm (params and moments untouched, "
+             "bad_step_count incremented)")
+    resil.add_argument(
+        "--skip_steps_with_grad_norm_above", default=0.0, type=float,
+        help="spike guard: also skip steps whose global grad norm "
+             "exceeds this threshold (0 = off)")
+    resil.add_argument(
+        "--max_consecutive_bad_steps", default=0, type=int,
+        help="after this many consecutive guarded-away steps, restore "
+             "the last checkpoint and skip the offending data window "
+             "(0 = never rewind)")
+    resil.add_argument(
+        "--max_rewinds", default=2, type=int,
+        help="abort after this many rewinds in one fit — a run that "
+             "keeps diverging needs a human, not another replay")
+    resil.add_argument(
+        "--loader_max_retries", default=0, type=int,
+        help="wrap the train/val loaders in ResilientLoader: retry "
+             "transient loader errors this many times with exponential "
+             "backoff before failing (0 = off)")
+    resil.add_argument("--loader_backoff_base", default=0.5, type=float,
+                       help="first-retry backoff in seconds; doubles "
+                            "per attempt, with jitter")
+    resil.add_argument(
+        "--loader_skip_batches", default=0, type=int,
+        help="per-epoch budget of batches that may be skipped outright "
+             "after retries exhaust")
     # mesh flags (replaces strategy=... + DeepSpeed JSON)
     MeshConfig.add_argparse_args(parent_parser)
     return parent_parser
@@ -167,22 +213,47 @@ class Trainer:
         self._log_path = os.path.join(
             getattr(args, "default_root_dir", "./runs"), "metrics.jsonl")
         self._preempted = False
+        #: deterministic fault-injection plan (tests/chaos drills); see
+        #: fengshen_tpu.resilience.faults.FaultPlan.install
+        self.fault_plan = None
         self._install_preemption_handler()
 
     def _install_preemption_handler(self) -> None:
-        """SIGTERM (the preemption notice on TPU pods) sets a flag; the
-        train loop checkpoints and exits cleanly at the next step
-        boundary."""
+        """SIGTERM (the preemption notice on TPU pods) sets the flag on
+        the most recently constructed Trainer; the train loop
+        checkpoints and exits cleanly at the next step boundary. The
+        previous handler is CHAINED, not discarded — outer launchers
+        (SLURM re-queue shims, pod managers) keep their own SIGTERM
+        behavior. ONE process-wide handler is installed (and re-pointed
+        via weakref) no matter how many Trainers a sweep driver builds,
+        so neither dead Trainers nor chain links accumulate."""
         import signal
         import threading
+        import weakref
         if threading.current_thread() is not threading.main_thread():
             return
-
-        def handler(signum, frame):
-            self._preempted = True
-
+        st = _SIGTERM_STATE
+        st["ref"] = weakref.ref(self)
         try:
-            self._prev_sigterm = signal.signal(signal.SIGTERM, handler)
+            current = signal.getsignal(signal.SIGTERM)
+        except (ValueError, OSError):  # restricted env
+            return
+        if st["handler"] is not None and current is st["handler"]:
+            self._prev_sigterm = st["prev"]
+            return
+
+        if st["handler"] is None:
+            def handler(signum, frame):
+                trainer = st["ref"]() if st["ref"] is not None else None
+                if trainer is not None:
+                    trainer._preempted = True
+                if callable(st["prev"]):
+                    st["prev"](signum, frame)
+
+            st["handler"] = handler
+        try:
+            st["prev"] = signal.signal(signal.SIGTERM, st["handler"])
+            self._prev_sigterm = st["prev"]
         except (ValueError, OSError):  # non-main thread / restricted env
             pass
 
@@ -197,6 +268,13 @@ class Trainer:
             return module.training_loss(params, batch, rng)
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        # deterministic fault injection (resilience harness): poison the
+        # in-graph loss at the planned step numbers so the guard path is
+        # exercised exactly where a real numeric blowup would hit. The
+        # plan is snapshotted at build time; disarming rebuilds the step.
+        plan = getattr(self, "fault_plan", None)
+        nan_steps = tuple(sorted(plan.nan_loss_at_steps)) \
+            if plan is not None else ()
 
         def grad_step(params, batch, rng, step):
             rng = jax.random.fold_in(rng, step)
@@ -227,19 +305,52 @@ class Trainer:
             metrics = dict(metrics)
             metrics["loss"] = loss
             metrics["grad_norm"] = optax.global_norm(grads)
+            if nan_steps:
+                hit = jnp.any(jnp.asarray(nan_steps, jnp.int32) == step)
+                metrics["loss"] = jnp.where(hit, jnp.float32(jnp.nan),
+                                            metrics["loss"])
             return grads, metrics
 
         return grad_step
+
+    def _guard_config(self) -> tuple[bool, float]:
+        """(guards_enabled, spike_threshold) from the flags — single
+        source for the fused, scanned, and offloaded step builders."""
+        return (not getattr(self.args, "disable_step_guards", False),
+                float(getattr(self.args,
+                              "skip_steps_with_grad_norm_above", 0.0)
+                      or 0.0))
+
+    def _make_update_applier(self):
+        """The (state, grads, metrics) -> (state, metrics) tail of a
+        train step: guarded by default (skip non-finite/spiking
+        updates in-graph, docs/fault_tolerance.md), unconditional
+        under --disable_step_guards. Shared by the fused K=1 step and
+        the steps_per_execution scan body."""
+        from fengshen_tpu.resilience.guards import guarded_apply, step_ok
+        guards_on, spike = self._guard_config()
+
+        def apply_update(state: TrainState, grads, metrics):
+            if guards_on:
+                new_state = guarded_apply(state, grads,
+                                          step_ok(metrics, spike))
+            else:
+                new_state = state.apply_gradients(grads)
+            metrics["bad_step_count"] = new_state.bad_step_count
+            return new_state, metrics
+
+        return apply_update
 
     def _build_train_step(self, module: TrainModule, state_sh, batch_spec,
                           sample_batch=None):
         mesh = self.mesh
         grad_step = self._make_grad_step(module)
+        apply_update = self._make_update_applier()
 
         def train_step(state: TrainState, batch, rng):
             grads, metrics = grad_step(state.params, batch, rng,
                                        state.step)
-            return state.apply_gradients(grads), metrics
+            return apply_update(state, grads, metrics)
 
         # fit specs to actual shapes: a debug batch smaller than the batch
         # axes degrades to replicated instead of erroring
@@ -282,10 +393,11 @@ class Trainer:
             def multi_step(state: TrainState, batches, rng):
                 def body(st, batch):
                     grads, m = grad_step(st.params, batch, rng, st.step)
-                    return st.apply_gradients(grads), m
+                    return apply_update(st, grads, m)
                 state, metrics = jax.lax.scan(body, state, batches)
                 # same reduction policy as grad accumulation: floats
                 # average over the K substeps, counts keep the last
+                # (bad_step_count is cumulative, so last == end-of-group)
                 metrics = jax.tree_util.tree_map(
                     lambda m: m.mean() if jnp.issubdtype(
                         m.dtype, jnp.floating) else m[-1], metrics)
@@ -348,10 +460,24 @@ class Trainer:
                     step + 1)
 
         update_jit = None
+        from fengshen_tpu.resilience.guards import step_ok
+        guards_on, spike = self._guard_config()
 
         def step_fn(state, batch, rng):
             nonlocal update_jit
             grads, metrics = grad_jit(state.params, batch, rng, state.step)
+            if guards_on:
+                # host-side guard, same predicate as the fused step:
+                # this path already pays a host round-trip per step for
+                # the moments, so pulling the scalar costs no extra
+                # dispatch
+                if not bool(step_ok(metrics, spike)):
+                    new_state = state.replace(
+                        step=state.step + 1,
+                        bad_step_count=state.bad_step_count + 1)
+                    metrics = dict(metrics)
+                    metrics["bad_step_count"] = new_state.bad_step_count
+                    return new_state, metrics
             # H2D: bring the moments on-device only for the update
             opt_dev = jax.device_put(state.opt_state, opt_dev_sh)
             if update_jit is None:
@@ -365,8 +491,11 @@ class Trainer:
                 state.params, grads, opt_dev, state.step)
             # D2H: park the moments back in host memory
             new_opt = jax.device_put(new_opt_dev, opt_host_sh)
-            return state.replace(step=new_step, params=new_params,
-                                 opt_state=new_opt), metrics
+            new_state = state.replace(step=new_step, params=new_params,
+                                      opt_state=new_opt)
+            metrics = dict(metrics)
+            metrics["bad_step_count"] = new_state.bad_step_count
+            return new_state, metrics
 
         return step_fn
 
@@ -396,6 +525,89 @@ class Trainer:
     def _restore_callback(self):
         return next((c for c in self.callbacks
                      if hasattr(c, "maybe_restore")), None)
+
+    # -- resilience ------------------------------------------------------
+    def _wrap_loader(self, loader, stage: str = "train"):
+        """Wrap a loader in ResilientLoader when --loader_max_retries
+        asks for it (transient read errors cost a backoff, not the
+        run); identity otherwise."""
+        retries = int(getattr(self.args, "loader_max_retries", 0) or 0)
+        skips = int(getattr(self.args, "loader_skip_batches", 0) or 0)
+        # a skip budget alone still needs the wrapper — silently
+        # ignoring --loader_skip_batches would be a misconfig trap
+        if loader is None or (retries <= 0 and skips <= 0):
+            return loader
+        from fengshen_tpu.resilience import ResilientLoader
+        wrapped = ResilientLoader(
+            loader, max_retries=retries,
+            backoff_base=float(getattr(self.args, "loader_backoff_base",
+                                       0.5)),
+            skip_batch_budget=skips,
+            log=self._log, stage=stage,
+            # per-host jitter: identical seeds would re-hit the storage
+            # in lockstep from every process on a retry (the thundering
+            # herd the jitter exists to break up)
+            jitter_seed=jax.process_index())
+        if skips > 0 and stage == "train" and not wrapped.resumable:
+            # the budget only works on loaders that can be advanced
+            # past a poison batch — say so instead of silently never
+            # skipping (e.g. --sampler_type single)
+            self._log({"event": "loader_skip_budget_inert",
+                       "reason": "train loader is not mid-epoch "
+                                 "resumable; skips need the stateful "
+                                 "random sampler"})
+        return wrapped
+
+    def _rewind(self, state: TrainState, ckpt_cb, bad_steps: int
+                ) -> TrainState:
+        """Rewind-on-divergence: restore the last checkpoint (its params
+        predate the bad window — the step guard skipped every bad
+        update) and advance consumed_samples PAST the offending data so
+        the replay sees fresh batches. Raises instead of replaying
+        forever: a run that keeps diverging needs a human."""
+        if ckpt_cb is None:
+            raise RuntimeError(
+                f"{bad_steps} consecutive bad steps at step "
+                f"{self.global_step} and no checkpoint callback to "
+                "rewind from — aborting instead of optimizing on "
+                "garbage")
+        if self._rewinds_left <= 0:
+            raise RuntimeError(
+                f"rewind budget exhausted (--max_rewinds="
+                f"{getattr(self.args, 'max_rewinds', 2)}) and still "
+                f"seeing {bad_steps} consecutive bad steps at step "
+                f"{self.global_step}")
+        self._rewinds_left -= 1
+        pre_step = int(self.global_step)
+        pre_consumed = int(self.consumed_samples)
+        if hasattr(ckpt_cb, "wait"):
+            ckpt_cb.wait()  # an in-flight async save must land first
+        # rewind to THIS run's latest checkpoint: maybe_restore reads
+        # load_ckpt_path, which may point at a stale warm-start dir —
+        # the run's own saves are the only valid rewind targets
+        orig_load = getattr(ckpt_cb, "load_path", None)
+        if getattr(ckpt_cb, "save_path", None):
+            ckpt_cb.load_path = ckpt_cb.save_path
+        try:
+            restored = ckpt_cb.maybe_restore(state, self)
+        finally:
+            if hasattr(ckpt_cb, "load_path"):
+                ckpt_cb.load_path = orig_load
+        if restored is state and int(self.global_step) == pre_step:
+            raise RuntimeError(
+                f"rewind after {bad_steps} consecutive bad steps found "
+                "no restorable checkpoint (set --save_ckpt_path/"
+                "--every_n_train_steps)")
+        # the window [checkpoint, pre_step] produced the divergence —
+        # keep the data cursor ahead of it
+        self.consumed_samples = max(pre_consumed,
+                                    int(self.consumed_samples))
+        self._log({"event": "rewind", "from_step": pre_step,
+                   "to_step": int(self.global_step),
+                   "bad_steps": int(bad_steps),
+                   "consumed_samples": int(self.consumed_samples),
+                   "rewinds_left": self._rewinds_left})
+        return restored
 
     # -- predict state ---------------------------------------------------
     def restore_for_predict(self, module: TrainModule,
@@ -512,7 +724,7 @@ class Trainer:
             max_steps = new_max
         # (re)create the train loader AFTER restore so the resumable
         # sampler starts from the restored consumed_samples
-        train_loader = datamodule.train_dataloader()
+        train_loader = self._wrap_loader(datamodule.train_dataloader())
 
         batch_spec = module.batch_spec(sample_batch)
         step_fn, batch_sh = self._build_train_step(module, state_sh,
@@ -546,6 +758,21 @@ class Trainer:
             # over the exact multiple)
             return every > 0 and (cur // every) > (prev // every)
 
+        # rewind-on-divergence bookkeeping (docs/fault_tolerance.md):
+        # only armed via --max_consecutive_bad_steps, because detecting
+        # the consecutive run needs the cumulative bad_step_count pulled
+        # to the host every execution (a per-step device sync the
+        # default fast path must not pay)
+        max_consec = int(getattr(args, "max_consecutive_bad_steps", 0)
+                         or 0)
+        if max_consec and getattr(args, "disable_step_guards", False):
+            raise ValueError("--max_consecutive_bad_steps needs the step "
+                             "guards; drop --disable_step_guards")
+        self._rewinds_left = int(getattr(args, "max_rewinds", 2))
+        consec_bad = 0
+        prev_bad_total = int(state.bad_step_count) if max_consec else 0
+        skips_credited = 0  # loader skips already folded into consumed
+
         t_last = time.perf_counter()
         tokens_since = 0
         epoch = 0
@@ -558,7 +785,8 @@ class Trainer:
                 train_loader.set_epoch(epoch)
             feed = (_prefetch(train_loader, batch_sh) if spe == 1 else
                     _prefetch_grouped(train_loader, batch_sh, spe))
-            for group, device_batch in feed:
+            rewound = False
+            for group, device_batch, skips_snap in feed:
                 if profile_range is not None:
                     self._maybe_profile(profile_range)
                 state, metrics = step_fn(state, device_batch, rng)
@@ -568,6 +796,15 @@ class Trainer:
                 # of this execution to detect crossed boundaries
                 self.prev_global_step = prev_step
                 self.consumed_samples += world_batch * len(group)
+                # credit skipped poison batches exactly when the
+                # training frontier passes them (the fetch-time
+                # snapshot), so a checkpoint taken inside the prefetch
+                # window never records a cursor ahead of the data
+                # actually trained on
+                if skips_snap > skips_credited:
+                    self.consumed_samples += world_batch * (
+                        skips_snap - skips_credited)
+                    skips_credited = skips_snap
                 tokens_since += sum(module.tokens_in_batch(b)
                                     for b in group)
 
@@ -592,6 +829,34 @@ class Trainer:
                 for cb in self.callbacks:
                     if hasattr(cb, "on_train_step_end"):
                         cb.on_train_step_end(self, state)
+                if max_consec:
+                    bad_total = int(metrics["bad_step_count"])
+                    delta, prev_bad_total = (bad_total - prev_bad_total,
+                                             bad_total)
+                    if delta >= len(group):
+                        consec_bad += len(group)  # whole execution bad
+                    elif delta > 0:
+                        # mixed group: substep order is unknown from the
+                        # host; assume the bad run is trailing
+                        consec_bad = delta
+                    else:
+                        consec_bad = 0
+                    if consec_bad >= max_consec:
+                        state = self._rewind(state, ckpt_cb, consec_bad)
+                        prev_bad_total = int(state.bad_step_count)
+                        consec_bad = 0
+                        plan = getattr(self, "fault_plan", None)
+                        if plan is not None and plan.nan_loss_at_steps \
+                                and plan.clear_nan_on_rewind:
+                            # replayed step numbers must not re-fire the
+                            # injected fault: disarm and rebuild the
+                            # step program without the injection
+                            plan.disarm_nan()
+                            step_fn, batch_sh = self._build_train_step(
+                                module, state_sh, batch_spec,
+                                sample_batch)
+                        rewound = True
+                        break
                 if self._preempted:
                     # preemption-aware autosave (SURVEY.md §5.3: TPU pods
                     # preempt; the reference only had SLURM re-queue).
@@ -608,6 +873,26 @@ class Trainer:
                 if self.global_step >= max_steps:
                     done = True
                     break
+            if rewound:
+                # same epoch, fresh loader: the resumable sampler picks
+                # up from the advanced consumed_samples, skipping the
+                # window that produced the bad steps
+                train_loader = self._wrap_loader(
+                    datamodule.train_dataloader())
+                skips_credited = 0  # fresh wrapper, fresh counter
+                continue
+            # a skip at the very end of the epoch has no later batch to
+            # carry its snapshot — settle the remainder here so the
+            # next epoch's loader starts past it. ONLY on a natural
+            # epoch end: after a max_steps break the uncredited skips
+            # sit beyond the training frontier (prefetch window) and
+            # must not advance the cursor a resume will trust
+            if not done:
+                tail_skips = getattr(train_loader, "skipped_total", 0)
+                if tail_skips > skips_credited:
+                    self.consumed_samples += world_batch * (
+                        tail_skips - skips_credited)
+                    skips_credited = tail_skips
             epoch += 1
             if getattr(args, "max_epochs", 1) and \
                     epoch >= max(getattr(args, "max_epochs", 1), 1):
@@ -690,7 +975,9 @@ class Trainer:
 
     # -- validation ------------------------------------------------------
     def _run_validation(self, module, datamodule, state, rng):
-        loader = getattr(datamodule, "val_dataloader", lambda: None)()
+        loader = self._wrap_loader(
+            getattr(datamodule, "val_dataloader", lambda: None)(),
+            stage="val")
         if loader is None:
             return
         losses, limit = [], getattr(self.args, "limit_val_batches", 0)

@@ -22,6 +22,13 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+class CheckpointStructureMismatch(ValueError):
+    """The checkpoint's tree/shapes don't match the run's state — a
+    config error (wrong model size, wrong directory), not data
+    corruption. Surfaced immediately; falling back to older steps would
+    fail identically N more times at multi-GB deserialization cost."""
+
+
 class UniversalCheckpoint:
     @staticmethod
     def add_argparse_args(parent_parser: argparse.ArgumentParser):
@@ -74,8 +81,19 @@ class UniversalCheckpoint:
     def save(self, state: Any, trainer: Any, sync: bool = False) -> None:
         """`sync=True` forces a flush (preemption / fit end must not
         lose the in-flight save); with --async_save, periodic saves
-        return immediately and serialization overlaps training."""
+        return immediately and serialization overlaps training.
+
+        Idempotent per step: a boundary save and the preemption
+        autosave can both fire for the same global step in one loop
+        iteration (and a rewind can replay a boundary) — orbax raises
+        StepAlreadyExistsError on a re-save, so an already-committed
+        step is skipped instead."""
         step = int(trainer.global_step)
+        mgr = self._get_manager()
+        if sync:
+            mgr.wait_until_finished()  # land any in-flight async save
+        if step in mgr.all_steps():
+            return
         payload = {"params": state.params}
         if not getattr(self.args, "save_weights_only", False):
             payload["opt_state"] = state.opt_state
@@ -88,6 +106,20 @@ class UniversalCheckpoint:
                 meta=ocp.args.JsonSave(meta)))
         if sync or not getattr(self.args, "async_save", False):
             self._get_manager().wait_until_finished()
+            # verify the commit actually landed (orbax finalizes a step
+            # by atomic rename): a save that silently failed must not
+            # masquerade as a restore point while older steps get
+            # pruned out from under it
+            mgr = self._get_manager()
+            if hasattr(mgr, "reload"):
+                mgr.reload()  # re-read the step list from disk
+                committed = mgr.all_steps()
+            else:  # pragma: no cover - pre-`reload` orbax
+                committed = mgr.all_steps(read=True)
+            if step not in committed:
+                raise RuntimeError(
+                    f"checkpoint step {step} did not commit under "
+                    f"{self.save_path}")
 
     def wait(self) -> None:
         """Flush any in-flight async save."""
@@ -95,20 +127,16 @@ class UniversalCheckpoint:
             self._manager.wait_until_finished()
 
     # -- restore -------------------------------------------------------------
-    def maybe_restore(self, state: Any, trainer: Any,
-                      weights_only: bool = False) -> Any:
-        """Silently skip a missing load path, exactly like the reference
-        (reference: universal_checkpoint.py:38-41). `weights_only` skips
-        the optimizer moments entirely — the eval-only entry restores
-        into a zero-size optimizer state."""
-        path = self.load_path
-        if not path or not os.path.isdir(path):
-            return state
-        mgr = ocp.CheckpointManager(os.path.abspath(path))
-        step = mgr.latest_step()
-        if step is None:
-            return state
+    def _restore_step(self, mgr: ocp.CheckpointManager, step: int,
+                      state: Any, weights_only: bool) -> dict:
+        """Restore ONE candidate step (raises on corrupt/partial data).
 
+        What the checkpoint CONTAINS (not what this run's flags say)
+        decides whether opt_state is restored: a weights-only
+        checkpoint loaded into a full run must silently fall back to
+        the freshly initialized optimizer state, and vice versa —
+        matching the reference's silent-skip semantics (reference:
+        universal_checkpoint.py:38-41)."""
         def _restore(with_opt: bool):
             payload = {"params": state.params}
             if with_opt:
@@ -122,11 +150,6 @@ class UniversalCheckpoint:
                     state=ocp.args.StandardRestore(abstract),
                     meta=ocp.args.JsonRestore()))
 
-        # What the checkpoint CONTAINS (not what this run's flags say) decides
-        # whether opt_state is restored: a weights-only checkpoint loaded into
-        # a full run must silently fall back to the freshly initialized
-        # optimizer state, and vice versa — matching the reference's
-        # silent-skip semantics (reference: universal_checkpoint.py:38-41).
         if weights_only:
             # The eval path carries a zero-size optimizer, so the
             # payload cannot describe the on-disk opt_state; restore the
@@ -136,20 +159,152 @@ class UniversalCheckpoint:
                     x.shape, x.dtype,
                     sharding=getattr(x, "sharding", None)),
                 state.params)}
-            restored = mgr.restore(
-                step, args=ocp.args.Composite(
-                    state=ocp.args.PyTreeRestore(item=abstract,
-                                                 partial_restore=True),
-                    meta=ocp.args.JsonRestore()))
-        else:
             try:
-                restored = _restore(with_opt=True)
+                pytree_args = ocp.args.PyTreeRestore(
+                    item=abstract, partial_restore=True)
+            except TypeError:
+                # older orbax (<0.9) spells partial restore as empty
+                # `transforms` + per-leaf restore_args
+                def _rarg(x):
+                    sharding = getattr(x, "sharding", None)
+                    if sharding is not None:
+                        return ocp.ArrayRestoreArgs(
+                            sharding=sharding, global_shape=x.shape,
+                            dtype=x.dtype)
+                    return ocp.RestoreArgs()
+
+                pytree_args = ocp.args.PyTreeRestore(
+                    item=abstract, transforms={},
+                    restore_args=jax.tree_util.tree_map(_rarg, abstract))
+            try:
+                return mgr.restore(
+                    step, args=ocp.args.Composite(
+                        state=pytree_args,
+                        meta=ocp.args.JsonRestore()))
             except ValueError as e:
-                if "opt_state" not in str(e):
-                    # a genuine mismatch elsewhere (param shapes/tree)
-                    # must surface, not silently reset the optimizer
-                    raise
-                restored = _restore(with_opt=False)
+                # same classification as the full path: a wrong-model
+                # eval restore must fast-fail, corrupt data falls back
+                if self._params_mismatch(mgr, step, state):
+                    raise CheckpointStructureMismatch(str(e)) from e
+                raise
+        try:
+            return _restore(with_opt=True)
+        except ValueError as e:
+            if "opt_state" in str(e):
+                try:
+                    return _restore(with_opt=False)
+                except ValueError as e2:
+                    e = e2
+            # a genuine mismatch (param shapes/tree — wrong model
+            # config or wrong directory) must surface, not silently
+            # reset the optimizer and not trigger the corrupt-step
+            # fallback; confirmed against the checkpoint METADATA,
+            # because corrupt payloads also raise ValueError and those
+            # must keep falling back to older steps
+            if self._params_mismatch(mgr, step, state):
+                raise CheckpointStructureMismatch(str(e)) from e
+            raise e
+
+    @staticmethod
+    def _params_mismatch(mgr: ocp.CheckpointManager, step: int,
+                         state: Any) -> bool:
+        """Does the saved params tree structurally differ from the
+        run's? Decided from the (cheap) checkpoint metadata; any
+        failure reading it means the step is corrupt, which is NOT a
+        structure mismatch."""
+        def key_meta(tree):
+            return {jax.tree_util.keystr(path):
+                    (tuple(getattr(leaf, "shape", ())),
+                     getattr(leaf, "dtype", None))
+                    for path, leaf in
+                    jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+        try:
+            meta = mgr.item_metadata(step)
+            saved = meta.get("state") if hasattr(meta, "get") else \
+                getattr(meta, "state", None)
+            want = key_meta(state.params)
+            got = key_meta(saved["params"])
+            if want.keys() != got.keys():
+                return True
+            for k, (shape_w, dtype_w) in want.items():
+                shape_g, dtype_g = got[k]
+                if shape_w != shape_g:
+                    return True
+                # dtype None on either side = metadata didn't record
+                # it; only a confirmed disagreement is structural
+                if dtype_w is not None and dtype_g is not None and \
+                        jax.numpy.dtype(dtype_w) != jax.numpy.dtype(
+                            dtype_g):
+                    return True
+            return False
+        except Exception:  # noqa: BLE001 — unreadable metadata =
+            # corrupt step, handled by the caller's fallback walk
+            return False
+
+    def maybe_restore(self, state: Any, trainer: Any,
+                      weights_only: bool = False) -> Any:
+        """Silently skip a missing load path, exactly like the reference
+        (reference: universal_checkpoint.py:38-41). `weights_only` skips
+        the optimizer moments entirely — the eval-only entry restores
+        into a zero-size optimizer state.
+
+        Integrity fallback (docs/fault_tolerance.md): candidate steps
+        are tried newest→oldest, and a step whose restore raises
+        (truncated/corrupt payload on a preempted or bit-rotted write)
+        is rejected with a logged `checkpoint_restore_rejected` event
+        instead of killing the run. Only when EVERY step is
+        unrestorable does the error surface — silently training a 10B
+        run from scratch would be worse than crashing."""
+        path = self.load_path
+        if not path or not os.path.isdir(path):
+            return state
+        path = os.path.abspath(path)
+        # reuse the save-side manager when load and save point at the
+        # same directory: a second CheckpointManager on one path races
+        # an in-flight --async_save write
+        mgr = self._get_manager() if path == self.save_path \
+            else ocp.CheckpointManager(path)
+        steps = sorted(mgr.all_steps(), reverse=True)
+        if not steps:
+            return state
+        log = getattr(trainer, "_log", None) or (lambda entry: None)
+        restored, errors = None, []
+        for step in steps:
+            try:
+                restored = self._restore_step(mgr, step, state,
+                                              weights_only)
+                break
+            except CheckpointStructureMismatch:
+                raise  # config error, identical on every step
+            except Exception as e:  # noqa: BLE001 — corrupt/partial
+                # step: log, fall back to the previous one
+                errors.append((step, e))
+                log({"event": "checkpoint_restore_rejected",
+                     "ckpt_step": int(step),
+                     "error": f"{type(e).__name__}: {str(e)[:200]}"})
+        if restored is None:
+            detail = "; ".join(
+                f"step {s}: {type(e).__name__}: {str(e)[:120]}"
+                for s, e in errors)
+            raise RuntimeError(
+                f"no restorable checkpoint under {path} ({detail})")
+        if errors and path == self.save_path:
+            # we OWN this directory: drop the unrestorable steps so the
+            # run can re-save past them — left in place, a corrupt
+            # newest step would shadow every later boundary save (the
+            # idempotent-save guard skips committed steps) and re-lose
+            # the same window on every future restore
+            for bad_step, _ in errors:
+                try:
+                    mgr.delete(bad_step)
+                    log({"event": "checkpoint_rejected_deleted",
+                         "ckpt_step": int(bad_step)})
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    # cleanup; the restore itself already succeeded
+                    log({"event": "checkpoint_delete_failed",
+                         "ckpt_step": int(bad_step),
+                         "error": str(e)[:200]})
         meta = restored["meta"]
         # restore loop counters the way the reference's on_load_checkpoint
         # does (reference: examples/pretrain_erlangshen_bert/

@@ -639,7 +639,7 @@ def test_grouped_prefetch_drops_partial_tail(capsys):
         lambda _: jax.sharding.SingleDeviceSharding(dev), {"x": 0})
     out = list(_prefetch_grouped(iter(batches), sh["x"], 2))
     assert len(out) == 2
-    group, stacked = out[0]
+    group, stacked, _skips = out[0]
     assert len(group) == 2 and stacked["x"].shape == (2, 2)
     assert "dropping 1 tail batch" in capsys.readouterr().out
 
@@ -693,6 +693,70 @@ def test_every_n_checkpoint_fires_on_crossed_boundary():
         t.prev_global_step, t.global_step = cur - 1, cur
         cb.on_train_step_end(t, state=None)
     assert saved == [8, 16]
+
+
+def test_sigterm_preemption_saves_and_resumes(mesh8, tmp_path):
+    """A REAL SIGTERM mid-fit (delivered by the fault-injection
+    harness) saves a sync checkpoint at the next step boundary and
+    exits cleanly; a fresh fit resumes from the saved global_step /
+    consumed_samples and finishes the budget."""
+    import signal
+
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.resilience import FaultPlan
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+    from fengshen_tpu.utils import UniversalCheckpoint
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2,
+                      max_position_embeddings=32, dtype="float32")
+    rng = np.random.RandomState(3)
+    data = [{"input_ids": rng.randint(0, 63, 16).tolist()}
+            for _ in range(64)]
+
+    class DS:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return data[i]
+
+    ck = tmp_path / "ck"
+    argv = ["--max_steps", "5", "--train_batchsize", "4",
+            "--log_every_n_steps", "1", "--warmup_steps", "1",
+            "--default_root_dir", str(tmp_path),
+            "--save_ckpt_path", str(ck), "--load_ckpt_path", str(ck)]
+
+    def run(plan=None):
+        args = _parse(argv)
+        trainer = Trainer(args)
+        trainer.callbacks.append(UniversalCheckpoint(args))
+        if plan is not None:
+            plan.install(trainer)
+        module = CausalLMModule(args, LlamaForCausalLM(cfg), cfg)
+        dm = UniversalDataModule(args=args, datasets={"train": DS()})
+        return trainer, trainer.fit(module, dm)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        trainer1, state1 = run(FaultPlan(sigterm_at_step=2))
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert trainer1._preempted
+    assert trainer1.global_step == 2 and int(state1.step) == 2
+    assert trainer1.consumed_samples == 8
+    import orbax.checkpoint as ocp
+    assert ocp.CheckpointManager(str(ck)).latest_step() == 2
+    lines = [json.loads(l) for l in
+             open(os.path.join(tmp_path, "metrics.jsonl"))]
+    assert any(l.get("event") == "preempted_saved" and l["step"] == 2
+               for l in lines)
+
+    trainer2, state2 = run()
+    assert trainer2.global_step == 5 and int(state2.step) == 5
+    assert trainer2.consumed_samples == 20  # resumed at 8, not replayed
 
 
 def test_grouped_prefetch_drops_ragged_group(capsys):
