@@ -1,0 +1,19 @@
+# Developer/CI entry points. The lint gate is the same analyzer the
+# fast pytest lane runs (tests/test_analysis.py); see
+# docs/static_analysis.md for the rule catalog and baseline workflow.
+
+PY ?= python
+
+.PHONY: lint lint-baseline test test-fast
+
+lint:
+	$(PY) -m fengshen_tpu.analysis --json
+
+lint-baseline:
+	$(PY) -m fengshen_tpu.analysis --write-baseline
+
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

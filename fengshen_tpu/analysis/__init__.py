@@ -1,0 +1,22 @@
+"""fslint — AST-based SPMD hazard analyzer for fengshen_tpu.
+
+Catches JAX/SPMD-specific bugs (host divergence, blocking transfers,
+retrace hazards, sharding typos, nondeterministic iteration, blanket
+excepts) at review time instead of step 40k on 256 chips. Pure stdlib;
+never imports jax. See docs/static_analysis.md for the rule catalog,
+the suppression/baseline workflow, and how to write a new rule.
+
+CLI: ``python -m fengshen_tpu.analysis [paths] [--select/--ignore]
+[--json]``. Library: ``check_paths(paths, make_rules())``.
+"""
+
+from fengshen_tpu.analysis.engine import (Finding, check_file,
+                                          check_paths,
+                                          default_project_root)
+from fengshen_tpu.analysis.registry import (Rule, all_rule_ids,
+                                            make_rules, register)
+
+__all__ = [
+    "Finding", "Rule", "all_rule_ids", "check_file", "check_paths",
+    "default_project_root", "make_rules", "register",
+]

@@ -1,0 +1,100 @@
+"""Checked-in baseline for legacy fslint findings.
+
+A baseline entry pins one pre-existing finding by ``(path, rule,
+code)`` — the stripped source line, NOT the line number — so unrelated
+edits above a legacy site don't invalidate the baseline, while any
+edit to the flagged line itself surfaces the finding again (you
+touched it, you fix it). Line numbers are stored purely for human
+navigation and refreshed by ``--write-baseline``.
+
+The file is JSON with findings sorted by (path, line, rule) and
+written with sorted keys + a trailing newline, so regeneration is
+byte-stable across hosts and CI diffs are meaningful.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+from fengshen_tpu.analysis.engine import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join("fengshen_tpu", "analysis",
+                                "fslint_baseline.json")
+
+
+def default_baseline_path(project_root: str) -> str:
+    return os.path.join(project_root, DEFAULT_BASELINE)
+
+
+def load_baseline(path: str) -> List[Dict[str, object]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}; "
+            f"this fslint understands version {BASELINE_VERSION}")
+    return data["findings"]
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   keep_entries: Optional[List[Dict[str, object]]] = None,
+                   ) -> None:
+    """Write the baseline from ``findings``, carrying over
+    ``keep_entries`` verbatim — entries outside the current run's
+    rule/path scope that a partial ``--write-baseline`` (with
+    ``--select``/``--ignore`` or explicit paths) must not delete."""
+    entries = [{"path": f.path, "line": f.line, "rule": f.rule,
+                "code": f.code, "justification": "TODO: why is this "
+                "finding acceptable?"}
+               for f in sorted(findings, key=Finding.sort_key)]
+    # keep hand-written justifications across regeneration
+    old = {}
+    if os.path.exists(path):
+        for e in load_baseline(path):
+            old[(e["path"], e["rule"], e["code"])] = e.get("justification")
+    for e in entries:
+        prev = old.get((e["path"], e["rule"], e["code"]))
+        if prev:
+            e["justification"] = prev
+    entries.extend(keep_entries or [])
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    payload = {"version": BASELINE_VERSION, "findings": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def split_by_baseline(
+        findings: List[Finding],
+        baseline_entries: List[Dict[str, object]],
+) -> Tuple[List[Finding], List[Finding], List[Dict[str, object]]]:
+    """(new, baselined, stale-baseline-entries).
+
+    Each baseline entry absorbs at most one current finding with the
+    same (path, rule, code); leftovers on either side are reported.
+    """
+    budget = Counter((e["path"], e["rule"], e["code"])
+                     for e in baseline_entries)
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        key = (f.path, f.rule, f.code)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = []
+    remaining = dict(budget)
+    for e in baseline_entries:
+        key = (e["path"], e["rule"], e["code"])
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            stale.append(e)
+    return new, baselined, stale

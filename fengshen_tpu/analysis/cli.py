@@ -1,0 +1,147 @@
+"""fslint command line.
+
+    python -m fengshen_tpu.analysis [paths...] [options]
+
+Exit codes: 0 = clean (everything baselined or nothing found),
+1 = non-baselined findings, 2 = bad invocation (unknown rule id,
+unreadable baseline).
+
+``--json`` emits a machine-readable report sorted by (path, line, col,
+rule) — byte-stable across hosts, so CI can diff runs directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from fengshen_tpu.analysis import baseline as baseline_mod
+from fengshen_tpu.analysis import engine
+from fengshen_tpu.analysis.registry import all_rule_ids, make_rules
+
+
+def _rule_list(value: str) -> List[str]:
+    return [r.strip() for r in value.split(",") if r.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m fengshen_tpu.analysis",
+        description="fslint — AST-based SPMD hazard analyzer for "
+                    "fengshen_tpu (see docs/static_analysis.md)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the fengshen_tpu "
+             "package)")
+    parser.add_argument(
+        "--select", type=_rule_list, default=[],
+        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument(
+        "--ignore", type=_rule_list, default=[],
+        help="comma-separated rule ids to skip")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit a sorted machine-readable JSON report")
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline file (default: fengshen_tpu/analysis/"
+             "fslint_baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print registered rule ids and exit")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid in all_rule_ids():
+            print(rid)
+        return 0
+
+    root = engine.default_project_root()
+    paths = args.paths or [os.path.join(root, "fengshen_tpu")]
+    try:
+        rules = make_rules(select=args.select, ignore=args.ignore)
+    except ValueError as e:
+        print(f"fslint: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        findings = engine.check_paths(paths, rules, project_root=root)
+    except FileNotFoundError as e:
+        print(f"fslint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or \
+        baseline_mod.default_baseline_path(root)
+    if args.write_baseline:
+        # a partial run (--select/--ignore or explicit paths) must not
+        # delete entries it never re-checked: carry over everything
+        # outside the active rule set or the analyzed paths
+        kept: list = []
+        if os.path.exists(baseline_path):
+            active = {r.id for r in rules}
+            analyzed = [engine._relpath(p, root) for p in paths]
+
+            def covered(rel: str) -> bool:
+                return any(rel == a or rel.startswith(a + "/")
+                           for a in analyzed)
+
+            try:
+                kept = [e for e in baseline_mod.load_baseline(
+                            baseline_path)
+                        if e["rule"] not in active
+                        or not covered(str(e["path"]))]
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"fslint: cannot read baseline: {e}",
+                      file=sys.stderr)
+                return 2
+        baseline_mod.write_baseline(baseline_path, findings,
+                                    keep_entries=kept)
+        print(f"fslint: wrote {len(findings) + len(kept)} finding(s) "
+              f"({len(kept)} carried over) to {baseline_path}")
+        return 0
+
+    stale: list = []
+    baselined: list = []
+    if not args.no_baseline:
+        try:
+            entries = baseline_mod.load_baseline(baseline_path)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"fslint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        findings, baselined, stale = baseline_mod.split_by_baseline(
+            findings, entries)
+
+    if args.json:
+        report = {
+            "findings": [f.to_dict() for f in findings],
+            "baselined": len(baselined),
+            "stale_baseline": [
+                {"path": e["path"], "rule": e["rule"], "code": e["code"]}
+                for e in stale],
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if baselined:
+            print(f"fslint: {len(baselined)} baselined finding(s) "
+                  "suppressed", file=sys.stderr)
+        for e in stale:
+            print(f"fslint: stale baseline entry {e['path']} "
+                  f"[{e['rule']}] `{e['code']}` no longer fires — "
+                  "remove it (or --write-baseline)", file=sys.stderr)
+        if not findings:
+            print("fslint: clean")
+    return 1 if findings else 0

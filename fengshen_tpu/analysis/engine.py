@@ -1,0 +1,374 @@
+"""fslint engine: one AST walk per file, shared trace-context analysis.
+
+Pure stdlib — the analyzer never imports jax (or the package under
+analysis), so ``python -m fengshen_tpu.analysis`` starts in
+milliseconds and runs identically on a dev laptop, CI, and a TPU host.
+
+The engine owns everything rules share:
+
+- parsing + a parent map (``ctx.parent``) over each file's tree
+- import-alias resolution (``ctx.qualname`` turns ``jnp.zeros`` /
+  ``P(...)`` / ``device_get(...)`` back into dotted origins like
+  ``jax.numpy.zeros`` regardless of local import spelling)
+- traced-context analysis (``ctx.in_traced_context``): which functions
+  are jitted / grad-transformed / scan-cond-while bodies, including
+  functions reached transitively by name from a traced one
+- per-line suppressions: ``# fslint: disable=<rule>[,<rule>]`` (or a
+  bare ``# fslint: disable`` for all rules) on the finding's line
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from fengshen_tpu.analysis.registry import Rule
+
+#: calls whose function-valued arguments are traced by JAX. Matched
+#: against alias-resolved dotted names, so ``from jax import lax;
+#: lax.scan`` and ``jax.lax.scan`` both hit.
+TRACING_ENTRY_POINTS = frozenset({
+    "jax.jit", "jax.pmap", "jax.grad", "jax.value_and_grad", "jax.vmap",
+    "jax.checkpoint", "jax.remat", "jax.eval_shape", "jax.make_jaxpr",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map", "shard_map",
+    "flax.linen.scan", "flax.linen.remat", "nn.scan", "nn.remat",
+})
+
+#: function names that are step functions by convention even when the
+#: jit call lives in another file (the trainer jits
+#: ``module.training_loss`` etc. — the definition site can't see that)
+TRACED_BY_NAME = frozenset({
+    "train_step", "eval_step", "training_loss", "validation_loss",
+    "predict_step",
+})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fslint:\s*disable(?:=(?P<rules>[\w,\- ]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. Sorts by (path, line, col, rule) so text and
+    ``--json`` output — and therefore the baseline file and CI diffs —
+    are deterministic across hosts and dict orderings."""
+
+    path: str       # repo-relative, posix separators
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str
+    code: str       # stripped source line (anchors baseline matching)
+
+    def sort_key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message,
+                "hint": self.hint, "code": self.code}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}\n    {self.code}\n    fix: {self.hint}")
+
+
+class FileContext:
+    """Everything rules may ask about one source file."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module, project_root: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.project_root = project_root
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.aliases = _collect_aliases(tree)
+        self.comments = _collect_comments(source)
+        self.suppressions = _collect_suppressions(self.comments)
+        self._traced = _traced_functions(self)
+
+    # -- structure ---------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        return [a for a in self.ancestors(node)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))]
+
+    # -- names -------------------------------------------------------
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted origin of a Name/Attribute chain, alias-resolved.
+
+        ``jnp.zeros`` -> ``jax.numpy.zeros`` (under ``import jax.numpy
+        as jnp``); non-name expressions (calls, subscripts) -> None.
+        """
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.qualname(node.value)
+            return None if base is None else f"{base}.{node.attr}"
+        return None
+
+    # -- tracing -----------------------------------------------------
+    def is_traced_function(self, fn: ast.AST) -> bool:
+        return fn in self._traced
+
+    def in_traced_context(self, node: ast.AST) -> bool:
+        """True when any enclosing function is traced by JAX (jitted,
+        grad/vmap-transformed, or a scan/cond/while body) — directly,
+        lexically (nested inside one), or transitively by call."""
+        return any(fn in self._traced
+                   for fn in self.enclosing_functions(node))
+
+    # -- suppressions ------------------------------------------------
+    def is_suppressed(self, line: int, rule_id: str) -> bool:
+        rules = self.suppressions.get(line)
+        if rules is None:
+            return False
+        return not rules or rule_id in rules
+
+    def line_comment(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            prefix = ("." * node.level) + node.module
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{prefix}.{a.name}"
+    return aliases
+
+
+def _collect_comments(source: str) -> Dict[int, str]:
+    comments: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # ast.parse already succeeded; comment map is best-effort
+    return comments
+
+
+def _collect_suppressions(
+        comments: Dict[int, str]) -> Dict[int, frozenset]:
+    """line -> suppressed rule ids (empty frozenset = all rules)."""
+    out: Dict[int, frozenset] = {}
+    for line, text in comments.items():
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        out[line] = frozenset(
+            r.strip() for r in rules.split(",") if r.strip()) \
+            if rules else frozenset()
+    return out
+
+
+def _function_nodes(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _is_tracing_decorator(dec: ast.AST, ctx: "FileContext") -> bool:
+    qn = ctx.qualname(dec)
+    if qn in TRACING_ENTRY_POINTS:
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=...) / @partial(jax.jit, ...)
+        fqn = ctx.qualname(dec.func)
+        if fqn in TRACING_ENTRY_POINTS:
+            return True
+        if fqn in ("functools.partial", "partial") and dec.args:
+            return ctx.qualname(dec.args[0]) in TRACING_ENTRY_POINTS
+    return False
+
+
+def _in_flax_module(fn: ast.AST, ctx: "FileContext") -> bool:
+    """Is ``fn`` a method of a class whose bases resolve to a flax
+    ``nn.Module`` (directly or through a local Module subclass)?"""
+    for anc in ctx.ancestors(fn):
+        if isinstance(anc, ast.ClassDef):
+            return any(
+                (ctx.qualname(b) or "").rsplit(".", 1)[-1] == "Module"
+                or isinstance(b, ast.Name) and b.id.endswith("Module")
+                for b in anc.bases)
+    return False
+
+
+def _traced_functions(ctx: "FileContext") -> Set[ast.AST]:
+    """Seed + fixpoint: which function defs end up inside a trace."""
+    fns = _function_nodes(ctx.tree)
+    by_name: Dict[str, List[ast.AST]] = {}
+    for fn in fns:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    traced: Set[ast.AST] = set()
+    for fn in fns:
+        if fn.name in TRACED_BY_NAME:
+            traced.add(fn)
+        if any(_is_tracing_decorator(d, ctx) for d in fn.decorator_list):
+            traced.add(fn)
+        if fn.name == "__call__" and _in_flax_module(fn, ctx):
+            # flax modules' __call__ always executes under a trace
+            traced.add(fn)
+
+    # functions passed by name into a tracing entry point:
+    #   jax.jit(train_step, ...), lax.scan(body, ...), partial(jax.jit, f)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fqn = ctx.qualname(node.func)
+        args = node.args
+        if fqn in ("functools.partial", "partial") and args and \
+                ctx.qualname(args[0]) in TRACING_ENTRY_POINTS:
+            args = args[1:]
+        elif fqn not in TRACING_ENTRY_POINTS:
+            continue
+        for arg in args:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                traced.update(by_name[arg.id])
+
+    # transitive closure: a call by bare name from a traced body drags
+    # the callee into the trace (grad_step -> micro -> loss_fn chains).
+    # Call edges are collected in one pass: callee name -> caller defs.
+    callers_of: Dict[str, Set[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in by_name:
+            callers_of.setdefault(node.func.id, set()).update(
+                ctx.enclosing_functions(node))
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            if fn not in traced and \
+                    callers_of.get(fn.name, set()) & traced:
+                traced.add(fn)
+                changed = True
+    return traced
+
+
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        if not os.path.isdir(path):
+            # a typo'd path must fail LOUDLY, not lint nothing and
+            # report the tree clean (a vacuous CI gate)
+            raise FileNotFoundError(f"no such file or directory: {path}")
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".venv"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def default_project_root() -> str:
+    """The repo root: parent of the fengshen_tpu package directory."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def check_file(path: str, rules: List[Rule],
+               project_root: Optional[str] = None) -> List[Finding]:
+    project_root = project_root or default_project_root()
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [_pseudo_finding(path, project_root, 1,
+                                f"unreadable file: {e}")]
+    relpath = _relpath(path, project_root)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [_pseudo_finding(path, project_root, e.lineno or 1,
+                                f"syntax error: {e.msg}")]
+
+    ctx = FileContext(path, relpath, source, tree, project_root)
+    dispatch: Dict[type, List[Rule]] = {}
+    for rule in rules:
+        rule.begin_file(ctx)
+        for nt in rule.NODE_TYPES:
+            dispatch.setdefault(nt, []).append(rule)
+
+    findings: List[Finding] = []
+
+    def emit(rule: Rule, hits: Iterable[Tuple[ast.AST, str]]) -> None:
+        for node, message in hits:
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+            if ctx.is_suppressed(line, rule.id):
+                continue
+            code = ctx.lines[line - 1].strip() \
+                if 0 < line <= len(ctx.lines) else ""
+            findings.append(Finding(
+                path=relpath, line=line, col=col, rule=rule.id,
+                message=message, hint=rule.hint, code=code))
+
+    for node in ast.walk(tree):
+        for rule in dispatch.get(type(node), ()):
+            emit(rule, rule.check(node, ctx))
+    for rule in rules:
+        emit(rule, rule.end_file(ctx))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def check_paths(paths: Iterable[str], rules: List[Rule],
+                project_root: Optional[str] = None) -> List[Finding]:
+    project_root = project_root or default_project_root()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(check_file(path, rules, project_root))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _relpath(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    return rel.replace(os.sep, "/")
+
+
+def _pseudo_finding(path: str, root: str, line: int,
+                    message: str) -> Finding:
+    return Finding(path=_relpath(path, root), line=line, col=0,
+                   rule="parse-error", message=message,
+                   hint="fix the file so ast.parse succeeds", code="")

@@ -1,0 +1,85 @@
+"""Rule registry for fslint.
+
+A rule is a class with a unique ``id``, a one-line ``hint`` (the fix
+suggestion attached to every finding), and a ``check(node, ctx)``
+generator that yields findings for the AST node types it subscribed to
+via ``NODE_TYPES``. The engine walks each file's tree exactly once and
+dispatches every node to all rules registered for its type — adding a
+rule is a new ~50-line module under ``analysis/rules/`` plus an import
+in ``rules/__init__.py``; nothing else changes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Tuple, Type
+
+#: rule id -> rule class (instantiated fresh per run)
+_RULES: Dict[str, Type["Rule"]] = {}
+
+
+class Rule:
+    """Base class for fslint rules.
+
+    Subclasses set:
+
+    - ``id``         — kebab-case rule name (stable; used in suppressions,
+      ``--select/--ignore``, and the baseline file)
+    - ``hint``       — one-line fix suggestion shown with every finding
+    - ``NODE_TYPES`` — tuple of ``ast`` node classes ``check`` wants
+
+    and implement ``check(node, ctx)`` yielding ``(node, message)``
+    pairs. ``begin_file(ctx)`` runs before the walk (per-file state),
+    ``end_file(ctx)`` after it (whole-file conclusions).
+    """
+
+    id: str = ""
+    hint: str = ""
+    NODE_TYPES: Tuple[type, ...] = ()
+
+    def begin_file(self, ctx) -> None:  # noqa: B027 - optional hook
+        pass
+
+    def check(self, node: ast.AST, ctx) -> Iterator[Tuple[ast.AST, str]]:
+        return iter(())
+
+    def end_file(self, ctx) -> Iterator[Tuple[ast.AST, str]]:
+        return iter(())
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a rule to the global registry."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def all_rule_ids() -> List[str]:
+    _ensure_loaded()
+    return sorted(_RULES)
+
+
+def make_rules(select: Iterable[str] = (),
+               ignore: Iterable[str] = ()) -> List[Rule]:
+    """Instantiate the active rule set.
+
+    ``select`` restricts to the given ids (empty = all); ``ignore``
+    drops ids from the selection. Unknown ids raise ``ValueError`` so a
+    typo in CI config fails loudly instead of silently checking nothing.
+    """
+    _ensure_loaded()
+    select, ignore = list(select), list(ignore)
+    unknown = [r for r in (*select, *ignore) if r not in _RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s) {unknown}; known: {sorted(_RULES)}")
+    active = select or sorted(_RULES)
+    return [_RULES[rid]() for rid in active if rid not in ignore]
+
+
+def _ensure_loaded() -> None:
+    # importing the subpackage registers every rule module
+    from fengshen_tpu.analysis import rules  # noqa: F401

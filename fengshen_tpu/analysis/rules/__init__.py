@@ -1,0 +1,14 @@
+"""fslint rule modules — importing this package registers every rule.
+
+To add rule 7: drop a module here with a ``@register``-decorated
+``Rule`` subclass (~50 lines, see any sibling) and import it below.
+"""
+
+from fengshen_tpu.analysis.rules import (  # noqa: F401
+    blanket_except,
+    blocking_transfer,
+    host_divergence,
+    nondet_iteration,
+    partition_spec_axes,
+    retrace_hazard,
+)

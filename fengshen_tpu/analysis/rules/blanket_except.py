@@ -1,0 +1,55 @@
+"""blanket-except: no silent ``except:`` / ``except Exception:``.
+
+AST successor of the regex lint that used to live in
+``tests/test_lint_excepts.py`` — same guarantee (resilience code dies
+when a blanket handler swallows a real error and turns a crash into a
+silently-wrong run), without the regex false positives on strings,
+comments, or ``except Exception as e: raise`` spread over lines.
+
+A blanket handler is allowed when the same line carries an explicit
+justification marker: ``# noqa: BLE001`` for re-raise/bounded-retry
+sites, ``# pragma: no cover`` for defensive probes (both grandfathered
+from the regex lint), or a ``# fslint: disable=blanket-except``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+BLANKET_NAMES = ("Exception", "BaseException")
+JUSTIFICATION_MARKERS = ("# noqa: BLE001", "# pragma: no cover")
+
+
+def _is_blanket(expr) -> bool:
+    if expr is None:  # bare `except:`
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in BLANKET_NAMES
+    if isinstance(expr, ast.Attribute):  # builtins.Exception etc.
+        return expr.attr in BLANKET_NAMES
+    if isinstance(expr, ast.Tuple):
+        return any(_is_blanket(e) for e in expr.elts)
+    return False
+
+
+@register
+class BlanketExcept(Rule):
+    id = "blanket-except"
+    hint = ("catch the specific exception, or justify on the same line "
+            "with `# noqa: BLE001` (re-raise/bounded-retry) or "
+            "`# pragma: no cover` (defensive probe)")
+    NODE_TYPES = (ast.ExceptHandler,)
+
+    def check(self, node: ast.ExceptHandler, ctx):
+        if not _is_blanket(node.type):
+            return
+        line = ctx.line_comment(node.lineno)
+        if any(marker in line for marker in JUSTIFICATION_MARKERS):
+            return
+        what = "bare `except:`" if node.type is None else \
+            f"blanket `except {ast.unparse(node.type)}:`"
+        yield node, (f"{what} without a justification marker swallows "
+                     "real errors (turns crashes into silently-wrong "
+                     "runs)")

@@ -1,0 +1,193 @@
+"""blocking-transfer: device->host syncs inside step hot paths.
+
+``.item()`` / ``.tolist()``, ``float()/int()/bool()`` on array-derived
+values, ``np.asarray``, and ``jax.device_get`` inside a traced
+function either raise ``ConcretizationTypeError`` at trace time (on
+tracers) or — worse — silently force a blocking device->host transfer
+per step on values closed over from outside the trace, stalling the
+dispatch pipeline the trainer works hard to keep async
+(docs/performance.md). Either way the right fix is the same: keep the
+hot path pure, pull scalars out ONCE outside the step.
+
+Precision: a cheap per-function taint pass separates array-derived
+values from trace-time-static host math, so ``int(cfg.hidden_size *
+8 / 3)`` or ``int(mesh.shape[axis])`` in a flax ``__call__`` stays
+clean while ``float(loss)`` on a value computed from a batch operand
+fires. Taint seeds are the traced function's parameters (arrays by
+convention; ``self``/``cls`` and params annotated as plain Python
+scalars are exempt) plus ``jnp``/``jax`` call results; ``.shape`` /
+``.dtype``-style metadata reads and subscript *indices* launder taint
+(host-static), everything else propagates it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+SYNC_METHOD_CALLS = frozenset({"item", "tolist", "block_until_ready"})
+SYNC_FREE_CALLS = frozenset({
+    "jax.device_get",
+    "numpy.asarray", "numpy.array", "numpy.asanyarray",
+})
+SCALAR_CASTS = frozenset({"float", "int", "bool"})
+
+#: attribute reads on an array that yield host-static metadata
+METADATA_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "sharding"})
+#: parameter annotations marking a host scalar (never an array)
+SCALAR_ANNOTATIONS = frozenset({"int", "float", "bool", "str", "bytes"})
+#: call roots whose results are host scalars even on tainted args
+HOST_MATH_ROOTS = frozenset({"math", "len", "max", "min", "abs",
+                             "round", "sum", "sorted", "range"})
+ARRAY_ROOTS = ("jax", "jax.numpy")
+
+
+def _is_scalar_annotation(ann) -> bool:
+    if isinstance(ann, ast.Name):
+        return ann.id in SCALAR_ANNOTATIONS
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value in SCALAR_ANNOTATIONS
+    if isinstance(ann, ast.Subscript):  # Optional[int] etc.
+        return _is_scalar_annotation(ann.slice)
+    return False
+
+
+class _Taint:
+    """Array-taint over one function scope (nested defs excluded)."""
+
+    def __init__(self, fn, ctx) -> None:
+        self.ctx = ctx
+        self.names: Set[str] = set()
+        for arg in (*fn.args.posonlyargs, *fn.args.args,
+                    *fn.args.kwonlyargs):
+            if arg.arg in ("self", "cls"):
+                continue
+            if arg.annotation is not None and \
+                    _is_scalar_annotation(arg.annotation):
+                continue
+            self.names.add(arg.arg)
+        stmts = [s for s in ast.walk(fn)
+                 if isinstance(s, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign, ast.For, ast.AsyncFor,
+                                   ast.comprehension, ast.NamedExpr))
+                 and self._owner(s, fn)]
+        # two passes: catches simple later-assigned-earlier-used loops
+        for _ in range(2):
+            for s in stmts:
+                self._absorb(s)
+
+    def _owner(self, node, fn) -> bool:
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc is fn
+        return False
+
+    def _absorb(self, stmt) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.comprehension)):
+            # `for x in xs:` — iterating a tainted array yields tainted
+            # elements
+            value, targets = stmt.iter, [stmt.target]
+        elif isinstance(stmt, ast.Assign):
+            value, targets = stmt.value, stmt.targets
+        else:  # AnnAssign / AugAssign / NamedExpr
+            value, targets = stmt.value, [stmt.target]
+        if value is None or not self.tainted(value):
+            return
+        for tgt in targets:
+            for leaf in ast.walk(tgt):
+                if isinstance(leaf, ast.Name):
+                    self.names.add(leaf.id)
+
+    def tainted(self, expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.names
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in METADATA_ATTRS:
+                return False  # x.shape / x.dtype are host-static
+            return self.tainted(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.tainted(expr.value)  # index taint is laundered
+        if isinstance(expr, (ast.BinOp,)):
+            return self.tainted(expr.left) or self.tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.tainted(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return self.tainted(expr.left) or \
+                any(self.tainted(c) for c in expr.comparators)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.IfExp):
+            return self.tainted(expr.body) or self.tainted(expr.orelse)
+        if isinstance(expr, ast.Call):
+            qn = self.ctx.qualname(expr.func)
+            if qn is not None:
+                root = qn.split(".", 1)[0]
+                if any(qn == r or qn.startswith(r + ".")
+                       for r in ARRAY_ROOTS) or root == "jnp":
+                    return True
+                if root in HOST_MATH_ROOTS:
+                    return False
+            if isinstance(expr.func, ast.Attribute) and \
+                    self.tainted(expr.func.value):
+                return True  # method on an array: (x ** 2).mean()
+            return any(self.tainted(a) for a in expr.args)
+        return False
+
+
+@register
+class BlockingTransfer(Rule):
+    id = "blocking-transfer"
+    hint = ("keep the traced body pure jnp; read scalars outside the "
+            "step (after dispatch), or use lax primitives instead of "
+            "host round-trips")
+    NODE_TYPES = (ast.Call,)
+
+    def begin_file(self, ctx) -> None:
+        self._taints: Dict[int, _Taint] = {}
+
+    def _taint_for(self, node, ctx) -> Optional[_Taint]:
+        fns = ctx.enclosing_functions(node)
+        fn = next((f for f in fns
+                   if isinstance(f, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))), None)
+        if fn is None:
+            return None
+        key = id(fn)
+        if key not in self._taints:
+            self._taints[key] = _Taint(fn, ctx)
+        return self._taints[key]
+
+    def check(self, node: ast.Call, ctx):
+        if not ctx.in_traced_context(node):
+            return
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in SYNC_METHOD_CALLS and not node.args:
+            taint = self._taint_for(node, ctx)
+            if taint is not None and taint.tainted(func.value):
+                yield node, (f"`.{func.attr}()` on an array in a "
+                             "traced function forces a blocking "
+                             "device->host transfer (or a "
+                             "ConcretizationTypeError on a tracer)")
+            return
+        qn = ctx.qualname(func)
+        if qn in SYNC_FREE_CALLS:
+            taint = self._taint_for(node, ctx)
+            if taint is not None and node.args and \
+                    taint.tainted(node.args[0]):
+                yield node, (f"`{qn}` on an array in a traced function "
+                             "pulls it to host memory every step — use "
+                             "jnp, or lift the conversion out of the "
+                             "trace")
+            return
+        if qn in SCALAR_CASTS and node.args and not isinstance(
+                node.args[0], ast.Constant):
+            taint = self._taint_for(node, ctx)
+            if taint is not None and taint.tainted(node.args[0]):
+                yield node, (f"`{qn}(...)` on an array-derived value "
+                             "in a traced function concretizes it on "
+                             "host — tracers raise, closures silently "
+                             "sync per step")

@@ -1,0 +1,76 @@
+"""host-divergence: host-varying values reached from traced code.
+
+``random.*``, ``time.time()``, ``os.environ``, ``uuid.*`` evaluated
+while JAX traces a step function are baked into the compiled program as
+constants — each host (and each retrace) bakes a DIFFERENT constant.
+When that value feeds a collective, a branch, or pytree structure, the
+hosts compile different programs and the pod deadlocks or silently
+diverges at step N, exactly the class of bug Megatron-style trainers
+make fail at review time instead (ISSUE 2 / arxiv 2104.04473 §B).
+
+Only fires inside traced contexts (jit/grad/vmap'd functions,
+scan/cond/while bodies, and functions they call — the engine's
+trace-context analysis), so host-side setup code that legitimately
+reads the environment stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+#: dotted prefixes whose call results vary per host / per call
+HOST_VARYING_CALLS = (
+    "random.",          # python stdlib RNG (module `random` only;
+                        # numpy.random / jax.random resolve differently)
+    "uuid.",
+    "secrets.",
+)
+HOST_VARYING_EXACT = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "os.getenv", "os.urandom", "os.getpid", "socket.gethostname",
+})
+#: attribute/subscript roots that are host state
+HOST_VARYING_ATTRS = frozenset({"os.environ"})
+
+
+@register
+class HostDivergence(Rule):
+    id = "host-divergence"
+    hint = ("hoist the host value out of the traced function and pass "
+            "it in as an argument (or fold it into the PRNG key / "
+            "config before tracing)")
+    NODE_TYPES = (ast.Call, ast.Subscript, ast.Attribute)
+
+    def check(self, node: ast.AST, ctx):
+        if isinstance(node, ast.Call):
+            qn = ctx.qualname(node.func)
+            if qn is None:
+                return
+            hit = qn in HOST_VARYING_EXACT or \
+                any(qn.startswith(p) for p in HOST_VARYING_CALLS) or \
+                any(qn.startswith(a + ".") or qn == a
+                    for a in HOST_VARYING_ATTRS)
+        elif isinstance(node, ast.Subscript):
+            hit = ctx.qualname(node.value) in HOST_VARYING_ATTRS
+        else:
+            # Attribute read like `os.environ` passed around (incl. as a
+            # call argument: `dict(os.environ)`). Attribute/Subscript
+            # parents are excluded only to avoid double-reporting
+            # `os.environ.get(...)` / `os.environ[...]`, which the Call
+            # and Subscript branches already cover.
+            hit = ctx.qualname(node) in HOST_VARYING_ATTRS and \
+                not isinstance(ctx.parent(node),
+                               (ast.Attribute, ast.Subscript))
+        if not hit or not ctx.in_traced_context(node):
+            return
+        desc = ctx.qualname(node.func if isinstance(node, ast.Call)
+                            else node.value if isinstance(node,
+                                                          ast.Subscript)
+                            else node)
+        yield node, (f"`{desc}` inside a traced function bakes a "
+                     "host-varying constant into the compiled program — "
+                     "hosts trace different programs and diverge (or "
+                     "deadlock in collectives)")

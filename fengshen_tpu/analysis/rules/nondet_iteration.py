@@ -1,0 +1,94 @@
+"""nondet-iteration: host-order-dependent loops feeding SPMD state.
+
+Iterating a ``set`` (or set-algebra over ``dict.keys()``) is ordered by
+string hashes, and ``PYTHONHASHSEED`` differs across hosts unless
+pinned — so a loop like ``for name in set(params) - skip:`` that emits
+collectives or builds a pytree runs in a DIFFERENT order on each host:
+collectives issue in different sequences (deadlock) or the pytrees
+disagree structurally (sharding mismatch at dispatch). ``sorted(...)``
+around the set is the one-token fix and is recognized as clean.
+
+Only set-typed iterables of non-literal origin fire; a literal
+``{"a", "b"}`` display is visible at review time and plain
+``dict``/``dict.keys()`` iteration is insertion-ordered (deterministic
+when the insertions are).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+SET_CONSTRUCTORS = frozenset({"set", "frozenset"})
+SET_METHODS = frozenset({"intersection", "union", "difference",
+                         "symmetric_difference"})
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "axis_index", "psum_scatter",
+    "with_sharding_constraint", "device_put", "make_array_from_callback",
+})
+PYTREE_BUILD_METHODS = frozenset({"append", "add", "update",
+                                  "setdefault", "extend"})
+
+
+def _is_setish(expr, ctx) -> bool:
+    if isinstance(expr, ast.Call):
+        qn = ctx.qualname(expr.func)
+        if qn in SET_CONSTRUCTORS:
+            return True
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr in SET_METHODS:
+            return True
+        return False
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        # set algebra: `set(a) - b`, `a.keys() & b.keys()` are sets
+        return _is_setish(expr.left, ctx) or _is_setish(expr.right, ctx) \
+            or _is_keys_call(expr.left) or _is_keys_call(expr.right)
+    return False
+
+
+def _is_keys_call(expr) -> bool:
+    return isinstance(expr, ast.Call) and \
+        isinstance(expr.func, ast.Attribute) and \
+        expr.func.attr == "keys" and not expr.args
+
+
+def _body_feeds_spmd(body, ctx) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                qn = ctx.qualname(node.func)
+                last = qn.rsplit(".", 1)[-1] if qn else (
+                    node.func.attr if isinstance(node.func,
+                                                 ast.Attribute) else None)
+                if last in COLLECTIVES:
+                    return True
+                if last in PYTREE_BUILD_METHODS or \
+                        last in ("dict", "list"):
+                    return True
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if any(isinstance(t, ast.Subscript) for t in targets):
+                    return True
+    return False
+
+
+@register
+class NondetIteration(Rule):
+    id = "nondet-iteration"
+    hint = ("wrap the iterable in sorted(...) so every host walks the "
+            "same order")
+    NODE_TYPES = (ast.For,)
+
+    def check(self, node: ast.For, ctx):
+        if not _is_setish(node.iter, ctx):
+            return
+        if not _body_feeds_spmd(node.body, ctx):
+            return
+        yield node, (
+            "iterating a set whose order is PYTHONHASHSEED-dependent "
+            "while the body emits collectives / builds pytrees — hosts "
+            "walk different orders and the SPMD programs disagree")

@@ -1,0 +1,95 @@
+"""partition-spec-axes: every PartitionSpec axis must exist on the mesh.
+
+GSPMD treats an unknown axis name in a ``PartitionSpec`` as "not on
+the mesh" and SILENTLY REPLICATES that dimension — a typo like
+``P("tenosr", "fsdp")`` compiles, runs, and quietly costs a full copy
+of the tensor on every device (the exact failure mode the round-2
+dryrun caught as an involuntary-rematerialization warning, except
+without the warning). The authoritative axis vocabulary is parsed from
+``fengshen_tpu/parallel/mesh.py`` (the ``*_AXIS`` constants), so a new
+mesh axis is one edit away from being legal everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import FrozenSet, Optional
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+MESH_FILE = os.path.join("fengshen_tpu", "parallel", "mesh.py")
+
+_AXES_CACHE: dict = {}
+
+
+def mesh_axes(project_root: str) -> Optional[FrozenSet[str]]:
+    """Axis names declared in mesh.py, parsed statically (no jax
+    import). None when mesh.py is missing (rule stays silent)."""
+    if project_root in _AXES_CACHE:
+        return _AXES_CACHE[project_root]
+    path = os.path.join(project_root, MESH_FILE)
+    axes = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        tree = None
+    if tree is not None:
+        found = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id.endswith("_AXIS") and \
+                            isinstance(stmt.value, ast.Constant) and \
+                            isinstance(stmt.value.value, str):
+                        found.add(stmt.value.value)
+        axes = frozenset(found) or None
+    _AXES_CACHE[project_root] = axes
+    return axes
+
+
+def _is_spec_call(node: ast.Call, ctx) -> bool:
+    qn = ctx.qualname(node.func)
+    if qn and qn.rsplit(".", 1)[-1] == "PartitionSpec":
+        return True
+    # the ubiquitous `from jax.sharding import PartitionSpec as P` plus
+    # re-exports: accept a call on a bare name `P` that the file
+    # imported (alias origin ending in .P or .PartitionSpec)
+    if isinstance(node.func, ast.Name) and node.func.id == "P":
+        origin = ctx.aliases.get("P")
+        return origin is None or origin.rsplit(".", 1)[-1] in ("P",
+                                                               "PartitionSpec")
+    return False
+
+
+def _axis_strings(arg):
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        yield arg, arg.value
+    elif isinstance(arg, (ast.Tuple, ast.List)):
+        for elt in arg.elts:
+            yield from _axis_strings(elt)
+
+
+@register
+class PartitionSpecAxes(Rule):
+    id = "partition-spec-axes"
+    hint = ("use an axis name declared in fengshen_tpu/parallel/mesh.py "
+            "(MESH_AXES) — unknown names silently replicate the "
+            "dimension")
+    NODE_TYPES = (ast.Call,)
+
+    def begin_file(self, ctx) -> None:
+        self._axes = mesh_axes(ctx.project_root)
+
+    def check(self, node: ast.Call, ctx):
+        if self._axes is None or not _is_spec_call(node, ctx):
+            return
+        for sub, value in ((s, v) for a in node.args
+                           for s, v in _axis_strings(a)):
+            if value not in self._axes:
+                yield sub, (
+                    f"PartitionSpec axis {value!r} is not a mesh axis "
+                    f"({', '.join(sorted(self._axes))}) — XLA will "
+                    "silently replicate this dimension")

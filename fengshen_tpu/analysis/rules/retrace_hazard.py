@@ -1,0 +1,114 @@
+"""retrace-hazard: jitted functions that recompile (or constant-bloat).
+
+Two concrete shapes, both seen in the wild:
+
+1. a jit-decorated function closing over a module-level ``jnp`` array —
+   the array is baked into every trace as a constant (HBM copy per
+   compiled program, and a silent retrace if the global is rebound).
+   Pass it as an argument so jit sees it as a traced operand.
+2. a jit-decorated function with an unhashable default (``[]``, ``{}``,
+   ``set()``) and no ``static_argnums``/``static_argnames`` — jit
+   hashes static arguments for its compilation cache; an unhashable
+   default either raises at call time or, as a pytree operand, invites
+   per-call retraces when callers mutate the shared default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+#: jnp/np constructors whose module-level results are device/host arrays
+ARRAY_MAKERS = frozenset({
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "tri", "empty",
+})
+ARRAY_ROOTS = ("jax.numpy", "numpy", "jax.nn")
+
+JIT_CALLS = frozenset({"jax.jit", "jax.pmap", "jit", "pmap"})
+
+
+def _jit_decoration(fn, ctx):
+    """The jit decorator Call node (for kwargs inspection), True for a
+    bare ``@jax.jit``, or None when the function is not jit-decorated."""
+    for dec in fn.decorator_list:
+        if ctx.qualname(dec) in JIT_CALLS:
+            return True
+        if isinstance(dec, ast.Call):
+            if ctx.qualname(dec.func) in JIT_CALLS:
+                return dec
+            if ctx.qualname(dec.func) in ("functools.partial", "partial") \
+                    and dec.args and \
+                    ctx.qualname(dec.args[0]) in JIT_CALLS:
+                return dec
+    return None
+
+
+def _has_static_kwarg(dec) -> bool:
+    if dec is True or dec is None:
+        return False
+    return any(kw.arg and kw.arg.startswith("static_")
+               for kw in dec.keywords)
+
+
+@register
+class RetraceHazard(Rule):
+    id = "retrace-hazard"
+    hint = ("pass module-level arrays as arguments; mark unhashable "
+            "config via static_argnums/static_argnames or make the "
+            "default hashable (None + in-body default)")
+    NODE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def begin_file(self, ctx) -> None:
+        # module-level `X = jnp.zeros(...)`-style array globals
+        self._module_arrays = set()
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call):
+                continue
+            qn = ctx.qualname(stmt.value.func)
+            if qn and qn.rsplit(".", 1)[-1] in ARRAY_MAKERS and \
+                    any(qn.startswith(root + ".") for root in ARRAY_ROOTS):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._module_arrays.add(tgt.id)
+
+    def check(self, fn, ctx):
+        dec = _jit_decoration(fn, ctx)
+        if dec is None:
+            return
+
+        if self._module_arrays:
+            # python scoping: ANY binding inside the function (param,
+            # assignment, for/with/walrus target) makes the name local —
+            # a Load of it is not a closure over the module array
+            local = {a.arg for a in (*fn.args.args, *fn.args.posonlyargs,
+                                     *fn.args.kwonlyargs)}
+            local.update(n.id for n in ast.walk(fn)
+                         if isinstance(n, ast.Name)
+                         and isinstance(n.ctx, ast.Store))
+            seen = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in self._module_arrays and \
+                        node.id not in local and node.id not in seen:
+                    seen.add(node.id)
+                    yield node, (
+                        f"jitted `{fn.name}` closes over module-level "
+                        f"array `{node.id}` — baked into every trace "
+                        "as a constant (HBM bloat, silent retrace on "
+                        "rebind)")
+
+        if not _has_static_kwarg(dec):
+            defaults = (*fn.args.defaults, *fn.args.kw_defaults)
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(d, ast.Call) and
+                        ctx.qualname(d.func) in ("set", "dict", "list")):
+                    yield d, (
+                        f"jitted `{fn.name}` takes an unhashable "
+                        f"default `{ast.unparse(d)}` without "
+                        "static_argnums — uncacheable as static, "
+                        "retrace-bait as a shared mutable pytree")

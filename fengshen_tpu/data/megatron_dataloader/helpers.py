@@ -29,7 +29,9 @@ def compile_helper() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True)
         return True
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # make missing (OSError) or the build failed (CalledProcessError)
+        # — caller falls back to the pure-python index builders
         return False
 
 

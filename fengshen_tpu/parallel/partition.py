@@ -181,7 +181,9 @@ def with_sharding_constraint(x: Any, spec: P, mesh: Optional[Mesh] = None):
                                           abstract.axis_types)
                   if "Manual" in str(t)} if abstract is not None and \
             abstract.axis_names else set()
-    except Exception:
+    except Exception:  # pragma: no cover - jax version probe (older
+        # jax lacks get_abstract_mesh / AxisType; degrade to "no
+        # manual axes" rather than pinning one jax API surface)
         abstract, manual = None, set()
     if manual:
         def strip(entry):

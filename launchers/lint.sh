@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI lint gate: run fslint (the AST SPMD hazard analyzer,
+# docs/static_analysis.md) over the package and fail on any
+# non-baselined finding. Emits the machine-readable report to stdout
+# (sorted — safe to diff across hosts); pass extra args through, e.g.
+#   launchers/lint.sh --select blanket-except
+#   FSLINT_OUT=lint.json launchers/lint.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${FSLINT_OUT:-}"
+if [[ -n "$out" ]]; then
+    python -m fengshen_tpu.analysis --json "$@" | tee "$out"
+else
+    python -m fengshen_tpu.analysis --json "$@"
+fi

@@ -1,0 +1,22 @@
+"""known-bad fixture: unannotated blanket handlers (3 findings)."""
+
+
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None
+
+
+def probe(fn):
+    try:
+        fn()
+    except:  # a bare handler
+        pass
+
+
+def tuple_handler(fn):
+    try:
+        fn()
+    except (ValueError, BaseException):
+        return None

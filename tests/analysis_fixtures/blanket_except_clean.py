@@ -1,0 +1,30 @@
+"""known-clean fixture: specific handlers + justified blankets.
+
+The string below must NOT trip the rule (it did trip the old regex
+lint — that's the false-positive class the AST port removes):
+
+    except Exception:
+"""
+
+HELP = "wrap risky calls in try/...: except Exception: handle it"
+
+
+def load(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None
+
+
+def probe(fn):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 - re-raised below after cleanup
+        raise
+
+
+def best_effort(fn):
+    try:
+        fn()
+    except Exception:  # pragma: no cover - defensive probe
+        pass

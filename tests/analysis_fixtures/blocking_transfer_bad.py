@@ -1,0 +1,24 @@
+"""known-bad fixture: device->host syncs inside traced step code."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def summarize(metrics):
+    return metrics["loss"].item()  # concretizes a tracer
+
+
+def train_step(state, batch):
+    loss = (batch["x"] ** 2).mean()
+    host_loss = float(loss)  # blocking scalar pull in the hot path
+    arr = np.asarray(batch["x"])  # forces host round-trip
+    got = jax.device_get(loss)
+    return state, host_loss + arr.sum() + got
+
+
+def outer(xs):
+    def body(carry, x):
+        return carry + int(x.sum()), None
+
+    return jax.lax.scan(body, 0, xs)

@@ -1,0 +1,17 @@
+"""known-clean fixture: scalars leave the device OUTSIDE the trace."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def train_loss(params, batch):
+    return jnp.mean((batch["x"] - params["w"]) ** 2)
+
+
+def fit(params, batches):
+    for batch in batches:
+        loss = train_loss(params, batch)
+        # host read AFTER dispatch, outside the traced function: fine
+        print("loss:", float(loss), loss.item())
+    return params

@@ -1,0 +1,28 @@
+"""known-bad fixture: host-varying values inside traced code."""
+
+import os
+import random
+import time
+import uuid
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x):
+    return x + random.random()  # baked per-host constant
+
+
+def train_step(state, batch):
+    seed = time.time()  # traced by name convention
+    tag = uuid.uuid4().int
+    scale = float(os.environ["LOSS_SCALE"])
+    return state, batch["x"] * seed * scale + tag
+
+
+def outer(xs):
+    def body(carry, x):
+        return carry + x * time.monotonic(), None
+
+    return jax.lax.scan(body, jnp.zeros(()), xs)

@@ -1,0 +1,26 @@
+"""known-clean fixture: host values read OUTSIDE traces, passed in."""
+
+import os
+import random
+import time
+
+import jax
+
+
+def make_run_config():
+    # host-side setup: reading the environment here is idiomatic
+    return {
+        "seed": int(os.environ.get("SEED", "0")),
+        "started": time.time(),
+        # seeded => identical on every host
+        "jitter": random.Random(17).random(),
+    }
+
+
+def build_step(cfg):
+    @jax.jit
+    def step(x, rng):
+        # randomness comes in through the functional PRNG, not the host
+        return x + jax.random.normal(rng, x.shape) * cfg["jitter"]
+
+    return step

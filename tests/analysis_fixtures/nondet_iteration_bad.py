@@ -1,0 +1,18 @@
+"""known-bad fixture: hash-ordered iteration feeding SPMD state."""
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_stats(params, skip):
+    stats = {}
+    for name in set(params) - set(skip):  # PYTHONHASHSEED order
+        stats[name] = jax.lax.psum(params[name], "data")
+    return stats
+
+
+def stack_overlap(a, b):
+    out = []
+    for key in a.keys() & b.keys():  # set algebra over keys
+        out.append(jnp.stack([a[key], b[key]]))
+    return out

@@ -1,0 +1,29 @@
+"""known-clean fixture: deterministic iteration orders."""
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_stats(params, skip):
+    stats = {}
+    for name in sorted(set(params) - set(skip)):  # pinned order
+        stats[name] = jax.lax.psum(params[name], "data")
+    return stats
+
+
+def stack_overlap(a, b):
+    out = []
+    for key in sorted(a.keys() & b.keys()):
+        out.append(jnp.stack([a[key], b[key]]))
+    return out
+
+
+def walk_config(cfg):
+    total = 0.0
+    # plain dict iteration is insertion-ordered: deterministic
+    for key in cfg:
+        total += cfg[key]
+    # a set loop whose body is pure host arithmetic is also fine
+    for flag in {"a", "b"}:
+        total += len(flag)
+    return total

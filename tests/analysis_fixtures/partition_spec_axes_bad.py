@@ -1,0 +1,14 @@
+"""known-bad fixture: PartitionSpec axis typos (silently replicate)."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+RULES = [
+    ("embed", P("tenosr", "fsdp")),       # typo'd tensor axis
+    ("mlp", P(("data", "fsp"), None)),    # typo'd fsdp inside a tuple
+]
+
+
+def shard(mesh, x):
+    spec = jax.sharding.PartitionSpec("batch", None)  # not a mesh axis
+    return jax.device_put(x, NamedSharding(mesh, spec))

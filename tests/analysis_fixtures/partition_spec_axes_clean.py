@@ -1,0 +1,21 @@
+"""known-clean fixture: every axis name exists on the mesh."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+RULES = [
+    ("embed", P("tensor", "fsdp")),
+    ("mlp", P(("data", "fsdp"), "tensor")),
+    ("norm", P(None)),
+    ("moe", P("expert", None, "sequence")),
+]
+
+
+def shard(mesh, x, axes):
+    # axis names flowing in as VARIABLES are out of scope (not literals)
+    spec = jax.sharding.PartitionSpec(*axes)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def stage_spec():
+    return P("pipe", ("data", "fsdp"))

@@ -1,0 +1,23 @@
+"""known-bad fixture: jit cache/constant hazards."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+POS_TABLE = jnp.arange(2048)  # module-level device array
+
+
+@jax.jit
+def embed(x):
+    return x + POS_TABLE[: x.shape[-1]]  # closure -> baked constant
+
+
+@functools.partial(jax.jit)
+def pad(x, widths=[1, 1]):  # unhashable default, no static_argnums
+    return jnp.pad(x, widths)
+
+
+@jax.jit
+def scale(x, factors={}):  # unhashable default, no static_argnums
+    return x * factors.get("gain", 1.0)

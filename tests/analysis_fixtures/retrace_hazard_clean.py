@@ -1,0 +1,30 @@
+"""known-clean fixture: arrays passed in, statics declared."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+POS_TABLE = jnp.arange(2048)
+
+
+@jax.jit
+def embed(x, pos_table):  # the table is a traced operand
+    return x + pos_table[: x.shape[-1]]
+
+
+def call_embed(x):
+    # host-side call: closing over the module array here is fine
+    return embed(x, POS_TABLE)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def pad(x, widths=(1, 1)):  # hashable default + declared static
+    return jnp.pad(x, list(widths))
+
+
+@jax.jit
+def shift(x, offset=None):  # None default resolved in-body
+    if offset is None:
+        offset = jnp.zeros(())
+    return x + offset

@@ -1,0 +1,383 @@
+"""fslint (fengshen_tpu.analysis) — rule fixtures, engine mechanics,
+baseline workflow, CLI contract, and the fast-lane whole-package gate.
+
+This file supersedes the old regex lint in test_lint_excepts.py: the
+AST `blanket-except` rule gives the same guarantee (no silent blanket
+handlers anywhere in fengshen_tpu/) without string/comment false
+positives, and the whole-package test below enforces it along with the
+five SPMD rules.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from fengshen_tpu.analysis import (all_rule_ids, check_file, check_paths,
+                                   default_project_root, make_rules)
+from fengshen_tpu.analysis import baseline as baseline_mod
+from fengshen_tpu.analysis.cli import main as fslint_main
+
+REPO = default_project_root()
+PKG = os.path.join(REPO, "fengshen_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+
+RULE_IDS = ("blanket-except", "blocking-transfer", "host-divergence",
+            "nondet-iteration", "partition-spec-axes", "retrace-hazard")
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    path = os.path.join(FIXTURES,
+                        f"{rule_id.replace('-', '_')}_{kind}.py")
+    assert os.path.exists(path), f"missing fixture {path}"
+    return path
+
+
+def test_registry_has_the_shipped_rules():
+    assert set(RULE_IDS) <= set(all_rule_ids())
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_bad_fixture(rule_id):
+    findings = check_file(_fixture(rule_id, "bad"), make_rules(), REPO)
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} found nothing in its known-bad fixture"
+    for f in hits:
+        assert f.line > 0 and f.hint and f.code
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_quiet_on_clean_fixture(rule_id):
+    findings = check_file(_fixture(rule_id, "clean"), make_rules(), REPO)
+    hits = [f for f in findings if f.rule == rule_id]
+    assert not hits, (
+        f"{rule_id} false-positives on idiomatic clean code:\n"
+        + "\n".join(f.render() for f in hits))
+
+
+def test_clean_fixtures_are_fully_clean():
+    """No rule — not just the one under test — fires on a clean
+    fixture: cross-rule noise in the clean set means a precision bug."""
+    for rule_id in RULE_IDS:
+        findings = check_file(_fixture(rule_id, "clean"), make_rules(),
+                              REPO)
+        assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_package_is_clean_under_shipped_baseline():
+    """The fast-lane gate: the full analyzer over fengshen_tpu/ must
+    report zero non-baselined findings on the merged tree."""
+    findings = check_paths([PKG], make_rules(), REPO)
+    entries = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path(REPO))
+    new, _, stale = baseline_mod.split_by_baseline(findings, entries)
+    assert not new, (
+        "fslint found non-baselined findings — fix them, suppress with "
+        "a justified `# fslint: disable=<rule>`, or (legacy only) "
+        "baseline them:\n" + "\n".join(f.render() for f in new))
+    assert not stale, (
+        "stale baseline entries (the finding no longer fires) — run "
+        f"--write-baseline or delete them: {stale}")
+
+
+def _write(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body), encoding="utf-8")
+    return str(path)
+
+
+def test_per_line_suppression(tmp_path):
+    bad = """
+    def f(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """
+    path = _write(tmp_path, "mod.py", bad)
+    assert [f.rule for f in check_file(path, make_rules(), REPO)] == \
+        ["blanket-except"]
+
+    suppressed = bad.replace(
+        "except Exception:",
+        "except Exception:  # fslint: disable=blanket-except")
+    path = _write(tmp_path, "mod2.py", suppressed)
+    assert not check_file(path, make_rules(), REPO)
+
+    # bare `disable` silences every rule on the line
+    suppressed_all = bad.replace("except Exception:",
+                                 "except Exception:  # fslint: disable")
+    path = _write(tmp_path, "mod3.py", suppressed_all)
+    assert not check_file(path, make_rules(), REPO)
+
+    # a different rule id does NOT silence it
+    wrong = bad.replace(
+        "except Exception:",
+        "except Exception:  # fslint: disable=host-divergence")
+    path = _write(tmp_path, "mod4.py", wrong)
+    assert [f.rule for f in check_file(path, make_rules(), REPO)] == \
+        ["blanket-except"]
+
+
+def test_baseline_pins_by_code_not_line(tmp_path):
+    src = """
+    def f(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """
+    path = _write(tmp_path, "legacy.py", src)
+    findings = check_file(path, make_rules(), REPO)
+    assert len(findings) == 1
+
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write_baseline(str(bl), findings)
+    entries = baseline_mod.load_baseline(str(bl))
+    assert entries and "justification" in entries[0]
+
+    # unrelated lines added ABOVE: line number moves, baseline holds
+    shifted = "import os  # noqa: F401\nimport sys  # noqa: F401\n" + \
+        textwrap.dedent(src)
+    (tmp_path / "legacy.py").write_text(shifted, encoding="utf-8")
+    findings2 = check_file(str(tmp_path / "legacy.py"), make_rules(),
+                           REPO)
+    new, baselined, stale = baseline_mod.split_by_baseline(findings2,
+                                                           entries)
+    assert not new and len(baselined) == 1 and not stale
+
+    # the flagged LINE itself changes: finding resurfaces, entry stale
+    edited = textwrap.dedent(src).replace("except Exception:",
+                                          "except BaseException:")
+    (tmp_path / "legacy.py").write_text(edited, encoding="utf-8")
+    findings3 = check_file(str(tmp_path / "legacy.py"), make_rules(),
+                           REPO)
+    new, baselined, stale = baseline_mod.split_by_baseline(findings3,
+                                                           entries)
+    assert len(new) == 1 and not baselined and len(stale) == 1
+
+
+def test_json_output_is_sorted_and_stable(tmp_path, capsys):
+    _write(tmp_path, "b.py", """
+    import random, jax
+
+    @jax.jit
+    def f(x):
+        return x + random.random()
+
+    def g(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """)
+    _write(tmp_path, "a.py", """
+    def h(fn):
+        try:
+            fn()
+        except:
+            pass
+    """)
+    argv = [str(tmp_path), "--json", "--no-baseline"]
+    assert fslint_main(argv) == 1
+    out1 = capsys.readouterr().out
+    assert fslint_main(argv) == 1
+    out2 = capsys.readouterr().out
+    assert out1 == out2, "--json output is not deterministic"
+
+    report = json.loads(out1)
+    keys = [(f["path"], f["line"], f["col"], f["rule"])
+            for f in report["findings"]]
+    assert keys == sorted(keys)
+    assert [f["rule"] for f in report["findings"]] == \
+        ["blanket-except", "host-divergence", "blanket-except"]
+
+
+def test_cli_select_ignore_and_unknown_rule(tmp_path, capsys):
+    path = _write(tmp_path, "m.py", """
+    def f(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """)
+    assert fslint_main([path, "--no-baseline",
+                        "--select", "blanket-except"]) == 1
+    capsys.readouterr()
+    assert fslint_main([path, "--no-baseline",
+                        "--ignore", "blanket-except"]) == 0
+    capsys.readouterr()
+    assert fslint_main([path, "--select", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    path = _write(tmp_path, "m.py", """
+    def f(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """)
+    bl = str(tmp_path / "bl.json")
+    assert fslint_main([path, "--baseline", bl,
+                        "--write-baseline"]) == 0
+    capsys.readouterr()
+    # baselined now: exit 0; byte-stable on rewrite
+    assert fslint_main([path, "--baseline", bl]) == 0
+    first = open(bl, encoding="utf-8").read()
+    assert fslint_main([path, "--baseline", bl,
+                        "--write-baseline"]) == 0
+    assert open(bl, encoding="utf-8").read() == first
+
+
+def test_partial_write_baseline_keeps_other_rules(tmp_path, capsys):
+    """--write-baseline with --select must not delete baseline entries
+    for rules (or paths) it never re-checked."""
+    path = _write(tmp_path, "m.py", """
+    import random, jax
+
+    @jax.jit
+    def f(x):
+        return x + random.random()
+
+    def g(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+    """)
+    bl = str(tmp_path / "bl.json")
+    assert fslint_main([path, "--baseline", bl,
+                        "--write-baseline"]) == 0
+    capsys.readouterr()
+    entries = baseline_mod.load_baseline(bl)
+    assert sorted(e["rule"] for e in entries) == \
+        ["blanket-except", "host-divergence"]
+
+    # rewrite only the blanket-except view: host-divergence must survive
+    assert fslint_main([path, "--baseline", bl, "--select",
+                        "blanket-except", "--write-baseline"]) == 0
+    capsys.readouterr()
+    entries = baseline_mod.load_baseline(bl)
+    assert sorted(e["rule"] for e in entries) == \
+        ["blanket-except", "host-divergence"]
+    # and the full gate still passes against the merged baseline
+    assert fslint_main([path, "--baseline", bl]) == 0
+
+
+def test_blocking_transfer_taint_skips_static_shape_math(tmp_path):
+    """Trace-time-static host math in traced code must NOT fire: config
+    attributes, `.shape` metadata, mesh sizes, annotated scalars."""
+    path = _write(tmp_path, "shapes.py", """
+    import math
+    import jax
+
+    class Cfg:
+        hidden_size = 512
+
+
+    def run(cfg, n_experts: int, mesh):
+        @jax.jit
+        def step(x):
+            b, s, h = x.shape
+            tokens = b * s
+            capacity = max(1, int(math.ceil(tokens / n_experts)))
+            inter = int(2 * 4 * cfg.hidden_size / 3)
+            width = int(mesh.shape["tensor"])
+            loss = (x ** 2).mean()
+            return loss * capacity * inter * width, float(loss)
+
+        return step
+    """)
+    findings = check_file(path, make_rules(), REPO)
+    assert [f.rule for f in findings] == ["blocking-transfer"]
+    assert "float" in findings[0].message
+
+
+def test_nonexistent_path_fails_loudly(tmp_path, capsys):
+    """A typo'd path must not lint nothing and report 'clean' — that
+    would make the CI gate vacuous."""
+    missing = str(tmp_path / "no_such_dir")
+    with pytest.raises(FileNotFoundError):
+        check_paths([missing], make_rules(), REPO)
+    assert fslint_main([missing, "--no-baseline"]) == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+def test_host_divergence_environ_as_call_argument(tmp_path):
+    path = _write(tmp_path, "env.py", """
+    import os
+    import jax
+
+    @jax.jit
+    def f(x):
+        env = dict(os.environ)
+        return x * len(env)
+    """)
+    findings = check_file(path, make_rules(), REPO)
+    assert [f.rule for f in findings] == ["host-divergence"]
+
+
+def test_retrace_hazard_ignores_local_shadowing(tmp_path):
+    path = _write(tmp_path, "shadow.py", """
+    import jax
+    import jax.numpy as jnp
+
+    MASK = jnp.zeros((4,))
+
+    @jax.jit
+    def f(x):
+        MASK = x * 2  # local rebinding, not a closure
+        return MASK
+
+    @jax.jit
+    def g(x):
+        return x + MASK  # the real closure still fires
+    """)
+    findings = check_file(path, make_rules(), REPO)
+    assert len(findings) == 1
+    assert findings[0].rule == "retrace-hazard" and "g" in \
+        findings[0].message
+
+
+def test_blocking_transfer_taints_loop_targets(tmp_path):
+    path = _write(tmp_path, "loop.py", """
+    import jax
+
+    @jax.jit
+    def f(xs):
+        total = 0.0
+        for x in xs:
+            total += x.item()
+        return total
+    """)
+    findings = check_file(path, make_rules(), REPO)
+    assert [f.rule for f in findings] == ["blocking-transfer"]
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    path = _write(tmp_path, "broken.py", "def f(:\n")
+    findings = check_file(path, make_rules(), REPO)
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_traced_context_spans_local_call_chains(tmp_path):
+    """A hazard two calls below a jit entry point is still caught."""
+    path = _write(tmp_path, "chain.py", """
+    import time
+    import jax
+
+    def leaf(x):
+        return x * time.time()
+
+    def mid(x):
+        return leaf(x) + 1
+
+    def run(xs):
+        return jax.jit(mid)(xs)
+    """)
+    findings = check_file(path, make_rules(), REPO)
+    assert [f.rule for f in findings] == ["host-divergence"]
