@@ -4,10 +4,16 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-fast
+.PHONY: lint lint-baseline test test-fast serve-bench
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
+
+# offline serving-throughput microbench (docs/serving.md): continuous
+# batching vs sequential per-request decode, one JSON line on CPU so
+# BENCH rounds can track serving throughput without a healthy relay
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.serving.bench
 
 lint-baseline:
 	$(PY) -m fengshen_tpu.analysis --write-baseline

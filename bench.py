@@ -162,6 +162,65 @@ def _ladder_of_rungs(rungs: list, label: str,
     raise RuntimeError(f"bench[{label}]: every ladder rung OOM")
 
 
+def _emit(row: dict) -> None:
+    """The one JSON metric line. A CPU-fallback run (BENCH_DEGRADED=1)
+    carries `"degraded": true` so the driver never mistakes the rescue
+    number for a hardware measurement."""
+    import os
+
+    if os.environ.get("BENCH_DEGRADED", "0") == "1":
+        row["degraded"] = True
+    print(json.dumps(row))
+
+
+# tiny shapes every mode can run on the CPU backend inside the watchdog
+# budget (mirrors tests/test_bench_smoke.py TINY)
+_CPU_TINY = {"BENCH_SEQ": "64", "BENCH_VOCAB": "256",
+             "BENCH_HIDDEN": "64", "BENCH_INTER": "128",
+             "BENCH_LAYERS": "2", "BENCH_HEADS": "4",
+             "BENCH_ATTN": "dense", "BENCH_SKIP_PROBE": "1"}
+
+
+def _cpu_fallback_env(mode: str) -> dict:
+    env = {"BENCH_CHILD": "1", "JAX_PLATFORMS": "cpu",
+           "BENCH_DEGRADED": "1", **_CPU_TINY}
+    if mode == "large":
+        env.update({"BENCH_LAYERS": "2", "BENCH_BATCH": "1",
+                    "BENCH_KV": "2"})
+    elif mode == "decode":
+        env.update({"BENCH_BATCH": "1", "BENCH_PROMPT": "16",
+                    "BENCH_NEW_TOKENS": "16", "BENCH_DECODE_RUNS": "1"})
+    elif mode == "sharded":
+        env.update({"BENCH_BATCH": "2", "BENCH_FSDP": "1",
+                    "BENCH_TP": "1"})
+    else:
+        env["BENCH_BATCH"] = "2"
+    return env
+
+
+def _run_with_cpu_fallback(spawn=_spawn_rung) -> None:
+    """Top-level rescue rung: run the real bench in a child process;
+    if the child dies of a watchdog abort (wedged relay — five BENCH
+    rounds ended with `parsed: null` exactly this way), retry ONCE on
+    the CPU backend with tiny shapes so the round still emits its one
+    JSON line, flagged degraded. Non-wedge failures propagate untouched
+    (an OOM ladder or real bug must not be masked by a CPU number)."""
+    import os
+    import sys
+
+    _disarm_watchdog()  # the child arms its own
+    rc, err = spawn({"BENCH_CHILD": "1"})
+    if rc == 0:
+        return
+    if "accelerator unresponsive" not in err:
+        sys.exit(rc)
+    mode = os.environ.get("BENCH_CONFIG", "default")
+    print(f"bench: relay wedged; retrying once on the CPU backend "
+          f"(mode={mode}, degraded)", file=sys.stderr, flush=True)
+    rc2, _ = spawn(_cpu_fallback_env(mode))
+    sys.exit(rc2)
+
+
 def _probe_and_arm() -> None:
     """Probe + arm the watchdog — called at the top of every LEAF bench
     path (one that actually touches the accelerator). Ladder parents
@@ -177,6 +236,15 @@ def _probe_and_arm() -> None:
 
 def _main() -> None:
     import os
+
+    # CPU-fallback wrapper: the OUTERMOST invocation runs the real
+    # bench in a child so a wedge (in-process os._exit, no JSON) can
+    # still be rescued with a degraded CPU number. BENCH_CHILD marks
+    # the inner run; BENCH_CPU_FALLBACK=0 opts out (embedders like the
+    # smoke tests set BENCH_CHILD directly to stay in-process).
+    if os.environ.get("BENCH_CHILD") != "1" and \
+            os.environ.get("BENCH_CPU_FALLBACK", "1") == "1":
+        return _run_with_cpu_fallback()
 
     # Arm the watchdog BEFORE anything can touch the backend: mode
     # entry points call jax.devices() for their shape math, and backend
@@ -314,12 +382,12 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     flops_per_token = 6.0 * n_params + flops_attn_term
     peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
     mfu = tps * flops_per_token / (peak * n_dev)
-    print(json.dumps({
+    _emit({
         "metric": metric_name,
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
-    }))
+    })
     return True
 
 
@@ -621,12 +689,12 @@ def _run_decode() -> None:
     # no MFU target for decode (bandwidth-bound); vs_baseline is
     # tokens/sec/chip relative to the training north-star scale (40%
     # MFU train ≈ 43k tok/s at 300M) — a rough single-number context
-    print(json.dumps({
+    _emit({
         "metric": metric,
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / n_dev / 43000.0, 4),
-    }))
+    })
 
 
 def _run(per_chip_batch: int) -> None:
@@ -749,7 +817,7 @@ def _run(per_chip_batch: int) -> None:
     peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
     mfu = tps * flops_per_token / (peak * n_dev)
 
-    print(json.dumps({
+    _emit({
         # lever rows must be distinguishable in the BENCH file (the
         # int8 head changes numerics; LoRA changes what trains)
         "metric": ("llama300m_lora_train_tokens_per_sec_per_chip"
@@ -760,7 +828,7 @@ def _run(per_chip_batch: int) -> None:
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
-    }))
+    })
 
 
 if __name__ == "__main__":

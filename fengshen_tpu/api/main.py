@@ -6,6 +6,14 @@ matching pipeline and exposes `POST /api/<task>`; CORS enabled; run with
 uvicorn. FastAPI/uvicorn are optional deps — gated at call time.
 
     python -m fengshen_tpu.api.main --config text_classification.json
+
+Beyond the reference: `"engine": "continuous"` in the SERVER block
+routes generation tasks through the continuous-batching slot-pool
+engine (`fengshen_tpu/serving/`, docs/serving.md) — many concurrent
+requests share ONE jitted decode step; the optional ENGINE block holds
+`serving.EngineConfig` overrides (num_slots, buckets, max_queue, …).
+Both engines get a warmup request at startup so the first user never
+pays jit compilation; `GET /stats` exposes the engine metrics.
 """
 
 from __future__ import annotations
@@ -14,16 +22,30 @@ import argparse
 import dataclasses
 import importlib
 import json
+import time
 from typing import Any, Optional
 
 
 @dataclasses.dataclass
 class ServerConfig:
-    """Reference: fengshen/API/utils.py config dataclasses."""
+    """Reference: fengshen/API/utils.py config dataclasses, plus the
+    serving-engine selection ("simple" = one pipeline call per POST;
+    "continuous" = slot-pool continuous batching)."""
 
     host: str = "0.0.0.0"
     port: int = 8000
     log_level: str = "info"
+    engine: str = "simple"
+    warmup: bool = True
+    request_timeout_s: float = 120.0
+    engine_args: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.engine not in ("simple", "continuous"):
+            # a typo must fail at startup, not silently serve the
+            # batch-1 legacy path under a continuous-looking config
+            raise ValueError(f"unknown engine {self.engine!r}; expected "
+                             "'simple' or 'continuous'")
 
 
 @dataclasses.dataclass
@@ -37,6 +59,7 @@ def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
     with open(path) as f:
         raw = json.load(f)
     server = ServerConfig(**raw.get("SERVER", {}))
+    server.engine_args = dict(raw.get("ENGINE", {}))
     pipeline = PipelineConfig(
         task=raw.get("PIPELINE", {}).get("task", "text_classification"),
         model=raw.get("PIPELINE", {}).get("model"),
@@ -45,17 +68,103 @@ def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
     return server, pipeline
 
 
-def build_app(pipeline_cfg: PipelineConfig, pipeline=None):
+def _accepts_max_new_tokens(pipeline) -> bool:
+    """Only generation pipelines take the per-request cap — forwarding
+    it to a classification pipeline would turn a client field into a
+    TypeError 500."""
+    import inspect
+    try:
+        return "max_new_tokens" in inspect.signature(pipeline).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def warmup_pipeline(pipeline, task: str) -> Optional[float]:
+    """Issue one warmup request through the legacy path so the first
+    user request doesn't pay jit compilation; returns seconds (None on
+    failure — a broken warmup must not keep the server down)."""
+    t0 = time.perf_counter()
+    try:
+        pipeline("warmup")
+    except Exception as e:  # noqa: BLE001 — warmup is best-effort
+        print(f"[serving] warmup request failed ({e}); first real "
+              "request will compile", flush=True)
+        return None
+    dt = time.perf_counter() - t0
+    print(f"[serving] warmup request for '{task}' compiled+ran in "
+          f"{dt:.1f}s", flush=True)
+    return dt
+
+
+def start_continuous_engine(pipeline, engine_args: dict,
+                            log=None):
+    """Build, warm up (compile all prefill buckets + the decode step,
+    logging the time), and start the continuous-batching engine."""
+    from fengshen_tpu.serving import (ContinuousBatchingEngine,
+                                      EngineConfig)
+    if not hasattr(pipeline, "engine_config_kwargs"):
+        raise ValueError(
+            "engine 'continuous' needs a generation pipeline exposing "
+            "module/params/engine_config_kwargs (task "
+            "'text_generation'), not a per-call classification "
+            "pipeline")
+    kwargs = {**pipeline.engine_config_kwargs(), **engine_args}
+    engine = ContinuousBatchingEngine(
+        pipeline.module, pipeline.params, EngineConfig(**kwargs),
+        log=log)
+    dt = engine.warmup()
+    print(f"[serving] continuous engine warmup "
+          f"(buckets={list(engine.ladder.buckets)}, "
+          f"num_slots={engine.config.num_slots}) compiled in {dt:.1f}s",
+          flush=True)
+    engine.start()
+    return engine
+
+
+def _engine_generate(engine, pipeline, req: dict,
+                     timeout_s: float) -> tuple[int, dict]:
+    """Submit one HTTP request to the engine; returns (status, body).
+    Backpressure maps to HTTP: queue full → 429, prompt too long → 413,
+    engine timeout/eviction → 503."""
+    from fengshen_tpu.serving import FINISHED, PromptTooLong, QueueFull
+    try:
+        request = engine.submit(
+            pipeline.encode(req["input_text"]),
+            max_new_tokens=req.get("max_new_tokens"))
+    except QueueFull as e:
+        return 429, {"error": str(e)}
+    except PromptTooLong as e:
+        return 413, {"error": str(e)}
+    except (ValueError, TypeError) as e:
+        # bad request payload (unencodable input, max_new_tokens < 1)
+        return 422, {"error": str(e)}
+    if not request.wait(timeout=timeout_s):
+        engine.cancel(request.request_id)
+        # the request may have completed in the wait→cancel window; a
+        # finished result must not be discarded as a timeout
+        if request.state != FINISHED:
+            return 503, {"error":
+                         f"request timed out after {timeout_s}s"}
+    if request.state != FINISHED:
+        return 503, {"error": f"request {request.state} "
+                              f"({request.finish_reason})"}
+    return 200, {"result": pipeline.decode(request.tokens),
+                 "request_id": request.request_id,
+                 "ttft_s": request.ttft_s,
+                 "finish_reason": request.finish_reason}
+
+
+def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
+              server_cfg: Optional[ServerConfig] = None, engine=None):
     """Create the FastAPI app around a pipeline instance."""
     from fastapi import FastAPI
     from fastapi.middleware.cors import CORSMiddleware
+    from fastapi.responses import JSONResponse
     from pydantic import BaseModel
 
+    server_cfg = server_cfg or ServerConfig()
     if pipeline is None:
-        module = importlib.import_module(
-            f"fengshen_tpu.pipelines.{pipeline_cfg.task}")
-        pipeline = module.Pipeline(args=None, model=pipeline_cfg.model,
-                                   **pipeline_cfg.pipeline_args)
+        pipeline = _resolve_pipeline(pipeline_cfg)
 
     app = FastAPI()
     app.add_middleware(CORSMiddleware, allow_origins=["*"],
@@ -63,14 +172,30 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None):
 
     class Request(BaseModel):
         input_text: str
+        max_new_tokens: Optional[int] = None
 
     @app.post(f"/api/{pipeline_cfg.task}")
     def run(req: Request) -> Any:
+        if engine is not None:
+            code, body = _engine_generate(
+                engine, pipeline, req.model_dump(),
+                server_cfg.request_timeout_s)
+            return JSONResponse(status_code=code, content=body)
+        if req.max_new_tokens is not None and \
+                _accepts_max_new_tokens(pipeline):
+            return {"result": pipeline(req.input_text,
+                                       max_new_tokens=req.max_new_tokens)}
         return {"result": pipeline(req.input_text)}
 
     @app.get("/healthz")
     def healthz():
         return {"status": "ok", "task": pipeline_cfg.task}
+
+    @app.get("/stats")
+    def stats():
+        if engine is not None:
+            return engine.stats()
+        return {"engine": "simple", "task": pipeline_cfg.task}
 
     return app
 
@@ -83,11 +208,12 @@ def _resolve_pipeline(pipeline_cfg: PipelineConfig):
 
 
 def build_stdlib_server(server_cfg: ServerConfig,
-                        pipeline_cfg: PipelineConfig, pipeline=None):
+                        pipeline_cfg: PipelineConfig, pipeline=None,
+                        engine=None):
     """Dependency-free fallback server (http.server) exposing the SAME
     surface as the FastAPI app: `POST /api/<task>` with
-    `{"input_text": ...}` and `GET /healthz`. FastAPI/uvicorn stay the
-    production path; this keeps the REST surface runnable (and
+    `{"input_text": ...}`, `GET /healthz`, `GET /stats`. FastAPI/uvicorn
+    stay the production path; this keeps the REST surface runnable (and
     testable) where they are not installed."""
     import http.server
 
@@ -112,6 +238,12 @@ def build_stdlib_server(server_cfg: ServerConfig,
             if self.path == "/healthz":
                 self._send(200, {"status": "ok",
                                  "task": pipeline_cfg.task})
+            elif self.path == "/stats":
+                if engine is not None:
+                    self._send(200, engine.stats())
+                else:
+                    self._send(200, {"engine": "simple",
+                                     "task": pipeline_cfg.task})
             else:
                 self._send(404, {"error": "not found"})
 
@@ -131,7 +263,21 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 self._send(422, {"error": "input_text required"})
                 return
             try:
-                self._send(200, {"result": pipeline(req["input_text"])})
+                if engine is not None:
+                    code, body = _engine_generate(
+                        engine, pipeline, req,
+                        server_cfg.request_timeout_s)
+                    self._send(code, body)
+                elif req.get("max_new_tokens") is not None and \
+                        _accepts_max_new_tokens(pipeline):
+                    # per-request cap on the legacy path too (only
+                    # generation pipelines accept it)
+                    self._send(200, {"result": pipeline(
+                        req["input_text"],
+                        max_new_tokens=req["max_new_tokens"])})
+                else:
+                    self._send(200,
+                               {"result": pipeline(req["input_text"])})
             except Exception as e:  # noqa: BLE001 — surface, don't die
                 self._send(500, {"error": str(e)[:500]})
 
@@ -144,11 +290,21 @@ def main(argv=None) -> None:
     parser.add_argument("--config", required=True, type=str)
     args = parser.parse_args(argv)
     server_cfg, pipeline_cfg = load_config(args.config)
+    pipeline = _resolve_pipeline(pipeline_cfg)
+    engine = None
+    if server_cfg.engine == "continuous":
+        # engine warmup compiles every prefill bucket + the decode step
+        engine = start_continuous_engine(pipeline,
+                                         server_cfg.engine_args)
+    elif server_cfg.warmup:
+        warmup_pipeline(pipeline, pipeline_cfg.task)
     try:
-        app = build_app(pipeline_cfg)
+        app = build_app(pipeline_cfg, pipeline=pipeline,
+                        server_cfg=server_cfg, engine=engine)
         import uvicorn
     except ModuleNotFoundError:
-        server = build_stdlib_server(server_cfg, pipeline_cfg)
+        server = build_stdlib_server(server_cfg, pipeline_cfg,
+                                     pipeline=pipeline, engine=engine)
         print(f"fastapi/uvicorn not installed — stdlib server on "
               f"{server_cfg.host}:{server_cfg.port}", flush=True)
         server.serve_forever()

@@ -194,17 +194,32 @@ class LlamaAttention(nn.Module):
                 (batch, seq, max_len))
             return k, v, valid[:, :, :seq]
         idx = cache_index.value
-        k_all = jax.lax.dynamic_update_slice(cached_k.value, k,
-                                             (0, idx, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(cached_v.value, v,
-                                             (0, idx, 0, 0))
-        cached_k.value, cached_v.value = k_all, v_all
-        cache_index.value = idx + seq
-        # per-query causal validity: query t (global position idx+t) sees
-        # cache positions ≤ idx+t  → [B, Sq, max_len]
-        q_pos = idx + jnp.arange(seq)
-        valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
-        valid = jnp.broadcast_to(valid[None], (batch, seq, max_len))
+        if idx.ndim == 1:
+            # slot-pool decode (fengshen_tpu/serving): a [B] cache_index
+            # gives every lane its own write position, so concurrently
+            # served requests at different progress share ONE jitted step
+            k_all = jax.vmap(lambda c, u, i: jax.lax.dynamic_update_slice(
+                c, u, (i, 0, 0)))(cached_k.value, k, idx)
+            v_all = jax.vmap(lambda c, u, i: jax.lax.dynamic_update_slice(
+                c, u, (i, 0, 0)))(cached_v.value, v, idx)
+            cached_k.value, cached_v.value = k_all, v_all
+            cache_index.value = idx + seq
+            # per-lane causal validity: lane b's query t (position
+            # idx[b]+t) sees cache positions ≤ idx[b]+t
+            q_pos = idx[:, None] + jnp.arange(seq)[None, :]
+            valid = jnp.arange(max_len)[None, None, :] <= q_pos[:, :, None]
+        else:
+            k_all = jax.lax.dynamic_update_slice(cached_k.value, k,
+                                                 (0, idx, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(cached_v.value, v,
+                                                 (0, idx, 0, 0))
+            cached_k.value, cached_v.value = k_all, v_all
+            cache_index.value = idx + seq
+            # per-query causal validity: query t (global position idx+t)
+            # sees cache positions ≤ idx+t  → [B, Sq, max_len]
+            q_pos = idx + jnp.arange(seq)
+            valid = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+            valid = jnp.broadcast_to(valid[None], (batch, seq, max_len))
         if attention_mask is not None:
             # left-padded batches mask out pad positions of the prompt
             pad = jnp.ones((attention_mask.shape[0],

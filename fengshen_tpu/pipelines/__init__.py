@@ -8,4 +8,5 @@ Each submodule exposes a ``Pipeline`` class with the reference's contract:
 
 #: registered task names — kept in sync with the submodules
 TASKS: list[str] = ["text_classification", "sequence_tagging",
-                    "multiplechoice", "information_extraction", "tcbert"]
+                    "multiplechoice", "information_extraction", "tcbert",
+                    "text_generation"]

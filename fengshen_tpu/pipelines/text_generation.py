@@ -1,0 +1,136 @@
+"""Text-generation pipeline: the generation-side `Pipeline` surface and
+the hook the continuous-batching serving engine plugs into.
+
+Follows the repo's pipeline contract (`__init__(args, model=...)`,
+`__call__(text)` — reference: fengshen/pipelines/text_classification.py
+:134-234) for a decoder-only causal LM. `__call__` is the LEGACY
+serving path: one batch-1 `utils.generate.generate` per call. The
+continuous engine (`fengshen_tpu/serving/`) instead drives the same
+model/params through its slot pool; this pipeline supplies what the
+engine needs — `module`, `params`, `encode`/`decode`, and the
+generation defaults (`engine_config_kwargs`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Pipeline:
+    """Causal-LM generation pipeline (LLaMA family).
+
+    Either pass `model` (an HF llama checkpoint directory — loaded via
+    `models.llama.convert.load_hf_pretrained` + AutoTokenizer, the
+    ziya_inference idiom) or inject `module`/`params`/`tokenizer`
+    directly (tests, custom checkpoints). The tokenizer needs
+    `encode(text) -> list[int]` / `decode(ids) -> str` plus
+    `eos_token_id`/`pad_token_id` attributes.
+    """
+
+    task = "text_generation"
+
+    def __init__(self, args: Any = None, model: Optional[str] = None,
+                 module: Any = None, params: Any = None,
+                 tokenizer: Any = None,
+                 max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 repetition_penalty: float = 1.0,
+                 min_length: int = 0, seed: int = 0):
+        if args is not None:
+            # the fengshen-pipeline CLI parses our
+            # add_pipeline_specific_args flags into `args`
+            max_new_tokens = getattr(args, "max_new_tokens",
+                                     max_new_tokens)
+            do_sample = getattr(args, "do_sample", do_sample)
+            temperature = getattr(args, "temperature", temperature)
+            top_k = getattr(args, "top_k", top_k)
+            top_p = getattr(args, "top_p", top_p)
+        if module is None:
+            if model is None:
+                raise ValueError(
+                    "text_generation needs either model=<hf checkpoint "
+                    "dir> or an injected module/params/tokenizer")
+            from transformers import AutoTokenizer
+
+            from fengshen_tpu.models.llama import LlamaForCausalLM
+            from fengshen_tpu.models.llama.convert import \
+                load_hf_pretrained
+            config, params = load_hf_pretrained(model)
+            module = LlamaForCausalLM(config)
+            if tokenizer is None:
+                tokenizer = AutoTokenizer.from_pretrained(model)
+        if params is None:
+            raise ValueError("params are required alongside module")
+        self.module = module
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id if eos_token_id is not None \
+            else getattr(tokenizer, "eos_token_id", None)
+        pad = pad_token_id if pad_token_id is not None \
+            else getattr(tokenizer, "pad_token_id", None)
+        self.pad_token_id = 0 if pad is None else int(pad)
+        self.sample_kw = dict(do_sample=do_sample,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p,
+                              repetition_penalty=repetition_penalty,
+                              min_length=min_length)
+        self.seed = seed
+        self._n_calls = 0
+
+    # ---- engine integration -----------------------------------------
+
+    def encode(self, text: str) -> np.ndarray:
+        return np.asarray(self.tokenizer.encode(text), np.int32)
+
+    def decode(self, token_ids) -> str:
+        ids = [int(t) for t in token_ids]
+        if self.eos_token_id is not None and self.eos_token_id in ids:
+            ids = ids[:ids.index(self.eos_token_id)]
+        return self.tokenizer.decode(ids)
+
+    def engine_config_kwargs(self) -> dict:
+        """Generation defaults for `serving.EngineConfig(**...)`."""
+        return dict(max_new_tokens=self.max_new_tokens,
+                    eos_token_id=self.eos_token_id,
+                    pad_token_id=self.pad_token_id, seed=self.seed,
+                    **self.sample_kw)
+
+    # ---- legacy one-request path ------------------------------------
+
+    def __call__(self, input_text: str,
+                 max_new_tokens: Optional[int] = None) -> str:
+        ids = self.encode(input_text)
+        out = self.generate_ids(
+            ids, max_new_tokens or self.max_new_tokens)
+        return self.decode(out)
+
+    def generate_ids(self, ids: np.ndarray,
+                     max_new_tokens: int) -> list:
+        """Batch-1 sequential decode (the legacy engine)."""
+        from fengshen_tpu.utils.generate import generate
+        self._n_calls += 1
+        out = generate(
+            self.module, self.params, jnp.asarray(ids)[None],
+            max_new_tokens=max_new_tokens,
+            eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id,
+            rng=jax.random.PRNGKey(self.seed + self._n_calls),
+            **self.sample_kw)
+        return np.asarray(out)[0, len(ids):].tolist()
+
+    @staticmethod
+    def add_pipeline_specific_args(parser):
+        parser.add_argument("--max_new_tokens", default=64, type=int)
+        parser.add_argument("--do_sample", action="store_true")
+        parser.add_argument("--temperature", default=1.0, type=float)
+        parser.add_argument("--top_k", default=0, type=int)
+        parser.add_argument("--top_p", default=0.0, type=float)
+        return parser

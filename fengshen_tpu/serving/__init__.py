@@ -1,0 +1,24 @@
+"""Continuous-batching serving engine (docs/serving.md).
+
+Multiplexes many concurrent generation requests onto ONE jitted decode
+step over a fixed slot pool — the serving-layer counterpart of
+`utils.generate`'s TPU-native scan decode.
+"""
+
+from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
+from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
+                                        reset_free_slots, rollback_slots)
+from fengshen_tpu.serving.engine import (CANCELLED, EXPIRED, FINISHED,
+                                         QUEUED, REJECTED, RUNNING,
+                                         ContinuousBatchingEngine,
+                                         EngineConfig, PromptTooLong,
+                                         QueueFull, Request)
+from fengshen_tpu.serving.metrics import EngineMetrics
+
+__all__ = [
+    "BucketLadder", "DEFAULT_BUCKETS", "ContinuousBatchingEngine",
+    "EngineConfig", "EngineMetrics", "PromptTooLong", "QueueFull",
+    "Request", "assign_slot", "init_slot_cache", "reset_free_slots",
+    "rollback_slots", "QUEUED", "RUNNING", "FINISHED", "CANCELLED",
+    "EXPIRED", "REJECTED",
+]

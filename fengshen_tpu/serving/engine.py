@@ -1,0 +1,551 @@
+"""Continuous-batching inference engine: ONE jitted decode for all
+in-flight requests.
+
+`api/main.py`'s legacy path runs one pipeline call per POST — a decode
+batch of 1, so concurrent users serialize behind each other and the
+chip idles between dispatches. This engine multiplexes many requests
+onto a fixed pool of `num_slots` KV-cache lanes:
+
+- admission: queued prompts are LEFT-padded to a bucket
+  (`buckets.BucketLadder`), prefilled batch-1 through the model's own
+  cache machinery (`utils.generate._prefill_cache` — reused, not
+  forked), and scattered into a free lane (`cache.assign_slot`);
+- decode: every tick runs ONE jitted step over all `num_slots` lanes —
+  per-lane `cache_index` vectors (modeling_llama's vector-index path)
+  let lanes sit at different write positions, so the step never
+  recompiles as requests come and go;
+- reclaim: a finished/cancelled/expired lane is immediately handed to
+  the next queued request — no drain barrier, no recompilation;
+- backpressure: a bounded admission queue; `submit` raises `QueueFull`
+  (HTTP 429 at the API layer) / `PromptTooLong` when the ladder can't
+  hold the prompt.
+
+Greedy decode is TOKEN-IDENTICAL to sequential
+`utils.generate.generate` on the bucket-padded prompt (the parity test
+pins it): same prefill, same logits controls
+(`utils.generate.apply_logits_controls`), same selection — only the
+physical cache layout is pooled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
+from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
+                                        reset_free_slots)
+from fengshen_tpu.serving.metrics import EngineMetrics
+from fengshen_tpu.utils.generate import (_controls_active, _prefill_cache,
+                                         _select_token,
+                                         apply_logits_controls)
+
+
+class QueueFull(Exception):
+    """Admission queue at `max_queue` — API layer maps this to 429."""
+
+
+class PromptTooLong(Exception):
+    """Prompt outgrows the bucket ladder or the cache headroom."""
+
+
+# request lifecycle states
+QUEUED, RUNNING, FINISHED, CANCELLED, EXPIRED, REJECTED = (
+    "queued", "running", "finished", "cancelled", "expired", "rejected")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Tuning knobs; see docs/serving.md for sizing guidance."""
+
+    num_slots: int = 8
+    buckets: Sequence[int] = DEFAULT_BUCKETS
+    max_new_tokens: int = 128
+    max_queue: int = 64
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    repetition_penalty: float = 1.0
+    no_repeat_ngram_size: int = 0   # 0 or 1 (see __post_init__)
+    min_length: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if self.max_queue < 1:
+            # admission always passes through the queue, so 0 would
+            # reject every request forever while all slots sit idle
+            raise ValueError("max_queue must be >= 1")
+        if self.no_repeat_ngram_size > 1:
+            # the >1 processor slices history at a SCALAR cursor
+            # (apply_logits_controls dynamic_slice); the pool decodes
+            # every lane at a different cursor, so only the
+            # ban-all-repeats size-1 form vectorizes
+            raise ValueError(
+                "the continuous engine supports no_repeat_ngram_size of "
+                "0 or 1 only (per-slot cursors cannot drive the n>1 "
+                "window processor)")
+
+
+class Request:
+    """One in-flight generation; host-side bookkeeping only."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 request_id: Optional[str], deadline: Optional[float],
+                 submit_time: float):
+        self.request_id = request_id if request_id is not None else \
+            f"req-{next(Request._ids)}"
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline            # engine-clock absolute time
+        self.submit_time = submit_time
+        self.state = QUEUED
+        self.tokens: list[int] = []         # generated tokens (eos incl.)
+        self.ttft_s: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+        self.slot: Optional[int] = None
+        self._cancel = False
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request leaves the engine (finished /
+        cancelled / expired). True when it did within `timeout`."""
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool continuous batching over one decoder-only model.
+
+    `model` must use the repo's preallocated flax cache contract
+    (cached_key/cached_value/cache_index — the LLaMA family). `clock`
+    is injectable for deterministic deadline tests.
+    """
+
+    def __init__(self, model: Any, params: Any, config: EngineConfig,
+                 log: Optional[Callable[[dict], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.model = model
+        self.params = params
+        self.config = config
+        self.ladder = BucketLadder(config.buckets)
+        self.metrics = EngineMetrics()
+        self._log = log or (lambda entry: None)
+        self._clock = clock
+        self.max_len = int(model.config.max_position_embeddings)
+        if self.ladder.buckets[0] + 1 > self.max_len:
+            raise ValueError(
+                f"smallest bucket {self.ladder.buckets[0]} leaves no "
+                f"decode headroom in max_position_embeddings="
+                f"{self.max_len}")
+
+        S, L = config.num_slots, self.max_len
+        self._cache = init_slot_cache(model, S)
+        self._history = jnp.zeros((S, L), jnp.int32)
+        self._mask = jnp.zeros((S, L), jnp.int32)
+        # host-side per-slot state (authoritative for scheduling)
+        self._last_tok = np.zeros((S,), np.int32)
+        self._pos = np.zeros((S,), np.int32)    # logical position of last_tok
+        self._phys = np.zeros((S,), np.int32)   # physical cache cursor
+        self._active = np.zeros((S,), bool)
+        self._slot_req: list[Optional[Request]] = [None] * S
+
+        self._queue: deque[Request] = deque()
+        self._cv = threading.Condition()
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._zero_key = jax.random.PRNGKey(0)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_flag = False
+
+        cfg = config
+        control_kw = dict(repetition_penalty=cfg.repetition_penalty,
+                          no_repeat_ngram_size=cfg.no_repeat_ngram_size,
+                          min_length=cfg.min_length,
+                          eos_token_id=cfg.eos_token_id)
+        controls_on = _controls_active(cfg.repetition_penalty,
+                                       cfg.no_repeat_ngram_size,
+                                       cfg.min_length)
+
+        def prefill_fn(params, ids, mask, rng):
+            # identical math to generate()'s prompt phase: mask-cumsum
+            # positions, _prefill_cache, controls on the last position
+            position_ids = jnp.clip(mask.cumsum(-1) - 1, 0, None)
+            logits, cache = _prefill_cache(model, params, ids, mask,
+                                           position_ids)
+            step_logits = logits[:, -1]
+            if controls_on:
+                step_logits = apply_logits_controls(
+                    step_logits, ids, jnp.int32(ids.shape[1]),
+                    history_mask=mask, **control_kw)
+            tok = _select_token(step_logits, rng, cfg.do_sample,
+                                cfg.temperature, cfg.top_k, cfg.top_p)
+            return cache, tok.astype(jnp.int32)
+
+        def assign_fn(cache, history, mask, primed, prompt_row, mask_row,
+                      slot):
+            cache = assign_slot(cache, primed, slot)
+            history = history.at[slot].set(prompt_row)
+            mask = mask.at[slot].set(mask_row)
+            return cache, history, mask
+
+        def decode_fn(params, cache, history, mask, tokens, pos, phys,
+                      active, rng):
+            n = tokens.shape[0]
+            # the token selected last tick enters the history at its
+            # physical cursor BEFORE the forward (its K/V are written at
+            # the same position by the cache update)
+            history = history.at[jnp.arange(n), phys].set(tokens)
+            logits, mutated = model.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                attention_mask=mask, position_ids=pos[:, None],
+                init_cache=True, mutable=["cache"])
+            cache = reset_free_slots(mutated["cache"], active)
+            step_logits = logits[:, -1]
+            if controls_on:
+                step_logits = apply_logits_controls(
+                    step_logits, history, (phys + 1)[:, None],
+                    history_mask=mask, **control_kw)
+            nxt = _select_token(step_logits, rng, cfg.do_sample,
+                                cfg.temperature, cfg.top_k, cfg.top_p)
+            nxt = jnp.where(active, nxt, cfg.pad_token_id)
+            return cache, history, nxt.astype(jnp.int32)
+
+        # one compile per bucket width / exactly one for decode — the
+        # parity + compile-count tests pin this via _cache_size().
+        # Donation keeps the pool cache in place across ticks (a
+        # num_slots × max_len KV pool re-copied every tick would cost
+        # more than the decode itself); every donated arg is reassigned
+        # from the outputs wherever these are called.
+        self._prefill_jit = jax.jit(prefill_fn)
+        self._assign_jit = jax.jit(assign_fn, donate_argnums=(0, 1, 2))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
+
+    # ---- submission side -------------------------------------------
+
+    def submit(self, input_ids, max_new_tokens: Optional[int] = None,
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Queue a prompt. Raises QueueFull (backpressure) or
+        PromptTooLong (no bucket / no cache headroom). `deadline_s` is
+        seconds from now; an expired request frees its slot and
+        finishes with reason "deadline"."""
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            # a bad request field, not a too-long prompt — the API
+            # layer maps this to 422, not 413
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        bucket = self.ladder.bucket_for(len(ids))
+        if bucket is None:
+            self.metrics.count("rejected_prompt_too_long")
+            self._log({"event": "serving_reject", "reason":
+                       "prompt_too_long", "prompt_tokens": len(ids)})
+            raise PromptTooLong(
+                f"prompt of {len(ids)} tokens exceeds the largest "
+                f"bucket {self.ladder.max_bucket}")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.config.max_new_tokens)
+        # the lane must hold bucket + generated tokens
+        max_new = min(max_new, self.max_len - bucket)
+        if max_new < 1:
+            self.metrics.count("rejected_prompt_too_long")
+            self._log({"event": "serving_reject", "reason":
+                       "prompt_too_long", "prompt_tokens": len(ids)})
+            raise PromptTooLong(
+                f"bucket {bucket} leaves no decode headroom in "
+                f"max_position_embeddings={self.max_len}")
+        now = self._clock()
+        req = Request(ids, max_new, request_id,
+                      None if deadline_s is None else now + deadline_s,
+                      now)
+        with self._cv:
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.count("rejected_queue_full")
+                self._log({"event": "serving_reject",
+                           "reason": "queue_full",
+                           "queue_depth": len(self._queue)})
+                req.state = REJECTED
+                raise QueueFull(
+                    f"admission queue at max_queue="
+                    f"{self.config.max_queue}")
+            self._queue.append(req)
+            self.metrics.count("admitted")
+            self._log({"event": "serving_admit",
+                       "request_id": req.request_id, "bucket": bucket,
+                       "queue_depth": len(self._queue)})
+            self._cv.notify_all()
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or running request; a running one frees its
+        slot at the next tick. False when the id is unknown/done."""
+        with self._cv:
+            for req in self._queue:
+                if req.request_id == request_id:
+                    self._queue.remove(req)
+                    self._finish(req, CANCELLED, "cancelled")
+                    return True
+            for req in self._slot_req:
+                if req is not None and req.request_id == request_id:
+                    req._cancel = True
+                    return True
+        return False
+
+    # ---- engine loop -----------------------------------------------
+
+    def step(self) -> int:
+        """One tick: reclaim → admit → one jitted decode over the pool.
+        Returns the number of lanes still active after the tick."""
+        with self._cv:
+            return self._step_locked()
+
+    def _step_locked(self) -> int:
+        now = self._clock()
+        # a queued request whose deadline already passed will never be
+        # worth prefilling — drop it while it waits, not just at pop
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        for req in expired:
+            self._queue.remove(req)
+            self._finish(req, EXPIRED, "deadline")
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if req._cancel:
+                self._release(i, CANCELLED, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._release(i, EXPIRED, "deadline")
+        self._admit()
+        active_idx = np.nonzero(self._active)[0]
+        if len(active_idx) == 0:
+            return 0
+        if self.config.do_sample:
+            self._rng, key = jax.random.split(self._rng)
+        else:
+            key = self._zero_key
+        t0 = time.perf_counter()
+        self._cache, self._history, nxt = self._decode_jit(
+            self.params, self._cache, self._history, self._mask,
+            self._last_tok, self._pos, self._phys, self._active, key)
+        # host sync: the scheduler needs the tokens (copy — the device
+        # view is read-only and lanes are overwritten on admission)
+        nxt = np.array(nxt)
+        dt = time.perf_counter() - t0
+        self.metrics.record_tick(len(active_idx), self.config.num_slots,
+                                 dt)
+        self._last_tok = nxt
+        self._pos[self._active] += 1
+        self._phys[self._active] += 1
+        for i in active_idx:
+            req = self._slot_req[i]
+            tok = int(nxt[i])
+            req.tokens.append(tok)
+            if self.config.eos_token_id is not None and \
+                    tok == self.config.eos_token_id:
+                self._release(i, FINISHED, "eos")
+            elif len(req.tokens) >= req.max_new_tokens:
+                self._release(i, FINISHED, "length")
+        return int(self._active.sum())
+
+    def _admit(self) -> None:
+        for slot in range(self.config.num_slots):
+            if self._active[slot] or not self._queue:
+                continue
+            req = self._queue.popleft()
+            now = self._clock()
+            if req._cancel:
+                self._finish(req, CANCELLED, "cancelled")
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, EXPIRED, "deadline")
+                continue
+            bucket = self.ladder.bucket_for(len(req.prompt))
+            row, mask_row = self.ladder.pad_prompt(
+                req.prompt, bucket, self.config.pad_token_id)
+            if self.config.do_sample:
+                self._rng, key = jax.random.split(self._rng)
+            else:
+                key = self._zero_key
+            primed, tok = self._prefill_jit(
+                self.params, row[None], mask_row[None], key)
+            tok = int(np.asarray(tok)[0])
+            self.metrics.record_prefill(bucket)
+            req.ttft_s = self._clock() - req.submit_time
+            self.metrics.record_ttft(req.ttft_s)
+            req.tokens.append(tok)
+            if self.config.eos_token_id is not None and \
+                    tok == self.config.eos_token_id:
+                self._finish(req, FINISHED, "eos")
+                continue
+            if req.max_new_tokens <= 1:
+                self._finish(req, FINISHED, "length")
+                continue
+            # history/mask lanes: padded prompt, mask open from the
+            # bucket edge on (causal validity bounds the open tail)
+            hist_row = np.zeros((self.max_len,), np.int32)
+            hist_row[:bucket] = row
+            full_mask = np.ones((self.max_len,), np.int32)
+            full_mask[:bucket] = mask_row
+            self._cache, self._history, self._mask = self._assign_jit(
+                self._cache, self._history, self._mask, primed,
+                hist_row, full_mask, np.int32(slot))
+            req.state = RUNNING
+            req.slot = slot
+            self._slot_req[slot] = req
+            self._active[slot] = True
+            self._last_tok[slot] = tok
+            self._pos[slot] = len(req.prompt)   # logical pos of last_tok
+            self._phys[slot] = bucket           # physical cursor
+        return
+
+    def _release(self, slot: int, state: str, reason: str) -> None:
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._phys[slot] = 0
+        self._pos[slot] = 0
+        self._finish(req, state, reason)
+
+    def _finish(self, req: Request, state: str, reason: str) -> None:
+        req.state = state
+        req.finish_reason = reason
+        req.slot = None
+        if state == FINISHED:
+            self.metrics.count("completed")
+        elif state == CANCELLED:
+            self.metrics.count("cancelled")
+        elif state == EXPIRED:
+            self.metrics.count("expired")
+        self.metrics.record_latency(self._clock() - req.submit_time)
+        self._log({"event": "serving_finish",
+                   "request_id": req.request_id, "reason": reason,
+                   "tokens": len(req.tokens), "ttft_s": req.ttft_s})
+        req._done.set()
+
+    # ---- drivers ----------------------------------------------------
+
+    def run_until_idle(self, max_ticks: int = 1_000_000) -> None:
+        """Offline driver: tick until queue and pool are empty."""
+        for _ in range(max_ticks):
+            with self._cv:
+                if not self._queue and not self._active.any():
+                    return
+                self._step_locked()
+        raise RuntimeError(f"engine still busy after {max_ticks} ticks")
+
+    def generate_all(self, prompts,
+                     max_new_tokens: Optional[int] = None) -> list:
+        """Submit every prompt, drain, return per-prompt token lists."""
+        reqs = [self.submit(p, max_new_tokens) for p in prompts]
+        self.run_until_idle()
+        return [r.tokens for r in reqs]
+
+    def start(self) -> None:
+        """Serve in a daemon thread (the API layer's mode): ticks run
+        whenever work exists, sleep on the condition var otherwise."""
+        if self._thread is not None:
+            return
+        self._stop_flag = False
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _serve_loop(self) -> None:
+        while not self._stop_flag:
+            try:
+                n = self.step()
+            except Exception as e:  # noqa: BLE001 — a dead serve
+                # thread would leave every waiter blocked for its full
+                # timeout and the server accepting traffic against a
+                # wedged engine; fail the in-flight work loudly and
+                # keep serving (the tick may have died mid-donation,
+                # so the pool is rebuilt from scratch)
+                self._log({"event": "serving_tick_error",
+                           "error": str(e)[:500]})
+                with self._cv:
+                    self._reset_pool_locked()
+                n = 0
+            if n == 0:
+                with self._cv:
+                    if not self._queue and not self._stop_flag:
+                        self._cv.wait(timeout=0.02)
+
+    def _reset_pool_locked(self) -> None:
+        """Fail every queued/running request and rebuild the slot pool
+        (donated buffers may be invalid after a mid-tick error)."""
+        for req in list(self._queue):
+            self._queue.remove(req)
+            self._finish(req, EXPIRED, "engine_error")
+        for i, req in enumerate(self._slot_req):
+            if req is not None:
+                self._release(i, EXPIRED, "engine_error")
+        S, L = self.config.num_slots, self.max_len
+        self._cache = init_slot_cache(self.model, S)
+        self._history = jnp.zeros((S, L), jnp.int32)
+        self._mask = jnp.zeros((S, L), jnp.int32)
+        self._last_tok = np.zeros((S,), np.int32)
+        self._pos = np.zeros((S,), np.int32)
+        self._phys = np.zeros((S,), np.int32)
+        self._active = np.zeros((S,), bool)
+
+    def stop(self) -> None:
+        self._stop_flag = True
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ---- observability ----------------------------------------------
+
+    def warmup(self) -> float:
+        """Compile every prefill bucket + the decode step before traffic
+        (satellite: the first user must not pay jit). Returns seconds."""
+        t0 = time.perf_counter()
+        with self._cv:
+            for bucket in self.ladder.buckets:
+                if bucket + 1 > self.max_len:
+                    continue
+                ids = np.ones((1, bucket), np.int32)
+                mask = np.ones((1, bucket), np.int32)
+                jax.block_until_ready(self._prefill_jit(
+                    self.params, ids, mask, self._zero_key))
+            # cache/history are donated, so reassign them; with every
+            # lane free the warmup tick is a no-op on pool state (free
+            # lanes write at index 0 and are fully overwritten by the
+            # next assignment anyway)
+            self._cache, self._history, _ = self._decode_jit(
+                self.params, self._cache, self._history, self._mask,
+                self._last_tok, self._pos, self._phys, self._active,
+                self._zero_key)
+            jax.block_until_ready(self._cache)
+        dt = time.perf_counter() - t0
+        self.metrics.warmup_compile_s = round(dt, 3)
+        self._log({"event": "serving_warmup", "seconds": round(dt, 3),
+                   "buckets": list(self.ladder.buckets),
+                   "num_slots": self.config.num_slots})
+        return dt
+
+    def stats(self) -> dict:
+        with self._cv:
+            return self.metrics.snapshot(
+                queue_depth=len(self._queue),
+                slots_active=int(self._active.sum()),
+                num_slots=self.config.num_slots)

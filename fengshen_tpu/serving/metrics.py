@@ -1,0 +1,98 @@
+"""Engine-level serving metrics.
+
+Same conventions as the resilience subsystem (resilience/loader.py): the
+engine takes an optional `log` callable and emits one small dict per
+event (`serving_admit`, `serving_reject`, `serving_finish`,
+`serving_warmup`) so a Trainer-style metrics.jsonl — or any structured
+logger — can ingest them; `snapshot()` is the `/stats` endpoint payload.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+def _percentile(values, q: float) -> float:
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    idx = min(int(q * len(vals)), len(vals) - 1)
+    return float(vals[idx])
+
+
+class EngineMetrics:
+    """Thread-safe counters + bounded windows for the serving engine."""
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self.admitted = 0
+        self.rejected_queue_full = 0
+        self.rejected_prompt_too_long = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.prefills = {}          # bucket -> count
+        self.decode_ticks = 0
+        self.decode_tokens = 0
+        self.decode_time_s = 0.0
+        self.occupied_slot_ticks = 0
+        self.total_slot_ticks = 0
+        self.warmup_compile_s = None
+        self._ttft = deque(maxlen=window)
+        self._latency = deque(maxlen=window)
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def record_prefill(self, bucket: int) -> None:
+        with self._lock:
+            self.prefills[bucket] = self.prefills.get(bucket, 0) + 1
+
+    def record_tick(self, n_active: int, num_slots: int,
+                    seconds: float) -> None:
+        with self._lock:
+            self.decode_ticks += 1
+            self.decode_tokens += n_active
+            self.decode_time_s += seconds
+            self.occupied_slot_ticks += n_active
+            self.total_slot_ticks += num_slots
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._ttft.append(seconds)
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latency.append(seconds)
+
+    def snapshot(self, queue_depth: int, slots_active: int,
+                 num_slots: int) -> dict:
+        with self._lock:
+            ttft = list(self._ttft)
+            decode_tps = (self.decode_tokens / self.decode_time_s
+                          if self.decode_time_s > 0 else 0.0)
+            occupancy = (self.occupied_slot_ticks / self.total_slot_ticks
+                         if self.total_slot_ticks > 0 else 0.0)
+            return {
+                "queue_depth": queue_depth,
+                "slots_active": slots_active,
+                "num_slots": num_slots,
+                "admitted": self.admitted,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_prompt_too_long": self.rejected_prompt_too_long,
+                "completed": self.completed,
+                "cancelled": self.cancelled,
+                "expired": self.expired,
+                "prefills_per_bucket": dict(self.prefills),
+                "decode_ticks": self.decode_ticks,
+                "decode_tokens": self.decode_tokens,
+                "decode_tokens_per_sec": round(decode_tps, 2),
+                "slot_occupancy": round(occupancy, 4),
+                "ttft_avg_s": round(sum(ttft) / len(ttft), 4) if ttft
+                              else 0.0,
+                "ttft_p50_s": round(_percentile(ttft, 0.5), 4),
+                "ttft_p95_s": round(_percentile(ttft, 0.95), 4),
+                "warmup_compile_s": self.warmup_compile_s,
+            }

@@ -230,6 +230,14 @@ def generate(model: Any, params: Any, input_ids: jax.Array,
     return buf
 
 
+def is_cache_index_path(path) -> bool:
+    """True when a tree_map_with_path key path addresses a `cache_index`
+    leaf (the decode write-position state in every cache family here).
+    Shared by `_rollback_cache` and the serving slot pool's per-slot
+    index surgery (fengshen_tpu/serving/cache.py)."""
+    return any(getattr(k, "key", None) == "cache_index" for k in path)
+
+
 def _rollback_cache(cache, delta):
     """Lower every `cache_index` leaf by `delta` (traced scalar).
 
@@ -239,7 +247,7 @@ def _rollback_cache(cache, delta):
     query — so after lowering the index, stale tail entries are masked
     out and later overwritten in place."""
     def fix(path, leaf):
-        if any(getattr(k, "key", None) == "cache_index" for k in path):
+        if is_cache_index_path(path):
             return leaf - jnp.asarray(delta, leaf.dtype)
         return leaf
     return jax.tree_util.tree_map_with_path(fix, cache)

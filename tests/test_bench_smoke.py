@@ -16,7 +16,10 @@ pytestmark = pytest.mark.slow
 TINY = {"BENCH_SEQ": "64", "BENCH_VOCAB": "256", "BENCH_HIDDEN": "64",
         "BENCH_INTER": "128", "BENCH_LAYERS": "2", "BENCH_HEADS": "4",
         "BENCH_BATCH": "2", "BENCH_ATTN": "dense",
-        "BENCH_SKIP_PROBE": "1"}
+        "BENCH_SKIP_PROBE": "1",
+        # stay in-process: the CPU-fallback wrapper would re-exec bench
+        # in a child whose stdout escapes redirect_stdout
+        "BENCH_CHILD": "1"}
 
 
 def _run_bench(monkeypatch, env: dict) -> dict:
@@ -36,7 +39,7 @@ def _run_bench(monkeypatch, env: dict) -> dict:
     lines = [l for l in out.getvalue().splitlines() if l.startswith("{")]
     assert lines, out.getvalue()
     row = json.loads(lines[-1])
-    assert set(row) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline"}
     assert row["value"] > 0
     return row
 
@@ -202,3 +205,61 @@ def test_bench_sharded_steps_per_exec(monkeypatch):
     row = _run_bench(monkeypatch, {"BENCH_CONFIG": "sharded",
                                    "BENCH_STEPS_PER_EXEC": "3"})
     assert row["metric"] == "llama300m_sharded_step_tokens_per_sec_per_chip"
+
+
+# ---- CPU fallback rung (always emit the one JSON line) --------------
+# Five BENCH rounds ended `parsed: null`: the relay wedged and the
+# watchdog's os._exit killed the process before any JSON. The top-level
+# wrapper now reruns ONCE on the CPU backend with tiny shapes, flagged
+# degraded, so the driver always gets a number it can label honestly.
+
+
+def test_cpu_fallback_engages_on_wedge_only():
+    import bench
+
+    calls = []
+
+    def spawn(env):
+        calls.append(env)
+        if len(calls) == 1:
+            return 1, "bench watchdog: accelerator unresponsive, aborting"
+        return 0, ""
+
+    with pytest.raises(SystemExit) as exc:
+        bench._run_with_cpu_fallback(spawn=spawn)
+    assert exc.value.code == 0
+    assert calls[0] == {"BENCH_CHILD": "1"}
+    rescue = calls[1]
+    assert rescue["JAX_PLATFORMS"] == "cpu"
+    assert rescue["BENCH_DEGRADED"] == "1"
+    assert rescue["BENCH_CHILD"] == "1"
+
+
+def test_cpu_fallback_propagates_non_wedge_failures():
+    import bench
+
+    def spawn(env):
+        return 3, "Ran out of memory in memory space hbm"
+
+    with pytest.raises(SystemExit) as exc:
+        bench._run_with_cpu_fallback(spawn=spawn)
+    # an OOM (or any non-wedge rc) must surface, not be masked by a
+    # degraded CPU number
+    assert exc.value.code == 3
+
+
+def test_cpu_fallback_env_pins_every_mode():
+    import bench
+
+    for mode in ("default", "large", "sharded", "decode"):
+        env = bench._cpu_fallback_env(mode)
+        assert env["BENCH_DEGRADED"] == "1"
+        assert env["BENCH_CHILD"] == "1"
+        assert "BENCH_BATCH" in env  # every mode runs pinned, no ladder
+    assert bench._cpu_fallback_env("large")["BENCH_LAYERS"] == "2"
+
+
+def test_degraded_flag_lands_in_json(monkeypatch):
+    row = _run_bench(monkeypatch, {"BENCH_DEGRADED": "1"})
+    assert row["degraded"] is True
+    assert row["metric"] == "llama300m_train_tokens_per_sec_per_chip"

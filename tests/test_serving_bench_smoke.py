@@ -1,0 +1,65 @@
+"""`make serve-bench` harness guard: the serving microbench must emit
+its one JSON line (tokens/s, ttft, speedup-vs-sequential) on CPU with
+tiny env shapes, so future BENCH rounds can track serving throughput.
+
+The ≥3x-at-8-concurrent acceptance number comes from the DEFAULT
+(weight-memory-bound) shape, which is too slow for the fast lane — this
+smoke only pins the harness: schema, positivity, degraded flag wiring.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+import pytest
+
+TINY = {"SERVE_BENCH_SLOTS": "4", "SERVE_BENCH_REQUESTS": "4",
+        "SERVE_BENCH_NEW_TOKENS": "8", "SERVE_BENCH_VOCAB": "128",
+        "SERVE_BENCH_HIDDEN": "32", "SERVE_BENCH_INTER": "64",
+        "SERVE_BENCH_LAYERS": "2", "SERVE_BENCH_HEADS": "4",
+        "SERVE_BENCH_BUCKETS": "16,32"}
+
+
+def _run(monkeypatch, env: dict, tiny: bool = True) -> dict:
+    from fengshen_tpu.serving import bench
+
+    for key in list(os.environ):
+        if key.startswith(("SERVE_BENCH_", "BENCH_DEGRADED")):
+            monkeypatch.delenv(key)
+    for key, val in {**(TINY if tiny else {}), **env}.items():
+        monkeypatch.setenv(key, val)
+    out = io.StringIO()
+    with redirect_stdout(out):
+        bench.main()
+    lines = [l for l in out.getvalue().splitlines() if l.startswith("{")]
+    assert lines, out.getvalue()
+    return json.loads(lines[-1])
+
+
+def test_serve_bench_emits_schema_row(monkeypatch):
+    row = _run(monkeypatch, {})
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline",
+                        "sequential_tokens_per_sec", "ttft_avg_s"}
+    assert row["metric"] == "serving_engine_tokens_per_sec"
+    assert row["unit"] == "tokens/s"
+    assert row["value"] > 0
+    assert row["sequential_tokens_per_sec"] > 0
+    assert row["vs_baseline"] > 0
+    assert row["ttft_avg_s"] >= 0
+    assert row["requests"] == 4
+    assert "degraded" not in row
+
+
+def test_serve_bench_degraded_flag(monkeypatch):
+    row = _run(monkeypatch, {"BENCH_DEGRADED": "1"})
+    assert row["degraded"] is True
+
+
+@pytest.mark.slow
+def test_serve_bench_default_shape_beats_sequential_3x(monkeypatch):
+    """The acceptance bar (ISSUE 3): ≥3x aggregate tokens/s over
+    sequential per-request generate at 8 concurrent requests, on the
+    default weight-memory-bound shape. Slow lane (~40s on CPU)."""
+    row = _run(monkeypatch, {}, tiny=False)
+    assert row["vs_baseline"] >= 3.0, row
