@@ -163,14 +163,19 @@ def _ladder_of_rungs(rungs: list, label: str,
 
 
 def _emit(row: dict) -> None:
-    """The one JSON metric line. A CPU-fallback run (BENCH_DEGRADED=1)
-    carries `"degraded": true` so the driver never mistakes the rescue
-    number for a hardware measurement."""
+    """The one JSON metric line, written through the unified jsonl
+    sink (docs/observability.md) — same schema, same stdout stream the
+    BENCH drivers parse. A CPU-fallback run (BENCH_DEGRADED=1) carries
+    `"degraded": true` so the driver never mistakes the rescue number
+    for a hardware measurement."""
     import os
+    import sys
+
+    from fengshen_tpu.observability import JsonlSink
 
     if os.environ.get("BENCH_DEGRADED", "0") == "1":
         row["degraded"] = True
-    print(json.dumps(row))
+    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
 
 
 # tiny shapes every mode can run on the CPU backend inside the watchdog
@@ -316,7 +321,7 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     from fengshen_tpu.parallel import set_mesh
     from fengshen_tpu.trainer import Trainer, add_trainer_args
     from fengshen_tpu.trainer.modules import CausalLMModule
-    from fengshen_tpu.trainer.trainer import PEAK_FLOPS
+    from fengshen_tpu.observability import peak_flops_per_chip
 
     # 900s, not the default 540: a 13B-shape rung is a long remote
     # compile plus 15 steps — a slow-but-healthy rung hitting the
@@ -380,13 +385,17 @@ def _trainer_bench(config, metric_name: str, per_chip: int,
     n_params = sum(int(np.prod(p.shape)) for p in
                    jax.tree_util.tree_leaves(state.params))
     flops_per_token = 6.0 * n_params + flops_attn_term
-    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
+    # resolver honors FSTPU_PEAK_FLOPS and the nominal CPU fallback
+    # (docs/observability.md) — same denominator as the decode and
+    # serving rows
+    peak = peak_flops_per_chip(jax.devices()[0].device_kind)
     mfu = tps * flops_per_token / (peak * n_dev)
     _emit({
         "metric": metric_name,
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": float(f"{mfu:.4g}"),
     })
     return True
 
@@ -689,19 +698,29 @@ def _run_decode() -> None:
     # no MFU target for decode (bandwidth-bound); vs_baseline is
     # tokens/sec/chip relative to the training north-star scale (40%
     # MFU train ≈ 43k tok/s at 300M) — a rough single-number context
-    _emit({
+    row = {
         "metric": metric,
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tps / n_dev / 43000.0, 4),
-    })
+    }
+    # utilization column (forward-only FLOPs — decode does no backward);
+    # the low absolute value IS the point: it quantifies how far
+    # bandwidth-bound batch-1 decode sits from the chip's matmul peak
+    from fengshen_tpu.observability import (estimate_flops_per_token,
+                                            peak_flops_per_chip)
+    f_tok = estimate_flops_per_token(config, include_backward=False)
+    if f_tok:
+        peak = peak_flops_per_chip(jax.devices()[0].device_kind)
+        row["mfu"] = float(f"{tps * f_tok / (peak * n_dev):.4g}")
+    _emit(row)
 
 
 def _run(per_chip_batch: int) -> None:
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from fengshen_tpu.parallel import MeshConfig, make_mesh, set_mesh
     from fengshen_tpu.parallel.cross_entropy import stable_cross_entropy
-    from fengshen_tpu.trainer.trainer import PEAK_FLOPS
+    from fengshen_tpu.observability import peak_flops_per_chip
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshConfig(data=n_dev, fsdp=1, sequence=1, tensor=1))
@@ -814,7 +833,10 @@ def _run(per_chip_batch: int) -> None:
                    jax.tree_util.tree_leaves(params))
     flops_per_token = 6.0 * n_params + 12.0 * config.num_hidden_layers * \
         config.hidden_size * seq  # attention term
-    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, 197e12)
+    # resolver honors FSTPU_PEAK_FLOPS and the nominal CPU fallback
+    # (docs/observability.md) — same denominator as the decode and
+    # serving rows
+    peak = peak_flops_per_chip(jax.devices()[0].device_kind)
     mfu = tps * flops_per_token / (peak * n_dev)
 
     _emit({
@@ -828,6 +850,7 @@ def _run(per_chip_batch: int) -> None:
         "value": round(tps / n_dev, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": float(f"{mfu:.4g}"),
     })
 
 

@@ -8,6 +8,7 @@ from fengshen_tpu.analysis.rules import (  # noqa: F401
     blanket_except,
     blocking_transfer,
     host_divergence,
+    metrics_in_traced_code,
     nondet_iteration,
     partition_spec_axes,
     retrace_hazard,

@@ -1,0 +1,114 @@
+"""metrics-in-traced-code: registry mutations reached from traced code.
+
+The observability registry's mutators (``inc``/``dec``/``set``/
+``observe`` — docs/observability.md) are host-side Python: called from
+a jit-traced function they run ONCE, at trace time, and the compiled
+program never touches them again — the counter silently stops counting
+(and, worse, records a tracer-shaped nonsense sample at every retrace).
+The fix is structural: return the value out of the traced function and
+record it on the host, exactly how the Trainer pulls
+``bad_step_count`` out of the step metrics.
+
+Precision: only receivers PROVEN metric-shaped fire — a name (or
+``self.<attr>``) assigned from a ``counter(...)``/``gauge(...)``/
+``histogram(...)`` factory call, a direct factory chain
+(``registry.counter("x").inc()``), or a ``labels(...)`` hop off either.
+Bare ``.set()`` on anything else — above all jax's ubiquitous
+``arr.at[i].set(v)`` — never matches, because its receiver is a
+subscript, not a tracked metric binding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fengshen_tpu.analysis.registry import Rule, register
+
+#: mutation methods of observability.registry metric objects
+MUTATOR_METHODS = frozenset({"inc", "dec", "set", "observe"})
+#: registry factory methods whose results are metric objects
+FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+
+def _target_key(node: ast.AST):
+    """Binding key for an assignment target: plain name or self-attr."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _is_factory_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in FACTORY_METHODS)
+
+
+@register
+class MetricsInTracedCode(Rule):
+    id = "metrics-in-traced-code"
+    hint = ("metrics record at TRACE time only inside jit — return the "
+            "value out of the traced function and mutate the registry "
+            "on the host (see docs/observability.md)")
+    NODE_TYPES = (ast.Call,)
+
+    def begin_file(self, ctx) -> None:
+        # one pre-pass: every name / self-attr bound to a registry
+        # factory result anywhere in the file (module consts, __init__
+        # attributes, locals). Instance attributes are also remembered
+        # by bare attr name so `stats.tokens.inc()` resolves when
+        # `self.tokens = reg.counter(...)` appears in the same file.
+        self._metric_bindings = set()
+        self._metric_attrs = set()
+        for node in ast.walk(ctx.tree):
+            value = None
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            else:
+                continue
+            if not (_is_factory_call(value) or
+                    self._is_labels_hop(value)):
+                continue
+            for t in targets:
+                key = _target_key(t)
+                if key is not None:
+                    self._metric_bindings.add(key)
+                    if key.startswith("self."):
+                        self._metric_attrs.add(key[len("self."):])
+
+    def _is_metric_expr(self, node: ast.AST) -> bool:
+        """Is this expression a metric object? A tracked binding, a
+        direct factory chain, or a labels() hop off either."""
+        key = _target_key(node)
+        if key is not None and key in self._metric_bindings:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in self._metric_attrs:
+            return True
+        if _is_factory_call(node):
+            return True
+        return self._is_labels_hop(node)
+
+    def _is_labels_hop(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"
+                and self._is_metric_expr(node.func.value))
+
+    def check(self, node: ast.Call, ctx):
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in MUTATOR_METHODS:
+            return
+        if not self._is_metric_expr(func.value):
+            return
+        if not ctx.in_traced_context(node):
+            return
+        yield node, (
+            f"metric mutation `.{func.attr}(...)` inside a traced "
+            "function runs at trace time only — the compiled step "
+            "never records it (and retraces record garbage)")

@@ -13,7 +13,10 @@ engine (`fengshen_tpu/serving/`, docs/serving.md) — many concurrent
 requests share ONE jitted decode step; the optional ENGINE block holds
 `serving.EngineConfig` overrides (num_slots, buckets, max_queue, …).
 Both engines get a warmup request at startup so the first user never
-pays jit compilation; `GET /stats` exposes the engine metrics.
+pays jit compilation; `GET /stats` exposes the engine metrics as JSON
+and `GET /metrics` renders the same registry (plus the process-global
+one — HTTP counters, span timings) as Prometheus text exposition, on
+BOTH the fastapi and the stdlib server paths (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -66,6 +69,33 @@ def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
         pipeline_args={k: v for k, v in raw.get("PIPELINE", {}).items()
                        if k not in ("task", "model")})
     return server, pipeline
+
+
+def _render_metrics(engine=None) -> str:
+    """Prometheus text over the process-global registry plus (when the
+    continuous engine is up) the engine's own registry; `engine.stats()`
+    runs first so the pool gauges are scrape-fresh."""
+    from fengshen_tpu.observability import get_registry, render_prometheus
+    registries = [get_registry()]
+    if engine is not None:
+        engine.stats()
+        registries.append(engine.metrics.registry)
+    return render_prometheus(*registries)
+
+
+def _count_http(route: str, code: int) -> None:
+    """`fstpu_http_requests_total{route,code}` in the global registry.
+    Routes are the fixed server surface (bounded label cardinality);
+    anything else counts as "other"."""
+    from fengshen_tpu.observability import get_registry
+    get_registry().counter(
+        "fstpu_http_requests_total", "REST requests by route and status",
+        labelnames=("route", "code")).labels(route, code).inc()
+
+
+def _classify_route(path: str, api_route: str) -> str:
+    return path if path in (api_route, "/healthz", "/stats",
+                            "/metrics") else "other"
 
 
 def _accepts_max_new_tokens(pipeline) -> bool:
@@ -159,7 +189,7 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
     """Create the FastAPI app around a pipeline instance."""
     from fastapi import FastAPI
     from fastapi.middleware.cors import CORSMiddleware
-    from fastapi.responses import JSONResponse
+    from fastapi.responses import JSONResponse, Response
     from pydantic import BaseModel
 
     server_cfg = server_cfg or ServerConfig()
@@ -174,28 +204,43 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         input_text: str
         max_new_tokens: Optional[int] = None
 
-    @app.post(f"/api/{pipeline_cfg.task}")
+    api_route = f"/api/{pipeline_cfg.task}"
+
+    @app.post(api_route)
     def run(req: Request) -> Any:
         if engine is not None:
             code, body = _engine_generate(
                 engine, pipeline, req.model_dump(),
                 server_cfg.request_timeout_s)
+            _count_http(api_route, code)
             return JSONResponse(status_code=code, content=body)
         if req.max_new_tokens is not None and \
                 _accepts_max_new_tokens(pipeline):
-            return {"result": pipeline(req.input_text,
-                                       max_new_tokens=req.max_new_tokens)}
-        return {"result": pipeline(req.input_text)}
+            result = pipeline(req.input_text,
+                              max_new_tokens=req.max_new_tokens)
+        else:
+            result = pipeline(req.input_text)
+        _count_http(api_route, 200)
+        return {"result": result}
 
     @app.get("/healthz")
     def healthz():
+        _count_http("/healthz", 200)
         return {"status": "ok", "task": pipeline_cfg.task}
 
     @app.get("/stats")
     def stats():
+        _count_http("/stats", 200)
         if engine is not None:
             return engine.stats()
         return {"engine": "simple", "task": pipeline_cfg.task}
+
+    @app.get("/metrics")
+    def metrics():
+        from fengshen_tpu.observability import CONTENT_TYPE_LATEST
+        _count_http("/metrics", 200)
+        return Response(content=_render_metrics(engine),
+                        media_type=CONTENT_TYPE_LATEST)
 
     return app
 
@@ -225,14 +270,20 @@ def build_stdlib_server(server_cfg: ServerConfig,
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload, ensure_ascii=False).encode()
+        def _send_bytes(self, code: int, body: bytes,
+                        content_type: str) -> None:
+            _count_http(_classify_route(self.path, route), code)
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Access-Control-Allow-Origin", "*")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send(self, code: int, payload: dict) -> None:
+            self._send_bytes(
+                code, json.dumps(payload, ensure_ascii=False).encode(),
+                "application/json")
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -244,6 +295,11 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 else:
                     self._send(200, {"engine": "simple",
                                      "task": pipeline_cfg.task})
+            elif self.path == "/metrics":
+                from fengshen_tpu.observability import \
+                    CONTENT_TYPE_LATEST
+                self._send_bytes(200, _render_metrics(engine).encode(),
+                                 CONTENT_TYPE_LATEST)
             else:
                 self._send(404, {"error": "not found"})
 

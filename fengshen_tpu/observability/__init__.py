@@ -1,0 +1,33 @@
+"""Unified observability: metrics registry, Prometheus exposition,
+jsonl event sink, MFU/goodput step stats, and trace spans
+(docs/observability.md).
+
+Every subsystem plugs into this one core instead of inventing its own
+telemetry dialect: the Trainer's step log, the serving engine's
+`EngineMetrics`, the resilience events, and bench's JSON rows all write
+through here; ``GET /metrics`` (api server routes + the standalone
+exporter thread) and `/stats` read from it.
+"""
+
+from fengshen_tpu.observability.exposition import (CONTENT_TYPE_LATEST,
+                                                   MetricsServer,
+                                                   render_prometheus,
+                                                   start_metrics_server)
+from fengshen_tpu.observability.flops import (NOMINAL_FALLBACK_FLOPS,
+                                              PEAK_FLOPS,
+                                              estimate_flops_per_token,
+                                              peak_flops_per_chip)
+from fengshen_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                                 MetricsRegistry,
+                                                 get_registry, percentile)
+from fengshen_tpu.observability.sink import JsonlSink
+from fengshen_tpu.observability.stepstats import StepStats
+from fengshen_tpu.observability.tracing import (current_span_stack, span)
+
+__all__ = [
+    "CONTENT_TYPE_LATEST", "Counter", "Gauge", "Histogram", "JsonlSink",
+    "MetricsRegistry", "MetricsServer", "NOMINAL_FALLBACK_FLOPS",
+    "PEAK_FLOPS", "StepStats", "current_span_stack",
+    "estimate_flops_per_token", "get_registry", "peak_flops_per_chip",
+    "percentile", "render_prometheus", "span", "start_metrics_server",
+]

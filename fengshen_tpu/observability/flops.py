@@ -1,0 +1,96 @@
+"""Model-FLOPs estimator + per-chip peak table: the MFU denominator.
+
+MFU (model-FLOPs-utilization, the PaLM accounting) = achieved model
+FLOP/s over peak hardware FLOP/s:
+
+    mfu = tokens_per_sec * flops_per_token / (peak_per_chip * n_chips)
+
+``estimate_flops_per_token`` counts the PARAMETER matmul FLOPs of one
+token through a dense decoder (2 FLOPs per multiply-add, x3 for
+forward+backward), from the model config alone:
+
+    per_layer = 2*h*h (q+o) + 2*h*(kv_heads*head_dim) (k+v, GQA-aware)
+              + 3*h*inter (gate/up/down)
+    per_token = mult * (layers * per_layer + h * vocab)   # mult: 6 train, 2 infer
+
+Assumptions (documented in docs/observability.md): attention
+score/value FLOPs (the O(seq) term) are excluded, as are norms,
+embeddings-as-lookup, and activation functions — the standard "6N"
+family of approximations, exact enough that MFU deltas track real
+optimization work. For a non-GQA model this reduces to the familiar
+``6*(l*(4h^2 + 3*h*inter) + h*v)``.
+
+Peak FLOP/s comes from the TPU table below (bf16), the
+``FSTPU_PEAK_FLOPS`` env override (benchmarking on an unlisted chip),
+or a nominal CPU figure — nominal so that MFU stays FINITE and
+monotonic in CI/CPU runs; absolute CPU MFU values are indicative only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+#: peak bf16 FLOP/s per chip (the table that lived in trainer.py;
+#: trainer re-exports it for compatibility)
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+#: nominal figure for backends not in the table (CPU CI runs): a
+#: round 1 TFLOP/s so mfu is finite and comparable run-to-run on the
+#: same host, never a hardware claim
+NOMINAL_FALLBACK_FLOPS = 1e12
+
+#: env override: FSTPU_PEAK_FLOPS=9.2e14 for an unlisted accelerator
+PEAK_FLOPS_ENV = "FSTPU_PEAK_FLOPS"
+
+
+def peak_flops_per_chip(device_kind: Optional[str] = None) -> float:
+    """Peak FLOP/s for one chip of ``device_kind`` (default: the first
+    visible jax device). Resolution order: env override, TPU table,
+    nominal fallback. Always positive and finite."""
+    env = os.environ.get(PEAK_FLOPS_ENV)
+    if env:
+        peak = float(env)
+        if peak <= 0:
+            raise ValueError(f"{PEAK_FLOPS_ENV}={env!r} must be > 0")
+        return peak
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — no jax/backend: use fallback
+            device_kind = ""
+    return PEAK_FLOPS.get(device_kind, NOMINAL_FALLBACK_FLOPS)
+
+
+def estimate_flops_per_token(config: Any,
+                             include_backward: bool = True
+                             ) -> Optional[float]:
+    """FLOPs one token costs through the model described by ``config``
+    (6x params-touched for training, 2x for inference). Returns None
+    when the config doesn't describe a dense decoder LM (no
+    hidden_size/num_hidden_layers) — callers treat None as "estimator
+    doesn't support this model" and omit mfu."""
+    h = getattr(config, "hidden_size", None)
+    layers = getattr(config, "num_hidden_layers", None)
+    if not h or not layers:
+        return None
+    inter = getattr(config, "intermediate_size", None) or 4 * h
+    vocab = getattr(config, "vocab_size", 0) or 0
+    heads = getattr(config, "num_attention_heads", None) or 1
+    kv_heads = getattr(config, "num_key_value_heads", None) or heads
+    head_dim = h // heads
+    per_layer = (2 * h * h                       # q_proj + o_proj
+                 + 2 * h * (kv_heads * head_dim)  # k_proj + v_proj (GQA)
+                 + 3 * h * inter)                # gate + up + down
+    mult = 6.0 if include_backward else 2.0
+    return mult * (layers * per_layer + h * vocab)

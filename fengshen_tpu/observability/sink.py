@@ -1,0 +1,79 @@
+"""JsonlSink: the one structured-event writer.
+
+Subsumes the event dialects that grew per-subsystem — the Trainer's
+``metrics.jsonl`` step/val/rewind entries, the resilience loader's
+``loader_retry``/``loader_skip_batch`` events, the serving engine's
+``serving_admit``/``serving_finish`` events, and bench's one-line JSON
+rows — behind a single callable ``sink(entry: dict)``. Event NAMES are
+unchanged (compatibility layer: anything already parsing metrics.jsonl
+or bench stdout keeps working); what unifies is the writer: one
+process-gating rule, one echo format, one logger bridge.
+
+A sink writes to a jsonl ``path``, a ``stream`` (bench writes stdout),
+or both; ``echo`` mirrors the Trainer's human-readable console line;
+``logger`` bridges numeric fields to a Lightning-style
+``log_metrics``. Multihost gating: only ``process_index == 0`` writes
+(``only_process_zero=False`` opts out — bench children are already
+single-process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, TextIO
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — no jax = single process
+        return 0
+
+
+class JsonlSink:
+    """Callable structured-event sink: ``sink({"event": ..., ...})``."""
+
+    def __init__(self, path: Optional[str] = None,
+                 stream: Optional[TextIO] = None,
+                 echo: bool = False,
+                 echo_prefix: str = "[fengshen-tpu] ",
+                 logger: Optional[Any] = None,
+                 only_process_zero: bool = True):
+        self.path = path
+        self.stream = stream
+        self.echo = echo
+        self.echo_prefix = echo_prefix
+        self.logger = logger
+        self.only_process_zero = only_process_zero
+
+    @staticmethod
+    def format_echo(entry: dict) -> str:
+        """The Trainer's console line format (floats at .4g)."""
+        return " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in entry.items())
+
+    def __call__(self, entry: dict) -> None:
+        if self.only_process_zero and _process_index() != 0:
+            return
+        line = json.dumps(entry)
+        if self.path is not None:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        if self.stream is not None:
+            self.stream.write(line + "\n")
+            self.stream.flush()
+        if self.echo:
+            print(f"{self.echo_prefix}{self.format_echo(entry)}",
+                  flush=True)
+        if self.logger is not None and hasattr(self.logger,
+                                               "log_metrics"):
+            self.logger.log_metrics(
+                {k: v for k, v in entry.items()
+                 if isinstance(v, (int, float))},
+                step=entry.get("step"))

@@ -1,0 +1,107 @@
+"""Step-stats pipeline: step time, tokens/s, MFU, goodput for the
+Trainer.
+
+The Trainer feeds one ``record_execution`` per jitted dispatch (K steps
+under --steps_per_execution) and asks for a ``window_entry`` at each
+log boundary; rewinds and the guards' cumulative ``bad_step_count``
+feed the goodput ledger. Everything lands twice: in the returned dict
+(merged into the metrics.jsonl step entry — keys are the PR-3 names
+plus ``mfu``/``goodput``) and in registry gauges for `/metrics`.
+
+Definitions (docs/observability.md):
+
+- ``tokens_per_sec``: tokens consumed over the wall-time window since
+  the last log entry (includes data loading — it's the pipeline rate,
+  not the bare step rate).
+- ``mfu``: tokens_per_sec * flops_per_token / (peak * n_devices). The
+  peak resolves via `flops.peak_flops_per_chip`, so mfu is ALWAYS
+  present and finite — on CPU against the documented nominal figure.
+- ``goodput``: productive steps over attempted steps, cumulative for
+  the run: attempted = global_step + steps replayed by rewinds,
+  productive = global_step - guarded-away (bad) steps. 1.0 for a clean
+  run; dips when the guards skip updates or a rewind replays a window.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from fengshen_tpu.observability.flops import peak_flops_per_chip
+from fengshen_tpu.observability.registry import (MetricsRegistry,
+                                                 get_registry)
+
+
+class StepStats:
+    def __init__(self, flops_per_token: float, n_devices: int,
+                 device_kind: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=time.perf_counter):
+        self.flops_per_token = float(flops_per_token)
+        self.peak_total = peak_flops_per_chip(device_kind) * max(
+            int(n_devices), 1)
+        self._clock = clock
+        self._window_start = clock()
+        self._window_tokens = 0
+        self._window_steps = 0
+        self._replayed_steps = 0
+        reg = registry if registry is not None else get_registry()
+        self._g_step = reg.gauge(
+            "fstpu_train_step", "current global step")
+        self._g_tps = reg.gauge(
+            "fstpu_train_tokens_per_sec",
+            "tokens/s over the last log window")
+        self._g_mfu = reg.gauge(
+            "fstpu_train_mfu",
+            "model-FLOPs-utilization over the last log window")
+        self._g_goodput = reg.gauge(
+            "fstpu_train_goodput",
+            "cumulative productive/attempted step ratio")
+        self._g_bad = reg.gauge(
+            "fstpu_train_bad_steps_total",
+            "cumulative steps skipped by the in-graph guards")
+        self._c_rewinds = reg.counter(
+            "fstpu_train_rewinds_total",
+            "rewind-on-divergence restores this run")
+        self._c_tokens = reg.counter(
+            "fstpu_train_tokens_total", "tokens consumed this run")
+
+    # -- feed ---------------------------------------------------------
+    def record_execution(self, n_steps: int, n_tokens: int) -> None:
+        self._window_steps += int(n_steps)
+        self._window_tokens += int(n_tokens)
+        self._c_tokens.inc(int(n_tokens))
+
+    def record_rewind(self, from_step: int, to_step: int) -> None:
+        """A rewind will replay [to_step, from_step) — count those
+        against goodput's attempted-steps denominator."""
+        self._replayed_steps += max(int(from_step) - int(to_step), 0)
+        self._c_rewinds.inc()
+
+    # -- read ---------------------------------------------------------
+    def goodput(self, global_step: int, bad_step_count: int) -> float:
+        attempted = int(global_step) + self._replayed_steps
+        if attempted <= 0:
+            return 1.0
+        productive = max(int(global_step) - int(bad_step_count), 0)
+        return productive / attempted
+
+    def window_entry(self, global_step: int,
+                     bad_step_count: int = 0) -> dict:
+        """Close the current window: compute + publish tokens_per_sec /
+        mfu / goodput, reset the window, return the dict to merge into
+        the step log entry."""
+        now = self._clock()
+        dt = now - self._window_start
+        tps = self._window_tokens / dt if dt > 0 else 0.0
+        mfu = tps * self.flops_per_token / self.peak_total
+        goodput = self.goodput(global_step, bad_step_count)
+        self._g_step.set(int(global_step))
+        self._g_tps.set(tps)
+        self._g_mfu.set(mfu)
+        self._g_goodput.set(goodput)
+        self._g_bad.set(int(bad_step_count))
+        self._window_start = now
+        self._window_tokens = 0
+        self._window_steps = 0
+        return {"tokens_per_sec": tps, "mfu": mfu, "goodput": goodput}

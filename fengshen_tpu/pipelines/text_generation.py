@@ -114,17 +114,34 @@ class Pipeline:
 
     def generate_ids(self, ids: np.ndarray,
                      max_new_tokens: int) -> list:
-        """Batch-1 sequential decode (the legacy engine)."""
+        """Batch-1 sequential decode (the legacy engine). Counted and
+        span-timed on the global registry (docs/observability.md) so
+        the simple-engine path shows up on /metrics like the
+        continuous engine does."""
+        from fengshen_tpu.observability import get_registry, span
         from fengshen_tpu.utils.generate import generate
         self._n_calls += 1
-        out = generate(
-            self.module, self.params, jnp.asarray(ids)[None],
-            max_new_tokens=max_new_tokens,
-            eos_token_id=self.eos_token_id,
-            pad_token_id=self.pad_token_id,
-            rng=jax.random.PRNGKey(self.seed + self._n_calls),
-            **self.sample_kw)
-        return np.asarray(out)[0, len(ids):].tolist()
+        with span("pipeline/generate"):
+            out = generate(
+                self.module, self.params, jnp.asarray(ids)[None],
+                max_new_tokens=max_new_tokens,
+                eos_token_id=self.eos_token_id,
+                pad_token_id=self.pad_token_id,
+                rng=jax.random.PRNGKey(self.seed + self._n_calls),
+                **self.sample_kw)
+        out = np.asarray(out)[0, len(ids):].tolist()
+        # generate() is fixed-shape: the row is always max_new_tokens
+        # long with pad after eos — count only the real tokens (up to
+        # and including eos), or the throughput metric inflates by the
+        # pad tail on every early stop
+        n_real = (out.index(self.eos_token_id) + 1
+                  if self.eos_token_id is not None
+                  and self.eos_token_id in out else len(out))
+        get_registry().counter(
+            "fstpu_pipeline_generated_tokens_total",
+            "tokens generated by the legacy batch-1 pipeline path"
+        ).inc(n_real)
+        return out
 
     @staticmethod
     def add_pipeline_specific_args(parser):

@@ -27,7 +27,10 @@ Semantics per failure:
 
 Counters (`retries_total`, `skipped_total`) and per-event structured
 log entries (`loader_retry` / `loader_skip_batch`) make the noise
-visible in metrics.jsonl.
+visible in metrics.jsonl — and, mirrored onto the observability
+registry's `fstpu_loader_*` counters (docs/observability.md), on any
+`/metrics` scrape: flaky storage shows up on the same dashboard as the
+throughput it is eroding.
 """
 
 from __future__ import annotations
@@ -35,6 +38,8 @@ from __future__ import annotations
 import random
 import time
 from typing import Any, Callable, Optional
+
+from fengshen_tpu.observability import get_registry
 
 
 class ResilientLoader:
@@ -58,6 +63,14 @@ class ResilientLoader:
         #: fetch time (see _prefetch) to fold skipped stream positions
         #: into consumed_samples exactly at the training frontier
         self.skipped_total = 0
+        reg = get_registry()
+        self._c_retries = reg.counter(
+            "fstpu_loader_retries_total",
+            "loader read retries", labelnames=("stage",))
+        self._c_skipped = reg.counter(
+            "fstpu_loader_skipped_batches_total",
+            "poison batches skipped after retries exhausted",
+            labelnames=("stage",))
         if resumable is None:
             # stateful samplers advertise mid-epoch resume; anything
             # else is assumed deterministic-from-iter() and gets the
@@ -98,11 +111,13 @@ class ResilientLoader:
                     # re-raised below once retries + skip budget exhaust
                     attempt += 1
                     self.retries_total += 1
+                    self._c_retries.labels(self.stage).inc()
                     if attempt > self.max_retries:
                         if self.resumable and \
                                 skipped_this_epoch < self.skip_batch_budget:
                             skipped_this_epoch += 1
                             self.skipped_total += 1
+                            self._c_skipped.labels(self.stage).inc()
                             self._log({"event": "loader_skip_batch",
                                        "stage": self.stage,
                                        "skipped_this_epoch":

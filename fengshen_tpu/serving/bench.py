@@ -24,8 +24,8 @@ the dominant inference-throughput lever).
 
 from __future__ import annotations
 
-import json
 import os
+import sys
 import time
 
 import jax
@@ -116,9 +116,19 @@ def main() -> None:
         "new_tokens": new_tokens,
         "backend": jax.default_backend(),
     }
+    # utilization column (docs/observability.md): forward-only FLOPs —
+    # decode does no backward; present whenever the estimator supports
+    # the benched model (it does: llama-shaped config)
+    from fengshen_tpu.observability import (JsonlSink,
+                                            estimate_flops_per_token,
+                                            peak_flops_per_chip)
+    f_tok = estimate_flops_per_token(config, include_backward=False)
+    if f_tok:
+        peak = peak_flops_per_chip(jax.devices()[0].device_kind)
+        row["mfu"] = float(f"{eng_tps * f_tok / (peak * len(jax.devices())):.4g}")
     if os.environ.get("BENCH_DEGRADED", "0") == "1":
         row["degraded"] = True
-    print(json.dumps(row))
+    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
 
 
 if __name__ == "__main__":

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fengshen_tpu.observability import span
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
                                         reset_free_slots)
@@ -275,7 +276,7 @@ class ContinuousBatchingEngine:
         req = Request(ids, max_new, request_id,
                       None if deadline_s is None else now + deadline_s,
                       now)
-        with self._cv:
+        with span("serving/admit"), self._cv:
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.count("rejected_queue_full")
                 self._log({"event": "serving_reject",
@@ -341,12 +342,14 @@ class ContinuousBatchingEngine:
         else:
             key = self._zero_key
         t0 = time.perf_counter()
-        self._cache, self._history, nxt = self._decode_jit(
-            self.params, self._cache, self._history, self._mask,
-            self._last_tok, self._pos, self._phys, self._active, key)
-        # host sync: the scheduler needs the tokens (copy — the device
-        # view is read-only and lanes are overwritten on admission)
-        nxt = np.array(nxt)
+        with span("serving/decode"):
+            self._cache, self._history, nxt = self._decode_jit(
+                self.params, self._cache, self._history, self._mask,
+                self._last_tok, self._pos, self._phys, self._active, key)
+            # host sync: the scheduler needs the tokens (copy — the
+            # device view is read-only and lanes are overwritten on
+            # admission)
+            nxt = np.array(nxt)
         dt = time.perf_counter() - t0
         self.metrics.record_tick(len(active_idx), self.config.num_slots,
                                  dt)
@@ -383,9 +386,10 @@ class ContinuousBatchingEngine:
                 self._rng, key = jax.random.split(self._rng)
             else:
                 key = self._zero_key
-            primed, tok = self._prefill_jit(
-                self.params, row[None], mask_row[None], key)
-            tok = int(np.asarray(tok)[0])
+            with span("serving/prefill"):
+                primed, tok = self._prefill_jit(
+                    self.params, row[None], mask_row[None], key)
+                tok = int(np.asarray(tok)[0])
             self.metrics.record_prefill(bucket)
             req.ttft_s = self._clock() - req.submit_time
             self.metrics.record_ttft(req.ttft_s)

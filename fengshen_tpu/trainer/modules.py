@@ -110,14 +110,10 @@ class CausalLMModule(TrainModule):
         return super().partition_rules()
 
     def flops_per_token(self) -> Optional[float]:
-        cfg = self.config
-        if hasattr(cfg, "hidden_size") and hasattr(cfg, "num_hidden_layers"):
-            h, l = cfg.hidden_size, cfg.num_hidden_layers
-            inter = getattr(cfg, "intermediate_size", 4 * h) or 4 * h
-            v = getattr(cfg, "vocab_size", 0)
-            per_layer = 4 * h * h + 2 * h * inter + h * inter
-            return 6.0 * (l * per_layer + h * v)
-        return None
+        # the single estimator (docs/observability.md): same numbers as
+        # the old inline formula for full-kv models, GQA-aware beyond it
+        from fengshen_tpu.observability import estimate_flops_per_token
+        return estimate_flops_per_token(self.config)
 
 
 class PipelinedCausalLMModule(TrainModule):
@@ -224,12 +220,8 @@ class PipelinedCausalLMModule(TrainModule):
         ]
 
     def flops_per_token(self):
-        cfg = self.config
-        per_layer = 4 * cfg.hidden_size ** 2 + \
-            3 * cfg.hidden_size * (cfg.intermediate_size or
-                                   4 * cfg.hidden_size)
-        return 6.0 * (cfg.num_hidden_layers * per_layer +
-                      cfg.hidden_size * cfg.vocab_size)
+        from fengshen_tpu.observability import estimate_flops_per_token
+        return estimate_flops_per_token(self.config)
 
 
 class LoraTrainModule(TrainModule):

@@ -11,9 +11,7 @@ unchanged (SURVEY.md §5.6 UX-preservation requirement).
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import time
 from typing import Any, Iterable, Optional
 
 import jax
@@ -22,6 +20,10 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from fengshen_tpu.observability import JsonlSink, StepStats, span
+# re-exported for compatibility (the table moved to observability.flops,
+# the single home of the MFU accounting)
+from fengshen_tpu.observability.flops import PEAK_FLOPS  # noqa: F401
 from fengshen_tpu.parallel.mesh import MeshConfig, make_mesh, set_mesh
 from fengshen_tpu.parallel.partition import make_shardings
 from fengshen_tpu.trainer.module import TrainModule
@@ -32,19 +34,6 @@ from fengshen_tpu.trainer.train_state import (TrainState,
 #: process-wide SIGTERM plumbing (see _install_preemption_handler):
 #: one handler, re-pointed at the latest Trainer via weakref
 _SIGTERM_STATE: dict = {"handler": None, "prev": None, "ref": None}
-
-#: peak bf16 FLOP/s per chip, for MFU (the metric BASELINE.md demands and
-#: the reference never measured)
-PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
 
 
 def _prefetch(loader, shardings, depth: int = 2):
@@ -125,6 +114,20 @@ def _prefetch_grouped(loader, shardings, k: int, depth: int = 2):
               flush=True)
 
 
+def _spanned_iter(it, name: str):
+    """Time each `next()` under a trace span — the fetch side of the
+    prefetch pipeline shows up as `name` in /metrics span timings and
+    on profiler traces, without restructuring the for loop."""
+    it = iter(it)
+    while True:
+        with span(name):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
+
+
 def add_trainer_args(parent_parser: argparse.ArgumentParser):
     """Lightning-Trainer-compatible flag subset actually used by the
     reference examples (SURVEY.md §2.9 pattern)."""
@@ -161,6 +164,12 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
              "(saved under default_root_dir/profile; SURVEY.md §5.1)")
     parser.add_argument("--seed", default=42, type=int)
     parser.add_argument("--default_root_dir", default="./runs", type=str)
+    parser.add_argument(
+        "--metrics_port", default=0, type=int,
+        help="serve GET /metrics (Prometheus text) from a stdlib "
+             "exporter thread on this port during fit; 0 = off. Only "
+             "process_index 0 of a multihost job binds the socket "
+             "(docs/observability.md)")
     # resilience (docs/fault_tolerance.md)
     resil = parent_parser.add_argument_group("resilience")
     resil.add_argument(
@@ -212,6 +221,12 @@ class Trainer:
         self.callbacks: list = []
         self._log_path = os.path.join(
             getattr(args, "default_root_dir", "./runs"), "metrics.jsonl")
+        #: the unified jsonl event sink (docs/observability.md): same
+        #: file, same event names, same echo format as the old ad-hoc
+        #: writer — resilience/serving events flow through it too
+        self._sink = JsonlSink(path=self._log_path, echo=True,
+                               logger=logger)
+        self._metrics_server = None
         self._preempted = False
         #: deterministic fault-injection plan (tests/chaos drills); see
         #: fengshen_tpu.resilience.faults.FaultPlan.install
@@ -602,6 +617,11 @@ class Trainer:
         # keep the data cursor ahead of it
         self.consumed_samples = max(pre_consumed,
                                     int(self.consumed_samples))
+        if getattr(self, "_stepstats", None) is not None:
+            # goodput ledger: the replayed window counts against the
+            # attempted-steps denominator
+            self._stepstats.record_rewind(pre_step,
+                                          int(self.global_step))
         self._log({"event": "rewind", "from_step": pre_step,
                    "to_step": int(self.global_step),
                    "bad_steps": int(bad_steps),
@@ -658,6 +678,20 @@ class Trainer:
 
     # -- fit -------------------------------------------------------------
     def fit(self, module: TrainModule, datamodule) -> TrainState:
+        try:
+            return self._fit(module, datamodule)
+        finally:
+            # the --metrics_port exporter must not outlive the fit: a
+            # leaked daemon socket serves stale metrics and makes the
+            # next Trainer on the same port die with EADDRINUSE
+            self._close_metrics_server()
+
+    def _close_metrics_server(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    def _fit(self, module: TrainModule, datamodule) -> TrainState:
         args = self.args
         module.setup("fit")
         # wire the datamodule so resumable samplers can read
@@ -741,8 +775,16 @@ class Trainer:
                    "total_steps": int(total_steps),
                    "mesh": dict(self.mesh.shape)})
 
+        # step-stats pipeline (docs/observability.md): tokens/s, MFU
+        # against the resolved per-chip peak (always finite — nominal
+        # fallback off-TPU), and goodput fed by the guards'
+        # bad_step_count + the rewind ledger
         flops_per_tok = module.flops_per_token() or 6.0 * float(n_params)
-        peak = PEAK_FLOPS.get(jax.devices()[0].device_kind, None)
+        self._stepstats = StepStats(
+            flops_per_token=flops_per_tok,
+            n_devices=len(jax.devices()),
+            device_kind=jax.devices()[0].device_kind)
+        self._maybe_start_metrics_server()
         log_every = max(int(getattr(args, "log_every_n_steps", 10)), 1)
         val_interval = int(getattr(args, "val_check_interval", 0) or 0)
 
@@ -773,8 +815,6 @@ class Trainer:
         prev_bad_total = int(state.bad_step_count) if max_consec else 0
         skips_credited = 0  # loader skips already folded into consumed
 
-        t_last = time.perf_counter()
-        tokens_since = 0
         epoch = 0
         # a run restored at (or past) its step budget must not execute
         # even one group — the loop body only checks max_steps AFTER an
@@ -786,10 +826,12 @@ class Trainer:
             feed = (_prefetch(train_loader, batch_sh) if spe == 1 else
                     _prefetch_grouped(train_loader, batch_sh, spe))
             rewound = False
-            for group, device_batch, skips_snap in feed:
+            for group, device_batch, skips_snap in _spanned_iter(
+                    feed, "train/load"):
                 if profile_range is not None:
                     self._maybe_profile(profile_range)
-                state, metrics = step_fn(state, device_batch, rng)
+                with span("train/step"):
+                    state, metrics = step_fn(state, device_batch, rng)
                 prev_step = int(self.global_step)
                 self.global_step = prev_step + len(group)
                 # callbacks (e.g. every-n checkpointing) need the span
@@ -805,30 +847,32 @@ class Trainer:
                     self.consumed_samples += world_batch * (
                         skips_snap - skips_credited)
                     skips_credited = skips_snap
-                tokens_since += sum(module.tokens_in_batch(b)
-                                    for b in group)
+                self._stepstats.record_execution(
+                    len(group), sum(module.tokens_in_batch(b)
+                                    for b in group))
 
                 if crossed(prev_step, self.global_step, log_every):
                     metrics = {k: float(v) for k, v in metrics.items()}
-                    now = time.perf_counter()
-                    dt = now - t_last
-                    tps = tokens_since / dt if dt > 0 else 0.0
                     entry = {"step": self.global_step,
                              "lr": float(self._schedule(self.global_step)),
-                             "tokens_per_sec": tps,
                              "consumed_samples": self.consumed_samples,
                              **metrics}
-                    if peak:
-                        entry["mfu"] = (tps * flops_per_tok /
-                                        (peak * len(jax.devices())))
+                    # tokens_per_sec / mfu / goodput over the window
+                    # since the last entry; closes the window
+                    entry.update(self._stepstats.window_entry(
+                        self.global_step,
+                        bad_step_count=int(
+                            metrics.get("bad_step_count", 0))))
                     self._log(entry)
-                    t_last, tokens_since = now, 0
 
                 if crossed(prev_step, self.global_step, val_interval):
                     self._run_validation(module, datamodule, state, rng)
                 for cb in self.callbacks:
                     if hasattr(cb, "on_train_step_end"):
-                        cb.on_train_step_end(self, state)
+                        # every-n checkpointing lives here; the span
+                        # makes save stalls visible next to step time
+                        with span("train/checkpoint"):
+                            cb.on_train_step_end(self, state)
                 if max_consec:
                     bad_total = int(metrics["bad_step_count"])
                     delta, prev_bad_total = (bad_total - prev_bad_total,
@@ -863,10 +907,11 @@ class Trainer:
                     # MUST flush: an async save lost to process exit is
                     # no save at all
                     if ckpt_cb is not None:
-                        try:
-                            ckpt_cb.save(state, self, sync=True)
-                        except TypeError:  # custom cb without sync kwarg
-                            ckpt_cb.save(state, self)
+                        with span("train/checkpoint"):
+                            try:
+                                ckpt_cb.save(state, self, sync=True)
+                            except TypeError:  # cb without sync kwarg
+                                ckpt_cb.save(state, self)
                     self._log({"event": "preempted_saved",
                                "step": self.global_step})
                     return state
@@ -1056,16 +1101,21 @@ class Trainer:
 
     # -- logging ---------------------------------------------------------
     def _log(self, entry: dict) -> None:
-        if jax.process_index() != 0:
+        """One structured event. Delegates to the unified JsonlSink
+        (process-0 gating, jsonl write, console echo, logger bridge) —
+        kept as a method because resilience loaders and callbacks hold
+        `log=self._log` references."""
+        self._sink(entry)
+
+    def _maybe_start_metrics_server(self) -> None:
+        """`--metrics_port N`: a stdlib exporter thread serving
+        GET /metrics for the duration of the job; process-0-gated (the
+        gate lives in start_metrics_server)."""
+        port = int(getattr(self.args, "metrics_port", 0) or 0)
+        if not port or self._metrics_server is not None:
             return
-        os.makedirs(os.path.dirname(self._log_path), exist_ok=True)
-        with open(self._log_path, "a") as f:
-            f.write(json.dumps(entry) + "\n")
-        msg = " ".join(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
-                       for k, v in entry.items())
-        print(f"[fengshen-tpu] {msg}", flush=True)
-        if self.logger is not None and hasattr(self.logger, "log_metrics"):
-            self.logger.log_metrics(
-                {k: v for k, v in entry.items()
-                 if isinstance(v, (int, float))},
-                step=entry.get("step"))
+        from fengshen_tpu.observability import start_metrics_server
+        self._metrics_server = start_metrics_server(port)
+        if self._metrics_server is not None:
+            self._log({"event": "metrics_server_started",
+                       "port": self._metrics_server.port})

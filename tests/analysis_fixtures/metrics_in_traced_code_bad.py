@@ -1,0 +1,40 @@
+"""known-bad fixture: registry mutations reached from traced code."""
+
+import jax
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+STEPS = REG.counter("fx_steps_total", "steps")
+LOSS_HIST = REG.histogram("fx_loss", "loss samples")
+
+
+class Stats:
+    def __init__(self):
+        self.tokens = REG.counter("fx_tokens_total", "tokens",
+                                  labelnames=("stage",))
+
+
+STATS = Stats()
+
+
+@jax.jit
+def jitted_step(x):
+    STEPS.inc()  # records at trace time only
+    return x * 2
+
+
+def train_step(state, batch):
+    # traced-by-convention name: every mutation below is trace-frozen
+    LOSS_HIST.observe(float(batch["x"].mean()))
+    STATS.tokens.labels("train").inc(batch["x"].size)
+    REG.gauge("fx_lr", "lr").set(0.1)
+    return state
+
+
+def outer(xs):
+    def body(carry, x):
+        STEPS.inc()  # scan body is traced
+        return carry + x, None
+
+    return jax.lax.scan(body, 0.0, xs)

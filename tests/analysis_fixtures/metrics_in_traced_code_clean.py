@@ -1,0 +1,27 @@
+"""known-clean fixture: metrics recorded on the host, around the jit —
+and jax's `.at[...].set(...)` (NOT a metric mutation) inside it."""
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+STEPS = REG.counter("fx_clean_steps_total", "steps")
+LOSS_HIST = REG.histogram("fx_clean_loss", "loss samples")
+
+
+@jax.jit
+def step(x):
+    # functional-update idiom: receiver is a subscript, not a metric
+    x = x.at[0].set(jnp.float32(0.0))
+    return x * 2
+
+
+def run_one(state, batch):
+    # host side: dispatch the jitted step, then record what came back
+    out = step(batch)
+    STEPS.inc()
+    LOSS_HIST.observe(float(out.mean()))
+    REG.gauge("fx_clean_lr", "lr").set(0.1)
+    return state, out

@@ -25,7 +25,8 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "analysis_fixtures")
 
 RULE_IDS = ("blanket-except", "blocking-transfer", "host-divergence",
-            "nondet-iteration", "partition-spec-axes", "retrace-hazard")
+            "metrics-in-traced-code", "nondet-iteration",
+            "partition-spec-axes", "retrace-hazard")
 
 
 def _fixture(rule_id: str, kind: str) -> str:

@@ -1,0 +1,452 @@
+"""Observability subsystem tests (fast CPU lane — NOT marked slow):
+registry determinism, histogram percentiles vs the reference
+implementation, span nesting + the no-profiler fallback, the MFU
+estimator against a hand-computed llama-shape FLOPs count, Prometheus
+exposition through both server paths, process_index gating, and the
+acceptance-bar Trainer fit logging a finite `mfu`.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fengshen_tpu.observability import (JsonlSink, MetricsRegistry,
+                                        NOMINAL_FALLBACK_FLOPS, PEAK_FLOPS,
+                                        StepStats, current_span_stack,
+                                        estimate_flops_per_token,
+                                        get_registry, peak_flops_per_chip,
+                                        percentile, render_prometheus,
+                                        span, start_metrics_server)
+
+
+# -- registry -------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "c")
+    c.inc()
+    c.inc(2)
+    assert c.value() == 3
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+    g = r.gauge("t_gauge", "g")
+    g.set(5.0)
+    g.inc()
+    g.dec(0.5)
+    assert g.value() == 5.5
+    h = r.histogram("t_hist", "h", buckets=(1.0, 10.0))
+    for v in (0.5, 2.0, 50.0):
+        h.observe(v)
+    child = h.labels() if h.labelnames else h._only_child()
+    assert child.count == 3 and child.sum == 52.5
+    assert child.counts == [1, 1, 1]  # <=1, <=10, +Inf
+
+
+def test_registry_get_or_create_and_conflicts():
+    r = MetricsRegistry()
+    a = r.counter("same_total", "x")
+    assert r.counter("same_total", "x") is a
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("same_total", "x")
+    with pytest.raises(ValueError, match="already registered"):
+        r.counter("same_total", "x", labelnames=("k",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        r.counter("bad name", "x")
+    lab = r.counter("lab_total", "x", labelnames=("k",))
+    with pytest.raises(ValueError, match="label"):
+        lab.labels("a", "b")
+    with pytest.raises(ValueError, match="labelled"):
+        lab.inc()
+
+
+def test_render_prometheus_is_sorted_and_typed():
+    r = MetricsRegistry()
+    # insert in an order that differs from sorted order
+    r.gauge("zz_gauge", "z").set(1)
+    c = r.counter("aa_total", "a", labelnames=("k",))
+    for key in {"zebra", "alpha", "mid"}:  # set: hash-ordered source
+        c.labels(key).inc()
+    text = render_prometheus(r)
+    lines = text.splitlines()
+    assert lines[0] == "# HELP aa_total a"
+    assert lines[1] == "# TYPE aa_total counter"
+    assert lines[2:5] == ['aa_total{k="alpha"} 1',
+                         'aa_total{k="mid"} 1',
+                         'aa_total{k="zebra"} 1']
+    assert lines[-1] == "zz_gauge 1"
+
+
+def test_render_deterministic_across_hashseed():
+    """Byte-identical exposition no matter PYTHONHASHSEED: label values
+    arrive from a set (hash-ordered), rendering must sort them."""
+    snippet = textwrap.dedent("""
+        from fengshen_tpu.observability import (MetricsRegistry,
+                                                render_prometheus)
+        r = MetricsRegistry()
+        c = r.counter("t_total", "t", labelnames=("k",))
+        for key in {"a", "b", "c", "dd", "ee", "zz", "m1", "m2"}:
+            c.labels(key).inc()
+        h = r.histogram("t_h", "h", labelnames=("k",))
+        for key in {"x", "y", "z"}:
+            h.labels(key).observe(1.0)
+        print(render_prometheus(r))
+    """)
+    outs = set()
+    for seed in ("0", "1", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        outs.add(subprocess.run(
+            [sys.executable, "-c", snippet], env=env, check=True,
+            capture_output=True, text=True).stdout)
+    assert len(outs) == 1
+
+
+def test_histogram_percentile_matches_reference():
+    """`registry.percentile` (the single implementation) agrees with
+    the PR-3 serving implementation it replaced, across sizes/qs."""
+    def reference(values, q):  # verbatim old serving/metrics.py
+        vals = sorted(values)
+        if not vals:
+            return 0.0
+        idx = min(int(q * len(vals)), len(vals) - 1)
+        return float(vals[idx])
+
+    rng = np.random.RandomState(7)
+    r = MetricsRegistry()
+    for n in (0, 1, 2, 7, 100, 513):
+        h = r.histogram(f"h_{n}", "h", window=512)
+        vals = rng.rand(n).tolist()
+        for v in vals:
+            h.observe(v)
+        window = vals[-512:]  # histogram window is bounded
+        for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+            assert h.percentile(q) == reference(window, q)
+            assert percentile(window, q) == reference(window, q)
+
+
+# -- spans ----------------------------------------------------------------
+
+def test_span_nesting_and_labels():
+    r = MetricsRegistry()
+    with span("outer", registry=r):
+        assert current_span_stack() == ("outer",)
+        with span("inner", registry=r):
+            assert current_span_stack() == ("outer", "inner")
+    assert current_span_stack() == ()
+    metric = r.get("fstpu_span_seconds")
+    labels = [v for v, _ in metric.children()]
+    assert (("outer",) in labels and ("outer/inner",) in labels)
+
+
+def test_span_fallback_without_jax_profiler(monkeypatch):
+    import fengshen_tpu.observability.tracing as tracing
+    monkeypatch.setattr(tracing, "_TRACE_ANNOTATION", None)
+    r = MetricsRegistry()
+    with span("noprof", registry=r):
+        pass
+    child = r.get("fstpu_span_seconds").labels("noprof")
+    assert child.count == 1 and child.sum >= 0
+
+
+def test_span_records_on_exception():
+    r = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with span("boom", registry=r):
+            raise RuntimeError("x")
+    assert r.get("fstpu_span_seconds").labels("boom").count == 1
+    assert current_span_stack() == ()
+
+
+# -- flops / mfu ----------------------------------------------------------
+
+class _Cfg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_flops_estimator_hand_computed_llama_shape():
+    # h=32, l=3, inter=64, v=97, 4 heads (no GQA):
+    #   per_layer = 2*32*32 (q+o) + 2*32*32 (k+v) + 3*32*64 (mlp)
+    #             = 2048 + 2048 + 6144 = 10240
+    #   total = 3*10240 + 32*97 = 30720 + 3104 = 33824 -> x6 = 202944
+    cfg = _Cfg(hidden_size=32, num_hidden_layers=3,
+               intermediate_size=64, vocab_size=97,
+               num_attention_heads=4)
+    assert estimate_flops_per_token(cfg) == 202944.0
+    assert estimate_flops_per_token(cfg, include_backward=False) == \
+        202944.0 / 3
+    # GQA: 8 kv heads of head_dim 128 under 40 query heads (13B shape)
+    gqa = _Cfg(hidden_size=5120, num_hidden_layers=1,
+               intermediate_size=13824, vocab_size=0,
+               num_attention_heads=40, num_key_value_heads=8)
+    per_layer = (2 * 5120 * 5120 + 2 * 5120 * (8 * 128)
+                 + 3 * 5120 * 13824)
+    assert estimate_flops_per_token(gqa) == 6.0 * per_layer
+    # unsupported config (no hidden_size/num_hidden_layers) -> None
+    assert estimate_flops_per_token(_Cfg(d_model=768)) is None
+
+
+def test_peak_flops_resolution(monkeypatch):
+    assert peak_flops_per_chip("TPU v5e") == PEAK_FLOPS["TPU v5e"]
+    assert peak_flops_per_chip("weird chip") == NOMINAL_FALLBACK_FLOPS
+    monkeypatch.setenv("FSTPU_PEAK_FLOPS", "2.5e13")
+    assert peak_flops_per_chip("TPU v5e") == 2.5e13
+    monkeypatch.setenv("FSTPU_PEAK_FLOPS", "-1")
+    with pytest.raises(ValueError):
+        peak_flops_per_chip()
+
+
+def test_stepstats_mfu_and_goodput():
+    r = MetricsRegistry()
+    clock = [0.0]
+    stats = StepStats(flops_per_token=100.0, n_devices=2,
+                      device_kind="weird chip", registry=r,
+                      clock=lambda: clock[0])
+    stats.record_execution(n_steps=2, n_tokens=1000)
+    clock[0] = 2.0
+    entry = stats.window_entry(global_step=2, bad_step_count=0)
+    assert entry["tokens_per_sec"] == 500.0
+    assert entry["mfu"] == pytest.approx(
+        500.0 * 100.0 / (2 * NOMINAL_FALLBACK_FLOPS))
+    assert entry["goodput"] == 1.0
+    # window resets: no tokens since -> 0 tps
+    clock[0] = 3.0
+    assert stats.window_entry(4, 0)["tokens_per_sec"] == 0.0
+    # guards skipped 3 of 10 steps, one rewind replayed 5
+    stats.record_rewind(from_step=10, to_step=5)
+    assert stats.goodput(global_step=10, bad_step_count=3) == \
+        pytest.approx(7 / 15)
+    assert int(r.get("fstpu_train_rewinds_total").value()) == 1
+
+
+# -- sink -----------------------------------------------------------------
+
+def test_jsonl_sink_writes_and_echoes(tmp_path, capsys):
+    path = tmp_path / "sub" / "metrics.jsonl"
+    sink = JsonlSink(path=str(path), echo=True)
+    sink({"event": "x", "v": 1.23456, "n": 7})
+    sink({"event": "y"})
+    lines = [json.loads(l) for l in open(path)]
+    assert lines == [{"event": "x", "v": 1.23456, "n": 7},
+                     {"event": "y"}]
+    out = capsys.readouterr().out
+    assert "[fengshen-tpu] event=x v=1.235 n=7" in out
+
+
+def test_jsonl_sink_stream_and_logger(tmp_path):
+    import io
+    buf = io.StringIO()
+    seen = []
+
+    class Logger:
+        def log_metrics(self, metrics, step=None):
+            seen.append((metrics, step))
+
+    sink = JsonlSink(stream=buf, logger=Logger())
+    sink({"step": 3, "loss": 1.5, "note": "text"})
+    assert json.loads(buf.getvalue()) == {"step": 3, "loss": 1.5,
+                                          "note": "text"}
+    assert seen == [({"step": 3, "loss": 1.5}, 3)]
+
+
+def test_jsonl_sink_process_index_gating(tmp_path, monkeypatch):
+    import fengshen_tpu.observability.sink as sink_mod
+    monkeypatch.setattr(sink_mod, "_process_index", lambda: 1)
+    path = tmp_path / "m.jsonl"
+    JsonlSink(path=str(path))({"event": "x"})
+    assert not path.exists()
+    JsonlSink(path=str(path), only_process_zero=False)({"event": "x"})
+    assert path.exists()
+
+
+# -- exposition endpoints -------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type"), \
+            r.read().decode()
+
+
+def test_metrics_exporter_thread_and_gating(monkeypatch):
+    reg = MetricsRegistry()
+    reg.counter("exp_total", "x").inc(4)
+    server = start_metrics_server(0, host="127.0.0.1",
+                                  registries=(reg,))
+    try:
+        code, ctype, body = _get(
+            f"http://127.0.0.1:{server.port}/metrics")
+        assert code == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert "exp_total 4" in body
+        code, _, _ = _get(f"http://127.0.0.1:{server.port}/healthz")
+        assert code == 200
+    finally:
+        server.close()
+    # multihost gating: non-zero process index binds no socket
+    import fengshen_tpu.observability.exposition as expo
+    monkeypatch.setattr(expo, "_process_index", lambda: 1)
+    assert start_metrics_server(0, registries=(reg,)) is None
+
+
+def test_metrics_endpoint_stdlib_server_simple_pipeline():
+    """GET /metrics on the stdlib server path: valid Prometheus text,
+    and the HTTP request counter shows up after a POST."""
+    from fengshen_tpu.api.main import (PipelineConfig, ServerConfig,
+                                       build_stdlib_server)
+
+    server = build_stdlib_server(
+        ServerConfig(host="127.0.0.1", port=0),
+        PipelineConfig(task="text_classification"),
+        pipeline=lambda text: {"label": 0})
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/text_classification",
+            data=json.dumps({"input_text": "hi"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        code, ctype, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        assert ('fstpu_http_requests_total{route='
+                '"/api/text_classification",code="200"} 1') in body
+        # every sample line parses as `name{labels} value`
+        for line in body.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name_part, _, value = line.rpartition(" ")
+            float(value)
+            assert name_part
+    finally:
+        server.shutdown()
+
+
+def test_metrics_endpoint_fastapi_path():
+    pytest.importorskip("fastapi")
+    from fastapi.testclient import TestClient
+    from fengshen_tpu.api.main import PipelineConfig, build_app
+
+    app = build_app(PipelineConfig(task="text_classification"),
+                    pipeline=lambda text: {"label": 0})
+    client = TestClient(app)
+    assert client.post("/api/text_classification",
+                       json={"input_text": "x"}).status_code == 200
+    r = client.get("/metrics")
+    assert r.status_code == 200
+    assert r.headers["content-type"].startswith(
+        "text/plain; version=0.0.4")
+    assert "fstpu_http_requests_total" in r.text
+
+
+# -- engine metrics adapter ----------------------------------------------
+
+def test_engine_metrics_snapshot_shape_pinned():
+    """EngineMetrics over the registry keeps the exact PR-3 /stats JSON
+    shape, and its registry renders the same numbers as Prometheus."""
+    from fengshen_tpu.serving.metrics import EngineMetrics
+
+    m = EngineMetrics()
+    m.count("admitted", 2)
+    m.count("completed")
+    m.record_prefill(64)
+    m.record_prefill(64)
+    m.record_tick(3, 8, 0.5)
+    m.record_ttft(0.2)
+    m.record_ttft(0.4)
+    m.warmup_compile_s = 1.5
+    snap = m.snapshot(queue_depth=1, slots_active=3, num_slots=8)
+    assert snap == {
+        "queue_depth": 1, "slots_active": 3, "num_slots": 8,
+        "admitted": 2, "rejected_queue_full": 0,
+        "rejected_prompt_too_long": 0, "completed": 1,
+        "cancelled": 0, "expired": 0,
+        "prefills_per_bucket": {64: 2},
+        "decode_ticks": 1, "decode_tokens": 3,
+        "decode_tokens_per_sec": 6.0, "slot_occupancy": 0.375,
+        "ttft_avg_s": 0.3, "ttft_p50_s": 0.4, "ttft_p95_s": 0.4,
+        "warmup_compile_s": 1.5,
+    }
+    text = render_prometheus(m.registry)
+    assert "fstpu_serving_admitted_total 2" in text
+    assert 'fstpu_serving_prefills_total{bucket="64"} 2' in text
+    assert "fstpu_serving_queue_depth 1" in text
+    # two independent engines never share counts
+    m2 = EngineMetrics()
+    assert m2.snapshot(0, 0, 8)["admitted"] == 0
+
+
+# -- trainer integration (the acceptance bar) -----------------------------
+
+def _parse(argv):
+    from fengshen_tpu.data.universal_datamodule import UniversalDataModule
+    from fengshen_tpu.models.model_utils import add_module_args
+    from fengshen_tpu.trainer import add_trainer_args
+    parser = argparse.ArgumentParser()
+    add_module_args(parser)
+    add_trainer_args(parser)
+    UniversalDataModule.add_data_specific_args(parser)
+    return parser.parse_args(argv)
+
+
+def test_trainer_fit_logs_finite_mfu_and_goodput(tmp_path):
+    """Tiny CPU fit: every step entry carries a finite `mfu` computed
+    by the estimator (nominal CPU peak) and a goodput of 1.0 on a
+    clean run; the exporter flag serves the same numbers over HTTP."""
+    from fengshen_tpu.data import UniversalDataModule
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer import Trainer
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    args = _parse(["--train_batchsize", "4", "--learning_rate", "1e-3",
+                   "--warmup_steps", "1", "--log_every_n_steps", "1",
+                   "--max_steps", "2", "--metrics_port", "0",
+                   "--default_root_dir", str(tmp_path)])
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2,
+                      max_position_embeddings=32, dtype="float32")
+    rng = np.random.RandomState(0)
+    rows = [{"input_ids": rng.randint(0, 63, 16).tolist()}
+            for _ in range(16)]
+
+    class DS:
+        def __len__(self):
+            return len(rows)
+
+        def __getitem__(self, i):
+            return rows[i]
+
+    module = CausalLMModule(args, LlamaForCausalLM(cfg), cfg)
+    dm = UniversalDataModule(args=args, datasets={"train": DS()})
+    trainer = Trainer(args)
+    state = trainer.fit(module, dm)
+    assert int(state.step) == 2
+
+    lines = [json.loads(l)
+             for l in open(os.path.join(tmp_path, "metrics.jsonl"))]
+    steps = [l for l in lines if "mfu" in l]
+    assert len(steps) == 2
+    for entry in steps:
+        assert np.isfinite(entry["mfu"]) and entry["mfu"] > 0
+        assert entry["goodput"] == 1.0
+        assert np.isfinite(entry["tokens_per_sec"])
+    # the estimator (not 6N) provided flops_per_token: cross-check the
+    # published gauge against a recomputation from the entry
+    from fengshen_tpu.observability import get_registry
+    reg = get_registry()
+    assert reg.get("fstpu_train_mfu") is not None
+    assert reg.get("fstpu_train_step").value() == 2
+    # spans recorded for load/step (checkpoint span needs a ckpt cb)
+    span_labels = {v[0] for v, _ in
+                   reg.get("fstpu_span_seconds").children()}
+    assert "train/load" in span_labels
+    assert "train/step" in span_labels
