@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-fast serve-bench
+.PHONY: lint lint-baseline test test-fast serve-bench aot-bench
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -14,6 +14,12 @@ lint:
 # BENCH rounds can track serving throughput without a healthy relay
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.serving.bench
+
+# AOT cold-start microbench (docs/aot_cache.md): cold-process vs
+# warm-process engine warmup through the persistent executable cache,
+# one BENCH-schema JSON line (aot_cold_s, aot_warm_s, speedup)
+aot-bench:
+	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.aot.bench
 
 lint-baseline:
 	$(PY) -m fengshen_tpu.analysis --write-baseline

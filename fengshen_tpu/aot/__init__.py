@@ -1,0 +1,32 @@
+"""AOT compile-cache subsystem (docs/aot_cache.md): persistent
+executable cache + warmup manifests for near-zero cold start.
+
+`cached_compile` / `CachedFunction` split jit into lower (cheap, keys
+the cache) and compile (expensive, skipped when a serialized executable
+for the same environment + StableHLO already exists on disk);
+`WarmupManifest` records every shape a process compiles and replays
+them thread-parallel at the next startup. Wired into the serving
+engine (`ContinuousBatchingEngine(aot=...)`), the trainer
+(`--aot_cache_dir`), the api server (the `AOT` config block), and the
+`python -m fengshen_tpu.aot {warm,ls,purge}` CLI.
+"""
+
+from fengshen_tpu.aot.cache import (BLOB_SUFFIX, BLOB_VERSION,
+                                    DEFAULT_MAX_BYTES, ERRORS_METRIC,
+                                    HITS_METRIC, MISSES_METRIC,
+                                    CachedFunction, CacheEntry,
+                                    ExecutableCache, cache_key,
+                                    cached_compile,
+                                    package_source_digest,
+                                    trusted_fingerprint)
+from fengshen_tpu.aot.warmup import (AotConfig, AotSetup,
+                                     WarmupManifest, decode_avals,
+                                     encode_avals)
+
+__all__ = [
+    "AotConfig", "AotSetup", "BLOB_SUFFIX", "BLOB_VERSION",
+    "CacheEntry", "CachedFunction", "DEFAULT_MAX_BYTES",
+    "ERRORS_METRIC", "ExecutableCache", "HITS_METRIC", "MISSES_METRIC",
+    "WarmupManifest", "cache_key", "cached_compile", "decode_avals",
+    "encode_avals", "package_source_digest", "trusted_fingerprint",
+]

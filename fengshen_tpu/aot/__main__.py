@@ -1,0 +1,146 @@
+"""AOT cache CLI: pre-bake, inspect, and prune executable caches.
+
+    python -m fengshen_tpu.aot warm  --config server.json
+    python -m fengshen_tpu.aot ls    --cache-dir /var/cache/fstpu [--json]
+    python -m fengshen_tpu.aot purge --cache-dir /var/cache/fstpu \
+        [--all | --older-than SECONDS | --max-bytes N]
+
+`warm` takes the SAME JSON config file the api server runs from
+(PIPELINE + AOT blocks, docs/aot_cache.md): it builds the pipeline and
+the continuous engine exactly as the server would, runs the engine
+warmup (manifest replay + every prefill bucket + decode), and exits —
+leaving the cache dir fully populated. CI/deploy images run it once at
+build time so every replica boots warm; the warmup must be executed on
+the SAME accelerator topology the replica will see (the cache key pins
+backend/device kind/count).
+
+Exit codes: 0 ok; 2 usage error (bad config, missing AOT block).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def cmd_ls(args) -> int:
+    from fengshen_tpu.aot import ExecutableCache
+    cache = ExecutableCache(args.cache_dir)
+    entries = cache.entries()
+    now = time.time()
+    if args.json:
+        print(json.dumps({
+            "cache_dir": args.cache_dir,
+            "total_bytes": sum(e.size_bytes for e in entries),
+            "entries": [{"name": e.name, "key": e.key,
+                         "bytes": e.size_bytes,
+                         "idle_s": round(now - e.mtime, 1)}
+                        for e in entries]}, indent=1, sort_keys=True))
+        return 0
+    if not entries:
+        print(f"{args.cache_dir}: empty")
+        return 0
+    for e in entries:
+        print(f"{e.name:<24} {e.key[:16]}  "
+              f"{_fmt_bytes(e.size_bytes):>10}  "
+              f"idle {now - e.mtime:8.1f}s")
+    print(f"total: {len(entries)} executables, "
+          f"{_fmt_bytes(sum(e.size_bytes for e in entries))}")
+    return 0
+
+
+def cmd_purge(args) -> int:
+    from fengshen_tpu.aot import ExecutableCache
+    if not (args.all or args.older_than is not None
+            or args.max_bytes is not None):
+        print("purge: pass --all, --older-than SECONDS, or "
+              "--max-bytes N", file=sys.stderr)
+        return 2
+    cache = ExecutableCache(args.cache_dir)
+    removed = cache.purge(max_bytes=args.max_bytes,
+                          older_than_s=args.older_than,
+                          drop_all=args.all)
+    print(f"purged {len(removed)} executables "
+          f"({_fmt_bytes(sum(e.size_bytes for e in removed))}); "
+          f"{_fmt_bytes(cache.total_bytes())} remain")
+    return 0
+
+
+def cmd_warm(args) -> int:
+    from fengshen_tpu.api.main import (create_continuous_engine,
+                                       load_config)
+    from fengshen_tpu.observability import record_build_info
+    try:
+        server_cfg, pipeline_cfg = load_config(args.config)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"warm: cannot load config {args.config!r}: {e}",
+              file=sys.stderr)
+        return 2
+    aot_args = dict(server_cfg.aot_args)
+    if args.cache_dir:
+        aot_args["cache_dir"] = args.cache_dir
+    if not aot_args.get("cache_dir"):
+        print("warm: the config has no AOT block (and no --cache-dir "
+              "override) — nothing to pre-bake", file=sys.stderr)
+        return 2
+    record_build_info()
+    from fengshen_tpu.api.main import _resolve_pipeline
+    pipeline = _resolve_pipeline(pipeline_cfg)
+    engine = create_continuous_engine(
+        pipeline, server_cfg.engine_args, aot_args=aot_args,
+        log=lambda entry: print(json.dumps(entry), flush=True))
+    dt = engine.warmup()
+    cache = engine._aot.cache
+    print(f"warmed {pipeline_cfg.task} in {dt:.1f}s — cache "
+          f"{aot_args['cache_dir']}: {len(cache.entries())} "
+          f"executables, {_fmt_bytes(cache.total_bytes())}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fengshen_tpu.aot",
+        description="AOT executable cache tools (docs/aot_cache.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_warm = sub.add_parser(
+        "warm", help="pre-bake a cache from a server config (CI/deploy)")
+    p_warm.add_argument("--config", required=True, type=str,
+                        help="api server JSON config (PIPELINE + AOT)")
+    p_warm.add_argument("--cache-dir", default=None, type=str,
+                        help="override the AOT block's cache_dir")
+    p_warm.set_defaults(fn=cmd_warm)
+
+    p_ls = sub.add_parser("ls", help="list cached executables")
+    p_ls.add_argument("--cache-dir", required=True, type=str)
+    p_ls.add_argument("--json", action="store_true")
+    p_ls.set_defaults(fn=cmd_ls)
+
+    p_purge = sub.add_parser("purge", help="evict cached executables")
+    p_purge.add_argument("--cache-dir", required=True, type=str)
+    p_purge.add_argument("--all", action="store_true",
+                         help="drop every entry")
+    p_purge.add_argument("--older-than", default=None, type=float,
+                         metavar="SECONDS",
+                         help="drop entries idle longer than this")
+    p_purge.add_argument("--max-bytes", default=None, type=int,
+                         help="drop least-recently-used entries until "
+                              "the dir fits")
+    p_purge.set_defaults(fn=cmd_purge)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

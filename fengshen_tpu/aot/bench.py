@@ -1,0 +1,142 @@
+"""AOT cold-start microbench: cold-process vs warm-process engine
+warmup (`make aot-bench`).
+
+Two CHILD processes run the identical startup sequence — build a
+llama-shaped model, build the continuous-batching engine with an AOT
+cache attached, run `engine.warmup()` (manifest replay + every prefill
+bucket + the jitted decode), then greedy-generate a fixed prompt:
+
+- the COLD child starts against an empty cache dir and pays full XLA
+  compilation (populating the cache + warmup manifest as it goes);
+- the WARM child starts against the now-populated dir and
+  deserializes.
+
+Separate processes, not two engines in one process: jax's in-memory
+jit caches would otherwise hand the second engine its executables for
+free and measure nothing. The parent emits ONE JSON line in the BENCH
+schema ({"metric", "value", "unit", "vs_baseline"} — value =
+cold/warm warmup speedup) with `aot_cold_s`, `aot_warm_s`, and
+`token_identical` (the warm child's greedy tokens must equal the cold
+child's: the acceptance bar couples the speedup to decode parity).
+
+    make aot-bench
+    AOT_BENCH_LAYERS=8 python -m fengshen_tpu.aot.bench
+
+Env knobs (AOT_BENCH_*): VOCAB, HIDDEN, INTER, LAYERS, HEADS, SLOTS,
+BUCKETS (comma list), NEW_TOKENS, SEED, WORKERS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _env(name: str, default: int) -> int:
+    return int(os.environ.get(f"AOT_BENCH_{name}", default))
+
+
+def _child(cache_dir: str) -> None:
+    """One measured process startup; prints a single JSON line."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fengshen_tpu.aot import AotConfig, AotSetup
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.serving import (ContinuousBatchingEngine,
+                                      EngineConfig)
+
+    # default shape: deep enough that XLA compile dominates the cold
+    # start (cold cost grows with layer count and bucket count; a warm
+    # start adopts executables by manifest key and pays neither tracing
+    # nor compile, so it stays flat — the same asymmetry real pods see,
+    # where compile is minutes and deserialize is milliseconds)
+    buckets = tuple(int(b) for b in os.environ.get(
+        "AOT_BENCH_BUCKETS", "32,64,128").split(","))
+    new_tokens = _env("NEW_TOKENS", 8)
+    config = LlamaConfig(
+        vocab_size=_env("VOCAB", 2048),
+        hidden_size=_env("HIDDEN", 512),
+        intermediate_size=_env("INTER", 1024),
+        num_hidden_layers=_env("LAYERS", 8),
+        num_attention_heads=_env("HEADS", 8),
+        max_position_embeddings=buckets[-1] + new_tokens,
+        dtype="float32")
+    model = LlamaForCausalLM(config)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(_env("SEED", 0)))
+
+    aot = AotSetup(AotConfig(cache_dir=cache_dir,
+                             workers=_env("WORKERS", 4)))
+    engine = ContinuousBatchingEngine(
+        model, params,
+        EngineConfig(num_slots=_env("SLOTS", 4), buckets=buckets,
+                     max_new_tokens=new_tokens, max_queue=8,
+                     eos_token_id=None, pad_token_id=0),
+        aot=aot)
+    warmup_s = engine.warmup()
+    # greedy decode through the (possibly deserialized) executables —
+    # the parent pins cold-vs-warm token identity
+    rng = np.random.RandomState(_env("SEED", 0))
+    prompt = rng.randint(3, config.vocab_size - 1,
+                         max(buckets[0] - 3, 1)).astype(np.int32)
+    tokens = engine.generate_all([prompt])[0]
+    print(json.dumps({"warmup_s": round(warmup_s, 3),
+                      "tokens": [int(t) for t in tokens],
+                      "backend": jax.default_backend(),
+                      "cache_files": sum(
+                          1 for f in os.listdir(cache_dir)
+                          if f.endswith(".aotx"))}), flush=True)
+
+
+def _run_child(cache_dir: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-m", "fengshen_tpu.aot.bench", "--child",
+         cache_dir],
+        capture_output=True, text=True, timeout=1800)
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"aot bench child failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    return json.loads(lines[-1])
+
+
+def main() -> None:
+    # parent stays jax-free: the children own the measured startups
+    from fengshen_tpu.observability import JsonlSink
+
+    with tempfile.TemporaryDirectory(prefix="fstpu-aot-bench-") as d:
+        t0 = time.perf_counter()
+        cold = _run_child(d)
+        warm = _run_child(d)
+        total_s = time.perf_counter() - t0
+    speedup = cold["warmup_s"] / max(warm["warmup_s"], 1e-9)
+    row = {
+        "metric": "aot_warm_warmup_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 2),
+        "aot_cold_s": cold["warmup_s"],
+        "aot_warm_s": warm["warmup_s"],
+        "token_identical": cold["tokens"] == warm["tokens"],
+        "cache_files": warm["cache_files"],
+        "bench_wall_s": round(total_s, 1),
+        "backend": warm["backend"],
+    }
+    if os.environ.get("BENCH_DEGRADED", "0") == "1":
+        row["degraded"] = True
+    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        main()

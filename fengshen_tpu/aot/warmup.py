@@ -1,0 +1,320 @@
+"""Warmup manifests: record every shape a process compiles, replay
+them all at the next startup.
+
+The executable cache (`fengshen_tpu.aot.cache`) removes the XLA cost of
+a compile the process has ALREADY asked for — but a freshly restarted
+server only asks as traffic arrives. The manifest closes that gap:
+
+- **record** (`record=True` on `WarmupManifest` / the AOT config
+  block): every (fn name, argument avals, mesh axes) that reaches
+  `CachedFunction` for the first time is appended to a JSON file,
+  deduplicated, committed by atomic rename;
+- **replay** (`replay()`): at startup, every manifest entry whose fn
+  name the caller registers is pre-compiled — or, with a warm cache,
+  deserialized — on a thread pool (XLA compilation releases the GIL,
+  so buckets compile in parallel), BEFORE the first request arrives.
+
+The serving engine replays `serving/prefill` (every bucket),
+`serving/assign`, and `serving/decode` inside `warmup()`; the `python
+-m fengshen_tpu.aot warm` CLI replays in CI/deploy images so the
+shipped cache is pre-baked (docs/aot_cache.md).
+
+Avals are stored structurally (nested dict/list/tuple tags with
+shape+dtype leaves), so a manifest is valid across processes but NOT
+across model-shape changes — a stale entry simply compiles an
+executable nobody calls, it cannot corrupt anything. A corrupt manifest
+file logs and starts empty (same never-break-a-job stance as the
+cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from fengshen_tpu.aot.cache import (DEFAULT_MAX_BYTES, CachedFunction,
+                                    ExecutableCache)
+
+MANIFEST_VERSION = 1
+
+
+# ---- aval (de)serialization ---------------------------------------------
+
+def encode_avals(obj: Any) -> Any:
+    """Positional args → JSON-safe nested structure. Leaves keep only
+    shape+dtype (exactly what `.lower()` needs); containers keep their
+    type so the pytree structure round-trips.
+
+    Raises ValueError on anything it cannot represent faithfully —
+    custom pytree nodes like the trainer's TrainState would otherwise
+    collapse to a 0-d object leaf, recording manifest entries that can
+    never replay (the caller skips such entries; the executable cache
+    itself is unaffected)."""
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, Mapping):
+        return {"t": "dict",
+                "v": {str(k): encode_avals(v)
+                      for k, v in sorted(obj.items())}}
+    if isinstance(obj, (list, tuple)):
+        return {"t": "list" if isinstance(obj, list) else "tuple",
+                "v": [encode_avals(v) for v in obj]}
+    dtype = getattr(obj, "dtype", None)
+    if dtype is None:
+        dtype = np.asarray(obj).dtype
+    if np.dtype(dtype) == object:
+        raise ValueError(
+            f"cannot encode avals for {type(obj).__name__} — only "
+            "arrays and dict/list/tuple containers round-trip through "
+            "a manifest")
+    return {"t": "aval", "shape": [int(d) for d in np.shape(obj)],
+            "dtype": str(np.dtype(dtype))}
+
+
+def decode_avals(enc: Any) -> Any:
+    import jax
+    t = enc["t"]
+    if t == "none":
+        return None
+    if t == "dict":
+        return {k: decode_avals(v) for k, v in enc["v"].items()}
+    if t == "list":
+        return [decode_avals(v) for v in enc["v"]]
+    if t == "tuple":
+        return tuple(decode_avals(v) for v in enc["v"])
+    if t == "aval":
+        return jax.ShapeDtypeStruct(tuple(enc["shape"]),
+                                    np.dtype(enc["dtype"]))
+    raise ValueError(f"unknown aval tag {t!r}")
+
+
+def _encode_mesh(mesh: Any) -> Optional[list]:
+    if mesh is None:
+        return None
+    return sorted([str(k), int(v)] for k, v in dict(mesh.shape).items())
+
+
+# ---- the manifest --------------------------------------------------------
+
+class WarmupManifest:
+    """JSON file of every (name, avals, mesh) worth pre-compiling."""
+
+    def __init__(self, path: str, record: bool = False,
+                 log: Optional[Callable[[dict], None]] = None):
+        self.path = path
+        self.record_mode = record
+        self._log = log or (lambda entry: None)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}   # dedup key -> entry
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+                if raw.get("version") != MANIFEST_VERSION:
+                    raise ValueError(
+                        f"manifest version {raw.get('version')!r}")
+                for entry in raw.get("entries", []):
+                    self._entries[self._dedup_key(entry)] = entry
+            except Exception as e:  # noqa: BLE001 — a corrupt manifest
+                # starts empty (and gets rewritten on the next record),
+                # it never blocks startup
+                self._log({"event": "aot_manifest_error", "path": path,
+                           "error": str(e)[:500]})
+                self._entries = {}
+
+    @staticmethod
+    def _dedup_key(entry: dict) -> str:
+        return json.dumps([entry.get("name"), entry.get("avals"),
+                           entry.get("mesh")], sort_keys=True)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self, name: Optional[str] = None) -> List[dict]:
+        out = [e for e in self._entries.values()
+               if name is None or e.get("name") == name]
+        return sorted(out, key=self._dedup_key)
+
+    def record(self, name: str, args: tuple, mesh: Any = None,
+               key: Optional[str] = None,
+               fingerprint: Optional[str] = None) -> bool:
+        """Append one compile site (dedup'd by name+avals+mesh; a
+        re-record with a new cache key/fingerprint — code or config
+        drift — overwrites newest-wins); True when the manifest
+        changed. No-op unless opened with record=True.
+
+        `key`/`fingerprint` enable TRUSTED replay (docs/aot_cache.md):
+        the cache key the compile landed under, and the code+env+config
+        fingerprint under which that key may be adopted without
+        re-lowering."""
+        if not self.record_mode:
+            return False
+        try:
+            avals = encode_avals(tuple(args))
+        except (ValueError, TypeError) as e:
+            # un-roundtrippable args (custom pytree nodes — the
+            # trainer's TrainState): the executable cache still works
+            # by content address, only manifest replay is unavailable
+            self._log({"event": "aot_manifest_skip", "fn": name,
+                       "reason": str(e)[:200]})
+            return False
+        entry = {"name": name, "avals": avals,
+                 "mesh": _encode_mesh(mesh), "key": key,
+                 "fingerprint": fingerprint}
+        dk = self._dedup_key(entry)
+        with self._lock:
+            if self._entries.get(dk) == entry:
+                return False
+            self._entries[dk] = entry
+            self._save_locked()
+        return True
+
+    def _save_locked(self) -> None:
+        doc = {"version": MANIFEST_VERSION,
+               "entries": self.entries()}
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".manifest-tmp-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            self._log({"event": "aot_manifest_error", "path": self.path,
+                       "error": str(e)[:500]})
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def replay(self, functions: Dict[str, CachedFunction],
+               workers: int = 4, trusted: bool = True) -> dict:
+        """Pre-compile/deserialize every entry whose name is in
+        `functions`, thread-parallel. Returns a summary dict; per-entry
+        failures are logged and skipped (a stale manifest must not
+        block startup).
+
+        With `trusted` (the near-zero-cold-start path), an entry whose
+        recorded fingerprint matches the function's current
+        code+env+config fingerprint is ADOPTED straight from the cache
+        by its recorded key — no tracing, no lowering; everything else
+        (fingerprint drift, missing blob, trusted=False) goes through
+        `warm()`: lower, hash, load-or-compile."""
+        todo = [e for e in self.entries() if e["name"] in functions]
+        skipped = len(self._entries) - len(todo)
+        t0 = time.perf_counter()
+        failed = 0
+        adopted = 0
+
+        def _one(entry: dict) -> Optional[bool]:
+            try:
+                fn = functions[entry["name"]]
+                avals = decode_avals(entry["avals"])
+                if trusted and entry.get("key") and \
+                        entry.get("fingerprint") == \
+                        fn.trusted_fingerprint() and \
+                        fn.adopt(avals, entry["key"]):
+                    return None    # adopted: no lower, no compile
+                fn.warm(*avals)
+                return True
+            except Exception as e:  # noqa: BLE001 — stale/foreign
+                # entries are logged and skipped, never fatal
+                self._log({"event": "aot_manifest_replay_error",
+                           "fn": entry.get("name"),
+                           "error": str(e)[:500]})
+                return False
+
+        if todo:
+            with ThreadPoolExecutor(
+                    max_workers=max(1, int(workers))) as pool:
+                results = list(pool.map(_one, todo))
+            failed = sum(1 for r in results if r is False)
+            adopted = sum(1 for r in results if r is None)
+        summary = {"replayed": len(todo) - failed, "failed": failed,
+                   "adopted": adopted, "skipped": skipped,
+                   "seconds": round(time.perf_counter() - t0, 3)}
+        self._log({"event": "aot_manifest_replay", **summary})
+        return summary
+
+
+# ---- config + bundle -----------------------------------------------------
+
+@dataclasses.dataclass
+class AotConfig:
+    """The `AOT` server-config block / trainer flags, as a dataclass.
+
+    `cache_dir` is the only required field. `manifest` defaults to
+    `<cache_dir>/warmup_manifest.json`; set it to "" to disable the
+    manifest entirely. Recording is on by default (appending a line of
+    JSON per new shape is free next to an XLA compile).
+    `trusted_replay` allows replay to adopt executables by recorded key
+    when the code+env+config fingerprint matches, skipping tracing
+    entirely — set False to force the verified lower-and-hash path on
+    every entry (docs/aot_cache.md)."""
+
+    cache_dir: str
+    manifest: Optional[str] = None
+    record: bool = True
+    replay: bool = True
+    trusted_replay: bool = True
+    max_bytes: int = DEFAULT_MAX_BYTES
+    workers: int = 4
+
+    def manifest_path(self) -> Optional[str]:
+        if self.manifest == "":
+            return None
+        if self.manifest is None:
+            return os.path.join(self.cache_dir, "warmup_manifest.json")
+        return self.manifest
+
+
+class AotSetup:
+    """One process's AOT wiring: the executable cache + the manifest,
+    with `wrap()` handing out `CachedFunction`s that record into both.
+    The serving engine takes one of these via its `aot=` argument; the
+    trainer builds one from `--aot_cache_dir`."""
+
+    def __init__(self, config: AotConfig, mesh: Any = None,
+                 registry: Any = None,
+                 log: Optional[Callable[[dict], None]] = None):
+        self.config = config
+        self.mesh = mesh
+        self._registry = registry
+        self._log = log or (lambda entry: None)
+        self.cache = ExecutableCache(
+            config.cache_dir, max_bytes=config.max_bytes,
+            registry=registry, log=self._log)
+        path = config.manifest_path()
+        self.manifest = WarmupManifest(
+            path, record=config.record, log=self._log) \
+            if path is not None else None
+
+    def wrap(self, fn: Any, name: str, donate_argnums=(),
+             fingerprint_extra: str = "") -> CachedFunction:
+        """`fingerprint_extra` must capture every static value the
+        caller bakes into the traced program that avals don't (model
+        config, engine config reprs) — it gates trusted replay."""
+        return CachedFunction(
+            fn, name, cache=self.cache, donate_argnums=donate_argnums,
+            mesh=self.mesh, manifest=self.manifest,
+            fingerprint_extra=fingerprint_extra,
+            registry=self._registry, log=self._log)
+
+    def replay(self, functions: Dict[str, CachedFunction]
+               ) -> Optional[dict]:
+        """Manifest replay over the caller's functions (None when no
+        manifest exists or replay is disabled)."""
+        if self.manifest is None or not self.config.replay or \
+                len(self.manifest) == 0:
+            return None
+        return self.manifest.replay(functions,
+                                    workers=self.config.workers,
+                                    trusted=self.config.trusted_replay)

@@ -11,12 +11,20 @@ Beyond the reference: `"engine": "continuous"` in the SERVER block
 routes generation tasks through the continuous-batching slot-pool
 engine (`fengshen_tpu/serving/`, docs/serving.md) — many concurrent
 requests share ONE jitted decode step; the optional ENGINE block holds
-`serving.EngineConfig` overrides (num_slots, buckets, max_queue, …).
-Both engines get a warmup request at startup so the first user never
-pays jit compilation; `GET /stats` exposes the engine metrics as JSON
-and `GET /metrics` renders the same registry (plus the process-global
-one — HTTP counters, span timings) as Prometheus text exposition, on
-BOTH the fastapi and the stdlib server paths (docs/observability.md).
+`serving.EngineConfig` overrides (num_slots, buckets, max_queue, …),
+and the optional AOT block (`{"cache_dir": ...}`, docs/aot_cache.md)
+routes every engine compile through the persistent executable cache so
+a restarted replica deserializes instead of recompiling.
+
+Both engines get warmed at startup so the first user never pays jit
+compilation — warmup runs in a BACKGROUND thread while the server is
+already listening, and `GET /healthz` answers 503 until it completes
+(load balancers must not route to a still-compiling replica) and 200
+after. `GET /stats` exposes the engine metrics as JSON and
+`GET /metrics` renders the same registry (plus the process-global one —
+HTTP counters, span timings, `fstpu_warmup_seconds{phase}`,
+`fstpu_build_info`) as Prometheus text exposition, on BOTH the fastapi
+and the stdlib server paths (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ class ServerConfig:
     warmup: bool = True
     request_timeout_s: float = 120.0
     engine_args: dict = dataclasses.field(default_factory=dict)
+    aot_args: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         if self.engine not in ("simple", "continuous"):
@@ -63,6 +72,7 @@ def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
         raw = json.load(f)
     server = ServerConfig(**raw.get("SERVER", {}))
     server.engine_args = dict(raw.get("ENGINE", {}))
+    server.aot_args = dict(raw.get("AOT", {}))
     pipeline = PipelineConfig(
         task=raw.get("PIPELINE", {}).get("task", "text_classification"),
         model=raw.get("PIPELINE", {}).get("model"),
@@ -113,6 +123,7 @@ def warmup_pipeline(pipeline, task: str) -> Optional[float]:
     """Issue one warmup request through the legacy path so the first
     user request doesn't pay jit compilation; returns seconds (None on
     failure — a broken warmup must not keep the server down)."""
+    from fengshen_tpu.observability import record_warmup_seconds
     t0 = time.perf_counter()
     try:
         pipeline("warmup")
@@ -121,15 +132,18 @@ def warmup_pipeline(pipeline, task: str) -> Optional[float]:
               "request will compile", flush=True)
         return None
     dt = time.perf_counter() - t0
+    record_warmup_seconds("pipeline", dt)
     print(f"[serving] warmup request for '{task}' compiled+ran in "
           f"{dt:.1f}s", flush=True)
     return dt
 
 
-def start_continuous_engine(pipeline, engine_args: dict,
-                            log=None):
-    """Build, warm up (compile all prefill buckets + the decode step,
-    logging the time), and start the continuous-batching engine."""
+def create_continuous_engine(pipeline, engine_args: dict,
+                             aot_args: Optional[dict] = None, log=None):
+    """Build (but do not warm or start) the continuous-batching engine;
+    `aot_args` is the AOT config block — when it names a cache_dir, the
+    engine's programs route through the persistent executable cache
+    (docs/aot_cache.md)."""
     from fengshen_tpu.serving import (ContinuousBatchingEngine,
                                       EngineConfig)
     if not hasattr(pipeline, "engine_config_kwargs"):
@@ -138,10 +152,22 @@ def start_continuous_engine(pipeline, engine_args: dict,
             "module/params/engine_config_kwargs (task "
             "'text_generation'), not a per-call classification "
             "pipeline")
+    aot = None
+    if aot_args and aot_args.get("cache_dir"):
+        from fengshen_tpu.aot import AotConfig, AotSetup
+        aot = AotSetup(AotConfig(**aot_args), log=log)
     kwargs = {**pipeline.engine_config_kwargs(), **engine_args}
-    engine = ContinuousBatchingEngine(
+    return ContinuousBatchingEngine(
         pipeline.module, pipeline.params, EngineConfig(**kwargs),
-        log=log)
+        log=log, aot=aot)
+
+
+def start_continuous_engine(pipeline, engine_args: dict, log=None,
+                            aot_args: Optional[dict] = None):
+    """Build, warm up (compile all prefill buckets + the decode step,
+    logging the time), and start the continuous-batching engine."""
+    engine = create_continuous_engine(pipeline, engine_args,
+                                      aot_args=aot_args, log=log)
     dt = engine.warmup()
     print(f"[serving] continuous engine warmup "
           f"(buckets={list(engine.ladder.buckets)}, "
@@ -185,8 +211,12 @@ def _engine_generate(engine, pipeline, req: dict,
 
 
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
-              server_cfg: Optional[ServerConfig] = None, engine=None):
-    """Create the FastAPI app around a pipeline instance."""
+              server_cfg: Optional[ServerConfig] = None, engine=None,
+              ready=None):
+    """Create the FastAPI app around a pipeline instance. `ready` is an
+    optional `threading.Event`: until set, `GET /healthz` answers 503
+    ("warming") so load balancers keep routing around a replica that is
+    still compiling; None means always ready."""
     from fastapi import FastAPI
     from fastapi.middleware.cors import CORSMiddleware
     from fastapi.responses import JSONResponse, Response
@@ -225,6 +255,12 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
 
     @app.get("/healthz")
     def healthz():
+        if ready is not None and not ready.is_set():
+            _count_http("/healthz", 503)
+            return JSONResponse(
+                status_code=503,
+                content={"status": "warming",
+                         "task": pipeline_cfg.task})
         _count_http("/healthz", 200)
         return {"status": "ok", "task": pipeline_cfg.task}
 
@@ -254,11 +290,12 @@ def _resolve_pipeline(pipeline_cfg: PipelineConfig):
 
 def build_stdlib_server(server_cfg: ServerConfig,
                         pipeline_cfg: PipelineConfig, pipeline=None,
-                        engine=None):
+                        engine=None, ready=None):
     """Dependency-free fallback server (http.server) exposing the SAME
     surface as the FastAPI app: `POST /api/<task>` with
-    `{"input_text": ...}`, `GET /healthz`, `GET /stats`. FastAPI/uvicorn
-    stay the production path; this keeps the REST surface runnable (and
+    `{"input_text": ...}`, `GET /healthz` (503 until the `ready` event
+    is set, like build_app), `GET /stats`. FastAPI/uvicorn stay the
+    production path; this keeps the REST surface runnable (and
     testable) where they are not installed."""
     import http.server
 
@@ -287,8 +324,12 @@ def build_stdlib_server(server_cfg: ServerConfig,
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"status": "ok",
-                                 "task": pipeline_cfg.task})
+                if ready is not None and not ready.is_set():
+                    self._send(503, {"status": "warming",
+                                     "task": pipeline_cfg.task})
+                else:
+                    self._send(200, {"status": "ok",
+                                     "task": pipeline_cfg.task})
             elif self.path == "/stats":
                 if engine is not None:
                     self._send(200, engine.stats())
@@ -341,26 +382,74 @@ def build_stdlib_server(server_cfg: ServerConfig,
         (server_cfg.host, server_cfg.port), Handler)
 
 
+def _start_warmup_thread(server_cfg: ServerConfig,
+                         pipeline_cfg: PipelineConfig, pipeline,
+                         engine):
+    """Warm up in the background while the server is already listening
+    (docs/aot_cache.md "cold start"): /healthz answers 503 until the
+    returned event is set, then 200 — the load-balancer readiness
+    contract. With an AOT cache the warmup is mostly deserialization
+    and the 503 window shrinks to near zero."""
+    import threading
+    ready = threading.Event()
+
+    def _warm():
+        from fengshen_tpu.observability import record_build_info
+        record_build_info()
+        try:
+            if engine is not None:
+                dt = engine.warmup()
+                print(f"[serving] continuous engine warmup "
+                      f"(buckets={list(engine.ladder.buckets)}, "
+                      f"num_slots={engine.config.num_slots}) ready in "
+                      f"{dt:.1f}s", flush=True)
+            elif server_cfg.warmup:
+                warmup_pipeline(pipeline, pipeline_cfg.task)
+        except Exception as e:  # noqa: BLE001 — warmup is best-effort;
+            # requests compile lazily (or surface the same error as a
+            # response) once the loop below starts
+            print(f"[serving] warmup failed ({e}); serving anyway — "
+                  "first requests will compile", flush=True)
+        finally:
+            # a failed warmup still opens the gate AND starts the serve
+            # loop: requests then compile lazily (or fail loudly) — a
+            # replica that reports ready while no loop drains its queue
+            # would hang every request to its full timeout instead
+            if engine is not None:
+                engine.start()
+            ready.set()
+
+    threading.Thread(target=_warm, daemon=True,
+                     name="fstpu-warmup").start()
+    return ready
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", required=True, type=str)
     args = parser.parse_args(argv)
     server_cfg, pipeline_cfg = load_config(args.config)
+    from fengshen_tpu.observability import record_build_info
+    record_build_info()
     pipeline = _resolve_pipeline(pipeline_cfg)
     engine = None
     if server_cfg.engine == "continuous":
-        # engine warmup compiles every prefill bucket + the decode step
-        engine = start_continuous_engine(pipeline,
-                                         server_cfg.engine_args)
-    elif server_cfg.warmup:
-        warmup_pipeline(pipeline, pipeline_cfg.task)
+        # warmup (all prefill buckets + the decode step) runs in the
+        # background thread below; construction itself is compile-free
+        engine = create_continuous_engine(pipeline,
+                                          server_cfg.engine_args,
+                                          aot_args=server_cfg.aot_args)
+    ready = _start_warmup_thread(server_cfg, pipeline_cfg, pipeline,
+                                 engine)
     try:
         app = build_app(pipeline_cfg, pipeline=pipeline,
-                        server_cfg=server_cfg, engine=engine)
+                        server_cfg=server_cfg, engine=engine,
+                        ready=ready)
         import uvicorn
     except ModuleNotFoundError:
         server = build_stdlib_server(server_cfg, pipeline_cfg,
-                                     pipeline=pipeline, engine=engine)
+                                     pipeline=pipeline, engine=engine,
+                                     ready=ready)
         print(f"fastapi/uvicorn not installed — stdlib server on "
               f"{server_cfg.host}:{server_cfg.port}", flush=True)
         server.serve_forever()

@@ -9,6 +9,10 @@ through here; ``GET /metrics`` (api server routes + the standalone
 exporter thread) and `/stats` read from it.
 """
 
+from fengshen_tpu.observability.buildinfo import (BUILD_INFO_METRIC,
+                                                  WARMUP_METRIC,
+                                                  record_build_info,
+                                                  record_warmup_seconds)
 from fengshen_tpu.observability.exposition import (CONTENT_TYPE_LATEST,
                                                    MetricsServer,
                                                    render_prometheus,
@@ -25,9 +29,11 @@ from fengshen_tpu.observability.stepstats import StepStats
 from fengshen_tpu.observability.tracing import (current_span_stack, span)
 
 __all__ = [
-    "CONTENT_TYPE_LATEST", "Counter", "Gauge", "Histogram", "JsonlSink",
-    "MetricsRegistry", "MetricsServer", "NOMINAL_FALLBACK_FLOPS",
-    "PEAK_FLOPS", "StepStats", "current_span_stack",
-    "estimate_flops_per_token", "get_registry", "peak_flops_per_chip",
-    "percentile", "render_prometheus", "span", "start_metrics_server",
+    "BUILD_INFO_METRIC", "CONTENT_TYPE_LATEST", "Counter", "Gauge",
+    "Histogram", "JsonlSink", "MetricsRegistry", "MetricsServer",
+    "NOMINAL_FALLBACK_FLOPS", "PEAK_FLOPS", "StepStats", "WARMUP_METRIC",
+    "current_span_stack", "estimate_flops_per_token", "get_registry",
+    "peak_flops_per_chip", "percentile", "record_build_info",
+    "record_warmup_seconds", "render_prometheus", "span",
+    "start_metrics_server",
 ]

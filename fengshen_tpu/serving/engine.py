@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fengshen_tpu.observability import span
+from fengshen_tpu.observability import record_warmup_seconds, span
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
                                         reset_free_slots)
@@ -137,12 +137,18 @@ class ContinuousBatchingEngine:
 
     `model` must use the repo's preallocated flax cache contract
     (cached_key/cached_value/cache_index — the LLaMA family). `clock`
-    is injectable for deterministic deadline tests.
+    is injectable for deterministic deadline tests. `aot` is an
+    optional `fengshen_tpu.aot.AotSetup`: when given, the prefill /
+    assign / decode programs route through the persistent executable
+    cache (`cached_compile`) instead of plain `jax.jit`, so a restarted
+    replica deserializes yesterday's executables rather than re-paying
+    XLA (docs/aot_cache.md).
     """
 
     def __init__(self, model: Any, params: Any, config: EngineConfig,
                  log: Optional[Callable[[dict], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 aot: Any = None):
         self.model = model
         self.params = params
         self.config = config
@@ -234,9 +240,26 @@ class ContinuousBatchingEngine:
         # num_slots × max_len KV pool re-copied every tick would cost
         # more than the decode itself); every donated arg is reassigned
         # from the outputs wherever these are called.
-        self._prefill_jit = jax.jit(prefill_fn)
-        self._assign_jit = jax.jit(assign_fn, donate_argnums=(0, 1, 2))
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
+        self._aot = aot
+        if aot is not None:
+            # everything the closures bake into the traced programs
+            # beyond argument avals — gates trusted manifest replay
+            # (docs/aot_cache.md): config drift must demote replay to
+            # the verified lower-and-hash path
+            fp = f"{model.config!r}::{config!r}"
+            self._prefill_jit = aot.wrap(prefill_fn, "serving/prefill",
+                                         fingerprint_extra=fp)
+            self._assign_jit = aot.wrap(assign_fn, "serving/assign",
+                                        donate_argnums=(0, 1, 2),
+                                        fingerprint_extra=fp)
+            self._decode_jit = aot.wrap(decode_fn, "serving/decode",
+                                        donate_argnums=(1, 2),
+                                        fingerprint_extra=fp)
+        else:
+            self._prefill_jit = jax.jit(prefill_fn)
+            self._assign_jit = jax.jit(assign_fn,
+                                       donate_argnums=(0, 1, 2))
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
 
     # ---- submission side -------------------------------------------
 
@@ -521,30 +544,67 @@ class ContinuousBatchingEngine:
 
     def warmup(self) -> float:
         """Compile every prefill bucket + the decode step before traffic
-        (satellite: the first user must not pay jit). Returns seconds."""
+        (the first user must not pay jit). Returns seconds.
+
+        With an AOT setup attached, the warmup manifest is replayed
+        first — thread-parallel, hitting the persistent executable
+        cache when warm (docs/aot_cache.md) — and covers `serving/
+        assign` too (which plain warmup only compiles at the first
+        admission); the loop below then finds every program already
+        built and is reduced to shape bookkeeping."""
         t0 = time.perf_counter()
-        with self._cv:
-            for bucket in self.ladder.buckets:
-                if bucket + 1 > self.max_len:
-                    continue
-                ids = np.ones((1, bucket), np.int32)
-                mask = np.ones((1, bucket), np.int32)
-                jax.block_until_ready(self._prefill_jit(
-                    self.params, ids, mask, self._zero_key))
-            # cache/history are donated, so reassign them; with every
-            # lane free the warmup tick is a no-op on pool state (free
-            # lanes write at index 0 and are fully overwritten by the
-            # next assignment anyway)
-            self._cache, self._history, _ = self._decode_jit(
-                self.params, self._cache, self._history, self._mask,
-                self._last_tok, self._pos, self._phys, self._active,
-                self._zero_key)
-            jax.block_until_ready(self._cache)
+        replay = None
+        if self._aot is not None:
+            replay = self._aot.replay({
+                "serving/prefill": self._prefill_jit,
+                "serving/assign": self._assign_jit,
+                "serving/decode": self._decode_jit})
+            if replay is not None:
+                record_warmup_seconds("aot_replay", replay["seconds"])
+        if self._aot is not None:
+            # AOT path: `warm()` builds (compiles or deserializes) each
+            # program WITHOUT executing it — after a manifest replay
+            # these are instant signature hits; on a cold/stale cache
+            # they compile exactly what the loop below would have
+            with self._cv:
+                for bucket in self.ladder.buckets:
+                    if bucket + 1 > self.max_len:
+                        continue
+                    ids = np.ones((1, bucket), np.int32)
+                    mask = np.ones((1, bucket), np.int32)
+                    self._prefill_jit.warm(self.params, ids, mask,
+                                           self._zero_key)
+                self._decode_jit.warm(
+                    self.params, self._cache, self._history,
+                    self._mask, self._last_tok, self._pos, self._phys,
+                    self._active, self._zero_key)
+        else:
+            with self._cv:
+                for bucket in self.ladder.buckets:
+                    if bucket + 1 > self.max_len:
+                        continue
+                    ids = np.ones((1, bucket), np.int32)
+                    mask = np.ones((1, bucket), np.int32)
+                    jax.block_until_ready(self._prefill_jit(
+                        self.params, ids, mask, self._zero_key))
+                # cache/history are donated, so reassign them; with
+                # every lane free the warmup tick is a no-op on pool
+                # state (free lanes write at index 0 and are fully
+                # overwritten by the next assignment anyway)
+                self._cache, self._history, _ = self._decode_jit(
+                    self.params, self._cache, self._history, self._mask,
+                    self._last_tok, self._pos, self._phys, self._active,
+                    self._zero_key)
+                jax.block_until_ready(self._cache)
         dt = time.perf_counter() - t0
         self.metrics.warmup_compile_s = round(dt, 3)
-        self._log({"event": "serving_warmup", "seconds": round(dt, 3),
-                   "buckets": list(self.ladder.buckets),
-                   "num_slots": self.config.num_slots})
+        record_warmup_seconds("engine", dt)
+        entry = {"event": "serving_warmup", "seconds": round(dt, 3),
+                 "buckets": list(self.ladder.buckets),
+                 "num_slots": self.config.num_slots}
+        if replay is not None:
+            entry["aot_replayed"] = replay["replayed"]
+        self._log(entry)
         return dt
 
     def stats(self) -> dict:

@@ -20,7 +20,8 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fengshen_tpu.observability import JsonlSink, StepStats, span
+from fengshen_tpu.observability import (JsonlSink, StepStats,
+                                        record_build_info, span)
 # re-exported for compatibility (the table moved to observability.flops,
 # the single home of the MFU accounting)
 from fengshen_tpu.observability.flops import PEAK_FLOPS  # noqa: F401
@@ -170,6 +171,14 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
              "exporter thread on this port during fit; 0 = off. Only "
              "process_index 0 of a multihost job binds the socket "
              "(docs/observability.md)")
+    parser.add_argument(
+        "--aot_cache_dir", default=None, type=str,
+        help="persistent AOT executable cache directory "
+             "(docs/aot_cache.md): the jitted train step is looked up "
+             "by content address (jax version, devices, mesh axes, "
+             "StableHLO) and deserialized instead of recompiled on "
+             "restart/rewind; any cache failure silently falls back "
+             "to a fresh compile")
     # resilience (docs/fault_tolerance.md)
     resil = parent_parser.add_argument_group("resilience")
     resil.add_argument(
@@ -432,19 +441,34 @@ class Trainer:
                 stacked_sh = jax.tree_util.tree_map(
                     lambda spec: NamedSharding(mesh, P(None, *spec)),
                     batch_spec, is_leaf=lambda x: isinstance(x, P))
-            return jax.jit(
+            return self._maybe_aot_wrap(jax.jit(
                 multi_step,
                 in_shardings=(state_sh, stacked_sh, None),
                 out_shardings=(state_sh, None),
                 donate_argnums=(0,),
-            ), stacked_sh
+            ), "trainer/multi_step"), stacked_sh
 
-        return jax.jit(
+        return self._maybe_aot_wrap(jax.jit(
             train_step,
             in_shardings=(state_sh, batch_shardings, None),
             out_shardings=(state_sh, None),
             donate_argnums=(0,),
-        ), batch_shardings
+        ), "trainer/train_step"), batch_shardings
+
+    def _maybe_aot_wrap(self, jitted, name: str):
+        """Route a jitted step through the persistent executable cache
+        when --aot_cache_dir is set (docs/aot_cache.md): a restart or
+        rewind deserializes the train step instead of re-paying XLA.
+        The offloaded path keeps plain jit (its update program is
+        built lazily per optimizer; see _build_offloaded_train_step)."""
+        cache_dir = getattr(self.args, "aot_cache_dir", None)
+        if not cache_dir:
+            return jitted
+        if getattr(self, "_aot_setup", None) is None:
+            from fengshen_tpu.aot import AotConfig, AotSetup
+            self._aot_setup = AotSetup(AotConfig(cache_dir=cache_dir),
+                                       mesh=self.mesh, log=self._log)
+        return self._aot_setup.wrap(jitted, name)
 
     def _build_offloaded_train_step(self, module, state_sh, batch_sh):
         """ZeRO-offload analog: the optimizer state lives in HOST memory
@@ -784,6 +808,7 @@ class Trainer:
             flops_per_token=flops_per_tok,
             n_devices=len(jax.devices()),
             device_kind=jax.devices()[0].device_kind)
+        record_build_info()
         self._maybe_start_metrics_server()
         log_every = max(int(getattr(args, "log_every_n_steps", 10)), 1)
         val_interval = int(getattr(args, "val_check_interval", 0) or 0)
