@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-fast serve-bench aot-bench
+.PHONY: lint lint-baseline test test-fast serve-bench \
+	serve-bench-parity aot-bench
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -14,6 +15,14 @@ lint:
 # BENCH rounds can track serving throughput without a healthy relay
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.serving.bench
+
+# KV memory-parity mode (docs/performance.md): slot vs paged vs
+# paged+int8 at the SAME KV byte budget — max concurrent admitted and
+# aggregate tokens/s per variant, one BENCH-schema JSON line
+serve-bench-parity:
+	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=memory_parity \
+		SERVE_BENCH_BUCKETS=32,128 SERVE_BENCH_NEW_TOKENS=32 \
+		$(PY) -m fengshen_tpu.serving.bench
 
 # AOT cold-start microbench (docs/aot_cache.md): cold-process vs
 # warm-process engine warmup through the persistent executable cache,

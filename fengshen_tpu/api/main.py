@@ -8,13 +8,19 @@ uvicorn. FastAPI/uvicorn are optional deps — gated at call time.
     python -m fengshen_tpu.api.main --config text_classification.json
 
 Beyond the reference: `"engine": "continuous"` in the SERVER block
-routes generation tasks through the continuous-batching slot-pool
-engine (`fengshen_tpu/serving/`, docs/serving.md) — many concurrent
-requests share ONE jitted decode step; the optional ENGINE block holds
-`serving.EngineConfig` overrides (num_slots, buckets, max_queue, …),
-and the optional AOT block (`{"cache_dir": ...}`, docs/aot_cache.md)
-routes every engine compile through the persistent executable cache so
-a restarted replica deserializes instead of recompiling.
+routes generation tasks through the continuous-batching engine
+(`fengshen_tpu/serving/`, docs/serving.md) — many concurrent requests
+share ONE jitted decode step; the optional ENGINE block holds
+`serving.EngineConfig` overrides (num_slots, buckets, max_queue, …,
+plus the KV-pool physicals `kv_layout: "slot"|"paged"`,
+`kv_dtype: "fp32"|"int8"`, `kv_block_size`, `kv_num_blocks` — the
+paged/int8 pool serves ≥2x the concurrent requests per KV byte, see
+docs/serving.md "Paged KV cache"), and the optional AOT block
+(`{"cache_dir": ...}`, docs/aot_cache.md) routes every engine compile
+through the persistent executable cache so a restarted replica
+deserializes instead of recompiling (the KV knobs join the cache key).
+`GET /stats` includes the KV-pool utilization (blocks total/used/free,
+bytes, fragmentation, layout/dtype) alongside the engine metrics.
 
 Both engines get warmed at startup so the first user never pays jit
 compilation — warmup runs in a BACKGROUND thread while the server is

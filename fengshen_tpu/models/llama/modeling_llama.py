@@ -175,10 +175,24 @@ class LlamaAttention(nn.Module):
     def _update_cache(self, k, v, attention_mask):
         """flax mutable-cache decode (same role as the reference's KV concat,
         reference: transformer.py:529-537, but with static shapes for XLA:
-        the cache is preallocated at max length and updated in place)."""
+        the cache is preallocated at max length and updated in place).
+
+        Three physical layouts share this entry point, detected from the
+        cache variables themselves (shapes are static under jit):
+
+        - scalar `cache_index`: lockstep batch decode (`utils.generate`);
+        - `[B]` vector index: the serving slot pool — every lane at its
+          own position, optionally int8 (a `cached_key_scale` variable
+          marks the quantized pool);
+        - `block_table` present: the paged pool
+          (`fengshen_tpu/serving/paged_cache.py`) — lanes indirect
+          through per-slot block lists into a shared block pool.
+        """
         cfg = self.config
         batch, seq, n_kv, head_dim = k.shape
         max_len = cfg.max_position_embeddings
+        if self.has_variable("cache", "block_table"):
+            return self._update_paged_cache(k, v, attention_mask)
         # when the variables are being created (the init_cache=True init
         # pass), skip the update so the returned cache starts at index 0
         is_initialized = self.has_variable("cache", "cached_key")
@@ -198,11 +212,36 @@ class LlamaAttention(nn.Module):
             # slot-pool decode (fengshen_tpu/serving): a [B] cache_index
             # gives every lane its own write position, so concurrently
             # served requests at different progress share ONE jitted step
+            quantized = self.has_variable("cache", "cached_key_scale")
+            if quantized:
+                from fengshen_tpu.ops.int8_matmul import (dequantize_kv,
+                                                          quantize_kv)
+                k_scale = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (batch, max_len, n_kv), jnp.float32)
+                v_scale = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (batch, max_len, n_kv), jnp.float32)
+                k, ks = quantize_kv(k)
+                v, vs = quantize_kv(v)
+                ks_all = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(
+                        c, u, (i, 0)))(k_scale.value, ks, idx)
+                vs_all = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(
+                        c, u, (i, 0)))(v_scale.value, vs, idx)
+                k_scale.value, v_scale.value = ks_all, vs_all
             k_all = jax.vmap(lambda c, u, i: jax.lax.dynamic_update_slice(
                 c, u, (i, 0, 0)))(cached_k.value, k, idx)
             v_all = jax.vmap(lambda c, u, i: jax.lax.dynamic_update_slice(
                 c, u, (i, 0, 0)))(cached_v.value, v, idx)
             cached_k.value, cached_v.value = k_all, v_all
+            if quantized:
+                # the attention read dequantizes in registers; the pool
+                # itself stays int8 in HBM
+                dt = _dt(cfg)
+                k_all = dequantize_kv(k_all, ks_all, dt)
+                v_all = dequantize_kv(v_all, vs_all, dt)
             cache_index.value = idx + seq
             # per-lane causal validity: lane b's query t (position
             # idx[b]+t) sees cache positions ≤ idx[b]+t
@@ -227,6 +266,102 @@ class LlamaAttention(nn.Module):
                            attention_mask.dtype)
             full = jnp.concatenate([attention_mask, pad], axis=1)
             valid = valid & full[:, None, :].astype(bool)
+        return k_all, v_all, valid
+
+    def _update_paged_cache(self, k, v, attention_mask):
+        """Paged decode (fengshen_tpu/serving/paged_cache.py): K/V live
+        in a shared `[num_blocks, block_size, kv, hd]` pool; each lane's
+        logical positions map through its `block_table` row to physical
+        blocks. The host scheduler owns the free list; this method only
+        scatters the step's K/V at `table[lane, idx // bs] * bs + idx %
+        bs` and gathers each lane's blocks back into a contiguous
+        virtual lane with `jnp.take` — the paged-attention analog in
+        pure gather/scatter ops, so the XLA-CPU tier-1 lane runs it
+        unchanged. Inactive lanes are parked on block 0 (the null
+        block, never allocated), which absorbs their stray writes.
+
+        An int8 pool (marked by `cached_key_scale`) stores per-(token,
+        head) absmax scales alongside and dequantizes inside the read.
+        """
+        cfg = self.config
+        batch, seq, n_kv, head_dim = k.shape
+        if seq != 1:
+            raise ValueError(
+                "the paged KV cache decodes one token per step (prefill "
+                f"runs on a contiguous batch-1 cache); got seq={seq}")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (1, 1, n_kv, head_dim), k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (1, 1, n_kv, head_dim), v.dtype)
+        cache_index = self.variable("cache", "cache_index",
+                                    lambda: jnp.zeros((batch,), jnp.int32))
+        table = self.variable("cache", "block_table",
+                              lambda: jnp.zeros((batch, 1), jnp.int32))
+        num_blocks, block_size = cached_k.value.shape[:2]
+        max_blocks = table.value.shape[-1]
+        virt_len = max_blocks * block_size   # the lane's logical extent
+        idx = cache_index.value              # [B] physical cursors
+        quantized = self.has_variable("cache", "cached_key_scale")
+
+        # scatter this step's K/V at each lane's physical position
+        blk = jnp.take_along_axis(table.value,
+                                  (idx // block_size)[:, None],
+                                  axis=-1)[:, 0]
+        pos = blk * block_size + idx % block_size          # [B] flat
+        flat_k = cached_k.value.reshape(num_blocks * block_size,
+                                        n_kv, head_dim)
+        flat_v = cached_v.value.reshape(num_blocks * block_size,
+                                        n_kv, head_dim)
+        if quantized:
+            from fengshen_tpu.ops.int8_matmul import (dequantize_kv,
+                                                      quantize_kv)
+            k_scale = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (num_blocks, block_size, n_kv), jnp.float32)
+            v_scale = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (num_blocks, block_size, n_kv), jnp.float32)
+            kq, ks = quantize_kv(k[:, 0])
+            vq, vs = quantize_kv(v[:, 0])
+            flat_k = flat_k.at[pos].set(kq)
+            flat_v = flat_v.at[pos].set(vq)
+            flat_ks = k_scale.value.reshape(-1, n_kv).at[pos].set(ks)
+            flat_vs = v_scale.value.reshape(-1, n_kv).at[pos].set(vs)
+            k_scale.value = flat_ks.reshape(num_blocks, block_size, n_kv)
+            v_scale.value = flat_vs.reshape(num_blocks, block_size, n_kv)
+        else:
+            flat_k = flat_k.at[pos].set(k[:, 0].astype(flat_k.dtype))
+            flat_v = flat_v.at[pos].set(v[:, 0].astype(flat_v.dtype))
+        cached_k.value = flat_k.reshape(num_blocks, block_size,
+                                        n_kv, head_dim)
+        cached_v.value = flat_v.reshape(num_blocks, block_size,
+                                        n_kv, head_dim)
+        cache_index.value = idx + 1
+
+        # gather each lane's blocks into a contiguous [B, virt_len] view
+        gather_idx = ((table.value * block_size)[:, :, None] +
+                      jnp.arange(block_size)[None, None, :]
+                      ).reshape(batch, virt_len)
+        k_all = jnp.take(flat_k, gather_idx, axis=0)
+        v_all = jnp.take(flat_v, gather_idx, axis=0)
+        if quantized:
+            dt = _dt(cfg)
+            k_all = dequantize_kv(k_all,
+                                  jnp.take(flat_ks, gather_idx, axis=0),
+                                  dt)
+            v_all = dequantize_kv(v_all,
+                                  jnp.take(flat_vs, gather_idx, axis=0),
+                                  dt)
+        # per-lane causal validity over the virtual lane (same law as
+        # the slot path: query at idx[b] sees positions <= idx[b])
+        q_pos = idx[:, None] + jnp.arange(seq)[None, :]
+        valid = jnp.arange(virt_len)[None, None, :] <= q_pos[:, :, None]
+        if attention_mask is not None:
+            m = attention_mask[:, :virt_len]
+            if m.shape[1] < virt_len:
+                pad = jnp.ones((batch, virt_len - m.shape[1]), m.dtype)
+                m = jnp.concatenate([m, pad], axis=1)
+            valid = valid & m[:, None, :].astype(bool)
         return k_all, v_all, valid
 
 

@@ -38,6 +38,26 @@ def _quant_cols(w: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., head_dim] K/V → (int8 values, fp32 per-head absmax scale
+    [...]). The KV-cache flavor of `_quant_rows`: one scale per
+    (token, head) vector, so the serving pool stores 1 byte/element
+    plus a float per head — the int8 KV mode of
+    `fengshen_tpu/serving/paged_cache.py` and the attention read in
+    `modeling_llama._update_cache`."""
+    absmax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.maximum(absmax.astype(jnp.float32), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of `quantize_kv`; XLA fuses this into the attention read
+    so the fp tensor never materializes in HBM."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 @jax.custom_vjp
 def int8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
     """x [..., K] @ w [K, N] via dynamic int8 quantization of both

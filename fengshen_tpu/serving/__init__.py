@@ -14,11 +14,17 @@ from fengshen_tpu.serving.engine import (CANCELLED, EXPIRED, FINISHED,
                                          EngineConfig, PromptTooLong,
                                          QueueFull, Request)
 from fengshen_tpu.serving.metrics import EngineMetrics
+from fengshen_tpu.serving.paged_cache import (NULL_BLOCK, BlockAllocator,
+                                              assign_paged,
+                                              assign_slot_quantized,
+                                              init_pool_cache)
 
 __all__ = [
-    "BucketLadder", "DEFAULT_BUCKETS", "ContinuousBatchingEngine",
-    "EngineConfig", "EngineMetrics", "PromptTooLong", "QueueFull",
-    "Request", "assign_slot", "init_slot_cache", "reset_free_slots",
+    "BlockAllocator", "BucketLadder", "DEFAULT_BUCKETS",
+    "ContinuousBatchingEngine", "EngineConfig", "EngineMetrics",
+    "NULL_BLOCK", "PromptTooLong", "QueueFull", "Request",
+    "assign_paged", "assign_slot", "assign_slot_quantized",
+    "init_pool_cache", "init_slot_cache", "reset_free_slots",
     "rollback_slots", "QUEUED", "RUNNING", "FINISHED", "CANCELLED",
     "EXPIRED", "REJECTED",
 ]

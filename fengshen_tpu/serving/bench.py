@@ -11,8 +11,19 @@ ttft and config echo keys.
     make serve-bench
     SERVE_BENCH_NEW_TOKENS=128 python -m fengshen_tpu.serving.bench
 
+`SERVE_BENCH_MODE=memory_parity` (`make serve-bench-parity`) switches
+to the KV **memory-parity** comparison (docs/performance.md): the slot
+pool's byte budget is held FIXED and re-carved as paged fp32 and
+paged+int8 pools; each variant reports the max concurrent requests it
+admitted and its aggregate tokens/s. The paged pool admits by ACTUAL
+footprint (bucket + max_new blocks) instead of worst-case max_len
+lanes, and int8 stores ~3-4x more KV tokens per byte, so `value` /
+`vs_baseline` become the paged-over-slot concurrency ratio (the >= 2x
+acceptance bar of ISSUE 6).
+
 Env knobs (SERVE_BENCH_*): SLOTS, REQUESTS, NEW_TOKENS, VOCAB, HIDDEN,
-INTER, LAYERS, HEADS, BUCKETS (comma list), SEED.
+INTER, LAYERS, HEADS, BUCKETS (comma list), SEED, MODE, BLOCK_SIZE,
+MAX_SLOTS (paged concurrency cap in parity mode).
 
 Why batching wins even here: batch-1 decode is weight-memory-bound —
 every generated token streams the full weight matrices for ONE row.
@@ -37,10 +48,136 @@ def _env(name: str, default: int) -> int:
     return int(os.environ.get(f"SERVE_BENCH_{name}", default))
 
 
+def _emit(row: dict) -> None:
+    from fengshen_tpu.observability import JsonlSink
+    if os.environ.get("BENCH_DEGRADED", "0") == "1":
+        row["degraded"] = True
+    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
+
+
+def _sequential_tps(model, params, prompts, new_tokens: int) -> float:
+    """The legacy api path: one jitted batch-1 generate per request
+    (compiles excluded via per-shape warmup)."""
+    from fengshen_tpu.utils.generate import generate
+
+    @jax.jit
+    def _gen(params, ids):
+        return generate(model, params, ids, max_new_tokens=new_tokens,
+                        eos_token_id=None, pad_token_id=0)
+
+    for n in sorted({len(p) for p in prompts}):
+        jax.block_until_ready(_gen(params, jnp.ones((1, n), jnp.int32)))
+    t0 = time.perf_counter()
+    for p in prompts:
+        jax.block_until_ready(_gen(params, jnp.asarray(p)[None]))
+    return len(prompts) * new_tokens / (time.perf_counter() - t0)
+
+
+def _run_engine(model, params, prompts, cfg) -> dict:
+    """Warm up, drain `prompts`, return throughput + pool stats."""
+    from fengshen_tpu.serving import ContinuousBatchingEngine
+    engine = ContinuousBatchingEngine(model, params, cfg)
+    engine.warmup()
+    t0 = time.perf_counter()
+    outs = engine.generate_all(prompts)
+    dt = time.perf_counter() - t0
+    stats = engine.stats()
+    return {"tokens_per_sec": round(sum(len(t) for t in outs) / dt, 1),
+            "stats": stats}
+
+
+def _memory_parity(model, params, config, buckets, new_tokens) -> None:
+    """Same KV byte budget, three carvings: slot fp32 (the reference),
+    paged fp32, paged int8. Deterministic concurrency: every variant
+    gets enough requests and slots to hit its admission bound."""
+    from fengshen_tpu.serving import EngineConfig
+
+    slots_ref = _env("SLOTS", 8)
+    block = _env("BLOCK_SIZE", 16)
+    slot_cap = _env("MAX_SLOTS", 32)
+    max_len = buckets[-1] + new_tokens
+    kv = config.num_key_value_heads
+    hd = config.head_dim
+    layers = config.num_hidden_layers
+    budget = slots_ref * max_len * kv * hd * 2 * 4 * layers
+
+    # all requests land in the SMALLEST bucket — the realistic skew the
+    # paged pool exploits (the ladder still serves the big bucket; the
+    # slot pool pays its worst case for every lane regardless)
+    prompt_len = max(buckets[0] // 2, 1)
+    bucket = buckets[0]
+    need_tokens = bucket + new_tokens
+    need_blocks = -(-need_tokens // block)
+
+    def blocks_for(budget_bytes: int, int8: bool) -> int:
+        per_tok = kv * hd * 2 * (1 if int8 else 4) * layers
+        if int8:
+            per_tok += kv * 2 * 4 * layers        # absmax scales
+        return budget_bytes // (block * per_tok)
+
+    variants = {
+        "slot": dict(num_slots=slots_ref),
+        "paged": dict(kv_layout="paged", kv_block_size=block,
+                      kv_num_blocks=blocks_for(budget, False)),
+        "paged_int8": dict(kv_layout="paged", kv_dtype="int8",
+                           kv_block_size=block,
+                           kv_num_blocks=blocks_for(budget, True)),
+    }
+    bounds = {"slot": slots_ref}
+    for name in ("paged", "paged_int8"):
+        nb = variants[name]["kv_num_blocks"]
+        bound = max((nb - 1) // need_blocks, 1)
+        bounds[name] = min(bound, slot_cap)
+        variants[name]["num_slots"] = bounds[name]
+
+    n_req = max(_env("REQUESTS", 0), max(bounds.values()) + 2)
+    rng = np.random.RandomState(_env("SEED", 0))
+    prompts = [rng.randint(3, config.vocab_size - 1,
+                           prompt_len).astype(np.int32)
+               for _ in range(n_req)]
+    seq_tps = _sequential_tps(model, params,
+                              prompts[:min(n_req, 8)], new_tokens)
+
+    results = {}
+    for name, overrides in variants.items():
+        cfg = EngineConfig(buckets=buckets, max_new_tokens=new_tokens,
+                           max_queue=n_req, eos_token_id=None,
+                           pad_token_id=0, **overrides)
+        run = _run_engine(model, params, prompts, cfg)
+        st = run["stats"]
+        results[name] = {
+            "max_concurrent": st["slots_active_peak"],
+            "tokens_per_sec": run["tokens_per_sec"],
+            "vs_sequential": round(run["tokens_per_sec"] / seq_tps, 3),
+            "kv_cache_bytes": st["kv_cache_bytes"],
+            "kv_blocks_total": st["kv_blocks_total"],
+            "num_slots": cfg.num_slots,
+            "deferred_admissions": st["deferred_admissions"],
+        }
+
+    slot_peak = max(results["slot"]["max_concurrent"], 1)
+    best = max(results["paged"]["max_concurrent"],
+               results["paged_int8"]["max_concurrent"])
+    _emit({
+        "metric": "serving_kv_memory_parity_max_concurrent",
+        "value": best,
+        "unit": "concurrent_requests",
+        "vs_baseline": round(best / slot_peak, 3),
+        "mode": "memory_parity",
+        "kv_budget_bytes": budget,
+        "block_size": block,
+        "requests": n_req,
+        "new_tokens": new_tokens,
+        "prompt_tokens": prompt_len,
+        "sequential_tokens_per_sec": round(seq_tps, 1),
+        "variants": results,
+        "backend": jax.default_backend(),
+    })
+
+
 def main() -> None:
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from fengshen_tpu.serving import ContinuousBatchingEngine, EngineConfig
-    from fengshen_tpu.utils.generate import generate
+    from fengshen_tpu.serving import EngineConfig
 
     slots = _env("SLOTS", 8)
     n_req = _env("REQUESTS", 8)
@@ -65,6 +202,11 @@ def main() -> None:
         r, jnp.zeros((1, 8), jnp.int32))["params"])(
         jax.random.PRNGKey(_env("SEED", 0)))
 
+    if os.environ.get("SERVE_BENCH_MODE", "throughput") == \
+            "memory_parity":
+        _memory_parity(model, params, config, buckets, new_tokens)
+        return
+
     rng = np.random.RandomState(_env("SEED", 0))
     span = max(buckets[-1] - 11, 1)  # varied lengths, any ladder size
     lengths = [min(buckets[-1], 12 + (i * 7) % span)
@@ -72,35 +214,18 @@ def main() -> None:
     prompts = [rng.randint(3, config.vocab_size - 1, n).astype(np.int32)
                for n in lengths]
 
-    # ---- sequential baseline: one jitted generate per request --------
-    # (exactly the legacy api/main.py path: each POST runs a batch-1
-    # pipeline call; jit compile excluded via per-shape warmup)
-    @jax.jit
-    def _gen(params, ids):
-        return generate(model, params, ids, max_new_tokens=new_tokens,
-                        eos_token_id=None, pad_token_id=0)
-
-    for n in sorted(set(lengths)):
-        jax.block_until_ready(_gen(params, jnp.ones((1, n), jnp.int32)))
-    t0 = time.perf_counter()
-    for p in prompts:
-        jax.block_until_ready(_gen(params, jnp.asarray(p)[None]))
-    seq_dt = time.perf_counter() - t0
-    seq_tps = n_req * new_tokens / seq_dt
-
-    # ---- continuous engine: all requests in flight together ----------
-    engine = ContinuousBatchingEngine(
-        model, params, EngineConfig(num_slots=slots, buckets=buckets,
-                                    max_new_tokens=new_tokens,
-                                    max_queue=max(n_req, 1),
-                                    eos_token_id=None, pad_token_id=0))
-    engine.warmup()
-    t0 = time.perf_counter()
-    outs = engine.generate_all(prompts)
-    eng_dt = time.perf_counter() - t0
-    generated = sum(len(t) for t in outs)
-    eng_tps = generated / eng_dt
-    stats = engine.stats()
+    # sequential baseline (the legacy api/main.py path) vs the
+    # continuous engine with all requests in flight together — the
+    # same helpers the memory-parity mode times with
+    seq_tps = _sequential_tps(model, params, prompts, new_tokens)
+    run = _run_engine(
+        model, params, prompts,
+        EngineConfig(num_slots=slots, buckets=buckets,
+                     max_new_tokens=new_tokens,
+                     max_queue=max(n_req, 1),
+                     eos_token_id=None, pad_token_id=0))
+    eng_tps = run["tokens_per_sec"]
+    stats = run["stats"]
 
     row = {
         "metric": "serving_engine_tokens_per_sec",
@@ -119,16 +244,13 @@ def main() -> None:
     # utilization column (docs/observability.md): forward-only FLOPs —
     # decode does no backward; present whenever the estimator supports
     # the benched model (it does: llama-shaped config)
-    from fengshen_tpu.observability import (JsonlSink,
-                                            estimate_flops_per_token,
+    from fengshen_tpu.observability import (estimate_flops_per_token,
                                             peak_flops_per_chip)
     f_tok = estimate_flops_per_token(config, include_backward=False)
     if f_tok:
         peak = peak_flops_per_chip(jax.devices()[0].device_kind)
         row["mfu"] = float(f"{eng_tps * f_tok / (peak * len(jax.devices())):.4g}")
-    if os.environ.get("BENCH_DEGRADED", "0") == "1":
-        row["degraded"] = True
-    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
+    _emit(row)
 
 
 if __name__ == "__main__":

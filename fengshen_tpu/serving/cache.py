@@ -78,9 +78,16 @@ def reset_free_slots(cache, active):
     [num_slots] bool vector). Free lanes still ride through every decode
     step (static shapes); without the clamp their index would creep one
     per tick and eventually walk the garbage writes off the end of the
-    preallocated lane."""
+    preallocated lane.
+
+    On a paged pool (serving/paged_cache.py) the same clamp also parks
+    inactive lanes' `block_table` rows on the null block — their blocks
+    may already be reallocated to another lane, so a stale row would
+    let the lane's garbage write corrupt a live request's K/V."""
     def fix(path, leaf):
         if is_cache_index_path(path):
             return jnp.where(active, leaf, 0)
+        if any(getattr(k, "key", None) == "block_table" for k in path):
+            return jnp.where(active[:, None], leaf, 0)
         return leaf
     return jax.tree_util.tree_map_with_path(fix, cache)

@@ -18,7 +18,13 @@ onto a fixed pool of `num_slots` KV-cache lanes:
   the next queued request — no drain barrier, no recompilation;
 - backpressure: a bounded admission queue; `submit` raises `QueueFull`
   (HTTP 429 at the API layer) / `PromptTooLong` when the ladder can't
-  hold the prompt.
+  hold the prompt;
+- KV physicals: `kv_layout="paged"` swaps the per-lane pool for the
+  block/paged pool (`serving/paged_cache.py`) — admission then charges
+  each request its ACTUAL footprint in blocks instead of a worst-case
+  lane, and an exhausted pool defers the queue head until reclaim;
+  `kv_dtype="int8"` stores K/V quantized with per-(token, head) absmax
+  scales. Both keep this module's one-jitted-decode contract.
 
 Greedy decode is TOKEN-IDENTICAL to sequential
 `utils.generate.generate` on the bucket-padded prompt (the parity test
@@ -44,6 +50,10 @@ from fengshen_tpu.observability import record_warmup_seconds, span
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
                                         reset_free_slots)
+from fengshen_tpu.serving.paged_cache import (BlockAllocator,
+                                              assign_paged,
+                                              assign_slot_quantized,
+                                              init_pool_cache)
 from fengshen_tpu.serving.metrics import EngineMetrics
 from fengshen_tpu.utils.generate import (_controls_active, _prefill_cache,
                                          _select_token,
@@ -81,10 +91,28 @@ class EngineConfig:
     no_repeat_ngram_size: int = 0   # 0 or 1 (see __post_init__)
     min_length: int = 0
     seed: int = 0
+    # KV pool physicals (docs/serving.md "Paged KV cache"): "paged"
+    # carves the pool into kv_block_size-token blocks so admission is
+    # bounded by ACTUAL footprint (bucket + max_new), not worst-case
+    # max_len; "int8" stores K/V quantized with per-(token, head)
+    # scales — ~3.7x more KV tokens in the same bytes
+    kv_layout: str = "slot"                  # "slot" | "paged"
+    kv_dtype: str = "fp32"                   # "fp32" | "int8"
+    kv_block_size: int = 64                  # tokens per paged block
+    kv_num_blocks: Optional[int] = None      # default: slot-parity + null
+    kv_max_blocks_per_slot: Optional[int] = None  # default: max_len/bs
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if self.kv_layout not in ("slot", "paged"):
+            raise ValueError(f"unknown kv_layout {self.kv_layout!r}; "
+                             "expected 'slot' or 'paged'")
+        if self.kv_dtype not in ("fp32", "int8"):
+            raise ValueError(f"unknown kv_dtype {self.kv_dtype!r}; "
+                             "expected 'fp32' or 'int8'")
+        if self.kv_layout == "paged" and self.kv_block_size < 1:
+            raise ValueError("kv_block_size must be >= 1")
         if self.max_queue < 1:
             # admission always passes through the queue, so 0 would
             # reject every request forever while all slots sit idle
@@ -157,14 +185,51 @@ class ContinuousBatchingEngine:
         self._log = log or (lambda entry: None)
         self._clock = clock
         self.max_len = int(model.config.max_position_embeddings)
-        if self.ladder.buckets[0] + 1 > self.max_len:
+        self.paged = config.kv_layout == "paged"
+        S = config.num_slots
+        if self.paged:
+            bs = int(config.kv_block_size)
+            if bs > self.max_len:
+                raise ValueError(
+                    f"kv_block_size {bs} exceeds "
+                    f"max_position_embeddings={self.max_len}")
+            mb = int(self.max_len // bs
+                     if config.kv_max_blocks_per_slot is None
+                     else config.kv_max_blocks_per_slot)
+            if mb < 1 or mb * bs > self.max_len:
+                raise ValueError(
+                    f"kv_max_blocks_per_slot={mb} x kv_block_size={bs} "
+                    f"must fit in 1..max_position_embeddings="
+                    f"{self.max_len}")
+            # explicit `is None` (not `or`): a computed kv_num_blocks of
+            # 0 must fail loudly below, never silently balloon to the
+            # slot-parity default pool
+            nb = int(S * mb + 1 if config.kv_num_blocks is None
+                     else config.kv_num_blocks)
+            self.block_size, self.max_blocks_per_slot = bs, mb
+            self.num_blocks = nb
+            # the lane's logical extent: positions beyond it have no
+            # block to land in, so it bounds prompt+decode like max_len
+            # bounds the slot layout
+            self.seq_capacity = mb * bs
+            self._allocator = BlockAllocator(nb)
+            self._slot_blocks: list[list[int]] = [[] for _ in range(S)]
+            self._deferred_req: Optional[str] = None
+        else:
+            self.seq_capacity = self.max_len
+        if self.ladder.buckets[0] + 1 > self.seq_capacity:
             raise ValueError(
                 f"smallest bucket {self.ladder.buckets[0]} leaves no "
-                f"decode headroom in max_position_embeddings="
-                f"{self.max_len}")
+                f"decode headroom in the KV lane capacity "
+                f"{self.seq_capacity}")
 
-        S, L = config.num_slots, self.max_len
-        self._cache = init_slot_cache(model, S)
+        L = self.seq_capacity
+        self._cache = self._init_pool()
+        self._kv_bytes = sum(
+            leaf.nbytes for path, leaf in
+            jax.tree_util.tree_flatten_with_path(self._cache)[0]
+            if any(getattr(k, "key", "").startswith("cached_")
+                   for k in path))
         self._history = jnp.zeros((S, L), jnp.int32)
         self._mask = jnp.zeros((S, L), jnp.int32)
         # host-side per-slot state (authoritative for scheduling)
@@ -205,16 +270,38 @@ class ContinuousBatchingEngine:
                                 cfg.temperature, cfg.top_k, cfg.top_p)
             return cache, tok.astype(jnp.int32)
 
-        def assign_fn(cache, history, mask, primed, prompt_row, mask_row,
-                      slot):
-            cache = assign_slot(cache, primed, slot)
-            history = history.at[slot].set(prompt_row)
-            mask = mask.at[slot].set(mask_row)
-            return cache, history, mask
+        paged = self.paged
+        if paged:
+            def assign_fn(cache, history, mask, primed, prompt_row,
+                          mask_row, table_row, slot):
+                cache = assign_paged(cache, primed, slot, table_row)
+                history = history.at[slot].set(prompt_row)
+                mask = mask.at[slot].set(mask_row)
+                return cache, history, mask
+        elif config.kv_dtype == "int8":
+            def assign_fn(cache, history, mask, primed, prompt_row,
+                          mask_row, slot):
+                cache = assign_slot_quantized(cache, primed, slot)
+                history = history.at[slot].set(prompt_row)
+                mask = mask.at[slot].set(mask_row)
+                return cache, history, mask
+        else:
+            def assign_fn(cache, history, mask, primed, prompt_row,
+                          mask_row, slot):
+                cache = assign_slot(cache, primed, slot)
+                history = history.at[slot].set(prompt_row)
+                mask = mask.at[slot].set(mask_row)
+                return cache, history, mask
 
         def decode_fn(params, cache, history, mask, tokens, pos, phys,
                       active, rng):
             n = tokens.shape[0]
+            if paged:
+                # clamp BEFORE the forward: a reclaimed lane's blocks
+                # may already belong to another request, so its stray
+                # write must be parked on the null block first (the
+                # slot layout clamps after — each lane owns its space)
+                cache = reset_free_slots(cache, active)
             # the token selected last tick enters the history at its
             # physical cursor BEFORE the forward (its K/V are written at
             # the same position by the cache update)
@@ -223,7 +310,8 @@ class ContinuousBatchingEngine:
                 {"params": params, "cache": cache}, tokens[:, None],
                 attention_mask=mask, position_ids=pos[:, None],
                 init_cache=True, mutable=["cache"])
-            cache = reset_free_slots(mutated["cache"], active)
+            cache = mutated["cache"] if paged else \
+                reset_free_slots(mutated["cache"], active)
             step_logits = logits[:, -1]
             if controls_on:
                 step_logits = apply_logits_controls(
@@ -261,6 +349,20 @@ class ContinuousBatchingEngine:
                                        donate_argnums=(0, 1, 2))
             self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
 
+    def _init_pool(self):
+        """Zeros KV pool in the configured (layout, dtype)."""
+        cfg = self.config
+        if not self.paged and cfg.kv_dtype == "fp32":
+            return init_slot_cache(self.model, cfg.num_slots)
+        if self.paged:
+            return init_pool_cache(
+                self.model, cfg.num_slots, layout="paged",
+                kv_dtype=cfg.kv_dtype, num_blocks=self.num_blocks,
+                block_size=self.block_size,
+                max_blocks_per_slot=self.max_blocks_per_slot)
+        return init_pool_cache(self.model, cfg.num_slots, layout="slot",
+                               kv_dtype=cfg.kv_dtype)
+
     # ---- submission side -------------------------------------------
 
     def submit(self, input_ids, max_new_tokens: Optional[int] = None,
@@ -286,15 +388,32 @@ class ContinuousBatchingEngine:
                 f"bucket {self.ladder.max_bucket}")
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.config.max_new_tokens)
-        # the lane must hold bucket + generated tokens
-        max_new = min(max_new, self.max_len - bucket)
+        # the lane must hold bucket + generated tokens (seq_capacity is
+        # max_len for the slot layout, blocks x block_size for paged)
+        max_new = min(max_new, self.seq_capacity - bucket)
         if max_new < 1:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
             raise PromptTooLong(
-                f"bucket {bucket} leaves no decode headroom in "
-                f"max_position_embeddings={self.max_len}")
+                f"bucket {bucket} leaves no decode headroom in the "
+                f"KV lane capacity {self.seq_capacity}")
+        if self.paged:
+            # a footprint the whole pool cannot hold would sit at the
+            # queue head forever (nothing can free enough blocks) —
+            # reject NOW instead of livelocking the FIFO
+            need = -(-(bucket + max_new) // self.block_size)
+            if need > self._allocator.total_blocks:
+                self.metrics.count("rejected_prompt_too_long")
+                self._log({"event": "serving_reject",
+                           "reason": "kv_pool_too_small",
+                           "prompt_tokens": len(ids),
+                           "blocks_needed": need,
+                           "blocks_total":
+                               self._allocator.total_blocks})
+                raise PromptTooLong(
+                    f"request needs {need} KV blocks but the pool "
+                    f"only has {self._allocator.total_blocks}")
         now = self._clock()
         req = Request(ids, max_new, request_id,
                       None if deadline_s is None else now + deadline_s,
@@ -403,6 +522,32 @@ class ContinuousBatchingEngine:
                 self._finish(req, EXPIRED, "deadline")
                 continue
             bucket = self.ladder.bucket_for(len(req.prompt))
+            blocks = None
+            if self.paged:
+                # admission switches from "free slot" to "enough free
+                # blocks" for the request's ACTUAL footprint; when the
+                # pool can't serve it, the head of the queue waits for
+                # reclaim (FIFO — later requests must not starve it),
+                # the queue fills, and submit's QueueFull (429) is the
+                # backpressure surface
+                need = -(-(bucket + req.max_new_tokens)
+                         // self.block_size)
+                blocks = self._allocator.alloc(need)
+                if blocks is None:
+                    self._queue.appendleft(req)
+                    if self._deferred_req != req.request_id:
+                        # count the deferral EVENT once, not once per
+                        # tick the head keeps waiting
+                        self._deferred_req = req.request_id
+                        self.metrics.count("deferred_admissions")
+                        self._log({"event": "serving_defer",
+                                   "reason": "kv_blocks_exhausted",
+                                   "request_id": req.request_id,
+                                   "blocks_needed": need,
+                                   "blocks_free":
+                                       self._allocator.free_blocks})
+                    return
+                self._deferred_req = None
             row, mask_row = self.ladder.pad_prompt(
                 req.prompt, bucket, self.config.pad_token_id)
             if self.config.do_sample:
@@ -419,20 +564,37 @@ class ContinuousBatchingEngine:
             req.tokens.append(tok)
             if self.config.eos_token_id is not None and \
                     tok == self.config.eos_token_id:
+                if blocks is not None:
+                    self._allocator.free(blocks)
                 self._finish(req, FINISHED, "eos")
                 continue
             if req.max_new_tokens <= 1:
+                if blocks is not None:
+                    self._allocator.free(blocks)
                 self._finish(req, FINISHED, "length")
                 continue
             # history/mask lanes: padded prompt, mask open from the
             # bucket edge on (causal validity bounds the open tail)
-            hist_row = np.zeros((self.max_len,), np.int32)
+            L = self.seq_capacity
+            hist_row = np.zeros((L,), np.int32)
             hist_row[:bucket] = row
-            full_mask = np.ones((self.max_len,), np.int32)
+            full_mask = np.ones((L,), np.int32)
             full_mask[:bucket] = mask_row
-            self._cache, self._history, self._mask = self._assign_jit(
-                self._cache, self._history, self._mask, primed,
-                hist_row, full_mask, np.int32(slot))
+            if self.paged:
+                table_row = np.zeros((self.max_blocks_per_slot,),
+                                     np.int32)
+                table_row[:len(blocks)] = blocks
+                self._slot_blocks[slot] = blocks
+                self._cache, self._history, self._mask = \
+                    self._assign_jit(self._cache, self._history,
+                                     self._mask, primed, hist_row,
+                                     full_mask, table_row,
+                                     np.int32(slot))
+            else:
+                self._cache, self._history, self._mask = \
+                    self._assign_jit(self._cache, self._history,
+                                     self._mask, primed, hist_row,
+                                     full_mask, np.int32(slot))
             req.state = RUNNING
             req.slot = slot
             self._slot_req[slot] = req
@@ -448,6 +610,12 @@ class ContinuousBatchingEngine:
         self._active[slot] = False
         self._phys[slot] = 0
         self._pos[slot] = 0
+        if self.paged and self._slot_blocks[slot]:
+            # blocks return to the free list NOW; the lane's stale
+            # block-table row is parked on the null block by the next
+            # decode's entry clamp before any write can land
+            self._allocator.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
         self._finish(req, state, reason)
 
     def _finish(self, req: Request, state: str, reason: str) -> None:
@@ -523,8 +691,12 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self._slot_req):
             if req is not None:
                 self._release(i, EXPIRED, "engine_error")
-        S, L = self.config.num_slots, self.max_len
-        self._cache = init_slot_cache(self.model, S)
+        S, L = self.config.num_slots, self.seq_capacity
+        if self.paged:
+            self._allocator = BlockAllocator(self.num_blocks)
+            self._slot_blocks = [[] for _ in range(S)]
+            self._deferred_req = None
+        self._cache = self._init_pool()
         self._history = jnp.zeros((S, L), jnp.int32)
         self._mask = jnp.zeros((S, L), jnp.int32)
         self._last_tok = np.zeros((S,), np.int32)
@@ -568,7 +740,7 @@ class ContinuousBatchingEngine:
             # they compile exactly what the loop below would have
             with self._cv:
                 for bucket in self.ladder.buckets:
-                    if bucket + 1 > self.max_len:
+                    if bucket + 1 > self.seq_capacity:
                         continue
                     ids = np.ones((1, bucket), np.int32)
                     mask = np.ones((1, bucket), np.int32)
@@ -581,7 +753,7 @@ class ContinuousBatchingEngine:
         else:
             with self._cv:
                 for bucket in self.ladder.buckets:
-                    if bucket + 1 > self.max_len:
+                    if bucket + 1 > self.seq_capacity:
                         continue
                     ids = np.ones((1, bucket), np.int32)
                     mask = np.ones((1, bucket), np.int32)
@@ -607,9 +779,38 @@ class ContinuousBatchingEngine:
         self._log(entry)
         return dt
 
+    def _kv_stats_locked(self) -> dict:
+        """KV-pool utilization for `/stats` + the `fstpu_kv_*` gauges.
+        The slot layout reports lanes as max_len-token blocks so the
+        two layouts read on one scale; fragmentation is the unwritten
+        fraction of ALLOCATED lane capacity (bucket padding counts as
+        written — those positions hold real, masked K/V)."""
+        cfg = self.config
+        used_tokens = int(self._phys[self._active].sum())
+        if self.paged:
+            total = self._allocator.total_blocks
+            used = self._allocator.used_blocks
+            block_tokens = self.block_size
+            alloc_tokens = sum(len(b) for b in self._slot_blocks) * \
+                block_tokens
+        else:
+            total = cfg.num_slots
+            used = int(self._active.sum())
+            block_tokens = self.max_len
+            alloc_tokens = used * block_tokens
+        frag = round(1.0 - used_tokens / alloc_tokens, 4) \
+            if alloc_tokens else 0.0
+        return {
+            "layout": cfg.kv_layout, "dtype": cfg.kv_dtype,
+            "blocks_total": total, "blocks_used": used,
+            "blocks_free": total - used, "block_tokens": block_tokens,
+            "bytes": self._kv_bytes, "fragmentation": frag,
+        }
+
     def stats(self) -> dict:
         with self._cv:
             return self.metrics.snapshot(
                 queue_depth=len(self._queue),
                 slots_active=int(self._active.sum()),
-                num_slots=self.config.num_slots)
+                num_slots=self.config.num_slots,
+                kv=self._kv_stats_locked())

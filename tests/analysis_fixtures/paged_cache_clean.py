@@ -1,0 +1,74 @@
+"""known-clean fixture: the paged KV-cache idiom (docs/serving.md) —
+free-list math lives on the HOST scheduler thread, the traced decode
+is a pure gather/scatter program, and every metric bump / host sync
+happens between jit boundaries.
+
+Mirrors `fengshen_tpu/serving/paged_cache.py` + the engine's paged
+decode tick: `metrics-in-traced-code`, `blocking-transfer` and
+`host-divergence` must all stay silent here — if one fires, the
+analyzer would also flag the real serving modules and block the merge
+gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry, span
+
+REG = get_registry()
+TICKS = REG.counter("fx_paged_decode_ticks_total", "ticks")
+DEFERRED = REG.counter("fx_paged_deferred_total", "deferred admissions")
+
+
+class FreeList:
+    """Host-side block allocator: plain Python lists, never traced.
+    Block 0 is the reserved null block free lanes park on."""
+
+    def __init__(self, num_blocks):
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    def alloc(self, n):
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks):
+        self._free.extend(blocks)
+
+
+@jax.jit
+def paged_decode(pool, table, index, tokens, active):
+    """The traced program: pure array math. Writes each lane's token
+    K/V at `table[lane, idx // bs] * bs + idx % bs`, gathers the
+    lane's blocks back into a contiguous view — no metrics, no host
+    pulls, no ambient randomness."""
+    num_blocks, block_size, width = pool.shape
+    table = jnp.where(active[:, None], table, 0)   # park free lanes
+    blk = jnp.take_along_axis(table, (index // block_size)[:, None],
+                              axis=-1)[:, 0]
+    pos = blk * block_size + index % block_size
+    flat = pool.reshape(num_blocks * block_size, width)
+    flat = flat.at[pos].set(tokens[:, None].astype(flat.dtype))
+    gather = ((table * block_size)[:, :, None] +
+              jnp.arange(block_size)[None, None, :]).reshape(
+                  table.shape[0], -1)
+    lanes = jnp.take(flat, gather, axis=0)
+    return flat.reshape(pool.shape), index + 1, lanes.sum(-1)
+
+
+def tick(state, freelist, queued):
+    """One scheduler tick: admission math and metric bumps on the
+    host, ONE jitted decode, host sync after dispatch."""
+    pool, table, index, tokens, active = state
+    for need in queued:
+        blocks = freelist.alloc(need)
+        if blocks is None:
+            DEFERRED.inc()
+            break
+    with span("serving/decode"):
+        pool, index, scores = paged_decode(pool, table, index, tokens,
+                                           active)
+        out = np.asarray(scores)           # host sync AFTER dispatch
+    TICKS.inc()
+    return (pool, table, index, tokens, active), out

@@ -401,3 +401,23 @@ def test_aot_cache_internals_are_clean():
     hits = [f for f in findings
             if f.rule in ("metrics-in-traced-code", "blocking-transfer")]
     assert not hits, "\n".join(f.render() for f in hits)
+
+
+def test_paged_cache_internals_are_clean():
+    """Regression fixture for the paged KV cache (ISSUE 6): block
+    free-list math stays host-side, the traced gather/scatter decode
+    stays pure — neither `metrics-in-traced-code`, `blocking-transfer`
+    nor `host-divergence` may fire on the fixture or on the real
+    serving package. A hit means either the allocator leaked into
+    traced code (a real hazard: a python list mutated under trace is a
+    silent retrace/divergence bug) or a rule lost precision."""
+    fixture = os.path.join(FIXTURES, "paged_cache_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    serving_pkg = os.path.join(PKG, "serving")
+    findings = check_paths([serving_pkg], make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)

@@ -363,12 +363,21 @@ def test_engine_metrics_snapshot_shape_pinned():
     m.record_ttft(0.2)
     m.record_ttft(0.4)
     m.warmup_compile_s = 1.5
-    snap = m.snapshot(queue_depth=1, slots_active=3, num_slots=8)
+    snap = m.snapshot(queue_depth=1, slots_active=3, num_slots=8,
+                      kv={"layout": "paged", "dtype": "int8",
+                          "blocks_total": 16, "blocks_used": 5,
+                          "blocks_free": 11, "block_tokens": 64,
+                          "bytes": 4096, "fragmentation": 0.25})
     assert snap == {
         "queue_depth": 1, "slots_active": 3, "num_slots": 8,
         "admitted": 2, "rejected_queue_full": 0,
         "rejected_prompt_too_long": 0, "completed": 1,
         "cancelled": 0, "expired": 0,
+        "deferred_admissions": 0, "slots_active_peak": 3,
+        "kv_layout": "paged", "kv_dtype": "int8",
+        "kv_blocks_total": 16, "kv_blocks_used": 5,
+        "kv_blocks_free": 11, "kv_block_tokens": 64,
+        "kv_cache_bytes": 4096, "kv_fragmentation": 0.25,
         "prefills_per_bucket": {64: 2},
         "decode_ticks": 1, "decode_tokens": 3,
         "decode_tokens_per_sec": 6.0, "slot_occupancy": 0.375,
@@ -379,6 +388,11 @@ def test_engine_metrics_snapshot_shape_pinned():
     assert "fstpu_serving_admitted_total 2" in text
     assert 'fstpu_serving_prefills_total{bucket="64"} 2' in text
     assert "fstpu_serving_queue_depth 1" in text
+    assert "fstpu_kv_blocks_total 16" in text
+    assert "fstpu_kv_blocks_used 5" in text
+    assert "fstpu_kv_fragmentation 0.25" in text
+    # the kv-less form (bare EngineMetrics) defaults to an empty pool
+    assert m.snapshot(1, 3, 8)["kv_blocks_total"] == 0
     # two independent engines never share counts
     m2 = EngineMetrics()
     assert m2.snapshot(0, 0, 8)["admitted"] == 0

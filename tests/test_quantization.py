@@ -29,22 +29,26 @@ def test_quantize_roundtrip_error_small():
     assert deq["a"]["kernel"].shape == (128, 64)
 
 
-@pytest.mark.xfail(
-    reason="pre-existing at seed (NOTES.md tier-1 triage): on this "
-           "jax/CPU build greedy argmax agreement lands at 0.8125 vs "
-           "the 0.9 bar — random-init tiny-model logits sit too close "
-           "to ties for int8 rounding; needs a margin-aware fixture "
-           "(trained or scaled weights), not a threshold shave",
-    strict=False)
 def test_quantized_generation_matches_fp_greedy():
-    """Greedy decode with int8 weights must match full-precision on a
-    small model (weight-only quantization preserves argmax almost
-    everywhere at this scale)."""
+    """Greedy decode with int8 weights, judged margin-aware (NOTES.md
+    triage item 2 — the old fixture xfailed at 0.8125 raw agreement
+    because random-init tiny-model logits sit in near-ties that int8
+    rounding legitimately flips).
+
+    The margin-aware bar: quantization noise must never flip a
+    CONFIDENT decision. The lm_head is scaled up so top-2 logit gaps
+    dominate the rounding noise on enough positions to make the test
+    non-vacuous; "confident" is judged per position against the
+    DIRECTLY MEASURED teacher-forced logit perturbation (fp vs
+    dequantized-int8 forward on the same sequence — no drift), and
+    agreement is asserted on confident positions only. The
+    autoregressive decode may only diverge at an unconfident step."""
     from fengshen_tpu.examples.ziya_inference.generate_ziya_int8 import (
         quantized_generate)
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from fengshen_tpu.utils.generate import generate
-    from fengshen_tpu.utils.quantization import quantize_params_int8
+    from fengshen_tpu.utils.quantization import (dequantize_params,
+                                                 quantize_params_int8)
 
     cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
                       num_hidden_layers=2, num_attention_heads=4,
@@ -53,12 +57,50 @@ def test_quantized_generation_matches_fp_greedy():
     ids = jnp.asarray(np.random.RandomState(0).randint(3, 120, (1, 8)),
                       jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    # sharpen the top-2 gaps past int8 rounding noise (the margins and
+    # the head's own noise scale together; what this buys is headroom
+    # over the earlier layers' fixed perturbation)
+    params = dict(params,
+                  lm_head={"kernel": params["lm_head"]["kernel"] * 4.0})
+    prompt_len, max_new = ids.shape[1], 8
 
-    full = generate(model, params, ids, max_new_tokens=8)
+    full = np.asarray(generate(model, params, ids,
+                               max_new_tokens=max_new))
     q = quantize_params_int8(params, min_size=512)
-    quant = quantized_generate(model, q, ids, max_new_tokens=8)
-    agree = float((np.asarray(full) == np.asarray(quant)).mean())
-    assert agree > 0.9, agree
+    quant = np.asarray(quantized_generate(model, q, ids,
+                                          max_new_tokens=max_new))
+
+    # teacher-forced on the fp trajectory: per-position noise + margin
+    seq = jnp.asarray(full[0])[None]
+    logits_fp = np.asarray(model.apply({"params": params}, seq))[0]
+    logits_q = np.asarray(model.apply(
+        {"params": dequantize_params(q)}, seq).astype(jnp.float32))[0]
+    gen_pos = range(prompt_len - 1, prompt_len + max_new - 1)
+    confident = 0
+    for t in gen_pos:
+        noise = float(np.abs(logits_fp[t] - logits_q[t]).max())
+        top2 = np.sort(logits_fp[t])[-2:]
+        if top2[1] - top2[0] <= 2 * noise:
+            continue                       # a legitimate near-tie
+        confident += 1
+        assert logits_fp[t].argmax() == logits_q[t].argmax(), (
+            f"int8 flipped a confident position {t}: margin "
+            f"{top2[1] - top2[0]:.4f} vs noise {noise:.4f}")
+    assert confident >= 3, (
+        f"fixture went vacuous: only {confident} confident positions")
+
+    # the autoregressive decode may only leave the fp trajectory at an
+    # unconfident step (after that, drift makes tokens incomparable)
+    for t in range(max_new):
+        a, b = full[0, prompt_len + t], quant[0, prompt_len + t]
+        if a == b:
+            continue
+        pos = prompt_len + t - 1
+        noise = float(np.abs(logits_fp[pos] - logits_q[pos]).max())
+        top2 = np.sort(logits_fp[pos])[-2:]
+        assert top2[1] - top2[0] <= 2 * noise, (
+            f"greedy decode diverged at CONFIDENT step {t}")
+        break
 
 
 def test_int8_matmul_numerics_and_grads():

@@ -63,3 +63,56 @@ def test_serve_bench_default_shape_beats_sequential_3x(monkeypatch):
     default weight-memory-bound shape. Slow lane (~40s on CPU)."""
     row = _run(monkeypatch, {}, tiny=False)
     assert row["vs_baseline"] >= 3.0, row
+
+
+PARITY = {"SERVE_BENCH_MODE": "memory_parity",
+          "SERVE_BENCH_SLOTS": "2", "SERVE_BENCH_BUCKETS": "8,32",
+          "SERVE_BENCH_NEW_TOKENS": "8", "SERVE_BENCH_BLOCK_SIZE": "8"}
+
+
+def test_serve_bench_memory_parity_schema_and_2x(monkeypatch):
+    """Fast-lane guard for `make serve-bench-parity` (ISSUE 6): the
+    BENCH schema row, per-variant sections, equal-or-smaller byte
+    budgets, and the ≥2x max-concurrent bar — which is DETERMINISTIC
+    (admission capacity is allocator math, not timing), so the fast
+    lane can assert it on tiny shapes."""
+    row = _run(monkeypatch, PARITY)
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline",
+                        "kv_budget_bytes", "variants",
+                        "sequential_tokens_per_sec"}
+    assert row["metric"] == "serving_kv_memory_parity_max_concurrent"
+    assert row["mode"] == "memory_parity"
+    variants = row["variants"]
+    assert set(variants) == {"slot", "paged", "paged_int8"}
+    budget = row["kv_budget_bytes"]
+    for name, v in variants.items():
+        assert v["kv_cache_bytes"] <= budget, (name, v)
+        assert v["tokens_per_sec"] > 0
+        assert v["max_concurrent"] >= 1
+    slot_peak = variants["slot"]["max_concurrent"]
+    assert variants["paged"]["max_concurrent"] >= 2 * slot_peak, row
+    assert variants["paged_int8"]["max_concurrent"] >= \
+        2 * slot_peak, row
+    assert row["vs_baseline"] >= 2.0
+
+
+def test_serve_bench_memory_parity_degraded_flag(monkeypatch):
+    row = _run(monkeypatch, {**PARITY, "BENCH_DEGRADED": "1"})
+    assert row["degraded"] is True
+
+
+@pytest.mark.slow
+def test_serve_bench_memory_parity_acceptance_bar(monkeypatch):
+    """ISSUE 6 acceptance: on the weight-memory-bound default shape,
+    ≥2x concurrent requests at the same KV byte budget with aggregate
+    tokens/s still ≥ the 3x-over-sequential serving bar. Slow lane
+    (~4 min on CPU: sequential baseline + three engine warmups)."""
+    row = _run(monkeypatch,
+               {"SERVE_BENCH_MODE": "memory_parity",
+                "SERVE_BENCH_BUCKETS": "32,128",
+                "SERVE_BENCH_NEW_TOKENS": "32"}, tiny=False)
+    variants = row["variants"]
+    slot_peak = variants["slot"]["max_concurrent"]
+    for name in ("paged", "paged_int8"):
+        assert variants[name]["max_concurrent"] >= 2 * slot_peak, row
+        assert variants[name]["vs_sequential"] >= 3.0, row
