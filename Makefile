@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: lint lint-baseline test test-fast serve-bench \
-	serve-bench-parity aot-bench
+	serve-bench-parity serve-bench-spec aot-bench
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -22,6 +22,15 @@ serve-bench:
 serve-bench-parity:
 	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=memory_parity \
 		SERVE_BENCH_BUCKETS=32,128 SERVE_BENCH_NEW_TOKENS=32 \
+		$(PY) -m fengshen_tpu.serving.bench
+
+# speculative-decode microbench (docs/serving.md "Speculative
+# decoding"): committed tokens per target forward + aggregate tokens/s
+# of the prompt-lookup engine vs the same engine with spec off, on a
+# self-repetitive workload — one BENCH-schema JSON line on CPU
+serve-bench-spec:
+	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=spec \
+		SERVE_BENCH_BUCKETS=32,64 SERVE_BENCH_NEW_TOKENS=96 \
 		$(PY) -m fengshen_tpu.serving.bench
 
 # AOT cold-start microbench (docs/aot_cache.md): cold-process vs

@@ -15,12 +15,18 @@ share ONE jitted decode step; the optional ENGINE block holds
 plus the KV-pool physicals `kv_layout: "slot"|"paged"`,
 `kv_dtype: "fp32"|"int8"`, `kv_block_size`, `kv_num_blocks` — the
 paged/int8 pool serves ≥2x the concurrent requests per KV byte, see
-docs/serving.md "Paged KV cache"), and the optional AOT block
-(`{"cache_dir": ...}`, docs/aot_cache.md) routes every engine compile
-through the persistent executable cache so a restarted replica
-deserializes instead of recompiling (the KV knobs join the cache key).
-`GET /stats` includes the KV-pool utilization (blocks total/used/free,
-bytes, fragmentation, layout/dtype) alongside the engine metrics.
+docs/serving.md "Paged KV cache" — and the speculative-decode knobs
+`spec_mode: "off"|"prompt_lookup"`, `spec_gamma`, `spec_ngram` — the
+draft/verify tick commits >1 token per weight stream on repetitive
+text, docs/serving.md "Speculative decoding"), and the optional AOT
+block (`{"cache_dir": ...}`, docs/aot_cache.md) routes every engine
+compile through the persistent executable cache so a restarted replica
+deserializes instead of recompiling (the KV and spec knobs join the
+cache key). `GET /stats` includes the KV-pool utilization (blocks
+total/used/free, bytes, fragmentation, layout/dtype) alongside the
+engine metrics, plus — on a spec engine only, so the non-spec payload
+shape never churns — `spec_mode`/`spec_gamma`/`spec_drafted_total`/
+`spec_accepted_total`/`spec_acceptance_rate`.
 
 Both engines get warmed at startup so the first user never pays jit
 compilation — warmup runs in a BACKGROUND thread while the server is

@@ -273,22 +273,29 @@ class LlamaAttention(nn.Module):
         in a shared `[num_blocks, block_size, kv, hd]` pool; each lane's
         logical positions map through its `block_table` row to physical
         blocks. The host scheduler owns the free list; this method only
-        scatters the step's K/V at `table[lane, idx // bs] * bs + idx %
-        bs` and gathers each lane's blocks back into a contiguous
-        virtual lane with `jnp.take` — the paged-attention analog in
-        pure gather/scatter ops, so the XLA-CPU tier-1 lane runs it
-        unchanged. Inactive lanes are parked on block 0 (the null
-        block, never allocated), which absorbs their stray writes.
+        scatters the step's K/V at `table[lane, p // bs] * bs + p % bs`
+        for each of the step's `seq` positions `p = idx + 0..seq-1`
+        (seq == 1 for the plain decode tick; seq == gamma+1 for the
+        speculative verify window, whose positions may CROSS a block
+        boundary — hence the per-position block lookup) and gathers
+        each lane's blocks back into a contiguous virtual lane with
+        `jnp.take` — the paged-attention analog in pure gather/scatter
+        ops, so the XLA-CPU tier-1 lane runs it unchanged. Inactive
+        lanes are parked on block 0 (the null block, never allocated),
+        which absorbs their stray writes; the engine's admission
+        charges blocks for the speculative tail too
+        (`serving/paged_cache.py blocks_for_tokens` over
+        bucket + max_new + gamma), so an active lane's over-scattered
+        window never reaches a block it does not own. Prefill still
+        runs on a contiguous batch-1 cache and is scattered in by
+        `assign_paged` — a whole prompt through this path would
+        overrun the lane, hence the seq bound below.
 
         An int8 pool (marked by `cached_key_scale`) stores per-(token,
         head) absmax scales alongside and dequantizes inside the read.
         """
         cfg = self.config
         batch, seq, n_kv, head_dim = k.shape
-        if seq != 1:
-            raise ValueError(
-                "the paged KV cache decodes one token per step (prefill "
-                f"runs on a contiguous batch-1 cache); got seq={seq}")
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                  (1, 1, n_kv, head_dim), k.dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
@@ -300,14 +307,25 @@ class LlamaAttention(nn.Module):
         num_blocks, block_size = cached_k.value.shape[:2]
         max_blocks = table.value.shape[-1]
         virt_len = max_blocks * block_size   # the lane's logical extent
+        if seq > virt_len:
+            # a window that cannot fit any lane (e.g. prefilling a
+            # long prompt through the paged path) must fail loudly —
+            # the block lookup below would clamp its overflow
+            # positions onto one block and silently corrupt it
+            raise ValueError(
+                f"paged cache updates take at most the virtual lane "
+                f"length {virt_len} tokens per step (decode tick or "
+                f"speculative verify window); got seq={seq}. Prefill "
+                "runs on a contiguous batch-1 cache.")
         idx = cache_index.value              # [B] physical cursors
         quantized = self.has_variable("cache", "cached_key_scale")
 
-        # scatter this step's K/V at each lane's physical position
-        blk = jnp.take_along_axis(table.value,
-                                  (idx // block_size)[:, None],
-                                  axis=-1)[:, 0]
-        pos = blk * block_size + idx % block_size          # [B] flat
+        # scatter this step's K/V at each lane's physical positions
+        # (lanes parked on the null block collide there by design —
+        # whichever garbage write wins is never read unmasked)
+        p = idx[:, None] + jnp.arange(seq)[None, :]        # [B, seq]
+        blk = jnp.take_along_axis(table.value, p // block_size, axis=-1)
+        pos = (blk * block_size + p % block_size).reshape(-1)
         flat_k = cached_k.value.reshape(num_blocks * block_size,
                                         n_kv, head_dim)
         flat_v = cached_v.value.reshape(num_blocks * block_size,
@@ -321,22 +339,30 @@ class LlamaAttention(nn.Module):
             v_scale = self.variable(
                 "cache", "cached_value_scale", jnp.zeros,
                 (num_blocks, block_size, n_kv), jnp.float32)
-            kq, ks = quantize_kv(k[:, 0])
-            vq, vs = quantize_kv(v[:, 0])
-            flat_k = flat_k.at[pos].set(kq)
-            flat_v = flat_v.at[pos].set(vq)
-            flat_ks = k_scale.value.reshape(-1, n_kv).at[pos].set(ks)
-            flat_vs = v_scale.value.reshape(-1, n_kv).at[pos].set(vs)
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            flat_k = flat_k.at[pos].set(
+                kq.reshape(batch * seq, n_kv, head_dim))
+            flat_v = flat_v.at[pos].set(
+                vq.reshape(batch * seq, n_kv, head_dim))
+            flat_ks = k_scale.value.reshape(-1, n_kv).at[pos].set(
+                ks.reshape(batch * seq, n_kv))
+            flat_vs = v_scale.value.reshape(-1, n_kv).at[pos].set(
+                vs.reshape(batch * seq, n_kv))
             k_scale.value = flat_ks.reshape(num_blocks, block_size, n_kv)
             v_scale.value = flat_vs.reshape(num_blocks, block_size, n_kv)
         else:
-            flat_k = flat_k.at[pos].set(k[:, 0].astype(flat_k.dtype))
-            flat_v = flat_v.at[pos].set(v[:, 0].astype(flat_v.dtype))
+            flat_k = flat_k.at[pos].set(
+                k.reshape(batch * seq, n_kv, head_dim).astype(
+                    flat_k.dtype))
+            flat_v = flat_v.at[pos].set(
+                v.reshape(batch * seq, n_kv, head_dim).astype(
+                    flat_v.dtype))
         cached_k.value = flat_k.reshape(num_blocks, block_size,
                                         n_kv, head_dim)
         cached_v.value = flat_v.reshape(num_blocks, block_size,
                                         n_kv, head_dim)
-        cache_index.value = idx + 1
+        cache_index.value = idx + seq
 
         # gather each lane's blocks into a contiguous [B, virt_len] view
         gather_idx = ((table.value * block_size)[:, :, None] +

@@ -21,9 +21,24 @@ lanes, and int8 stores ~3-4x more KV tokens per byte, so `value` /
 `vs_baseline` become the paged-over-slot concurrency ratio (the >= 2x
 acceptance bar of ISSUE 6).
 
+`SERVE_BENCH_MODE=spec` (`make serve-bench-spec`) benches the
+**speculative decode tick** (docs/serving.md "Speculative decoding"):
+the same engine/workload with `spec_mode="off"` vs `"prompt_lookup"`.
+The workload is repetitive TEXT by construction — a random-init model
+has no real language to copy, so the bench probes candidate tokens
+with one short batched generate and keeps the ones whose greedy
+continuations are the most self-repetitive (the synthetic stand-in
+for the extractive/summarisation regime where prompt lookup pays).
+`value` = committed tokens per target forward (1 + gamma x
+acceptance_rate; the non-spec tick is exactly 1.0), `vs_baseline` the
+same ratio; the row also carries `acceptance_rate`, both engines'
+tokens/s, and `token_identical` (greedy spec output must equal the
+non-spec engine's).
+
 Env knobs (SERVE_BENCH_*): SLOTS, REQUESTS, NEW_TOKENS, VOCAB, HIDDEN,
 INTER, LAYERS, HEADS, BUCKETS (comma list), SEED, MODE, BLOCK_SIZE,
-MAX_SLOTS (paged concurrency cap in parity mode).
+MAX_SLOTS (paged concurrency cap in parity mode), SPEC_GAMMA,
+SPEC_NGRAM, PROBE (spec-workload candidate count).
 
 Why batching wins even here: batch-1 decode is weight-memory-bound —
 every generated token streams the full weight matrices for ONE row.
@@ -83,7 +98,7 @@ def _run_engine(model, params, prompts, cfg) -> dict:
     dt = time.perf_counter() - t0
     stats = engine.stats()
     return {"tokens_per_sec": round(sum(len(t) for t in outs) / dt, 1),
-            "stats": stats}
+            "stats": stats, "outputs": outs}
 
 
 def _memory_parity(model, params, config, buckets, new_tokens) -> None:
@@ -175,6 +190,94 @@ def _memory_parity(model, params, config, buckets, new_tokens) -> None:
     })
 
 
+def committed_per_forward(gamma: int, acceptance_rate: float) -> float:
+    """Committed tokens per target forward per lane: every verify
+    commits the accepted prefix plus one correction, so the mean is
+    `1 + gamma * acceptance_rate` (an identity over the engine's
+    spec_drafted/spec_accepted counters — the fast-lane smoke pins the
+    math without a model forward). The non-spec tick is exactly 1.0."""
+    if gamma < 0 or not 0.0 <= acceptance_rate <= 1.0:
+        raise ValueError(f"bad spec stats: gamma={gamma} "
+                         f"acceptance_rate={acceptance_rate}")
+    return 1.0 + gamma * acceptance_rate
+
+
+def _spec_prompts(model, params, vocab: int, prompt_len: int,
+                  n_req: int, seed: int, probe: int,
+                  probe_new: int = 32):
+    """The repetitive-text workload: probe `probe` candidate tokens
+    with ONE batched short generate and keep the `n_req` whose greedy
+    continuations are most self-repetitive (fraction of positions
+    matching one of the two previous tokens — what an ngram<=2 lookup
+    can exploit). A random-init model has no real text to copy; this
+    selects the rows where its greedy decode actually loops, the
+    synthetic stand-in for extractive/repetitive serving traffic."""
+    from fengshen_tpu.utils.generate import generate
+    rng = np.random.RandomState(seed)
+    cands = rng.randint(3, vocab - 1, probe).astype(np.int32)
+    ids = jnp.asarray(np.repeat(cands[:, None], prompt_len, axis=1))
+    out = np.asarray(generate(model, params,
+                              max_new_tokens=probe_new,
+                              input_ids=ids))[:, prompt_len:]
+    rep = ((out[:, 2:] == out[:, 1:-1]) |
+           (out[:, 2:] == out[:, :-2])).mean(1)
+    best = cands[np.argsort(-rep, kind="stable")[:n_req]]
+    return [np.full(prompt_len, int(t), np.int32) for t in best]
+
+
+def _spec_bench(model, params, config, buckets, new_tokens) -> None:
+    """Same engine, same prompts, spec off vs prompt_lookup: committed
+    tokens per target forward (the >=1.8x bar), aggregate tokens/s
+    (the >=1.3x bar), greedy token identity."""
+    from fengshen_tpu.serving import EngineConfig
+
+    slots = _env("SLOTS", 8)
+    gamma = _env("SPEC_GAMMA", 4)
+    ngram = _env("SPEC_NGRAM", 2)
+    n_req = max(_env("REQUESTS", 8), 1)
+    prompt_len = max(buckets[0] - 4, 1)
+    max_len = int(model.config.max_position_embeddings)
+    prompts = _spec_prompts(model, params, config.vocab_size,
+                            prompt_len, n_req, _env("SEED", 0),
+                            probe=_env("PROBE", 64),
+                            probe_new=min(32, max_len - prompt_len))
+
+    base_kw = dict(num_slots=slots, buckets=buckets,
+                   max_new_tokens=new_tokens, max_queue=n_req,
+                   eos_token_id=None, pad_token_id=0)
+    off = _run_engine(model, params, prompts, EngineConfig(**base_kw))
+    spec = _run_engine(
+        model, params, prompts,
+        EngineConfig(spec_mode="prompt_lookup", spec_gamma=gamma,
+                     spec_ngram=ngram, **base_kw))
+    st = spec["stats"]
+    cpf = committed_per_forward(gamma, st["spec_acceptance_rate"])
+    _emit({
+        "metric": "serving_spec_committed_per_forward",
+        "value": round(cpf, 3),
+        "unit": "tokens/forward",
+        # the non-spec tick commits exactly one token per lane per
+        # weight stream, so cpf IS the vs-baseline ratio
+        "vs_baseline": round(cpf, 3),
+        "mode": "spec",
+        "acceptance_rate": st["spec_acceptance_rate"],
+        "spec_gamma": gamma,
+        "spec_ngram": ngram,
+        "tokens_per_sec": spec["tokens_per_sec"],
+        "tokens_per_sec_off": off["tokens_per_sec"],
+        "speedup_vs_off": round(spec["tokens_per_sec"] /
+                                off["tokens_per_sec"], 3),
+        "token_identical": spec["outputs"] == off["outputs"],
+        "decode_ticks": st["decode_ticks"],
+        "decode_ticks_off": off["stats"]["decode_ticks"],
+        "requests": n_req,
+        "num_slots": slots,
+        "new_tokens": new_tokens,
+        "prompt_tokens": prompt_len,
+        "backend": jax.default_backend(),
+    })
+
+
 def main() -> None:
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from fengshen_tpu.serving import EngineConfig
@@ -182,8 +285,12 @@ def main() -> None:
     slots = _env("SLOTS", 8)
     n_req = _env("REQUESTS", 8)
     new_tokens = _env("NEW_TOKENS", 48)
+    mode = os.environ.get("SERVE_BENCH_MODE", "throughput")
     buckets = tuple(int(b) for b in os.environ.get(
         "SERVE_BENCH_BUCKETS", "32,64").split(","))
+    # the spec verify scatters a gamma-wide tail past the cursor, so
+    # the lane needs gamma extra positions (engine admission headroom)
+    spec_headroom = _env("SPEC_GAMMA", 4) if mode == "spec" else 0
     # default shape sits in the weight-memory-bound decode regime (the
     # 300M-bench hidden/intermediate at 4 layers): batch-1 GEMV and
     # batch-8 GEMM stream the same weights, so the slot pool's batching
@@ -195,16 +302,18 @@ def main() -> None:
         intermediate_size=_env("INTER", 2816),
         num_hidden_layers=_env("LAYERS", 4),
         num_attention_heads=_env("HEADS", 8),
-        max_position_embeddings=buckets[-1] + new_tokens,
+        max_position_embeddings=buckets[-1] + new_tokens + spec_headroom,
         dtype="float32")
     model = LlamaForCausalLM(config)
     params = jax.jit(lambda r: model.init(
         r, jnp.zeros((1, 8), jnp.int32))["params"])(
         jax.random.PRNGKey(_env("SEED", 0)))
 
-    if os.environ.get("SERVE_BENCH_MODE", "throughput") == \
-            "memory_parity":
+    if mode == "memory_parity":
         _memory_parity(model, params, config, buckets, new_tokens)
+        return
+    if mode == "spec":
+        _spec_bench(model, params, config, buckets, new_tokens)
         return
 
     rng = np.random.RandomState(_env("SEED", 0))

@@ -65,7 +65,10 @@ def rollback_slots(cache, delta):
     """Per-slot analog of `utils.generate._rollback_cache`: lower each
     lane's cache_index by `delta` ([num_slots] vector). Sound for the
     same reason as the scalar version — entries past the index are
-    masked out and overwritten in place."""
+    masked out and overwritten in place. The engine's speculative tick
+    leans on this every verify: the forward advances all lanes by
+    gamma+1 and each lane rolls back its own rejected tail
+    (serving/engine.py, docs/serving.md "Speculative decoding")."""
     def fix(path, leaf):
         if is_cache_index_path(path):
             return leaf - jnp.asarray(delta, leaf.dtype)

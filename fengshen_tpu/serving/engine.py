@@ -25,6 +25,16 @@ onto a fixed pool of `num_slots` KV-cache lanes:
   lane, and an exhausted pool defers the queue head until reclaim;
   `kv_dtype="int8"` stores K/V quantized with per-(token, head) absmax
   scales. Both keep this module's one-jitted-decode contract.
+- speculative tick: `spec_mode="prompt_lookup"` swaps the one-token
+  decode for a draft→verify tick — an in-graph n-gram drafter proposes
+  `spec_gamma` continuations per lane from the lane's on-device
+  committed history, ONE jitted forward verifies all `[B, gamma+1]`
+  positions through the same slot/paged cache, and per-lane accept
+  counts (`utils.generate._spec_round_tokens`' greedy rule) advance
+  each lane's cursor independently — decode is memory-bandwidth-bound,
+  so committing >1 token per weight stream is the per-request latency
+  lever the pool alone cannot pull. Works over both layouts and both
+  kv dtypes; still exactly one decode program per engine.
 
 Greedy decode is TOKEN-IDENTICAL to sequential
 `utils.generate.generate` on the bucket-padded prompt (the parity test
@@ -49,14 +59,17 @@ import numpy as np
 from fengshen_tpu.observability import record_warmup_seconds, span
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
-                                        reset_free_slots)
+                                        reset_free_slots, rollback_slots)
 from fengshen_tpu.serving.paged_cache import (BlockAllocator,
                                               assign_paged,
                                               assign_slot_quantized,
+                                              blocks_for_tokens,
                                               init_pool_cache)
 from fengshen_tpu.serving.metrics import EngineMetrics
-from fengshen_tpu.utils.generate import (_controls_active, _prefill_cache,
-                                         _select_token,
+from fengshen_tpu.utils.generate import (_controls_active,
+                                         _ngram_propose_lanes,
+                                         _prefill_cache, _select_token,
+                                         _spec_round_tokens,
                                          apply_logits_controls)
 
 
@@ -101,6 +114,15 @@ class EngineConfig:
     kv_block_size: int = 64                  # tokens per paged block
     kv_num_blocks: Optional[int] = None      # default: slot-parity + null
     kv_max_blocks_per_slot: Optional[int] = None  # default: max_len/bs
+    # speculative decode (docs/serving.md "Speculative decoding"):
+    # "prompt_lookup" makes every tick draft spec_gamma tokens per lane
+    # by n-gram match against the lane's on-device committed history
+    # and verify all of them in ONE jitted forward — >1 committed token
+    # per weight stream on repetitive/extractive text, greedy output
+    # token-identical to the non-spec engine
+    spec_mode: str = "off"                   # "off" | "prompt_lookup"
+    spec_gamma: int = 4                      # drafted tokens per tick
+    spec_ngram: int = 2                      # suffix length to match
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -126,6 +148,34 @@ class EngineConfig:
                 "the continuous engine supports no_repeat_ngram_size of "
                 "0 or 1 only (per-slot cursors cannot drive the n>1 "
                 "window processor)")
+        if self.spec_mode not in ("off", "prompt_lookup"):
+            raise ValueError(
+                f"unknown spec_mode {self.spec_mode!r}; expected 'off' "
+                "or 'prompt_lookup'")
+        if self.spec_mode != "off":
+            if self.spec_gamma < 1:
+                raise ValueError("spec_gamma must be >= 1")
+            if self.spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
+            if self.do_sample:
+                # the rejection-sampling scheme needs the DRAFTER's
+                # proposal distribution q; prompt lookup has none (its
+                # proposals are copied tokens), so only greedy
+                # accept-while-argmax-agrees is sound here
+                raise ValueError(
+                    "spec_mode='prompt_lookup' is greedy-only "
+                    "(do_sample=False): lookup proposals carry no "
+                    "draft distribution for the rejection-sampling "
+                    "accept rule")
+            if (self.repetition_penalty != 1.0 or
+                    self.no_repeat_ngram_size > 0 or self.min_length > 0):
+                # the processors are defined at ONE committed cursor;
+                # the verify window scores gamma+1 cursors at once
+                raise ValueError(
+                    "spec_mode cannot run logits controls "
+                    "(repetition_penalty / no_repeat_ngram_size / "
+                    "min_length act per committed cursor, but the "
+                    "verify forward scores gamma+1 positions at once)")
 
 
 class Request:
@@ -186,6 +236,13 @@ class ContinuousBatchingEngine:
         self._clock = clock
         self.max_len = int(model.config.max_position_embeddings)
         self.paged = config.kv_layout == "paged"
+        self.spec = config.spec_mode != "off"
+        # every admission must reserve gamma EXTRA positions: the
+        # verify forward scatters the full gamma+1 window before the
+        # accept counts are known, so rejected tails land past the
+        # cursor (masked, later overwritten) but must stay inside the
+        # lane (the engine analog of _check_spec_cache_headroom)
+        self._gamma = config.spec_gamma if self.spec else 0
         S = config.num_slots
         if self.paged:
             bs = int(config.kv_block_size)
@@ -217,11 +274,13 @@ class ContinuousBatchingEngine:
             self._deferred_req: Optional[str] = None
         else:
             self.seq_capacity = self.max_len
-        if self.ladder.buckets[0] + 1 > self.seq_capacity:
+        if self.ladder.buckets[0] + 1 + self._gamma > self.seq_capacity:
             raise ValueError(
                 f"smallest bucket {self.ladder.buckets[0]} leaves no "
                 f"decode headroom in the KV lane capacity "
-                f"{self.seq_capacity}")
+                f"{self.seq_capacity}" +
+                (f" (speculative window needs gamma={self._gamma} "
+                 "extra positions)" if self._gamma else ""))
 
         L = self.seq_capacity
         self._cache = self._init_pool()
@@ -293,34 +352,93 @@ class ContinuousBatchingEngine:
                 mask = mask.at[slot].set(mask_row)
                 return cache, history, mask
 
-        def decode_fn(params, cache, history, mask, tokens, pos, phys,
-                      active, rng):
-            n = tokens.shape[0]
-            if paged:
-                # clamp BEFORE the forward: a reclaimed lane's blocks
-                # may already belong to another request, so its stray
-                # write must be parked on the null block first (the
-                # slot layout clamps after — each lane owns its space)
-                cache = reset_free_slots(cache, active)
-            # the token selected last tick enters the history at its
-            # physical cursor BEFORE the forward (its K/V are written at
-            # the same position by the cache update)
-            history = history.at[jnp.arange(n), phys].set(tokens)
-            logits, mutated = model.apply(
-                {"params": params, "cache": cache}, tokens[:, None],
-                attention_mask=mask, position_ids=pos[:, None],
-                init_cache=True, mutable=["cache"])
-            cache = mutated["cache"] if paged else \
-                reset_free_slots(mutated["cache"], active)
-            step_logits = logits[:, -1]
-            if controls_on:
-                step_logits = apply_logits_controls(
-                    step_logits, history, (phys + 1)[:, None],
-                    history_mask=mask, **control_kw)
-            nxt = _select_token(step_logits, rng, cfg.do_sample,
-                                cfg.temperature, cfg.top_k, cfg.top_p)
-            nxt = jnp.where(active, nxt, cfg.pad_token_id)
-            return cache, history, nxt.astype(jnp.int32)
+        gamma, ngram = cfg.spec_gamma, cfg.spec_ngram
+        if self.spec:
+            def decode_fn(params, cache, history, mask, tokens, pos,
+                          phys, active, rng):
+                """Speculative tick: per-lane prompt-lookup draft → ONE
+                verify forward over [B, gamma+1] → per-lane greedy
+                accept/commit. Entirely in-graph: the committed-history
+                ring already lives on device, so the drafter costs no
+                host round-trip (the fslint fixture
+                spec_decode_clean.py pins this path clean)."""
+                n = tokens.shape[0]
+                if paged:
+                    cache = reset_free_slots(cache, active)
+                # the token selected last tick enters the history at
+                # its physical cursor BEFORE the forward, exactly like
+                # the plain tick — the drafter then matches the
+                # ngram-suffix ending at phys+1
+                history = history.at[jnp.arange(n), phys].set(tokens)
+                drafts = _ngram_propose_lanes(history, phys + 1, ngram,
+                                              gamma, tokens)
+                verify = jnp.concatenate([tokens[:, None], drafts],
+                                         axis=1)
+                v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
+                logits, mutated = model.apply(
+                    {"params": params, "cache": cache}, verify,
+                    attention_mask=mask, position_ids=v_pos,
+                    init_cache=True, mutable=["cache"])
+                # greedy accept = longest draft==argmax prefix, w = the
+                # per-position corrections: EXACTLY _spec_round_tokens'
+                # rule, shared with speculative_generate
+                n_r, w = _spec_round_tokens(logits, None, drafts, rng,
+                                            do_sample=False)
+                n_r = jnp.where(active, n_r, 0)
+                # the verify advanced every lane's cursor by gamma+1;
+                # each lane rolls back its REJECTED tail independently
+                # (no KV rewind needed: entries past the index are
+                # masked and overwritten — the _rollback_cache
+                # invariant, per-lane via rollback_slots)
+                cache = rollback_slots(
+                    mutated["cache"],
+                    jnp.where(active, gamma - n_r, 0))
+                if not paged:
+                    cache = reset_free_slots(cache, active)
+                c = n_r + 1     # committed this tick (1..gamma+1)
+                win = jnp.where(
+                    jnp.arange(gamma + 1)[None] < c[:, None], w,
+                    cfg.pad_token_id)
+                win = jnp.where(active[:, None], win, cfg.pad_token_id)
+                # committed window tokens join the history ring at
+                # phys+1.. so the next tick's drafter can match them;
+                # the slot past the new cursor holds pad, like
+                # _speculative_loop's buffer
+                history = jax.vmap(
+                    lambda row, wrow, p: jax.lax.dynamic_update_slice(
+                        row, wrow, (p,)))(history, win, phys + 1)
+                return cache, history, n_r, win
+        else:
+            def decode_fn(params, cache, history, mask, tokens, pos,
+                          phys, active, rng):
+                n = tokens.shape[0]
+                if paged:
+                    # clamp BEFORE the forward: a reclaimed lane's
+                    # blocks may already belong to another request, so
+                    # its stray write must be parked on the null block
+                    # first (the slot layout clamps after — each lane
+                    # owns its space)
+                    cache = reset_free_slots(cache, active)
+                # the token selected last tick enters the history at
+                # its physical cursor BEFORE the forward (its K/V are
+                # written at the same position by the cache update)
+                history = history.at[jnp.arange(n), phys].set(tokens)
+                logits, mutated = model.apply(
+                    {"params": params, "cache": cache}, tokens[:, None],
+                    attention_mask=mask, position_ids=pos[:, None],
+                    init_cache=True, mutable=["cache"])
+                cache = mutated["cache"] if paged else \
+                    reset_free_slots(mutated["cache"], active)
+                step_logits = logits[:, -1]
+                if controls_on:
+                    step_logits = apply_logits_controls(
+                        step_logits, history, (phys + 1)[:, None],
+                        history_mask=mask, **control_kw)
+                nxt = _select_token(step_logits, rng, cfg.do_sample,
+                                    cfg.temperature, cfg.top_k,
+                                    cfg.top_p)
+                nxt = jnp.where(active, nxt, cfg.pad_token_id)
+                return cache, history, nxt.astype(jnp.int32)
 
         # one compile per bucket width / exactly one for decode — the
         # parity + compile-count tests pin this via _cache_size().
@@ -388,21 +506,27 @@ class ContinuousBatchingEngine:
                 f"bucket {self.ladder.max_bucket}")
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.config.max_new_tokens)
-        # the lane must hold bucket + generated tokens (seq_capacity is
-        # max_len for the slot layout, blocks x block_size for paged)
-        max_new = min(max_new, self.seq_capacity - bucket)
+        # the lane must hold bucket + generated tokens + the gamma-wide
+        # speculative tail (seq_capacity is max_len for the slot
+        # layout, blocks x block_size for paged); clamping without the
+        # gamma term would let the verify window silently walk past
+        # the lane end — the off-by-gamma the boundary test pins
+        max_new = min(max_new, self.seq_capacity - bucket - self._gamma)
         if max_new < 1:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
             raise PromptTooLong(
                 f"bucket {bucket} leaves no decode headroom in the "
-                f"KV lane capacity {self.seq_capacity}")
+                f"KV lane capacity {self.seq_capacity}" +
+                (f" (speculative window needs gamma={self._gamma} "
+                 "extra positions)" if self._gamma else ""))
         if self.paged:
             # a footprint the whole pool cannot hold would sit at the
             # queue head forever (nothing can free enough blocks) —
             # reject NOW instead of livelocking the FIFO
-            need = -(-(bucket + max_new) // self.block_size)
+            need = blocks_for_tokens(bucket + max_new + self._gamma,
+                                     self.block_size)
             if need > self._allocator.total_blocks:
                 self.metrics.count("rejected_prompt_too_long")
                 self._log({"event": "serving_reject",
@@ -484,6 +608,60 @@ class ContinuousBatchingEngine:
         else:
             key = self._zero_key
         t0 = time.perf_counter()
+        if self.spec:
+            with span("serving/decode"):
+                self._cache, self._history, n_r, win = self._decode_jit(
+                    self.params, self._cache, self._history, self._mask,
+                    self._last_tok, self._pos, self._phys, self._active,
+                    key)
+                # host sync: the scheduler needs the accept counts and
+                # the committed window (copies — the device views are
+                # read-only and lanes are overwritten on admission)
+                n_r = np.array(n_r)
+                win = np.array(win)
+            dt = time.perf_counter() - t0
+            # per-lane commit: accepted prefix + the correction token,
+            # so each lane's cursor advances INDEPENDENTLY (the whole
+            # point over generate's batched min-advance)
+            commit = np.where(self._active, n_r + 1, 0)
+            last = win[np.arange(win.shape[0]),
+                       np.maximum(commit - 1, 0)]
+            self._last_tok = np.where(self._active, last,
+                                      self.config.pad_token_id
+                                      ).astype(np.int32)
+            self._pos = (self._pos + commit).astype(np.int32)
+            self._phys = (self._phys + commit).astype(np.int32)
+            # metrics count DELIVERED tokens, not the raw window: a
+            # lane finishing mid-window (eos, or the max_new cap)
+            # discards the tail, and counting it would inflate
+            # decode_tokens and the acceptance rate the bench's
+            # committed-per-forward headline is derived from
+            delivered = 0
+            accepted_delivered = 0
+            for i in active_idx:
+                req = self._slot_req[i]
+                k = 0
+                for tok in (int(t) for t in win[i, :commit[i]]):
+                    req.tokens.append(tok)
+                    k += 1
+                    if self.config.eos_token_id is not None and \
+                            tok == self.config.eos_token_id:
+                        self._release(i, FINISHED, "eos")
+                        break
+                    if len(req.tokens) >= req.max_new_tokens:
+                        self._release(i, FINISHED, "length")
+                        break
+                delivered += k
+                # delivered tokens at offsets < n_r are accepted
+                # drafts; the one at offset n_r is the correction
+                accepted_delivered += min(int(n_r[i]), k)
+            self.metrics.record_tick(len(active_idx),
+                                     self.config.num_slots, dt,
+                                     tokens=delivered)
+            self.metrics.record_spec(
+                self.config.spec_gamma * len(active_idx),
+                accepted_delivered)
+            return int(self._active.sum())
         with span("serving/decode"):
             self._cache, self._history, nxt = self._decode_jit(
                 self.params, self._cache, self._history, self._mask,
@@ -530,8 +708,9 @@ class ContinuousBatchingEngine:
                 # reclaim (FIFO — later requests must not starve it),
                 # the queue fills, and submit's QueueFull (429) is the
                 # backpressure surface
-                need = -(-(bucket + req.max_new_tokens)
-                         // self.block_size)
+                need = blocks_for_tokens(
+                    bucket + req.max_new_tokens + self._gamma,
+                    self.block_size)
                 blocks = self._allocator.alloc(need)
                 if blocks is None:
                     self._queue.appendleft(req)
@@ -762,11 +941,14 @@ class ContinuousBatchingEngine:
                 # cache/history are donated, so reassign them; with
                 # every lane free the warmup tick is a no-op on pool
                 # state (free lanes write at index 0 and are fully
-                # overwritten by the next assignment anyway)
-                self._cache, self._history, _ = self._decode_jit(
+                # overwritten by the next assignment anyway); the spec
+                # tick returns (cache, history, n_r, win), the plain
+                # one (cache, history, nxt) — slice the shared prefix
+                out = self._decode_jit(
                     self.params, self._cache, self._history, self._mask,
                     self._last_tok, self._pos, self._phys, self._active,
                     self._zero_key)
+                self._cache, self._history = out[0], out[1]
                 jax.block_until_ready(self._cache)
         dt = time.perf_counter() - t0
         self.metrics.warmup_compile_s = round(dt, 3)
@@ -813,4 +995,9 @@ class ContinuousBatchingEngine:
                 queue_depth=len(self._queue),
                 slots_active=int(self._active.sum()),
                 num_slots=self.config.num_slots,
-                kv=self._kv_stats_locked())
+                kv=self._kv_stats_locked(),
+                # None keeps the non-spec payload byte-identical to
+                # the pre-spec /stats shape (pinned by tests)
+                spec=({"mode": self.config.spec_mode,
+                       "gamma": self.config.spec_gamma}
+                      if self.spec else None))

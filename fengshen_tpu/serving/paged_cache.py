@@ -42,6 +42,16 @@ from fengshen_tpu.ops.int8_matmul import quantize_kv
 NULL_BLOCK = 0
 
 
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    """ceil(n_tokens / block_size): the engine's admission charge for a
+    request footprint. The ONE place the rounding lives — a speculative
+    engine must charge `bucket + max_new + gamma` tokens (the verify
+    window over-scatters up to gamma rejected entries past the cursor,
+    and those writes must land in blocks the lane owns, never in a
+    neighbour's)."""
+    return -(-int(n_tokens) // int(block_size))
+
+
 class BlockAllocator:
     """Host-side free list over the paged KV pool.
 

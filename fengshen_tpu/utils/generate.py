@@ -341,7 +341,8 @@ def _spec_early_return(input_ids, max_new_tokens, return_stats):
     """Shared no-op path for max_new_tokens <= 0 (None = proceed)."""
     if max_new_tokens > 0:
         return None
-    return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0}) \
+    return (input_ids, {"rounds": 0, "drafted": 0, "accepted": 0,
+                        "acceptance_rate": 0.0}) \
         if return_stats else input_ids
 
 
@@ -457,8 +458,12 @@ def _speculative_loop(model, params, input_ids, attention_mask,
         jax.lax.while_loop(cond, body, init)
     out = buf[:, :total_len]
     if return_stats:
-        return out, {"rounds": rounds, "drafted": rounds * gamma,
-                     "accepted": accepted}
+        drafted = rounds * gamma
+        return out, {"rounds": rounds, "drafted": drafted,
+                     "accepted": accepted,
+                     "acceptance_rate":
+                         accepted.astype(jnp.float32) /
+                         jnp.maximum(drafted, 1).astype(jnp.float32)}
     return out
 
 
@@ -588,6 +593,21 @@ def _ngram_propose(buf, t, ngram, gamma, pad_token_id):
                    width - 1)
     d = jnp.take_along_axis(buf, idx, axis=1)
     return jnp.where((j >= 0)[:, None], d, pad_token_id).astype(jnp.int32)
+
+
+def _ngram_propose_lanes(buf, t, ngram, gamma, fallback):
+    """Per-lane-cursor flavor of `_ngram_propose` for the serving slot
+    pool (fengshen_tpu/serving/engine.py): `t` is a [B] vector — every
+    lane's committed history ends at its own position — and a lane with
+    no n-gram hit proposes its `fallback` token (its last committed
+    token) repeated, so degenerate lanes degrade to >=1 committed token
+    per verify instead of drafting pads that can never be accepted.
+    Pure + static shapes; vmap turns the dynamic suffix slice into a
+    gather, so the ONE matcher implementation serves both the lockstep
+    `prompt_lookup_generate` loop and the pool's per-lane tick."""
+    def one(row, ti, fb):
+        return _ngram_propose(row[None], ti, ngram, gamma, fb)[0]
+    return jax.vmap(one)(buf, t, fallback)
 
 
 def prompt_lookup_generate(model: Any, params: Any,

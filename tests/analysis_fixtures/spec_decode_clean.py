@@ -1,0 +1,83 @@
+"""known-clean fixture: the speculative decode tick idiom (ISSUE 7,
+docs/serving.md "Speculative decoding") — the n-gram drafter, verify
+forward, and accept/commit math are ONE pure traced program over the
+on-device history ring (the matcher is a tempting place to leak an
+`.item()` or a host-side loop over lanes — it must not), while metric
+bumps (drafted/accepted counters) and the per-lane commit bookkeeping
+happen on the scheduler thread between jit boundaries.
+
+Mirrors `fengshen_tpu/serving/engine.py`'s spec tick +
+`fengshen_tpu/utils/generate.py`'s `_ngram_propose_lanes` /
+`_spec_round_tokens`: `host-divergence`, `blocking-transfer` and
+`metrics-in-traced-code` must all stay silent here — if one fires, the
+analyzer would also flag the real modules and block the merge gate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry, span
+
+REG = get_registry()
+DRAFTED = REG.counter("fx_spec_drafted_total", "drafted tokens")
+ACCEPTED = REG.counter("fx_spec_accepted_total", "accepted tokens")
+
+
+def _ngram_draft(history, t, gamma):
+    """The traced drafter: match the 2-token suffix ending at each
+    lane's own cursor and propose what followed the latest earlier
+    occurrence — pure gathers, no host pull, no randomness."""
+    def one(row, ti):
+        width = row.shape[0]
+        suffix = jax.lax.dynamic_slice_in_dim(row, ti - 2, 2)
+        wins = jnp.stack([row[:width - 1], row[1:]], axis=-1)
+        pos = jnp.arange(width - 1)
+        match = jnp.all(wins == suffix[None, :], axis=-1) & \
+            (pos + 2 < ti)
+        j = jnp.max(jnp.where(match, pos, -1))
+        idx = jnp.clip(j + 2 + jnp.arange(gamma), 0, width - 1)
+        return jnp.where(j >= 0, row[idx], row[ti - 1])
+    return jax.vmap(one)(history, t)
+
+
+@jax.jit
+def spec_tick(history, tokens, phys, active, logits_table):
+    """The traced verify/commit program: draft, score, accept the
+    longest draft==argmax prefix, scatter the committed window back
+    into the history ring — all in-graph."""
+    n, gamma = tokens.shape[0], 3
+    history = history.at[jnp.arange(n), phys].set(tokens)
+    drafts = _ngram_draft(history, phys + 1, gamma)
+    verify = jnp.concatenate([tokens[:, None], drafts], axis=1)
+    t_logits = logits_table[verify]
+    y = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    m = drafts == y[:, :gamma]
+    n_r = jnp.sum(jnp.cumprod(m.astype(jnp.int32), axis=1), axis=1)
+    n_r = jnp.where(active, n_r, 0)
+    win = jnp.where(jnp.arange(gamma + 1)[None] < (n_r + 1)[:, None],
+                    y, 0)
+    history = jax.vmap(
+        lambda row, w, p: jax.lax.dynamic_update_slice(row, w, (p,)))(
+        history, win, phys + 1)
+    return history, n_r, win
+
+
+def host_commit(state, logits_table):
+    """Scheduler-side tick driver: the ONLY place device values cross
+    to the host, and the only place metrics move."""
+    history, tokens, phys, active = state
+    with span("fx/spec_tick"):
+        history, n_r, win = spec_tick(history, tokens, phys, active,
+                                      logits_table)
+        n_r = np.array(n_r)          # host sync AFTER the jit boundary
+        win = np.array(win)
+    commit = np.where(active, n_r + 1, 0)
+    DRAFTED.inc(3 * int(np.asarray(active).sum()))
+    ACCEPTED.inc(int(n_r[np.asarray(active)].sum()))
+    committed = [list(map(int, win[i, :commit[i]]))
+                 for i in range(len(commit))]
+    phys = np.asarray(phys) + commit
+    return (history, win[np.arange(len(commit)),
+                         np.maximum(commit - 1, 0)],
+            phys.astype(np.int32), active), committed

@@ -403,6 +403,28 @@ def test_aot_cache_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_spec_decode_internals_are_clean():
+    """Regression fixture for the speculative decode tick (ISSUE 7):
+    the drafter + verify + accept/commit stay ONE pure traced program
+    (the n-gram matcher is a tempting place to leak an `.item()` or a
+    metrics bump), host syncs and counters strictly between jit
+    boundaries — neither `metrics-in-traced-code`,
+    `blocking-transfer` nor `host-divergence` may fire on the fixture
+    or on the real modules (the serving package and utils/generate.py,
+    which owns the shared drafter/accept helpers)."""
+    fixture = os.path.join(FIXTURES, "spec_decode_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "serving"),
+             os.path.join(PKG, "utils", "generate.py")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_paged_cache_internals_are_clean():
     """Regression fixture for the paged KV cache (ISSUE 6): block
     free-list math stays host-side, the traced gather/scatter decode

@@ -384,6 +384,22 @@ def test_engine_metrics_snapshot_shape_pinned():
         "ttft_avg_s": 0.3, "ttft_p50_s": 0.4, "ttft_p95_s": 0.4,
         "warmup_compile_s": 1.5,
     }
+    # a spec engine (ISSUE 7) ADDS exactly its five keys — the
+    # non-spec payload above stays byte-identical
+    assert not any(k.startswith("spec_") for k in snap)
+    m.record_spec(8, 5)
+    m.record_tick(3, 8, 0.5, tokens=8)   # spec tick: 8 committed
+    snap2 = m.snapshot(queue_depth=1, slots_active=3, num_slots=8,
+                       kv={"layout": "paged", "dtype": "int8",
+                           "blocks_total": 16, "blocks_used": 5,
+                           "blocks_free": 11, "block_tokens": 64,
+                           "bytes": 4096, "fragmentation": 0.25},
+                       spec={"mode": "prompt_lookup", "gamma": 4})
+    assert snap2 == dict(snap, decode_ticks=2, decode_tokens=11,
+                         decode_tokens_per_sec=11.0,
+                         spec_mode="prompt_lookup", spec_gamma=4,
+                         spec_drafted_total=8, spec_accepted_total=5,
+                         spec_acceptance_rate=0.625)
     text = render_prometheus(m.registry)
     assert "fstpu_serving_admitted_total 2" in text
     assert 'fstpu_serving_prefills_total{bucket="64"} 2' in text
@@ -391,6 +407,9 @@ def test_engine_metrics_snapshot_shape_pinned():
     assert "fstpu_kv_blocks_total 16" in text
     assert "fstpu_kv_blocks_used 5" in text
     assert "fstpu_kv_fragmentation 0.25" in text
+    assert "fstpu_serving_spec_drafted_total 8" in text
+    assert "fstpu_serving_spec_accepted_total 5" in text
+    assert "fstpu_spec_accepted_ratio 0.625" in text
     # the kv-less form (bare EngineMetrics) defaults to an empty pool
     assert m.snapshot(1, 3, 8)["kv_blocks_total"] == 0
     # two independent engines never share counts

@@ -1,0 +1,140 @@
+"""`make serve-bench-spec` harness guard (ISSUE 7).
+
+Fast lane: the acceptance MATH is deterministic — drafter proposals on
+a synthetic repetitive history, `_spec_round_tokens`' greedy rule on
+hand-built logits, and the committed-per-forward identity — so it is
+pinned here with NO model forward; the tiny-shape end-to-end run only
+guards the schema/wiring. The real >=1.8x committed-per-forward and
+>=1.3x tokens/s bars need the default weight-memory-bound shape and
+live in the slow lane.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+TINY = {"SERVE_BENCH_SLOTS": "4", "SERVE_BENCH_REQUESTS": "4",
+        "SERVE_BENCH_NEW_TOKENS": "8", "SERVE_BENCH_VOCAB": "128",
+        "SERVE_BENCH_HIDDEN": "32", "SERVE_BENCH_INTER": "64",
+        "SERVE_BENCH_LAYERS": "2", "SERVE_BENCH_HEADS": "4",
+        "SERVE_BENCH_BUCKETS": "16,32", "SERVE_BENCH_MODE": "spec"}
+
+
+def _run(monkeypatch, env: dict, tiny: bool = True) -> dict:
+    from fengshen_tpu.serving import bench
+
+    for key in list(os.environ):
+        if key.startswith(("SERVE_BENCH_", "BENCH_DEGRADED")):
+            monkeypatch.delenv(key)
+    for key, val in {**(TINY if tiny else {}), **env}.items():
+        monkeypatch.setenv(key, val)
+    out = io.StringIO()
+    with redirect_stdout(out):
+        bench.main()
+    lines = [l for l in out.getvalue().splitlines() if l.startswith("{")]
+    assert lines, out.getvalue()
+    return json.loads(lines[-1])
+
+
+# ---- deterministic acceptance math (no model forward) -------------------
+
+def test_spec_acceptance_math_deterministic():
+    """The whole spec-tick accept pipeline on synthetic data: the
+    drafter must propose the period's continuation from a repetitive
+    history, the greedy rule must accept exactly the matching prefix,
+    and committed-per-forward is the 1 + gamma*rate identity the bench
+    reports."""
+    from fengshen_tpu.serving.bench import committed_per_forward
+    from fengshen_tpu.utils.generate import (_ngram_propose_lanes,
+                                             _spec_round_tokens)
+
+    # lane 0: period-2 history committed through t=6 → suffix [7, 9]
+    # recurs at j=0 with whole-gamma continuation [7, 9, 7];
+    # lane 1: no repeat → fallback (last token 5) repeated
+    hist = jnp.asarray([[7, 9, 7, 9, 7, 9, 0, 0, 0, 0],
+                        [1, 2, 3, 4, 5, 6, 0, 0, 0, 0]], jnp.int32)
+    d = _ngram_propose_lanes(hist, jnp.asarray([6, 6]), 2, 3,
+                             jnp.asarray([9, 5], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(d),
+                                  [[7, 9, 7], [5, 5, 5]])
+
+    # greedy verify on one-hot logits: lane 0's target continues
+    # [7, 9, 8, ...] → accepts 2, correction 8; lane 1's target is
+    # [5, 5, 5, 5] → full accept + bonus
+    targets = np.array([[7, 9, 8, 1], [5, 5, 5, 5]])
+    t_logits = jnp.asarray(np.eye(12, dtype=np.float32)[targets])
+    n_r, w = _spec_round_tokens(t_logits, None, d,
+                                jnp.zeros((2,), jnp.uint32),
+                                do_sample=False)
+    np.testing.assert_array_equal(np.asarray(n_r), [2, 3])
+    np.testing.assert_array_equal(np.asarray(w), targets)
+
+    # the identity the BENCH row reports: per-lane committed tokens
+    # per verify = 1 + accepted; aggregated = 1 + gamma * rate
+    rate = float(np.asarray(n_r).sum()) / (2 * 3)
+    assert committed_per_forward(3, rate) == pytest.approx(
+        np.asarray(n_r + 1).mean())
+    assert committed_per_forward(4, 0.0) == 1.0
+    assert committed_per_forward(4, 1.0) == 5.0
+    with pytest.raises(ValueError):
+        committed_per_forward(4, 1.5)
+
+
+def test_make_target_wired():
+    """`make serve-bench-spec` must keep pointing at the spec mode."""
+    mk = open(os.path.join(os.path.dirname(__file__), "..",
+                           "Makefile")).read()
+    assert "serve-bench-spec:" in mk
+    assert "SERVE_BENCH_MODE=spec" in mk
+
+
+# ---- tiny end-to-end: schema + wiring -----------------------------------
+
+def test_serve_bench_spec_emits_schema_row(monkeypatch):
+    row = _run(monkeypatch, {})
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline",
+                        "acceptance_rate", "spec_gamma", "spec_ngram",
+                        "tokens_per_sec", "tokens_per_sec_off",
+                        "speedup_vs_off", "token_identical"}
+    assert row["metric"] == "serving_spec_committed_per_forward"
+    assert row["mode"] == "spec"
+    assert row["unit"] == "tokens/forward"
+    # greedy spec output must equal the non-spec engine even at tiny
+    # shapes — this is the cheap end-to-end parity guard
+    assert row["token_identical"] is True
+    assert 0.0 <= row["acceptance_rate"] <= 1.0
+    from fengshen_tpu.serving.bench import committed_per_forward
+    assert row["value"] == pytest.approx(
+        committed_per_forward(row["spec_gamma"],
+                              row["acceptance_rate"]), abs=1e-3)
+    assert row["value"] == row["vs_baseline"]
+    assert row["tokens_per_sec"] > 0 and row["tokens_per_sec_off"] > 0
+    assert "degraded" not in row
+
+
+def test_serve_bench_spec_degraded_flag(monkeypatch):
+    row = _run(monkeypatch, {"BENCH_DEGRADED": "1"})
+    assert row["degraded"] is True
+
+
+@pytest.mark.slow
+def test_serve_bench_spec_acceptance_bar(monkeypatch):
+    """ISSUE 7 acceptance: on the default weight-memory-bound shape's
+    repetitive workload at 8 concurrent, gamma=4 commits >=1.8 tokens
+    per target forward and the spec engine clears >=1.3x the non-spec
+    engine's aggregate tokens/s, token-identically. Slow lane (~2 min
+    on CPU: probe + two engine warmups)."""
+    row = _run(monkeypatch, {"SERVE_BENCH_MODE": "spec",
+                             "SERVE_BENCH_BUCKETS": "32,64",
+                             "SERVE_BENCH_NEW_TOKENS": "96"},
+               tiny=False)
+    assert row["spec_gamma"] == 4
+    assert row["value"] >= 1.8, row
+    assert row["speedup_vs_off"] >= 1.3, row
+    assert row["token_identical"] is True, row

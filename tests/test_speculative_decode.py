@@ -63,6 +63,7 @@ def test_speculative_self_draft_accepts_everything():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
     assert int(stats["rounds"]) == 5  # ceil(23 / 5)
     assert int(stats["accepted"]) == int(stats["rounds"]) * 4
+    assert float(stats["acceptance_rate"]) == 1.0
 
 
 def test_speculative_eos_exact():
@@ -85,6 +86,51 @@ def test_speculative_eos_exact():
                                 attention_mask=mask, max_new_tokens=24,
                                 gamma=4, eos_token_id=eos, pad_token_id=0)
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+
+def test_spec_round_tokens_hand_computed():
+    """The factored accept/commit helper (`_spec_round_tokens`) against
+    hand-computed cases — the engine's speculative tick and the
+    generate-level loop both call THIS function, so pinning its exact
+    outputs here proves the two paths share one implementation.
+
+    Greedy: accept = longest draft==argmax prefix, w = the per-position
+    argmax corrections. Rejection sampling with degenerate one-hot
+    (p, q): disjoint mass rejects at position 0 and resamples from the
+    residual (= p); identical mass accepts everything and samples the
+    bonus from p — all deterministic despite the random key."""
+    from fengshen_tpu.utils.generate import _spec_round_tokens
+
+    # greedy, V=12, gamma=3: row 0 accepts 2 then mismatches, row 1
+    # rejects immediately, row 2 accepts all 3
+    targets = np.array([[7, 9, 8, 1], [5, 4, 3, 2], [6, 6, 6, 6]])
+    t_logits = jnp.asarray(np.eye(12, dtype=np.float32)[targets])
+    d = jnp.asarray([[7, 9, 9], [9, 4, 3], [6, 6, 6]], jnp.int32)
+    n_r, w = _spec_round_tokens(t_logits, None, d, jax.random.PRNGKey(0),
+                                do_sample=False)
+    np.testing.assert_array_equal(np.asarray(n_r), [2, 0, 3])
+    np.testing.assert_array_equal(np.asarray(w), targets)
+
+    # rejection sampling, gamma=2: q one-hot on token 0, p one-hot on
+    # token 1 → accept prob p(0)/q(0) ~ e^-50, the draft is rejected
+    # and the resample comes from norm(max(p-q, 0)) = one-hot(1)
+    big = 50.0
+    q_log = jnp.asarray(np.eye(4, dtype=np.float32)[[0, 0]])[None] * big
+    p_log = jnp.asarray(np.eye(4, dtype=np.float32)[[1, 1]])[None] * big
+    t3 = jnp.concatenate([p_log, p_log[:, :1]], axis=1)  # [1, 3, 4]
+    d2 = jnp.zeros((1, 2), jnp.int32)                    # draft ~ q
+    n_r, w = _spec_round_tokens(t3, q_log, d2, jax.random.PRNGKey(1),
+                                do_sample=True)
+    assert int(n_r[0]) == 0
+    assert int(w[0, 0]) == 1
+    # p == q (both one-hot on 2): min(1, p/q) = 1 accepts every draft,
+    # the bonus is sampled from p_gamma = one-hot(2)
+    pq = jnp.asarray(np.eye(4, dtype=np.float32)[[2, 2, 2]])[None] * big
+    d3 = jnp.full((1, 2), 2, jnp.int32)
+    n_r, w = _spec_round_tokens(pq, pq[:, :2], d3, jax.random.PRNGKey(2),
+                                do_sample=True)
+    assert int(n_r[0]) == 2
+    np.testing.assert_array_equal(np.asarray(w), [[2, 2, 2]])
 
 
 def test_spec_round_sampling_distribution_exact():
@@ -267,6 +313,8 @@ def test_prompt_lookup_exact_vs_greedy(ngram):
     # n-grams), so lookup acceptance must be non-trivial
     assert int(stats["accepted"]) > 0
     assert int(stats["rounds"]) < 23  # strictly fewer target passes
+    assert float(stats["acceptance_rate"]) == pytest.approx(
+        int(stats["accepted"]) / int(stats["drafted"]))
 
 
 def test_speculative_edge_shapes_exact():
