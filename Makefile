@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: lint lint-baseline test test-fast serve-bench \
-	serve-bench-parity serve-bench-spec aot-bench
+	serve-bench-parity serve-bench-spec aot-bench benchdiff
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -38,6 +38,14 @@ serve-bench-spec:
 # one BENCH-schema JSON line (aot_cold_s, aot_warm_s, speedup)
 aot-bench:
 	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.aot.bench
+
+# bench trajectory comparator (docs/observability.md "benchdiff"):
+# classifies each BENCH_r*.json round (ok / wedged / failed), diffs
+# every metric against the previous round carrying it (and
+# BASELINE.json's published table), and prints a deterministic
+# verdict — every future bench round lands with a trajectory readout
+benchdiff:
+	$(PY) -m fengshen_tpu.observability.benchdiff
 
 lint-baseline:
 	$(PY) -m fengshen_tpu.analysis --write-baseline

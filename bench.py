@@ -19,6 +19,32 @@ import optax
 _WATCHDOG_DEADLINE = [None]
 
 
+def _flight_dump(trigger: str, timeout_s: float = 5.0) -> None:
+    """Best-effort post-mortem bundle before the watchdog's os._exit
+    (docs/observability.md "Flight recorder"): five BENCH rounds died
+    of a wedged relay leaving nothing but a two-line stderr tail — the
+    bundle at least carries the rows emitted so far plus a final
+    metrics snapshot. Must never hang or raise: the dump runs in a
+    daemon thread with a bounded join, so even a sick filesystem
+    cannot stall the abort the watchdog exists to guarantee."""
+    import threading
+
+    def _run():
+        try:
+            from fengshen_tpu.observability import (get_flight_recorder,
+                                                    get_registry)
+            recorder = get_flight_recorder()
+            recorder.snapshot_metrics([get_registry()], force=True)
+            recorder.dump(reason=trigger)
+        except Exception:  # noqa: BLE001 — telemetry must not block
+            # the abort
+            pass
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+
+
 def _watchdog(seconds: int = 540) -> None:
     """Fail fast (exit 1) instead of hanging forever if the accelerator or
     its compile service is wedged.
@@ -38,6 +64,7 @@ def _watchdog(seconds: int = 540) -> None:
         import sys
         print("bench watchdog: accelerator unresponsive, aborting",
               file=sys.stderr, flush=True)
+        _flight_dump("bench_watchdog")
         os._exit(1)
 
     try:
@@ -58,6 +85,7 @@ def _watchdog(seconds: int = 540) -> None:
             if deadline is not None and time.time() > deadline:
                 print("bench watchdog (thread): accelerator unresponsive,"
                       " aborting", file=sys.stderr, flush=True)
+                _flight_dump("bench_watchdog")
                 os._exit(1)
 
     threading.Thread(target=watch, daemon=True).start()
@@ -171,10 +199,14 @@ def _emit(row: dict) -> None:
     import os
     import sys
 
-    from fengshen_tpu.observability import JsonlSink
+    from fengshen_tpu.observability import (JsonlSink,
+                                            get_flight_recorder)
 
     if os.environ.get("BENCH_DEGRADED", "0") == "1":
         row["degraded"] = True
+    # rows join the flight recorder's ring so a later wedge's
+    # post-mortem bundle shows what DID complete this round
+    get_flight_recorder().record(row)
     JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
 
 

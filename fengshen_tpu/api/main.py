@@ -32,11 +32,23 @@ Both engines get warmed at startup so the first user never pays jit
 compilation — warmup runs in a BACKGROUND thread while the server is
 already listening, and `GET /healthz` answers 503 until it completes
 (load balancers must not route to a still-compiling replica) and 200
-after. `GET /stats` exposes the engine metrics as JSON and
+after. `GET /stats` exposes the engine metrics as JSON (now incl.
+`uptime_s` and `last_error` — type + age, never a traceback) and
 `GET /metrics` renders the same registry (plus the process-global one —
-HTTP counters, span timings, `fstpu_warmup_seconds{phase}`,
-`fstpu_build_info`) as Prometheus text exposition, on BOTH the fastapi
-and the stdlib server paths (docs/observability.md).
+HTTP counters, `fstpu_http_request_seconds{route}` latency histograms,
+span timings, `fstpu_warmup_seconds{phase}`, `fstpu_build_info`) as
+Prometheus text exposition, on BOTH the fastapi and the stdlib server
+paths (docs/observability.md).
+
+Debug introspection (docs/serving.md "Debug endpoints"), again on both
+paths: `GET /debug/requests` lists in-flight + recently finished
+request summaries, `GET /debug/requests/<id>` returns one request's
+full lifecycle timeline and latency waterfall (queue wait / prefill /
+decode phases), and `POST /debug/dump` writes the flight recorder's
+post-mortem bundle on demand (docs/observability.md "Flight
+recorder"). `main()` wires a `FlightRecorder` through the engine and
+chains it onto SIGTERM, so a drained/killed replica leaves a bundle
+behind.
 """
 
 from __future__ import annotations
@@ -61,6 +73,9 @@ class ServerConfig:
     engine: str = "simple"
     warmup: bool = True
     request_timeout_s: float = 120.0
+    # flight-recorder post-mortem bundles (POST /debug/dump, engine
+    # tick errors, SIGTERM) land here (docs/observability.md)
+    dump_dir: str = "fstpu_dumps"
     engine_args: dict = dataclasses.field(default_factory=dict)
     aot_args: dict = dataclasses.field(default_factory=dict)
 
@@ -115,9 +130,43 @@ def _count_http(route: str, code: int) -> None:
         labelnames=("route", "code")).labels(route, code).inc()
 
 
+def _observe_http(route: str, seconds: float) -> None:
+    """`fstpu_http_request_seconds{route}` beside the counter: the
+    request-latency histogram both API paths feed (docs/observability.md)."""
+    from fengshen_tpu.observability import get_registry
+    get_registry().histogram(
+        "fstpu_http_request_seconds",
+        "REST request wall seconds by route",
+        labelnames=("route",)).labels(route).observe(seconds)
+
+
 def _classify_route(path: str, api_route: str) -> str:
-    return path if path in (api_route, "/healthz", "/stats",
-                            "/metrics") else "other"
+    if path.startswith("/debug/requests/"):
+        # one label for every id — request ids must not become a
+        # per-request label cardinality leak
+        return "/debug/requests/<id>"
+    return path if path in (api_route, "/healthz", "/stats", "/metrics",
+                            "/debug/requests", "/debug/dump") else "other"
+
+
+def _dump_recorder(recorder, engine, reason: str = "on_demand") -> str:
+    """POST /debug/dump: refresh a metrics snapshot into the ring, then
+    write the bundle; returns its path."""
+    from fengshen_tpu.observability import get_registry
+    registries = [get_registry()]
+    if engine is not None:
+        engine.stats()      # gauges scrape-fresh, like /metrics
+        registries.append(engine.metrics.registry)
+    recorder.snapshot_metrics(registries, force=True)
+    return recorder.dump(reason=reason)
+
+
+def _debug_requests_payload(engine) -> dict:
+    if engine is None:
+        # the simple path has no request lifecycle to introspect; keep
+        # the payload shape so dashboards need no engine-type branch
+        return {"in_flight": [], "recent": [], "debug_ring": 0}
+    return engine.debug_requests()
 
 
 def _accepts_max_new_tokens(pipeline) -> bool:
@@ -151,11 +200,14 @@ def warmup_pipeline(pipeline, task: str) -> Optional[float]:
 
 
 def create_continuous_engine(pipeline, engine_args: dict,
-                             aot_args: Optional[dict] = None, log=None):
+                             aot_args: Optional[dict] = None, log=None,
+                             recorder=None):
     """Build (but do not warm or start) the continuous-batching engine;
     `aot_args` is the AOT config block — when it names a cache_dir, the
     engine's programs route through the persistent executable cache
-    (docs/aot_cache.md)."""
+    (docs/aot_cache.md). `recorder` is an optional
+    `observability.FlightRecorder` the engine feeds its event stream
+    into and dumps through on tick errors."""
     from fengshen_tpu.serving import (ContinuousBatchingEngine,
                                       EngineConfig)
     if not hasattr(pipeline, "engine_config_kwargs"):
@@ -171,15 +223,17 @@ def create_continuous_engine(pipeline, engine_args: dict,
     kwargs = {**pipeline.engine_config_kwargs(), **engine_args}
     return ContinuousBatchingEngine(
         pipeline.module, pipeline.params, EngineConfig(**kwargs),
-        log=log, aot=aot)
+        log=log, aot=aot, recorder=recorder)
 
 
 def start_continuous_engine(pipeline, engine_args: dict, log=None,
-                            aot_args: Optional[dict] = None):
+                            aot_args: Optional[dict] = None,
+                            recorder=None):
     """Build, warm up (compile all prefill buckets + the decode step,
     logging the time), and start the continuous-batching engine."""
     engine = create_continuous_engine(pipeline, engine_args,
-                                      aot_args=aot_args, log=log)
+                                      aot_args=aot_args, log=log,
+                                      recorder=recorder)
     dt = engine.warmup()
     print(f"[serving] continuous engine warmup "
           f"(buckets={list(engine.ladder.buckets)}, "
@@ -224,11 +278,12 @@ def _engine_generate(engine, pipeline, req: dict,
 
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
               server_cfg: Optional[ServerConfig] = None, engine=None,
-              ready=None):
+              ready=None, recorder=None):
     """Create the FastAPI app around a pipeline instance. `ready` is an
     optional `threading.Event`: until set, `GET /healthz` answers 503
     ("warming") so load balancers keep routing around a replica that is
-    still compiling; None means always ready."""
+    still compiling; None means always ready. `recorder` enables
+    `POST /debug/dump`."""
     from fastapi import FastAPI
     from fastapi.middleware.cors import CORSMiddleware
     from fastapi.responses import JSONResponse, Response
@@ -247,6 +302,16 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         max_new_tokens: Optional[int] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
+
+    @app.middleware("http")
+    async def _time_request(request, call_next):
+        # the `fstpu_http_request_seconds{route}` histogram beside the
+        # per-route counter (the stdlib path times in _send_bytes)
+        t0 = time.perf_counter()
+        response = await call_next(request)
+        _observe_http(_classify_route(request.url.path, api_route),
+                      time.perf_counter() - t0)
+        return response
 
     @app.post(api_route)
     def run(req: Request) -> Any:
@@ -290,6 +355,40 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         return Response(content=_render_metrics(engine),
                         media_type=CONTENT_TYPE_LATEST)
 
+    @app.get("/debug/requests")
+    def debug_requests():
+        _count_http("/debug/requests", 200)
+        return _debug_requests_payload(engine)
+
+    @app.get("/debug/requests/{request_id}")
+    def debug_request(request_id: str):
+        d = engine.debug_request(request_id) if engine is not None \
+            else None
+        code = 200 if d is not None else 404
+        _count_http("/debug/requests/<id>", code)
+        if d is None:
+            return JSONResponse(
+                status_code=404,
+                content={"error": f"unknown request_id {request_id!r}"})
+        return d
+
+    @app.post("/debug/dump")
+    def debug_dump():
+        if recorder is None:
+            _count_http("/debug/dump", 404)
+            return JSONResponse(
+                status_code=404,
+                content={"error": "no flight recorder configured"})
+        try:
+            bundle = _dump_recorder(recorder, engine)
+        except Exception as e:  # noqa: BLE001 — an unwritable dump_dir
+            # (the sick-host case) must answer, not drop the socket
+            _count_http("/debug/dump", 500)
+            return JSONResponse(status_code=500,
+                                content={"error": str(e)[:500]})
+        _count_http("/debug/dump", 200)
+        return {"bundle": bundle}
+
     return app
 
 
@@ -302,12 +401,14 @@ def _resolve_pipeline(pipeline_cfg: PipelineConfig):
 
 def build_stdlib_server(server_cfg: ServerConfig,
                         pipeline_cfg: PipelineConfig, pipeline=None,
-                        engine=None, ready=None):
+                        engine=None, ready=None, recorder=None):
     """Dependency-free fallback server (http.server) exposing the SAME
     surface as the FastAPI app: `POST /api/<task>` with
     `{"input_text": ...}`, `GET /healthz` (503 until the `ready` event
-    is set, like build_app), `GET /stats`. FastAPI/uvicorn stay the
-    production path; this keeps the REST surface runnable (and
+    is set, like build_app), `GET /stats`, `GET /metrics`, and the
+    debug introspection routes (`GET /debug/requests[/<id>]`,
+    `POST /debug/dump` when a `recorder` is wired). FastAPI/uvicorn
+    stay the production path; this keeps the REST surface runnable (and
     testable) where they are not installed."""
     import http.server
 
@@ -321,7 +422,11 @@ def build_stdlib_server(server_cfg: ServerConfig,
 
         def _send_bytes(self, code: int, body: bytes,
                         content_type: str) -> None:
-            _count_http(_classify_route(self.path, route), code)
+            label = _classify_route(self.path, route)
+            _count_http(label, code)
+            t0 = getattr(self, "_t_start", None)
+            if t0 is not None:
+                _observe_http(label, time.perf_counter() - t0)
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Access-Control-Allow-Origin", "*")
@@ -335,6 +440,7 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 "application/json")
 
         def do_GET(self):
+            self._t_start = time.perf_counter()
             if self.path == "/healthz":
                 if ready is not None and not ready.is_set():
                     self._send(503, {"status": "warming",
@@ -353,10 +459,36 @@ def build_stdlib_server(server_cfg: ServerConfig,
                     CONTENT_TYPE_LATEST
                 self._send_bytes(200, _render_metrics(engine).encode(),
                                  CONTENT_TYPE_LATEST)
+            elif self.path == "/debug/requests":
+                self._send(200, _debug_requests_payload(engine))
+            elif self.path.startswith("/debug/requests/"):
+                rid = self.path[len("/debug/requests/"):]
+                d = engine.debug_request(rid) if engine is not None \
+                    else None
+                if d is None:
+                    self._send(404, {"error":
+                                     f"unknown request_id {rid!r}"})
+                else:
+                    self._send(200, d)
             else:
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            self._t_start = time.perf_counter()
+            if self.path == "/debug/dump":
+                if recorder is None:
+                    self._send(404, {"error":
+                                     "no flight recorder configured"})
+                    return
+                try:
+                    bundle = _dump_recorder(recorder, engine)
+                except Exception as e:  # noqa: BLE001 — an unwritable
+                    # dump_dir (the sick-host case) must answer, not
+                    # drop the socket
+                    self._send(500, {"error": str(e)[:500]})
+                    return
+                self._send(200, {"bundle": bundle})
+                return
             if self.path != route:
                 self._send(404, {"error": "not found"})
                 return
@@ -441,8 +573,14 @@ def main(argv=None) -> None:
     parser.add_argument("--config", required=True, type=str)
     args = parser.parse_args(argv)
     server_cfg, pipeline_cfg = load_config(args.config)
-    from fengshen_tpu.observability import record_build_info
+    from fengshen_tpu.observability import (FlightRecorder,
+                                            record_build_info)
     record_build_info()
+    # post-mortem flight recorder (docs/observability.md): engine tick
+    # errors and SIGTERM dump the last window of events; POST
+    # /debug/dump does so on demand
+    recorder = FlightRecorder(dump_dir=server_cfg.dump_dir)
+    recorder.install_sigterm()
     pipeline = _resolve_pipeline(pipeline_cfg)
     engine = None
     if server_cfg.engine == "continuous":
@@ -450,24 +588,34 @@ def main(argv=None) -> None:
         # background thread below; construction itself is compile-free
         engine = create_continuous_engine(pipeline,
                                           server_cfg.engine_args,
-                                          aot_args=server_cfg.aot_args)
+                                          aot_args=server_cfg.aot_args,
+                                          recorder=recorder)
     ready = _start_warmup_thread(server_cfg, pipeline_cfg, pipeline,
                                  engine)
     try:
         app = build_app(pipeline_cfg, pipeline=pipeline,
                         server_cfg=server_cfg, engine=engine,
-                        ready=ready)
+                        ready=ready, recorder=recorder)
         import uvicorn
     except ModuleNotFoundError:
         server = build_stdlib_server(server_cfg, pipeline_cfg,
                                      pipeline=pipeline, engine=engine,
-                                     ready=ready)
+                                     ready=ready, recorder=recorder)
         print(f"fastapi/uvicorn not installed — stdlib server on "
               f"{server_cfg.host}:{server_cfg.port}", flush=True)
         server.serve_forever()
         return
     uvicorn.run(app, host=server_cfg.host, port=server_cfg.port,
                 log_level=server_cfg.log_level)
+    # uvicorn installs its OWN signal handlers (replacing the chained
+    # SIGTERM dump above) and returns here after its graceful
+    # shutdown — dump on the way out so a drained uvicorn replica
+    # still leaves a bundle; the stdlib path keeps the chained handler
+    try:
+        recorder.dump(reason="shutdown")
+    except Exception:  # noqa: BLE001 — never fail process exit on
+        # telemetry
+        pass
 
 
 if __name__ == "__main__":

@@ -17,6 +17,8 @@ from fengshen_tpu.observability.exposition import (CONTENT_TYPE_LATEST,
                                                    MetricsServer,
                                                    render_prometheus,
                                                    start_metrics_server)
+from fengshen_tpu.observability.flightrecorder import (FlightRecorder,
+                                                       get_flight_recorder)
 from fengshen_tpu.observability.flops import (NOMINAL_FALLBACK_FLOPS,
                                               PEAK_FLOPS,
                                               estimate_flops_per_token,
@@ -26,14 +28,17 @@ from fengshen_tpu.observability.registry import (Counter, Gauge, Histogram,
                                                  get_registry, percentile)
 from fengshen_tpu.observability.sink import JsonlSink
 from fengshen_tpu.observability.stepstats import StepStats
+from fengshen_tpu.observability.timeline import (PHASE_NAMES,
+                                                 RequestTimeline)
 from fengshen_tpu.observability.tracing import (current_span_stack, span)
 
 __all__ = [
-    "BUILD_INFO_METRIC", "CONTENT_TYPE_LATEST", "Counter", "Gauge",
-    "Histogram", "JsonlSink", "MetricsRegistry", "MetricsServer",
-    "NOMINAL_FALLBACK_FLOPS", "PEAK_FLOPS", "StepStats", "WARMUP_METRIC",
-    "current_span_stack", "estimate_flops_per_token", "get_registry",
-    "peak_flops_per_chip", "percentile", "record_build_info",
-    "record_warmup_seconds", "render_prometheus", "span",
-    "start_metrics_server",
+    "BUILD_INFO_METRIC", "CONTENT_TYPE_LATEST", "Counter",
+    "FlightRecorder", "Gauge", "Histogram", "JsonlSink",
+    "MetricsRegistry", "MetricsServer", "NOMINAL_FALLBACK_FLOPS",
+    "PEAK_FLOPS", "PHASE_NAMES", "RequestTimeline", "StepStats",
+    "WARMUP_METRIC", "current_span_stack", "estimate_flops_per_token",
+    "get_flight_recorder", "get_registry", "peak_flops_per_chip",
+    "percentile", "record_build_info", "record_warmup_seconds",
+    "render_prometheus", "span", "start_metrics_server",
 ]

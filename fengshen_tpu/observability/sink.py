@@ -15,6 +15,12 @@ or both; ``echo`` mirrors the Trainer's human-readable console line;
 ``log_metrics``. Multihost gating: only ``process_index == 0`` writes
 (``only_process_zero=False`` opts out — bench children are already
 single-process).
+
+``max_bytes`` caps the jsonl file for long-running serve processes:
+when the next line would push past the cap, the file rotates
+``path -> path.1 -> ... -> path.<backups>`` (oldest dropped). Rotation
+only renames files — the event names and the line format stay
+byte-identical, so anything tailing the jsonl keeps parsing.
 """
 
 from __future__ import annotations
@@ -40,13 +46,32 @@ class JsonlSink:
                  echo: bool = False,
                  echo_prefix: str = "[fengshen-tpu] ",
                  logger: Optional[Any] = None,
-                 only_process_zero: bool = True):
+                 only_process_zero: bool = True,
+                 max_bytes: Optional[int] = None,
+                 backups: int = 1):
         self.path = path
         self.stream = stream
         self.echo = echo
         self.echo_prefix = echo_prefix
         self.logger = logger
         self.only_process_zero = only_process_zero
+        self.max_bytes = max_bytes
+        self.backups = max(int(backups), 1)
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Size-based rotation (opt-in via ``max_bytes``): shift the
+        backup chain so the active file always has room for the next
+        line; renames only, content untouched."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return      # no file yet — nothing to rotate
+        if size + incoming <= self.max_bytes:
+            return
+        for i in range(self.backups, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i}")
 
     @staticmethod
     def format_echo(entry: dict) -> str:
@@ -63,6 +88,8 @@ class JsonlSink:
             parent = os.path.dirname(self.path)
             if parent:
                 os.makedirs(parent, exist_ok=True)
+            if self.max_bytes is not None:
+                self._maybe_rotate(len(line) + 1)
             with open(self.path, "a") as f:
                 f.write(line + "\n")
         if self.stream is not None:

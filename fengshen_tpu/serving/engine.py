@@ -41,6 +41,20 @@ Greedy decode is TOKEN-IDENTICAL to sequential
 pins it): same prefill, same logits controls
 (`utils.generate.apply_logits_controls`), same selection — only the
 physical cache layout is pooled.
+
+Debug introspection (docs/serving.md "Debug endpoints"): every request
+carries a host-side `RequestTimeline` of lifecycle events (enqueued,
+admitted, prefill, per-tick commits incl. spec accept counts,
+terminal), rendered as a latency waterfall by `debug_request()` /
+`GET /debug/requests/<id>` and fed into
+`fstpu_request_phase_seconds{phase}` at finish; a bounded ring keeps
+the last `debug_ring` finished timelines. With a `recorder`
+(`observability.FlightRecorder`) attached, the engine's event stream
+enters the recorder's ring and a serve-loop tick error dumps a
+post-mortem bundle (stats + config + the ring of timelines) before the
+pool is rebuilt. All of it is host-side bookkeeping between jit
+boundaries — the one-decode-compile contract and greedy token identity
+are untouched (the timeline parity test pins both).
 """
 
 from __future__ import annotations
@@ -56,7 +70,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fengshen_tpu.observability import record_warmup_seconds, span
+from fengshen_tpu.observability import (RequestTimeline,
+                                        record_warmup_seconds, span)
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
                                         reset_free_slots, rollback_slots)
@@ -123,10 +138,16 @@ class EngineConfig:
     spec_mode: str = "off"                   # "off" | "prompt_lookup"
     spec_gamma: int = 4                      # drafted tokens per tick
     spec_ngram: int = 2                      # suffix length to match
+    # debug introspection (docs/serving.md "Debug endpoints"): how many
+    # finished-request timelines the engine retains for
+    # `GET /debug/requests` and the flight-recorder bundle
+    debug_ring: int = 64
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if self.debug_ring < 1:
+            raise ValueError("debug_ring must be >= 1")
         if self.kv_layout not in ("slot", "paged"):
             raise ValueError(f"unknown kv_layout {self.kv_layout!r}; "
                              "expected 'slot' or 'paged'")
@@ -199,6 +220,10 @@ class Request:
         self.slot: Optional[int] = None
         self._cancel = False
         self._done = threading.Event()
+        #: host-side lifecycle events (docs/observability.md "Request
+        #: tracing") — appended on the scheduler thread only, never
+        #: inside traced code
+        self.timeline = RequestTimeline(submit_time)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request leaves the engine (finished /
@@ -226,7 +251,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model: Any, params: Any, config: EngineConfig,
                  log: Optional[Callable[[dict], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 aot: Any = None):
+                 aot: Any = None, recorder: Any = None):
         self.model = model
         self.params = params
         self.config = config
@@ -234,6 +259,20 @@ class ContinuousBatchingEngine:
         self.metrics = EngineMetrics()
         self._log = log or (lambda entry: None)
         self._clock = clock
+        # debug introspection state (docs/serving.md "Debug endpoints"):
+        # a bounded ring of finished-request timelines, engine start
+        # time for /stats uptime, and the last serve-loop error (type +
+        # age only — never a traceback payload)
+        self._recent: deque = deque(maxlen=config.debug_ring)
+        self._t0_clock = clock()
+        self._last_error: Optional[dict] = None
+        self._recorder = recorder
+        if recorder is not None:
+            # engine events enter the recorder's ring on their way to
+            # the caller's sink; the provider contributes stats/config/
+            # timelines to every post-mortem bundle
+            self._log = recorder.wrap_sink(self._log)
+            recorder.attach("engine", self._debug_bundle)
         self.max_len = int(model.config.max_position_embeddings)
         self.paged = config.kv_layout == "paged"
         self.spec = config.spec_mode != "off"
@@ -483,6 +522,28 @@ class ContinuousBatchingEngine:
 
     # ---- submission side -------------------------------------------
 
+    def _record_rejection_locked(self, req: Request, reason: str,
+                                 **attrs) -> None:
+        """The ONE rejection record: mark the request, stamp the
+        terminal timeline event, and put its waterfall in the debug
+        ring. Caller holds self._cv."""
+        req.state = REJECTED
+        req.finish_reason = reason
+        req.timeline.add(self._clock(), "rejected", reason=reason,
+                         **attrs)
+        self._recent.append(self._request_dict(req))
+
+    def _reject_prompt(self, ids: np.ndarray, reason: str,
+                       request_id: Optional[str], **attrs) -> None:
+        """413-class rejections happen before a Request enters the
+        queue, but their timelines still belong in the debug ring — a
+        burst of 413s must be diagnosable from `GET /debug/requests`
+        and the post-mortem bundle, like the 429s are."""
+        req = Request(ids, 0, request_id, None, self._clock())
+        with self._cv:
+            self._record_rejection_locked(
+                req, reason, prompt_tokens=int(len(ids)), **attrs)
+
     def submit(self, input_ids, max_new_tokens: Optional[int] = None,
                request_id: Optional[str] = None,
                deadline_s: Optional[float] = None) -> Request:
@@ -501,6 +562,7 @@ class ContinuousBatchingEngine:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
+            self._reject_prompt(ids, "prompt_too_long", request_id)
             raise PromptTooLong(
                 f"prompt of {len(ids)} tokens exceeds the largest "
                 f"bucket {self.ladder.max_bucket}")
@@ -516,6 +578,8 @@ class ContinuousBatchingEngine:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
+            self._reject_prompt(ids, "prompt_too_long", request_id,
+                                bucket=int(bucket))
             raise PromptTooLong(
                 f"bucket {bucket} leaves no decode headroom in the "
                 f"KV lane capacity {self.seq_capacity}" +
@@ -535,6 +599,10 @@ class ContinuousBatchingEngine:
                            "blocks_needed": need,
                            "blocks_total":
                                self._allocator.total_blocks})
+                self._reject_prompt(
+                    ids, "kv_pool_too_small", request_id,
+                    blocks_needed=int(need),
+                    blocks_total=int(self._allocator.total_blocks))
                 raise PromptTooLong(
                     f"request needs {need} KV blocks but the pool "
                     f"only has {self._allocator.total_blocks}")
@@ -548,11 +616,17 @@ class ContinuousBatchingEngine:
                 self._log({"event": "serving_reject",
                            "reason": "queue_full",
                            "queue_depth": len(self._queue)})
-                req.state = REJECTED
+                # rejected timelines join the debug ring: "who was 429'd
+                # and when" is exactly the overload question
+                self._record_rejection_locked(
+                    req, "queue_full", queue_depth=len(self._queue))
                 raise QueueFull(
                     f"admission queue at max_queue="
                     f"{self.config.max_queue}")
             self._queue.append(req)
+            req.timeline.add(now, "enqueued",
+                             prompt_tokens=int(len(ids)), bucket=bucket,
+                             queue_depth=len(self._queue))
             self.metrics.count("admitted")
             self._log({"event": "serving_admit",
                        "request_id": req.request_id, "bucket": bucket,
@@ -638,19 +712,28 @@ class ContinuousBatchingEngine:
             # committed-per-forward headline is derived from
             delivered = 0
             accepted_delivered = 0
+            t_commit = self._clock()
             for i in active_idx:
                 req = self._slot_req[i]
                 k = 0
+                fin = None
                 for tok in (int(t) for t in win[i, :commit[i]]):
                     req.tokens.append(tok)
                     k += 1
                     if self.config.eos_token_id is not None and \
                             tok == self.config.eos_token_id:
-                        self._release(i, FINISHED, "eos")
+                        fin = "eos"
                         break
                     if len(req.tokens) >= req.max_new_tokens:
-                        self._release(i, FINISHED, "length")
+                        fin = "length"
                         break
+                # the commit event must precede a release: _finish
+                # snapshots the timeline into the debug ring
+                req.timeline.add(t_commit, "commit", n=k,
+                                 accepted=min(int(n_r[i]), k),
+                                 tick_s=round(dt, 6))
+                if fin is not None:
+                    self._release(i, FINISHED, fin)
                 delivered += k
                 # delivered tokens at offsets < n_r are accepted
                 # drafts; the one at offset n_r is the correction
@@ -676,10 +759,13 @@ class ContinuousBatchingEngine:
         self._last_tok = nxt
         self._pos[self._active] += 1
         self._phys[self._active] += 1
+        t_commit = self._clock()
         for i in active_idx:
             req = self._slot_req[i]
             tok = int(nxt[i])
             req.tokens.append(tok)
+            req.timeline.add(t_commit, "commit", n=1,
+                             tick_s=round(dt, 6))
             if self.config.eos_token_id is not None and \
                     tok == self.config.eos_token_id:
                 self._release(i, FINISHED, "eos")
@@ -719,6 +805,9 @@ class ContinuousBatchingEngine:
                         # tick the head keeps waiting
                         self._deferred_req = req.request_id
                         self.metrics.count("deferred_admissions")
+                        req.timeline.add(
+                            now, "deferred", blocks_needed=int(need),
+                            blocks_free=int(self._allocator.free_blocks))
                         self._log({"event": "serving_defer",
                                    "reason": "kv_blocks_exhausted",
                                    "request_id": req.request_id,
@@ -733,13 +822,19 @@ class ContinuousBatchingEngine:
                 self._rng, key = jax.random.split(self._rng)
             else:
                 key = self._zero_key
+            req.timeline.add(self._clock(), "admitted", slot=slot,
+                             bucket=int(bucket))
+            req.timeline.add(self._clock(), "prefill_start",
+                             bucket=int(bucket))
             with span("serving/prefill"):
                 primed, tok = self._prefill_jit(
                     self.params, row[None], mask_row[None], key)
                 tok = int(np.asarray(tok)[0])
             self.metrics.record_prefill(bucket)
-            req.ttft_s = self._clock() - req.submit_time
+            t_first = self._clock()
+            req.ttft_s = t_first - req.submit_time
             self.metrics.record_ttft(req.ttft_s)
+            req.timeline.add(t_first, "first_token")
             req.tokens.append(tok)
             if self.config.eos_token_id is not None and \
                     tok == self.config.eos_token_id:
@@ -807,7 +902,12 @@ class ContinuousBatchingEngine:
             self.metrics.count("cancelled")
         elif state == EXPIRED:
             self.metrics.count("expired")
-        self.metrics.record_latency(self._clock() - req.submit_time)
+        end_t = self._clock()
+        req.timeline.add(end_t, state, reason=reason)
+        phases = req.timeline.phases(end_t)
+        self.metrics.record_phases(phases)
+        self._recent.append(self._request_dict(req, phases=phases))
+        self.metrics.record_latency(end_t - req.submit_time)
         self._log({"event": "serving_finish",
                    "request_id": req.request_id, "reason": reason,
                    "tokens": len(req.tokens), "ttft_s": req.ttft_s})
@@ -854,8 +954,32 @@ class ContinuousBatchingEngine:
                 self._log({"event": "serving_tick_error",
                            "error": str(e)[:500]})
                 with self._cv:
+                    # /stats surfaces type + age only — the full text
+                    # already went to the log line above, and a
+                    # traceback has no place in a polled JSON payload
+                    self._last_error = {"type": type(e).__name__,
+                                        "at": self._clock()}
                     self._reset_pool_locked()
+                if self._recorder is not None:
+                    # the reset above finished the in-flight requests,
+                    # so their timelines are already in the debug ring
+                    # the bundle snapshots; dump failures must not
+                    # re-kill the loop the except arm just saved
+                    try:
+                        self._recorder.snapshot_metrics(
+                            (self.metrics.registry,), force=True)
+                        self._recorder.dump(
+                            reason="engine_tick_error",
+                            extra={"error_type": type(e).__name__})
+                    except Exception as dump_err:  # noqa: BLE001
+                        self._log({"event": "flightrec_dump_error",
+                                   "error": str(dump_err)[:200]})
                 n = 0
+            if self._recorder is not None:
+                # periodic ring snapshot (rate-limited inside): the
+                # post-mortem bundle carries recent metric trajectories,
+                # not just the final values
+                self._recorder.snapshot_metrics((self.metrics.registry,))
             if n == 0:
                 with self._cv:
                     if not self._queue and not self._stop_flag:
@@ -991,6 +1115,12 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> dict:
         with self._cv:
+            now = self._clock()
+            last_error = None
+            if self._last_error is not None:
+                last_error = {
+                    "type": self._last_error["type"],
+                    "age_s": round(now - self._last_error["at"], 3)}
             return self.metrics.snapshot(
                 queue_depth=len(self._queue),
                 slots_active=int(self._active.sum()),
@@ -1000,4 +1130,91 @@ class ContinuousBatchingEngine:
                 # the pre-spec /stats shape (pinned by tests)
                 spec=({"mode": self.config.spec_mode,
                        "gamma": self.config.spec_gamma}
-                      if self.spec else None))
+                      if self.spec else None),
+                uptime_s=now - self._t0_clock,
+                last_error=last_error)
+
+    # ---- debug introspection (docs/serving.md "Debug endpoints") ----
+
+    def _request_dict(self, req: Request,
+                      phases: Optional[dict] = None) -> dict:
+        """Full waterfall payload for one request (live or finished).
+        Callers hold self._cv (every mutation site does)."""
+        if phases is None:
+            phases = req.timeline.phases(self._clock())
+        d = {"request_id": req.request_id,
+             "state": req.state,
+             "finish_reason": req.finish_reason,
+             "prompt_tokens": int(len(req.prompt)),
+             "generated_tokens": len(req.tokens),
+             "max_new_tokens": int(req.max_new_tokens),
+             "slot": req.slot,
+             "ttft_s": (None if req.ttft_s is None
+                        else round(req.ttft_s, 6)),
+             "phases": phases}
+        d.update(req.timeline.to_dict())
+        return d
+
+    @staticmethod
+    def _request_summary(d: dict) -> dict:
+        """The list-endpoint row: the waterfall minus its event log."""
+        return {k: d[k] for k in
+                ("request_id", "state", "finish_reason",
+                 "prompt_tokens", "generated_tokens", "slot",
+                 "ttft_s", "phases")}
+
+    def _live_summary_locked(self, req: Request) -> dict:
+        """Summary for a LIVE request without materializing its event
+        list — debug_requests holds the engine lock, so the scheduler
+        must not stall behind event serialization on every scrape."""
+        return {"request_id": req.request_id, "state": req.state,
+                "finish_reason": req.finish_reason,
+                "prompt_tokens": int(len(req.prompt)),
+                "generated_tokens": len(req.tokens),
+                "slot": req.slot,
+                "ttft_s": (None if req.ttft_s is None
+                           else round(req.ttft_s, 6)),
+                "phases": req.timeline.phases(self._clock())}
+
+    def _live_requests_locked(self) -> list:
+        return list(self._queue) + [r for r in self._slot_req
+                                    if r is not None]
+
+    def debug_requests(self) -> dict:
+        """`GET /debug/requests`: summaries of every queued + running
+        request plus the bounded ring of recently finished (or
+        rejected) timelines, newest last."""
+        with self._cv:
+            in_flight = [self._live_summary_locked(r)
+                         for r in self._live_requests_locked()]
+            recent = [self._request_summary(d) for d in self._recent]
+        return {"in_flight": in_flight, "recent": recent,
+                "debug_ring": self.config.debug_ring}
+
+    def debug_request(self, request_id: str) -> Optional[dict]:
+        """`GET /debug/requests/<id>`: the full event timeline +
+        derived waterfall; None when the id is neither live nor in the
+        ring (it aged out or never existed)."""
+        with self._cv:
+            for req in self._live_requests_locked():
+                if req.request_id == request_id:
+                    return self._request_dict(req)
+            for d in reversed(self._recent):
+                if d["request_id"] == request_id:
+                    return d
+        return None
+
+    def _debug_bundle(self) -> dict:
+        """The flight-recorder provider: everything a post-mortem needs
+        to answer "what was the engine doing" (docs/observability.md
+        "Flight recorder"). Runs on the dumping thread with no engine
+        lock held across the whole bundle — stats() and
+        debug_requests() each take it briefly."""
+        with self._cv:
+            requests = [self._request_dict(r)
+                        for r in self._live_requests_locked()]
+            requests += list(self._recent)
+        return {"stats": self.stats(),
+                "engine_config": repr(self.config),
+                "model_config": repr(self.model.config),
+                "requests": requests}

@@ -20,8 +20,9 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fengshen_tpu.observability import (JsonlSink, StepStats,
-                                        record_build_info, span)
+from fengshen_tpu.observability import (FlightRecorder, JsonlSink,
+                                        StepStats, record_build_info,
+                                        span)
 # re-exported for compatibility (the table moved to observability.flops,
 # the single home of the MFU accounting)
 from fengshen_tpu.observability.flops import PEAK_FLOPS  # noqa: F401
@@ -235,6 +236,15 @@ class Trainer:
         #: writer — resilience/serving events flow through it too
         self._sink = JsonlSink(path=self._log_path, echo=True,
                                logger=logger)
+        #: flight recorder (docs/observability.md "Flight recorder"):
+        #: every _log entry also enters a bounded in-memory ring, and a
+        #: step-guard rewind dumps it — the last window of step stats —
+        #: as a post-mortem bundle under <root>/flightrec
+        self._flightrec = FlightRecorder(
+            dump_dir=os.path.join(
+                getattr(args, "default_root_dir", "./runs"),
+                "flightrec"))
+        self._flightrec.attach("trainer", self._flight_state)
         self._metrics_server = None
         self._preempted = False
         #: deterministic fault-injection plan (tests/chaos drills); see
@@ -651,6 +661,25 @@ class Trainer:
                    "bad_steps": int(bad_steps),
                    "consumed_samples": int(self.consumed_samples),
                    "rewinds_left": self._rewinds_left})
+        try:
+            # post-mortem bundle (docs/fault_tolerance.md): the ring
+            # holds the step entries — tokens/s, mfu, goodput,
+            # bad_step_count — leading into the divergence. Process-0
+            # only, like every other writer (a collective divergence
+            # would otherwise have N hosts clobbering one bundle path)
+            if jax.process_index() == 0:
+                from fengshen_tpu.observability import get_registry
+                self._flightrec.snapshot_metrics([get_registry()],
+                                                 force=True)
+                self._flightrec.dump(
+                    reason="rewind",
+                    extra={"from_step": pre_step,
+                           "to_step": int(self.global_step),
+                           "bad_steps": int(bad_steps)})
+        except Exception as e:  # noqa: BLE001 — telemetry must never
+            # fail the rewind that is saving the run
+            self._log({"event": "flightrec_dump_error",
+                       "error": str(e)[:200]})
         return restored
 
     # -- predict state ---------------------------------------------------
@@ -1129,8 +1158,24 @@ class Trainer:
         """One structured event. Delegates to the unified JsonlSink
         (process-0 gating, jsonl write, console echo, logger bridge) —
         kept as a method because resilience loaders and callbacks hold
-        `log=self._log` references."""
+        `log=self._log` references. Every entry also enters the flight
+        recorder's ring so a rewind dump carries the recent step
+        trajectory."""
+        self._flightrec.record(entry)
         self._sink(entry)
+
+    def _flight_state(self) -> dict:
+        """The flight recorder's trainer provider: cursor state + the
+        scalar run config (the post-mortem bundle's `trainer.json`)."""
+        return {
+            "step": int(self.global_step),
+            "consumed_samples": int(self.consumed_samples),
+            "rewinds_left": int(getattr(self, "_rewinds_left", 0) or 0),
+            "args": {k: v for k, v in
+                     sorted(getattr(self.args, "__dict__", {}).items())
+                     if isinstance(v, (bool, int, float, str,
+                                       type(None)))},
+        }
 
     def _maybe_start_metrics_server(self) -> None:
         """`--metrics_port N`: a stdlib exporter thread serving

@@ -1,0 +1,81 @@
+"""known-clean fixture: the request-timeline / flight-recorder idiom
+(ISSUE 8, docs/observability.md "Request tracing" / "Flight recorder")
+— the decode tick stays ONE pure traced program, while ALL lifecycle
+bookkeeping (timestamped timeline events, the recorder's event ring,
+metric snapshots, the post-mortem dump) happens on the scheduler
+thread between jit boundaries. The timeline is a tempting place to
+leak `time.monotonic()` into traced code (host-divergence), an
+`.item()` per committed token (blocking-transfer), or a counter bump
+inside the tick (metrics-in-traced-code) — none may happen.
+
+Mirrors `fengshen_tpu/serving/engine.py`'s tick + timeline wiring and
+`fengshen_tpu/observability/{timeline,flightrecorder}.py`: if a rule
+fires here, it would also flag the real modules and block the merge
+gate.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import (FlightRecorder, RequestTimeline,
+                                        get_registry, span)
+
+REG = get_registry()
+COMMITTED = REG.counter("fx_timeline_committed_total",
+                        "tokens committed by ticks")
+PHASES = REG.histogram("fx_request_phase_seconds", "phase seconds",
+                       labelnames=("phase",))
+
+
+@jax.jit
+def decode_tick(cache, history, tokens, phys, active, logits_table):
+    """The traced tick: pure gathers/scatters over device state — no
+    clocks, no host pulls, no metric mutation."""
+    n = tokens.shape[0]
+    history = history.at[jnp.arange(n), phys].set(tokens)
+    step_logits = logits_table[tokens]
+    nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, 0)
+    cache = cache.at[jnp.arange(n), phys].set(nxt)
+    return cache, history, nxt
+
+
+def host_tick(state, timelines, logits_table, clock=time.monotonic):
+    """Scheduler-side driver: the ONLY place clocks are read, device
+    values cross to the host, timelines grow, and metrics move."""
+    cache, history, tokens, phys, active = state
+    t0 = clock()
+    with span("fx/decode"):
+        cache, history, nxt = decode_tick(cache, history, tokens, phys,
+                                          active, logits_table)
+        nxt = np.array(nxt)          # host sync AFTER the jit boundary
+    dt = clock() - t0
+    t_commit = clock()
+    for i, tl in enumerate(timelines):
+        if active[i]:
+            tl.add(t_commit, "commit", n=1, tick_s=round(dt, 6))
+    COMMITTED.inc(int(np.asarray(active).sum()))
+    phys = np.asarray(phys) + np.asarray(active).astype(np.int32)
+    return (cache, history, nxt, phys.astype(np.int32), active)
+
+
+def finish_request(recorder: FlightRecorder, tl: RequestTimeline,
+                   clock=time.monotonic) -> dict:
+    """Terminal bookkeeping: derive the waterfall, observe the phase
+    histogram, feed the recorder's ring — all host-side."""
+    end = clock()
+    tl.add(end, "finished", reason="length")
+    phases = tl.phases(end)
+    for key in ("queue_wait_s", "prefill_s", "decode_s"):
+        PHASES.labels(key[:-2]).observe(phases[key])
+    recorder.record({"event": "fx_finish", "phases": phases})
+    return phases
+
+
+def post_mortem(recorder: FlightRecorder, reason: str) -> str:
+    """The dump trigger: ring + providers to disk, never traced."""
+    recorder.snapshot_metrics([REG], force=True)
+    return recorder.dump(reason=reason)

@@ -425,6 +425,31 @@ def test_spec_decode_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_flight_recorder_internals_are_clean():
+    """Regression fixture for the request-timeline / flight-recorder
+    tier (ISSUE 8): lifecycle timestamps, the event ring, phase
+    histograms, and the post-mortem dump are HOST-side bookkeeping
+    between jit boundaries — `metrics-in-traced-code`,
+    `blocking-transfer` and `host-divergence` must all stay silent on
+    the fixture and on the real modules (the observability package,
+    the serving package whose engine appends the timeline events, and
+    the api layer's debug endpoints). A hit means a clock/counter/sync
+    leaked into a traced program (a real hazard: timelines must never
+    add traced work) or a rule lost precision."""
+    fixture = os.path.join(FIXTURES, "flight_recorder_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "observability"),
+             os.path.join(PKG, "serving"),
+             os.path.join(PKG, "api")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_paged_cache_internals_are_clean():
     """Regression fixture for the paged KV cache (ISSUE 6): block
     free-list math stays host-side, the traced gather/scatter decode

@@ -383,6 +383,10 @@ def test_engine_metrics_snapshot_shape_pinned():
         "decode_tokens_per_sec": 6.0, "slot_occupancy": 0.375,
         "ttft_avg_s": 0.3, "ttft_p50_s": 0.4, "ttft_p95_s": 0.4,
         "warmup_compile_s": 1.5,
+        # ISSUE 8: the payload only EXTENDS (uptime + last error type/
+        # age — never a traceback); every pre-existing key above is
+        # unrenamed
+        "uptime_s": 0.0, "last_error": None,
     }
     # a spec engine (ISSUE 7) ADDS exactly its five keys — the
     # non-spec payload above stays byte-identical
