@@ -89,9 +89,17 @@ def _mesh_ident(mesh: Any) -> Optional[list]:
 
 
 def cache_key(name: str, lowered: Any, mesh: Any = None,
-              compiler_options: Optional[dict] = None) -> str:
+              compiler_options: Optional[dict] = None,
+              extra: str = "") -> str:
     """The content address of one compiled executable (see module
-    docstring for the anatomy). `lowered` is a `jax.stages.Lowered`."""
+    docstring for the anatomy). `lowered` is a `jax.stages.Lowered`.
+
+    `extra` carries static context that changes the program's runtime
+    choreography without necessarily changing its StableHLO — the
+    trainer passes the resolved offload placement
+    (`OffloadPolicy.fingerprint()`, docs/offload.md) so two placements
+    can never share an entry. Empty `extra` keeps the pre-existing key
+    derivation (no silent cache invalidation for everyone else)."""
     devices = jax.devices()
     ident = {
         "name": name,
@@ -106,6 +114,8 @@ def cache_key(name: str, lowered: Any, mesh: Any = None,
         "stablehlo_sha256": hashlib.sha256(
             lowered.as_text().encode()).hexdigest(),
     }
+    if extra:
+        ident["extra"] = extra
     return hashlib.sha256(
         json.dumps(ident, sort_keys=True).encode()).hexdigest()
 
@@ -415,6 +425,7 @@ def cached_compile(fn: Any, name: str, *avals,
                    donate_argnums: Sequence[int] = (),
                    mesh: Any = None,
                    compiler_options: Optional[dict] = None,
+                   key_extra: str = "",
                    registry: Optional[MetricsRegistry] = None,
                    log: Optional[Callable[[dict], None]] = None):
     """Lower `fn` at `avals`, then fetch-or-compile the executable.
@@ -433,6 +444,7 @@ def cached_compile(fn: Any, name: str, *avals,
     exe, _ = _compile_with_cache(jitted, name, avals, cache=cache,
                                  mesh=mesh,
                                  compiler_options=compiler_options,
+                                 key_extra=key_extra,
                                  registry=registry)
     return exe
 
@@ -440,12 +452,13 @@ def cached_compile(fn: Any, name: str, *avals,
 def _compile_with_cache(jitted, name: str, avals: tuple,
                         cache: Optional[ExecutableCache],
                         mesh: Any, compiler_options: Optional[dict],
-                        registry: Optional[MetricsRegistry]):
+                        registry: Optional[MetricsRegistry],
+                        key_extra: str = ""):
     """lower → key → load-or-compile; returns (executable, key)."""
     with span("aot/lower"):
         lowered = jitted.lower(*avals)
     key = cache_key(name, lowered, mesh=mesh,
-                    compiler_options=compiler_options)
+                    compiler_options=compiler_options, extra=key_extra)
     if cache is not None:
         exe = cache.load(name, key, out_tree=lowered.out_tree)
         if exe is not None:
@@ -480,6 +493,7 @@ class CachedFunction:
                  compiler_options: Optional[dict] = None,
                  manifest: Any = None,
                  fingerprint_extra: str = "",
+                 key_extra: str = "",
                  registry: Optional[MetricsRegistry] = None,
                  log: Optional[Callable[[dict], None]] = None):
         self._jitted = fn if hasattr(fn, "lower") else \
@@ -490,6 +504,9 @@ class CachedFunction:
         self.compiler_options = compiler_options
         self.manifest = manifest
         self.fingerprint_extra = fingerprint_extra
+        #: folded into the content address itself (see `cache_key`):
+        #: static placement context two programs must never share
+        self.key_extra = key_extra
         self._fingerprint: Optional[str] = None
         self._registry = registry
         self._log = log or (lambda entry: None)
@@ -510,9 +527,16 @@ class CachedFunction:
         be adopted from the cache WITHOUT re-lowering (see
         `cache.trusted_fingerprint`)."""
         if self._fingerprint is None:
-            self._fingerprint = trusted_fingerprint(
-                extra=f"{self.name}|{self.compiler_options!r}|"
-                      f"{self.fingerprint_extra}", mesh=self.mesh)
+            extra = (f"{self.name}|{self.compiler_options!r}|"
+                     f"{self.fingerprint_extra}")
+            if self.key_extra:
+                # key_extra gates trusted replay too — but ONLY when
+                # set: appending unconditionally would change the
+                # fingerprint of every existing key_extra="" user and
+                # invalidate their recorded warmup manifests
+                extra += f"|{self.key_extra}"
+            self._fingerprint = trusted_fingerprint(extra=extra,
+                                                    mesh=self.mesh)
         return self._fingerprint
 
     def adopt(self, avals: tuple, key: str) -> bool:
@@ -554,7 +578,7 @@ class CachedFunction:
         exe, key = _compile_with_cache(
             self._jitted, self.name, args, cache=self.cache,
             mesh=self.mesh, compiler_options=self.compiler_options,
-            registry=self._registry)
+            registry=self._registry, key_extra=self.key_extra)
         if self.manifest is not None:
             self.manifest.record(self.name, args, mesh=self.mesh,
                                  key=key,

@@ -298,14 +298,19 @@ class AotSetup:
             if path is not None else None
 
     def wrap(self, fn: Any, name: str, donate_argnums=(),
-             fingerprint_extra: str = "") -> CachedFunction:
+             fingerprint_extra: str = "",
+             key_extra: str = "") -> CachedFunction:
         """`fingerprint_extra` must capture every static value the
         caller bakes into the traced program that avals don't (model
-        config, engine config reprs) — it gates trusted replay."""
+        config, engine config reprs) — it gates trusted replay.
+        `key_extra` additionally enters the cache key itself (the
+        trainer's offload placement, docs/offload.md): entries under
+        different `key_extra` values can never cross-hit, even within
+        one process."""
         return CachedFunction(
             fn, name, cache=self.cache, donate_argnums=donate_argnums,
             mesh=self.mesh, manifest=self.manifest,
-            fingerprint_extra=fingerprint_extra,
+            fingerprint_extra=fingerprint_extra, key_extra=key_extra,
             registry=self._registry, log=self._log)
 
     def replay(self, functions: Dict[str, CachedFunction]

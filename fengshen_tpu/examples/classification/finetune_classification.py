@@ -430,11 +430,14 @@ class ClassificationModule(TrainModule):
             train_default=r"cls_layer")
         parser.add_argument(
             "--offload_moments_dtype", default="param", type=str,
-            choices=["param", "float32", "bfloat16"],
+            choices=["param", "auto", "float32", "bfloat16"],
             help="host-resident adam moment storage dtype under "
                  "--offload_params. 'param' (default) keeps each "
                  "param's own dtype with update math in that dtype — "
-                 "bit-parity with the monolithic optax step; "
+                 "bit-parity with the monolithic optax step; 'auto' "
+                 "lets the offload policy pick bfloat16 when fp32 "
+                 "moments would exceed half of host RAM "
+                 "(docs/offload.md); "
                  "'bfloat16' stores moments reduced (halving the host "
                  "memory term that bounds streamable model size) while "
                  "the update math runs in fp32. fp16 is deliberately "

@@ -177,10 +177,13 @@ class Llama(CausalLMModule):
                       targets_default=r"(q_proj|k_proj|v_proj|o_proj)")
         parser.add_argument(
             "--offload_moments_dtype", default="param", type=str,
-            choices=["param", "float32", "bfloat16"],
+            choices=["param", "auto", "float32", "bfloat16"],
             help="host-resident adam moment storage dtype under "
                  "--offload_params. 'param' (default) = bit-parity "
-                 "with the monolithic optax step; 'bfloat16' halves "
+                 "with the monolithic optax step; 'auto' lets the "
+                 "offload policy pick bfloat16 when fp32 moments "
+                 "would exceed half of host RAM (docs/offload.md); "
+                 "'bfloat16' halves "
                  "the moment memory (fp32 m+v for 13B is 104 GB — "
                  "more than many hosts; bf16 is 52 GB) with update "
                  "math in fp32. fp16 is deliberately NOT offered "

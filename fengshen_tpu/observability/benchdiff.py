@@ -24,7 +24,10 @@ optionally "mfu", "degraded", ...}). The comparator:
 - flags ``regression`` / ``improvement`` when |delta| exceeds
   ``--threshold`` (relative), ``flat`` otherwise, and ``incomparable``
   when exactly one side is a degraded CPU-fallback number (a rescue
-  row must never read as a hardware regression);
+  row must never read as a hardware regression) or when the two sides
+  ran at different memory placements (the ``offload`` +
+  ``memory_kind`` row fields, docs/offload.md — an offloaded-update
+  row is a different program from a device-resident one);
 - prints a deterministic report (sorted rounds, sorted metrics,
   ``sort_keys`` JSON) and an overall verdict: ``REGRESSED`` /
   ``OK`` / ``NO_SIGNAL`` (no parseable rounds at all — five wedges).
@@ -90,8 +93,20 @@ def classify_round(payload: dict) -> Tuple[str, List[dict]]:
     return "failed", rows
 
 
+def _placement(row: dict) -> str:
+    """The memory-placement identity of a BENCH row (docs/offload.md):
+    offload ladder level + resolved memory kind. Rows without the
+    fields are level "none" (the pre-offload row shape); rows at
+    different placements measure different programs and must never be
+    compared."""
+    level = str(row.get("offload") or "none")
+    kind = str(row.get("memory_kind") or "")
+    return f"{level}:{kind}" if level != "none" else "none"
+
+
 def _compare(metric: str, round_n: int, value: float, degraded: bool,
-             prev_round, prev_value: float, prev_degraded: bool,
+             placement: str, prev_round, prev_value: float,
+             prev_degraded: bool, prev_placement: str,
              threshold: float) -> dict:
     comparison = {
         "metric": metric,
@@ -100,7 +115,7 @@ def _compare(metric: str, round_n: int, value: float, degraded: bool,
         "value": value,
         "prev_value": prev_value,
     }
-    if degraded != prev_degraded:
+    if degraded != prev_degraded or placement != prev_placement:
         comparison.update(status="incomparable", delta_pct=None)
         return comparison
     if prev_value == 0:
@@ -152,15 +167,20 @@ def diff_rounds(rounds: List[Tuple[int, str, dict]],
             metric = str(row["metric"])
             value = float(row["value"])
             degraded = bool(row.get("degraded"))
+            placement = _placement(row)
             prev = last_seen.get(metric)
             if prev is not None:
                 comparisons.append(_compare(
-                    metric, round_n, value, degraded, *prev, threshold))
+                    metric, round_n, value, degraded, placement,
+                    *prev, threshold))
             elif metric in published and not degraded:
+                # published baselines predate the placement fields:
+                # they are level-"none" hardware rows
                 comparisons.append(_compare(
-                    metric, round_n, value, degraded, "baseline",
-                    float(published[metric]), False, threshold))
-            last_seen[metric] = (round_n, value, degraded)
+                    metric, round_n, value, degraded, placement,
+                    "baseline", float(published[metric]), False,
+                    "none", threshold))
+            last_seen[metric] = (round_n, value, degraded, placement)
     counts = {s: sum(1 for r in report_rounds if r["status"] == s)
               for s in ("ok", "wedged", "failed")}
     regressions = [c for c in comparisons if c["status"] == "regression"]

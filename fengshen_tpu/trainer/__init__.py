@@ -10,8 +10,14 @@ onto optimizer-state sharding over the mesh's batch axes; activation
 checkpointing maps onto ``jax.checkpoint`` policies inside the models.
 """
 
+from fengshen_tpu.trainer.memory import (MemoryCapabilities,
+                                         OffloadPolicy,
+                                         probe_memory_capabilities,
+                                         resolve_offload_policy)
 from fengshen_tpu.trainer.module import TrainModule
 from fengshen_tpu.trainer.train_state import TrainState
 from fengshen_tpu.trainer.trainer import Trainer, add_trainer_args
 
-__all__ = ["TrainModule", "TrainState", "Trainer", "add_trainer_args"]
+__all__ = ["MemoryCapabilities", "OffloadPolicy", "TrainModule",
+           "TrainState", "Trainer", "add_trainer_args",
+           "probe_memory_capabilities", "resolve_offload_policy"]

@@ -449,6 +449,25 @@ def run_streamed_fit(args, spec: StreamSpec, loader, apply_fn,
     total_steps = get_total_steps(args, len(loader.dataset),
                                   args.train_batchsize)
     schedule = get_scheduler(args, total_steps)
+    # the streamed loop IS the "stream" rung of the offload ladder
+    # (docs/offload.md): resolve the policy so the placement + its
+    # reason get the same loud announcement as the Trainer levels, and
+    # so moments_dtype becomes a policy knob — "param" (the default)
+    # demands bit-parity storage and is never auto-upgraded; "auto"
+    # lets the policy pick bfloat16 storage when fp32 moments would
+    # dwarf host RAM (the sizing term that decides whether a 13B
+    # stream fits the host); explicit dtypes pass through
+    from fengshen_tpu.trainer.memory import (
+        MOMENT_BYTES_PER_PARAM_FP32, resolve_offload_policy)
+    leaves = jax.tree_util.tree_leaves(
+        [spec.bottom, spec.layers, spec.top])
+    n_params = sum(int(np.prod(np.shape(x))) for x in leaves)
+    raw_moments = getattr(args, "offload_moments_dtype", "param")
+    policy = resolve_offload_policy(
+        "stream",
+        params_bytes=sum(int(getattr(x, "nbytes", 0)) for x in leaves),
+        opt_bytes=n_params * MOMENT_BYTES_PER_PARAM_FP32,
+        moments_dtype=(None if raw_moments == "auto" else raw_moments))
     eng = make_streamed(
         spec,
         # optax schedules are 0-based; the engine count is 1-based
@@ -459,9 +478,7 @@ def run_streamed_fit(args, spec: StreamSpec, loader, apply_fn,
         weight_decay=getattr(args, "weight_decay", 0.01),
         clip_norm=getattr(args, "gradient_clip_val", 0.0) or None,
         use_decay_mask=True,
-        moments_dtype=(None if getattr(args, "offload_moments_dtype",
-                                       "param") == "param"
-                       else args.offload_moments_dtype))
+        moments_dtype=policy.moments_dtype)
 
     class _TrainerView:
         global_step = 0

@@ -60,34 +60,81 @@ def state_shardings(rules, state: Any, mesh: Mesh):
 
 
 def offload_opt_state_shardings(shardings: "TrainState",
-                                memory_kind: str = "pinned_host"
+                                memory_kind: Optional[str] = None
                                 ) -> "TrainState":
     """ZeRO-offload analog: move the optimizer-state shardings to host
     memory (the capability behind the reference's '1.3B finetune in 7 GB'
     recipe, reference: fengshen/examples/classification/
     demo_classification_afqmc_erlangshen_offload.sh:9-33 — DeepSpeed
     `offload_optimizer: cpu`). XLA streams the moments host↔device around
-    the optimizer update, so HBM holds only params/grads/activations."""
+    the optimizer update, so HBM holds only params/grads/activations.
+
+    `memory_kind=None` resolves the host kind through the capability
+    probe (docs/offload.md): `pinned_host` where the backend has it,
+    `unpinned_host` otherwise — hard-coding `pinned_host` raised at
+    sharding construction on backends without that space (this repo's
+    CPU tier-1 backend), which is how the offload bench rungs failed
+    from seed through PR 8. Explicitly passing an unsupported kind
+    still raises, with the probe's findings in the message."""
+    from fengshen_tpu.trainer.memory import probe_memory_capabilities
+    caps = probe_memory_capabilities()
+    if memory_kind is None:
+        memory_kind = caps.host_kind
+        if memory_kind is None:
+            raise ValueError(
+                "offload_opt_state_shardings: the "
+                f"{caps.backend} backend supports no host memory kind "
+                f"(probed: {caps.describe()['supported']}) — resolve an "
+                "OffloadPolicy instead of calling this directly so the "
+                "ladder can degrade to level 'none'")
+    elif not caps.supports(memory_kind):
+        raise ValueError(
+            f"offload_opt_state_shardings: memory kind {memory_kind!r} "
+            f"is unsupported on the {caps.backend} backend (probed: "
+            f"{caps.describe()['supported']})")
     host_opt = jax.tree_util.tree_map(
         lambda s: s.with_memory_kind(memory_kind), shardings.opt_state)
     return shardings.replace(opt_state=host_opt)
 
 
 def create_sharded_state(init_fn: Callable[[], TrainState], rules,
-                         mesh: Mesh, offload_optimizer: bool = False
+                         mesh: Mesh, offload_optimizer: bool = False,
+                         policy: Optional[Any] = None,
+                         abstract: Optional[Any] = None
                          ) -> tuple[TrainState, Any]:
     """jit `init_fn` with out_shardings from `rules` so parameters are
     created directly on their target devices (never materialised on one
     host — the analog of the reference's CPU-vs-GPU init switch,
-    reference: fengshen/models/megatron/mpu/initialize.py:47-54)."""
-    abstract = jax.eval_shape(init_fn)
+    reference: fengshen/models/megatron/mpu/initialize.py:47-54).
+
+    `policy` (an OffloadPolicy, docs/offload.md) decides what gets
+    parked in host memory after init; the legacy `offload_optimizer`
+    bool resolves a level-"opt" policy through the capability probe.
+    The returned shardings carry the BETWEEN-STEP placement (moments on
+    host under level "opt"+); params shardings stay device-resident —
+    the offloaded step manages its own H2D/D2H explicitly."""
+    if abstract is None:
+        abstract = jax.eval_shape(init_fn)
     shardings = state_shardings(rules, abstract, mesh)
+    if policy is None and offload_optimizer:
+        from fengshen_tpu.trainer.memory import resolve_offload_policy
+        policy = resolve_offload_policy("opt", abstract_state=abstract)
     # XLA in this build cannot emit mixed-memory-space outputs from one
     # SPMD program, so init on device and park the moments on host with an
     # outside-jit transfer
     state = jax.jit(init_fn, out_shardings=shardings)()
-    if offload_optimizer:
-        shardings = offload_opt_state_shardings(shardings)
+    if policy is not None and policy.offloads_opt_state:
+        shardings = offload_opt_state_shardings(
+            shardings, policy.opt_state_kind)
         state = state.replace(opt_state=jax.device_put(
             state.opt_state, shardings.opt_state))
+    if policy is not None and policy.offloads_params:
+        # level opt_master: the master/param copies ALSO park in host
+        # memory between steps; the step brings them on-device only for
+        # the duration of one grad+update (Trainer's offloaded step)
+        host_params = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind(policy.master_kind),
+            shardings.params)
+        state = state.replace(params=jax.device_put(
+            state.params, host_params))
     return state, shardings

@@ -157,9 +157,29 @@ def add_trainer_args(parent_parser: argparse.ArgumentParser):
     parser.add_argument("--precision", default="bf16", type=str,
                         choices=["bf16", "fp32", "16", "32", "bf16-mixed"])
     parser.add_argument(
+        "--offload", default="auto", type=str,
+        choices=["auto", "none", "opt", "opt_master", "stream"],
+        help="memory-placement ladder (docs/offload.md): none (all "
+             "device-resident), opt (adam moments in host memory "
+             "between steps), opt_master (moments + master/param "
+             "copies host-resident), stream (per-layer parameter "
+             "streaming — needs a stream-spec driver; the standard "
+             "Trainer degrades it to opt_master loudly). auto probes "
+             "the backend's memory kinds + byte budgets and picks the "
+             "shallowest level that fits; every level falls back down "
+             "the ladder when its memory kind is unsupported")
+    parser.add_argument(
+        "--offload_memory_kind", default="auto", type=str,
+        choices=["auto", "pinned_host", "unpinned_host"],
+        help="override the probe's host-memory-kind choice; forcing a "
+             "kind the backend lacks raises instead of silently "
+             "degrading")
+    parser.add_argument(
         "--offload_optimizer", action="store_true", default=False,
-        help="keep adam moments in host memory (ZeRO-offload analog; "
-             "reference: demo_classification_afqmc_erlangshen_offload.sh)")
+        help="DEPRECATED: same as --offload=opt (kept so reference "
+             "recipes parse; --offload wins when both are given). "
+             "ZeRO-offload analog; reference: "
+             "demo_classification_afqmc_erlangshen_offload.sh")
     parser.add_argument(
         "--profile_steps", default=None, type=str,
         help="START,END step range to capture a jax.profiler trace "
@@ -408,17 +428,22 @@ class Trainer:
         self._batch_sh = batch_shardings
 
         spe = max(int(getattr(self.args, "steps_per_execution", 1)), 1)
-        if getattr(self.args, "offload_optimizer", False):
+        policy = getattr(self, "_offload_policy", None)
+        offloaded = (policy.offloads_opt_state if policy is not None
+                     else bool(getattr(self.args, "offload_optimizer",
+                                       False)))
+        if offloaded:
             if spe > 1:
                 import sys
                 print("[fengshen-tpu] --steps_per_execution is ignored "
-                      "with --offload_optimizer (the offloaded update is "
+                      "with optimizer offload (the offloaded update is "
                       "a two-program step with a host round-trip per "
                       "step — scanning K steps on-device would keep the "
                       "moments in HBM and defeat the offload)",
                       file=sys.stderr, flush=True)
             return self._build_offloaded_train_step(
-                module, state_sh, batch_shardings), batch_shardings
+                module, state_sh, batch_shardings,
+                policy=policy), batch_shardings
 
         if spe > 1:
             # K steps per dispatch: scan over K stacked batches. The rng
@@ -478,25 +503,56 @@ class Trainer:
             from fengshen_tpu.aot import AotConfig, AotSetup
             self._aot_setup = AotSetup(AotConfig(cache_dir=cache_dir),
                                        mesh=self.mesh, log=self._log)
-        return self._aot_setup.wrap(jitted, name)
+        # a non-"none" placement enters the cache key — and, through
+        # key_extra, the trusted-replay fingerprint (docs/offload.md):
+        # placement changes the programs' transfer choreography, so a
+        # stale cross-placement cache hit must be impossible. Level
+        # "none" keeps key_extra EMPTY on purpose: it runs the
+        # identical pre-placement program, and a non-empty extra would
+        # invalidate every existing cache entry and warmup manifest of
+        # users who never touch --offload
+        policy = getattr(self, "_offload_policy", None)
+        placement = policy.fingerprint() \
+            if policy is not None and policy.level != "none" else ""
+        return self._aot_setup.wrap(jitted, name, key_extra=placement)
 
-    def _build_offloaded_train_step(self, module, state_sh, batch_sh):
+    def _build_offloaded_train_step(self, module, state_sh, batch_sh,
+                                    policy=None):
         """ZeRO-offload analog: the optimizer state lives in HOST memory
         between steps, so the gradient pass runs with HBM holding only
         params + grads + activations (reference capability:
         DeepSpeed offload_optimizer, fengshen/examples/classification/
-        demo_classification_afqmc_erlangshen_offload.sh:9-33).
+        demo_classification_afqmc_erlangshen_offload.sh:9-33). Under
+        the policy's "opt_master" level the master/param copies ALSO
+        park host-side between steps — device memory holds the model
+        only transiently during one grad+update (docs/offload.md).
 
         XLA in this build cannot annotate memory spaces inside an SPMD
         program, so the H2D/D2H moves happen BETWEEN two jitted programs:
         grad_step (device-only) and update_step (donated; moments are
         device-resident only transiently during the update).
         """
+        from fengshen_tpu.trainer.memory import (
+            probe_memory_capabilities, resolve_offload_policy)
+        if policy is None:
+            policy = resolve_offload_policy("opt", log=self._log)
         grad_step = self._make_grad_step(module)
+        # "bring it back on-device" = the device's DEFAULT memory kind:
+        # the literal "device" raises on backends whose default space
+        # has another name (the CPU backend's is "unpinned_host")
+        device_kind = probe_memory_capabilities().device_memory_kind
         param_sh = state_sh.params
-        opt_host_sh = state_sh.opt_state
+        opt_host_sh = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind(policy.opt_state_kind),
+            state_sh.opt_state)
         opt_dev_sh = jax.tree_util.tree_map(
-            lambda s: s.with_memory_kind("device"), opt_host_sh)
+            lambda s: s.with_memory_kind(device_kind), state_sh.opt_state)
+        park_params = policy.offloads_params
+        param_host_sh = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind(policy.master_kind),
+            param_sh) if park_params else None
+        param_dev_sh = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind(device_kind), param_sh)
 
         grad_jit = jax.jit(
             grad_step,
@@ -514,7 +570,11 @@ class Trainer:
 
         def step_fn(state, batch, rng):
             nonlocal update_jit
-            grads, metrics = grad_jit(state.params, batch, rng, state.step)
+            # H2D (opt_master): master/param copies park host-side
+            # between steps — bring them on-device for this step only
+            params_dev = jax.device_put(state.params, param_dev_sh) \
+                if park_params else state.params
+            grads, metrics = grad_jit(params_dev, batch, rng, state.step)
             if guards_on:
                 # host-side guard, same predicate as the fused step:
                 # this path already pays a host round-trip per step for
@@ -537,8 +597,11 @@ class Trainer:
                     out_shardings=(param_sh, opt_dev_sh, None),
                     donate_argnums=(0, 1, 2))
             new_params, new_opt_dev, new_step = update_jit(
-                state.params, grads, opt_dev, state.step)
-            # D2H: park the moments back in host memory
+                params_dev, grads, opt_dev, state.step)
+            # D2H: park the moments (and under opt_master the params)
+            # back in host memory
+            if park_params:
+                new_params = jax.device_put(new_params, param_host_sh)
             new_opt = jax.device_put(new_opt_dev, opt_host_sh)
             new_state = state.replace(step=new_step, params=new_params,
                                       opt_state=new_opt)
@@ -762,19 +825,56 @@ class Trainer:
         max_steps = getattr(args, "max_steps", -1)
         if max_steps is None or max_steps <= 0:
             max_steps = total_steps
-        spe = 1 if getattr(args, "offload_optimizer", False) else \
-            max(int(getattr(args, "steps_per_execution", 1)), 1)
 
         # build sharded state (peek never advances the stateful sampler)
         sample_batch = meta_loader.peek() if hasattr(meta_loader, "peek") \
             else next(iter(meta_loader))
         rules = module.partition_rules()
 
+        # memory placement (docs/offload.md): probe the backend's
+        # memory kinds, size the state from eval_shape (no buffers),
+        # resolve the offload ladder level BEFORE anything compiles —
+        # the policy decides the state shardings, which step program is
+        # built, and the AOT cache key
+        from fengshen_tpu.trainer.memory import (offload_request_from_args,
+                                                 record_offload_metrics,
+                                                 resolve_offload_policy)
+        init_fn = self._make_init_fn(module, rng, total_steps)
+        abstract = jax.eval_shape(init_fn)
+        mesh_shape = dict(self.mesh.shape)
+        policy = resolve_offload_policy(
+            offload_request_from_args(args),
+            abstract_state=abstract,
+            memory_kind=getattr(args, "offload_memory_kind", "auto"),
+            can_stream=False,  # the standard Trainer has no stream spec
+            # one state replica shards over the model axes only — the
+            # data/sequence axes REPLICATE it, so counting every device
+            # would overestimate capacity by the DP factor
+            state_shard_ways=(mesh_shape.get("fsdp", 1) *
+                              mesh_shape.get("tensor", 1) *
+                              mesh_shape.get("pipe", 1)),
+            log=self._log)
+        self._offload_policy = policy
+        spe = 1 if policy.offloads_opt_state else \
+            max(int(getattr(args, "steps_per_execution", 1)), 1)
+
         state, state_sh = create_sharded_state(
-            self._make_init_fn(module, rng, total_steps), rules,
-            self.mesh,
-            offload_optimizer=bool(getattr(args, "offload_optimizer",
-                                           False)))
+            init_fn, rules, self.mesh, policy=policy, abstract=abstract)
+
+        # observability (docs/observability.md): ladder level, probed
+        # kinds, and the bytes actually parked host-side between steps
+        host_bytes = 0
+        if policy.offloads_opt_state:
+            host_bytes += sum(
+                leaf.nbytes
+                for leaf in jax.tree_util.tree_leaves(state.opt_state)
+                if hasattr(leaf, "nbytes"))
+        if policy.offloads_params:
+            host_bytes += sum(
+                leaf.nbytes
+                for leaf in jax.tree_util.tree_leaves(state.params)
+                if hasattr(leaf, "nbytes"))
+        record_offload_metrics(policy, host_resident_bytes=host_bytes)
         _, self._schedule = module.configure_optimizers(total_steps,
                                                         state.params)
 
@@ -1079,6 +1179,23 @@ class Trainer:
             stage="val")
         if loader is None:
             return
+        val_params = state.params
+        policy = getattr(self, "_offload_policy", None)
+        if policy is not None and policy.offloads_params and \
+                getattr(self, "_state_sh", None) is not None:
+            # opt_master parks params in HOST memory between steps
+            # (docs/offload.md), but the cached val jit's in_shardings
+            # are device-resident — bring one device copy up for the
+            # sweep (dropped when the sweep ends). Without this, any
+            # backend whose host kind differs from the device default
+            # would mismatch and silently demote every batch to the
+            # inferred-sharding fallback jit.
+            device_kind = policy.caps.device_memory_kind
+            val_params = jax.device_put(
+                state.params,
+                jax.tree_util.tree_map(
+                    lambda s: s.with_memory_kind(device_kind),
+                    self._state_sh.params))
         losses, limit = [], getattr(self.args, "limit_val_batches", 0)
         # cache the compiled val step across invocations; params ride the
         # training shardings so validation never gathers the model onto
@@ -1129,7 +1246,7 @@ class Trainer:
                 break
             rows = _batch_rows(batch)
             try:
-                loss, metrics = val_fn(state.params, batch, rng)
+                loss, metrics = val_fn(val_params, batch, rng)
             except (TypeError, ValueError) as e:
                 # this batch doesn't fit the train batch spec — run IT on a
                 # separately cached inferred-sharding jit, but keep the
@@ -1139,7 +1256,7 @@ class Trainer:
                     self._log({"event": "val_shard_fallback",
                                "step": self.global_step,
                                "error": str(e)[:200]})
-                loss, metrics = self._val_fn_plain(state.params, batch,
+                loss, metrics = self._val_fn_plain(val_params, batch,
                                                    rng)
             _accumulate(metrics, rows)
             losses.append((float(loss), rows))

@@ -1,0 +1,76 @@
+"""known-clean fixture: the memory-placement idiom (docs/offload.md) —
+the capability probe, the offload-policy resolution, and the placement
+gauges are HOST code that runs strictly OUTSIDE traced programs,
+between jit boundaries.
+
+Mirrors `fengshen_tpu/trainer/memory.py` + the offloaded two-program
+step: the probe's tiny transfer and `block_until_ready`, the byte-math
+placement decision, and the gauge sets all happen around the jitted
+grad/update programs, never inside one. None of `host-divergence`,
+`blocking-transfer`, or `metrics-in-traced-code` may fire here — if one
+does, the analyzer would also flag the real subsystem and block the
+merge gate (or a rule lost precision).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+LEVEL = REG.gauge("fx_offload_level", "resolved ladder level")
+SUPPORTED = REG.gauge("fx_memory_kind_supported", "probe bits",
+                      labelnames=("kind",))
+
+
+def probe_kind(kind):
+    """The probe's shape: attempt a sharding construction plus a tiny
+    transfer ON THE HOST — the block_until_ready is legal because no
+    traced program is anywhere on the stack."""
+    device = jax.devices()[0]
+    try:
+        sharding = jax.sharding.SingleDeviceSharding(device,
+                                                     memory_kind=kind)
+        x = jax.device_put(np.ones((8,), np.uint8), sharding)
+        jax.block_until_ready(x)
+        return True
+    except ValueError:
+        return False
+
+
+def resolve_level(params_bytes, opt_bytes, budget):
+    """Placement math: pure host integers, no arrays at all."""
+    if budget is None or 2 * params_bytes + opt_bytes <= budget:
+        return 0
+    if 2 * params_bytes <= budget:
+        return 1
+    return 2
+
+
+def grad_step(params, batch):
+    # the traced program: pure array math — no probes, no gauges
+    pred = batch["x"] @ params["w"]
+    return jax.tree_util.tree_map(
+        lambda w: w * pred.sum(), params)
+
+
+def offloaded_fit(params, batches, host_sharding):
+    """The offloaded-step choreography: jitted compute with explicit
+    host parking BETWEEN the programs, gauges set once on the host."""
+    supported = probe_kind("pinned_host")
+    SUPPORTED.labels("pinned_host").set(1.0 if supported else 0.0)
+    LEVEL.set(float(resolve_level(1 << 20, 2 << 20, None)))
+    grad_jit = jax.jit(grad_step)
+    update_jit = jax.jit(
+        lambda p, g: jax.tree_util.tree_map(
+            lambda a, b: a - 0.1 * b, p, g))
+    moments = jax.device_put(
+        jax.tree_util.tree_map(jnp.zeros_like, params), host_sharding)
+    for batch in batches:
+        grads = grad_jit(params, batch)
+        # H2D / D2H between the two programs, outside any trace
+        moments_dev = jax.device_put(moments)
+        params = update_jit(params, grads)
+        moments = jax.device_put(moments_dev, host_sharding)
+    return params, moments

@@ -403,6 +403,31 @@ def test_aot_cache_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_offload_policy_internals_are_clean():
+    """Regression fixture for the memory-placement subsystem (ISSUE 9,
+    docs/offload.md): the capability probe runs OUTSIDE traced code by
+    construction (its tiny transfer + block_until_ready are host-side),
+    the placement math is pure host integers, and the gauges are set
+    between jit boundaries — none of `host-divergence`,
+    `blocking-transfer`, or `metrics-in-traced-code` may fire on the
+    fixture or on the real modules (trainer/memory.py and the
+    train_state/param_streaming wiring). A hit means a probe or gauge
+    leaked into a traced program (a real SPMD hazard) or a rule lost
+    precision."""
+    fixture = os.path.join(FIXTURES, "offload_policy_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "trainer", "memory.py"),
+             os.path.join(PKG, "trainer", "train_state.py"),
+             os.path.join(PKG, "trainer", "param_streaming.py")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_spec_decode_internals_are_clean():
     """Regression fixture for the speculative decode tick (ISSUE 7):
     the drafter + verify + accept/commit stay ONE pure traced program

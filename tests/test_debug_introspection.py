@@ -717,6 +717,41 @@ def test_benchdiff_flags_regressions(tmp_path):
     assert benchdiff.main(["--dir", str(empty)]) == 2
 
 
+def test_benchdiff_never_compares_across_placements(tmp_path):
+    """ISSUE 9 satellite: offload rows carry their resolved placement
+    ({"offload", "memory_kind"}, docs/offload.md) and rows at
+    different placements are INCOMPARABLE — an offloaded-update rung
+    slowing down relative to a device-resident rung is a placement
+    change, not a perf regression."""
+    from fengshen_tpu.observability import benchdiff
+
+    d = str(tmp_path)
+    _write_round(d, 1, [{"metric": "off_tps", "value": 100.0,
+                         "unit": "tok/s", "vs_baseline": 1.0}])
+    # same metric, now measured at an offload placement: incomparable
+    _write_round(d, 2, [{"metric": "off_tps", "value": 40.0,
+                         "unit": "tok/s", "vs_baseline": 0.4,
+                         "offload": "opt",
+                         "memory_kind": "unpinned_host"}])
+    # same placement again: comparable, and this IS a regression
+    _write_round(d, 3, [{"metric": "off_tps", "value": 30.0,
+                         "unit": "tok/s", "vs_baseline": 0.3,
+                         "offload": "opt",
+                         "memory_kind": "unpinned_host"}])
+    # same level on a DIFFERENT memory kind: incomparable again
+    _write_round(d, 4, [{"metric": "off_tps", "value": 60.0,
+                         "unit": "tok/s", "vs_baseline": 0.6,
+                         "offload": "opt",
+                         "memory_kind": "pinned_host"}])
+    report = benchdiff.diff_rounds(benchdiff.load_rounds(d),
+                                   threshold=0.15)
+    by_round = {c["round"]: c for c in report["comparisons"]}
+    assert by_round[2]["status"] == "incomparable"
+    assert by_round[2]["delta_pct"] is None
+    assert by_round[3]["status"] == "regression"
+    assert by_round[4]["status"] == "incomparable"
+
+
 def test_benchdiff_report_deterministic_across_hashseed(tmp_path):
     d = str(tmp_path)
     _write_round(d, 1, [{"metric": f"m{i}", "value": float(i + 1),
