@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: lint lint-baseline test test-fast serve-bench \
-	serve-bench-parity serve-bench-spec aot-bench benchdiff
+	serve-bench-parity serve-bench-spec serve-bench-fleet \
+	serve-fleet aot-bench benchdiff
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -32,6 +33,24 @@ serve-bench-spec:
 	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=spec \
 		SERVE_BENCH_BUCKETS=32,64 SERVE_BENCH_NEW_TOKENS=96 \
 		$(PY) -m fengshen_tpu.serving.bench
+
+# fleet-router microbench (docs/fleet.md): aggregate tokens/s over
+# N=3 stdlib api replica subprocesses vs one, plus the
+# kill-one-replica-mid-run rung (must finish with zero failed
+# requests) — one BENCH-schema JSON line carrying the replica count
+serve-bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.fleet.bench
+
+# local fleet: spawn $(N) stdlib api replicas from the api config
+# $(CONFIG) and front them with the router on port $(PORT)
+# (docs/fleet.md), e.g.
+#     make serve-fleet CONFIG=generation.json N=3 PORT=8080
+serve-fleet:
+	@test -n "$(CONFIG)" || \
+		{ echo "usage: make serve-fleet CONFIG=<api config json> [N=3] [PORT=8080]"; exit 2; }
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} $(PY) -m fengshen_tpu.fleet \
+		--spawn $(or $(N),3) --config $(CONFIG) \
+		--port $(or $(PORT),8080)
 
 # AOT cold-start microbench (docs/aot_cache.md): cold-process vs
 # warm-process engine warmup through the persistent executable cache,

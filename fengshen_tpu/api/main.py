@@ -49,6 +49,16 @@ post-mortem bundle on demand (docs/observability.md "Flight
 recorder"). `main()` wires a `FlightRecorder` through the engine and
 chains it onto SIGTERM, so a drained/killed replica leaves a bundle
 behind.
+
+Fleet composition (ISSUE 10, docs/fleet.md): N replicas of this server
+compose behind `python -m fengshen_tpu.fleet`. The replica-side
+contract lives here — `/healthz` 503 bodies carry `{"ready": false,
+"reason": "warmup"|"draining"}` so the router can tell the way IN from
+the way OUT; SIGTERM triggers a graceful drain (`install_drain_handler`:
+admission stops, in-flight requests finish, then the process exits)
+instead of immediate death; and a request body may carry a
+`request_id`, which the engine DEDUPES (409 on a live duplicate) so
+the router's retry-on-another-replica is idempotent-safe.
 """
 
 from __future__ import annotations
@@ -73,6 +83,9 @@ class ServerConfig:
     engine: str = "simple"
     warmup: bool = True
     request_timeout_s: float = 120.0
+    # SIGTERM drain (docs/fleet.md "Drain runbook"): how long the
+    # stdlib server waits for in-flight requests before shutting down
+    drain_timeout_s: float = 30.0
     # flight-recorder post-mortem bundles (POST /debug/dump, engine
     # tick errors, SIGTERM) land here (docs/observability.md)
     dump_dir: str = "fstpu_dumps"
@@ -106,6 +119,21 @@ def load_config(path: str) -> tuple[ServerConfig, PipelineConfig]:
         pipeline_args={k: v for k, v in raw.get("PIPELINE", {}).items()
                        if k not in ("task", "model")})
     return server, pipeline
+
+
+def _healthz_payload(task: str, ready, draining) -> tuple[int, dict]:
+    """The readiness contract BOTH server paths answer (pinned by
+    tests): 503 with `{"ready": false, "reason": "warmup"|"draining"}`
+    while the replica must not receive traffic, 200 with
+    `{"ready": true}` otherwise. The legacy `status` key stays for
+    pre-fleet monitors; the fleet router keys on `reason`."""
+    if draining is not None and draining.is_set():
+        return 503, {"status": "draining", "task": task,
+                     "ready": False, "reason": "draining"}
+    if ready is not None and not ready.is_set():
+        return 503, {"status": "warming", "task": task,
+                     "ready": False, "reason": "warmup"}
+    return 200, {"status": "ok", "task": task, "ready": True}
 
 
 def _render_metrics(engine=None) -> str:
@@ -247,12 +275,22 @@ def _engine_generate(engine, pipeline, req: dict,
                      timeout_s: float) -> tuple[int, dict]:
     """Submit one HTTP request to the engine; returns (status, body).
     Backpressure maps to HTTP: queue full → 429, prompt too long → 413,
-    engine timeout/eviction → 503."""
-    from fengshen_tpu.serving import FINISHED, PromptTooLong, QueueFull
+    engine timeout/eviction → 503, draining replica → 503 with reason,
+    duplicate request_id → 409 (the fleet router's idempotent-safe
+    retry contract, docs/fleet.md)."""
+    from fengshen_tpu.serving import (FINISHED, Draining,
+                                      DuplicateRequest, PromptTooLong,
+                                      QueueFull)
+    rid = req.get("request_id")
     try:
         request = engine.submit(
             pipeline.encode(req["input_text"]),
-            max_new_tokens=req.get("max_new_tokens"))
+            max_new_tokens=req.get("max_new_tokens"),
+            request_id=None if rid is None else str(rid))
+    except Draining as e:
+        return 503, {"error": str(e), "reason": "draining"}
+    except DuplicateRequest as e:
+        return 409, {"error": str(e)}
     except QueueFull as e:
         return 429, {"error": str(e)}
     except PromptTooLong as e:
@@ -278,12 +316,14 @@ def _engine_generate(engine, pipeline, req: dict,
 
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
               server_cfg: Optional[ServerConfig] = None, engine=None,
-              ready=None, recorder=None):
+              ready=None, recorder=None, draining=None):
     """Create the FastAPI app around a pipeline instance. `ready` is an
     optional `threading.Event`: until set, `GET /healthz` answers 503
     ("warming") so load balancers keep routing around a replica that is
-    still compiling; None means always ready. `recorder` enables
-    `POST /debug/dump`."""
+    still compiling; None means always ready. `draining` is the mirror
+    event for the way OUT: once set, `/healthz` answers 503 with reason
+    "draining" and new generate requests get 503 while in-flight ones
+    finish (docs/fleet.md). `recorder` enables `POST /debug/dump`."""
     from fastapi import FastAPI
     from fastapi.middleware.cors import CORSMiddleware
     from fastapi.responses import JSONResponse, Response
@@ -300,6 +340,10 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
     class Request(BaseModel):
         input_text: str
         max_new_tokens: Optional[int] = None
+        # the fleet router's idempotent-safe retry hook: without this
+        # field pydantic silently DROPS the router-assigned id and the
+        # engine dedupe (409 contract) never sees it
+        request_id: Optional[str] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
 
@@ -315,6 +359,14 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
 
     @app.post(api_route)
     def run(req: Request) -> Any:
+        if draining is not None and draining.is_set():
+            # the engine path would answer the same via Draining; this
+            # ALSO covers the simple path, and spares encode work
+            _count_http(api_route, 503)
+            return JSONResponse(
+                status_code=503,
+                content={"error": "replica draining",
+                         "reason": "draining"})
         if engine is not None:
             code, body = _engine_generate(
                 engine, pipeline, req.model_dump(),
@@ -332,14 +384,12 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
 
     @app.get("/healthz")
     def healthz():
-        if ready is not None and not ready.is_set():
-            _count_http("/healthz", 503)
-            return JSONResponse(
-                status_code=503,
-                content={"status": "warming",
-                         "task": pipeline_cfg.task})
-        _count_http("/healthz", 200)
-        return {"status": "ok", "task": pipeline_cfg.task}
+        code, body = _healthz_payload(pipeline_cfg.task, ready,
+                                      draining)
+        _count_http("/healthz", code)
+        if code != 200:
+            return JSONResponse(status_code=code, content=body)
+        return body
 
     @app.get("/stats")
     def stats():
@@ -401,20 +451,28 @@ def _resolve_pipeline(pipeline_cfg: PipelineConfig):
 
 def build_stdlib_server(server_cfg: ServerConfig,
                         pipeline_cfg: PipelineConfig, pipeline=None,
-                        engine=None, ready=None, recorder=None):
+                        engine=None, ready=None, recorder=None,
+                        draining=None):
     """Dependency-free fallback server (http.server) exposing the SAME
     surface as the FastAPI app: `POST /api/<task>` with
-    `{"input_text": ...}`, `GET /healthz` (503 until the `ready` event
-    is set, like build_app), `GET /stats`, `GET /metrics`, and the
-    debug introspection routes (`GET /debug/requests[/<id>]`,
+    `{"input_text": ...}`, `GET /healthz` (503 `{"ready": false,
+    "reason": "warmup"}` until the `ready` event is set, 503 with
+    reason "draining" once the `draining` event is set — both mirrored
+    by build_app), `GET /stats`, `GET /metrics`, and the debug
+    introspection routes (`GET /debug/requests[/<id>]`,
     `POST /debug/dump` when a `recorder` is wired). FastAPI/uvicorn
     stay the production path; this keeps the REST surface runnable (and
-    testable) where they are not installed."""
+    testable) where they are not installed. The returned server tracks
+    its in-flight generate requests (`server.in_flight()`) so the
+    SIGTERM drain handler can wait them out (docs/fleet.md)."""
     import http.server
+    import threading
 
     if pipeline is None:
         pipeline = _resolve_pipeline(pipeline_cfg)
     route = f"/api/{pipeline_cfg.task}"
+    inflight_lock = threading.Lock()
+    inflight = [0]
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -442,12 +500,9 @@ def build_stdlib_server(server_cfg: ServerConfig,
         def do_GET(self):
             self._t_start = time.perf_counter()
             if self.path == "/healthz":
-                if ready is not None and not ready.is_set():
-                    self._send(503, {"status": "warming",
-                                     "task": pipeline_cfg.task})
-                else:
-                    self._send(200, {"status": "ok",
-                                     "task": pipeline_cfg.task})
+                code, body = _healthz_payload(pipeline_cfg.task, ready,
+                                              draining)
+                self._send(code, body)
             elif self.path == "/stats":
                 if engine is not None:
                     self._send(200, engine.stats())
@@ -503,6 +558,14 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 # the pipeline must surface as 500, not as this 422
                 self._send(422, {"error": "input_text required"})
                 return
+            if draining is not None and draining.is_set():
+                # admission edge of the drain: requests already past
+                # it (counted in-flight below) finish normally
+                self._send(503, {"error": "replica draining",
+                                 "reason": "draining"})
+                return
+            with inflight_lock:
+                inflight[0] += 1
             try:
                 if engine is not None:
                     code, body = _engine_generate(
@@ -521,9 +584,66 @@ def build_stdlib_server(server_cfg: ServerConfig,
                                {"result": pipeline(req["input_text"])})
             except Exception as e:  # noqa: BLE001 — surface, don't die
                 self._send(500, {"error": str(e)[:500]})
+            finally:
+                with inflight_lock:
+                    inflight[0] -= 1
 
-    return http.server.ThreadingHTTPServer(
+    server = http.server.ThreadingHTTPServer(
         (server_cfg.host, server_cfg.port), Handler)
+    server.in_flight = lambda: inflight[0]
+    return server
+
+
+def install_drain_handler(server, draining, engine=None, recorder=None,
+                          drain_timeout_s: float = 30.0,
+                          poll_s: float = 0.05):
+    """SIGTERM → graceful replica drain (docs/fleet.md "Drain
+    runbook"): set the `draining` event (healthz flips to 503
+    `{"reason": "draining"}`; new generates get 503), stop engine
+    admission (`begin_drain`), then — on a waiter thread — wait until
+    the engine is idle and no HTTP generate is in flight (bounded by
+    `drain_timeout_s`), dump the flight recorder, and shut the server
+    down so `serve_forever` returns and the process exits 0.
+
+    Deliberately REPLACES (does not chain) any prior SIGTERM handler:
+    the flight recorder's own handler re-delivers the default
+    disposition after dumping — i.e. immediate death — which is
+    exactly what a drain must prevent. Its dump still happens, here,
+    after the drain. Returns the previous handler (tests restore it)
+    or None when not on the main thread."""
+    import signal
+    import threading
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    previous = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        if draining.is_set():
+            return          # second SIGTERM: drain already underway
+        draining.set()
+        if engine is not None:
+            engine.begin_drain()
+
+        def waiter():
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                engine_idle = engine is None or engine.idle()
+                if engine_idle and server.in_flight() == 0:
+                    break
+                time.sleep(poll_s)
+            if recorder is not None:
+                try:
+                    recorder.dump(reason="sigterm_drain")
+                except Exception:  # noqa: BLE001 — a failed dump must
+                    # not leave the server running forever
+                    pass
+            server.shutdown()
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="fstpu-drain").start()
+
+    signal.signal(signal.SIGTERM, handler)
+    return previous
 
 
 def _start_warmup_thread(server_cfg: ServerConfig,
@@ -592,18 +712,43 @@ def main(argv=None) -> None:
                                           recorder=recorder)
     ready = _start_warmup_thread(server_cfg, pipeline_cfg, pipeline,
                                  engine)
-    try:
-        app = build_app(pipeline_cfg, pipeline=pipeline,
-                        server_cfg=server_cfg, engine=engine,
-                        ready=ready, recorder=recorder)
-        import uvicorn
-    except ModuleNotFoundError:
+    import os
+    import threading
+    draining = threading.Event()
+    # FSTPU_API_SERVER=stdlib forces the stdlib path even where
+    # uvicorn is installed — the fleet launcher sets it because only
+    # this path has the SIGTERM graceful drain (uvicorn installs its
+    # own signal handlers; its shutdown drops in-flight engine waits)
+    use_stdlib = os.environ.get("FSTPU_API_SERVER",
+                                "").lower() == "stdlib"
+    app = None
+    if not use_stdlib:
+        try:
+            app = build_app(pipeline_cfg, pipeline=pipeline,
+                            server_cfg=server_cfg, engine=engine,
+                            ready=ready, recorder=recorder,
+                            draining=draining)
+            import uvicorn
+        except ModuleNotFoundError:
+            app = None
+    if app is None:
         server = build_stdlib_server(server_cfg, pipeline_cfg,
                                      pipeline=pipeline, engine=engine,
-                                     ready=ready, recorder=recorder)
-        print(f"fastapi/uvicorn not installed — stdlib server on "
+                                     ready=ready, recorder=recorder,
+                                     draining=draining)
+        # graceful drain replaces the recorder's dump-then-die SIGTERM
+        # chain installed above (the dump still happens, post-drain)
+        install_drain_handler(server, draining, engine=engine,
+                              recorder=recorder,
+                              drain_timeout_s=server_cfg.drain_timeout_s)
+        why = "FSTPU_API_SERVER=stdlib" if use_stdlib else \
+            "fastapi/uvicorn not installed"
+        print(f"{why} — stdlib server on "
               f"{server_cfg.host}:{server_cfg.port}", flush=True)
         server.serve_forever()
+        server.server_close()
+        if engine is not None:
+            engine.stop()
         return
     uvicorn.run(app, host=server_cfg.host, port=server_cfg.port,
                 log_level=server_cfg.log_level)

@@ -1,0 +1,29 @@
+"""Fleet router: health-gated multi-replica serving (docs/fleet.md).
+
+Composes N `fengshen_tpu/api` replicas into one fault-tolerant
+endpoint: least-occupancy load balancing from polled `/stats`, health
+gating with eased recovery, bounded retries with jittered backoff on a
+different replica, per-replica circuit breaking with half-open probes,
+and graceful drain on SIGTERM. Pure stdlib — the router runs on hosts
+with no accelerator runtime.
+
+    python -m fengshen_tpu.fleet --replicas host:port,host:port
+    make serve-fleet CONFIG=api.json
+"""
+
+from fengshen_tpu.fleet.faults import (FaultInjectingTransport,
+                                       FleetFaultPlan)
+from fengshen_tpu.fleet.router import (BROKEN, DRAINING, HEALTHY,
+                                       FleetConfig, FleetRouter,
+                                       Replica, TransportError,
+                                       UrllibTransport)
+from fengshen_tpu.fleet.server import (build_fleet_server,
+                                       healthz_payload,
+                                       install_router_sigterm)
+
+__all__ = [
+    "BROKEN", "DRAINING", "HEALTHY", "FaultInjectingTransport",
+    "FleetConfig", "FleetFaultPlan", "FleetRouter", "Replica",
+    "TransportError", "UrllibTransport", "build_fleet_server",
+    "healthz_payload", "install_router_sigterm",
+]

@@ -1,0 +1,150 @@
+"""Deterministic fault injection for fleet-router tests.
+
+The trainer's resilience doctrine (`resilience/faults.py`): chaos that
+only fires on a real pod is untestable chaos — every failure mode the
+router must survive is injected at exact, deterministic coordinates.
+Here the coordinate system is **generate-request indices**: the plan
+wraps the router's HTTP transport and counts `POST /api/*` attempts in
+dispatch order (0-based; health/stats polls are never counted), so
+"kill replica r1 just before the 5th generate request" means exactly
+that, every run, regardless of wall clock.
+
+Fault kinds (each keyed `{request_index: replica_name}`):
+
+- ``kill_at``: from the moment attempt `index` is dispatched, the
+  replica is DEAD — every request and poll to it raises
+  `TransportError(sent=False)` (connect refused: the process is gone).
+  If attempt `index` itself targets the replica, it fails too.
+- ``wedge_at``: like ``kill_at`` but the process is WEDGED, not gone:
+  requests raise `TransportError(sent=True)` (hang-until-timeout — the
+  replica may still be executing), the dangerous failure mode that
+  exercises the idempotent-safe retry rule.
+- ``error_503_at``: that ONE attempt, if it targets the replica,
+  answers `503 {"error": "injected 503"}` — a transient warming/
+  draining window.
+- ``slow_at``: that one attempt is delayed by ``slow_s`` (through the
+  injectable sleep) before proceeding normally — tail-latency, not
+  failure.
+
+``fired`` records every (kind, index, replica) that actually triggered,
+so tests can pin that the injected fault count matches the router's
+`fstpu_fleet_retries_total` exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fengshen_tpu.fleet.router import TransportError
+
+
+class FleetFaultPlan:
+    """WHEN faults fire, in deterministic request coordinates."""
+
+    def __init__(self, kill_at: Optional[Dict[int, str]] = None,
+                 wedge_at: Optional[Dict[int, str]] = None,
+                 error_503_at: Optional[Dict[int, str]] = None,
+                 slow_at: Optional[Dict[int, str]] = None,
+                 slow_s: float = 0.05):
+        self.kill_at = {int(k): str(v)
+                        for k, v in (kill_at or {}).items()}
+        self.wedge_at = {int(k): str(v)
+                         for k, v in (wedge_at or {}).items()}
+        self.error_503_at = {int(k): str(v)
+                             for k, v in (error_503_at or {}).items()}
+        self.slow_at = {int(k): str(v)
+                        for k, v in (slow_at or {}).items()}
+        self.slow_s = slow_s
+        self.fired: List[Tuple[str, int, str]] = []
+        self._lock = threading.Lock()
+        self._index = 0
+        self._dead: Dict[str, str] = {}    # name -> "kill" | "wedge"
+
+    @property
+    def fault_count(self) -> int:
+        """Faults that actually fired (the retries-must-match pin)."""
+        return len(self.fired)
+
+    def wrap(self, transport, sleep: Callable[[float], None] = time.sleep
+             ) -> "FaultInjectingTransport":
+        return FaultInjectingTransport(transport, self, sleep)
+
+    # -- internals (called by the wrapper under self._lock) -----------
+    def _advance_locked(self, replica: str) -> Optional[str]:
+        """Account one generate attempt targeting `replica`; returns
+        the one-shot fault to apply to THIS attempt (or None)."""
+        idx = self._index
+        self._index += 1
+        for at, name in self.kill_at.items():
+            if at <= idx and name not in self._dead:
+                self._dead[name] = "kill"
+        for at, name in self.wedge_at.items():
+            if at <= idx and name not in self._dead:
+                self._dead[name] = "wedge"
+        if self.error_503_at.get(idx) == replica:
+            self.fired.append(("error_503", idx, replica))
+            return "error_503"
+        if self.slow_at.get(idx) == replica:
+            self.fired.append(("slow", idx, replica))
+            return "slow"
+        return None
+
+    def _dead_mode_locked(self, replica: str,
+                          idx: Optional[int]) -> Optional[str]:
+        mode = self._dead.get(replica)
+        if mode is not None and idx is not None:
+            self.fired.append((mode, idx, replica))
+        return mode
+
+
+class FaultInjectingTransport:
+    """Transport wrapper applying a `FleetFaultPlan`. Generate attempts
+    (`POST` to an `/api/` path) advance the request index; polls only
+    observe the dead-set (a killed replica stops answering /healthz
+    too, which is exactly how the router's sweep notices it)."""
+
+    def __init__(self, inner, plan: FleetFaultPlan,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.plan = plan
+        self._sleep = sleep
+        self._names: Dict[str, str] = {}   # base_url -> replica name
+
+    def bind(self, router) -> "FaultInjectingTransport":
+        """Learn base_url -> name from the router's replica set (names
+        default to host:port, matching the plan's coordinates)."""
+        for rep in router.replicas:
+            self._names[rep.base_url.rstrip("/")] = rep.name
+        return self
+
+    def _name(self, base_url: str) -> str:
+        key = base_url.rstrip("/")
+        return self._names.get(key, key.split("://", 1)[-1])
+
+    def request(self, base_url, method, path, body, timeout_s):
+        name = self._name(base_url)
+        is_generate = method.upper() == "POST" and \
+            path.startswith("/api/")
+        with self.plan._lock:
+            if is_generate:
+                one_shot = self.plan._advance_locked(name)
+                idx = self.plan._index - 1
+                mode = self.plan._dead_mode_locked(name, idx)
+            else:
+                one_shot = None
+                mode = self.plan._dead_mode_locked(name, None)
+        if mode == "kill":
+            raise TransportError(
+                f"injected kill: connect to {name} refused", sent=False)
+        if mode == "wedge":
+            raise TransportError(
+                f"injected wedge: request to {name} timed out",
+                sent=True)
+        if one_shot == "error_503":
+            return 503, {"error": "injected 503", "reason": "injected"}
+        if one_shot == "slow":
+            self._sleep(self.plan.slow_s)
+        return self.inner.request(base_url, method, path, body,
+                                  timeout_s)

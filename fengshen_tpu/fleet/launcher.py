@@ -1,0 +1,76 @@
+"""Local fleet launcher: spawn N stdlib api replicas from one config.
+
+`python -m fengshen_tpu.fleet --spawn N --config api.json` (the
+`make serve-fleet` path) takes the SAME config file a single replica
+runs with (`api/main.py`), writes N derived copies whose `SERVER.port`
+is `base_port + i`, and starts each as a
+`python -m fengshen_tpu.api.main --config <derived>` subprocess. The
+router then fronts them; its health gating keeps traffic off each
+replica until its warmup 503 window closes, and its drain handler
+SIGTERMs the children (each drains gracefully, docs/fleet.md "Drain
+runbook") once the router itself has drained.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+from typing import List, Tuple
+
+
+def spawn_replicas(config_path: str, n: int, base_port: int,
+                   host: str = "127.0.0.1",
+                   workdir: str = None) -> Tuple[List[str], list]:
+    """Write derived configs and start N replica subprocesses. Returns
+    (targets, processes) where targets are "host:port" strings for
+    `FleetConfig.replicas`. Replicas inherit this process's env (so
+    `JAX_PLATFORMS` etc. flow through) plus `FSTPU_API_SERVER=stdlib`:
+    only the stdlib server path has the SIGTERM graceful drain the
+    fleet's rolling restarts depend on — a uvicorn replica would die
+    with its in-flight requests instead of draining."""
+    if n < 1:
+        raise ValueError("need at least one replica")
+    with open(config_path) as f:
+        raw = json.load(f)
+    workdir = workdir or tempfile.mkdtemp(prefix="fstpu_fleet_")
+    targets, procs = [], []
+    for i in range(n):
+        cfg = json.loads(json.dumps(raw))    # deep copy
+        server = cfg.setdefault("SERVER", {})
+        port = base_port + i
+        server["host"] = host
+        server["port"] = port
+        # per-replica dump dirs: two replicas sharing one flight-
+        # recorder directory would interleave their bundle sequences
+        server["dump_dir"] = os.path.join(
+            workdir, f"replica{i}_dumps")
+        path = os.path.join(workdir, f"replica{i}.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "fengshen_tpu.api.main",
+             "--config", path],
+            env={**os.environ, "FSTPU_API_SERVER": "stdlib"}))
+        targets.append(f"{host}:{port}")
+    return targets, procs
+
+
+def terminate_replicas(procs, timeout_s: float = 30.0) -> None:
+    """SIGTERM every replica (graceful drain), then wait; SIGKILL any
+    that outlive the timeout."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()

@@ -1,0 +1,714 @@
+"""Fleet router core: health-gated least-occupancy load balancing over
+N api replicas, with retries, circuit breaking, and graceful drain.
+
+One serving replica (`fengshen_tpu/api/main.py` + the continuous
+engine) is a single point of failure: a wedged tick, a restart, or a
+warmup window is a full outage. The router composes replicas so the
+fleet survives every single-replica failure mode (docs/fleet.md):
+
+- **placement**: generate requests go to the IN-rotation replica with
+  the least slot occupancy, computed from each replica's polled
+  `/stats` (`slots_active + queue_depth` over `num_slots`) plus the
+  router's own not-yet-visible in-flight count; ties break by replica
+  index, so placement is deterministic under a deterministic clock;
+- **health gating**: a background poll hits every replica's
+  `/healthz`; a replica is OUT while it answers anything but 200
+  (warming, draining, unreachable) and is eased back in only after
+  `recovery_probes` consecutive healthy polls — a replica that flaps
+  must not immediately re-absorb traffic;
+- **retries**: a connect failure or a 5xx answer costs one bounded
+  retry on a DIFFERENT replica after a jittered exponential backoff.
+  A failure that happened after the request may have reached the
+  replica (timeout, reset mid-response) is only retried because the
+  routed surface is idempotent-safe: never-streamed greedy generation
+  carrying a router-assigned `request_id` that the replica dedupes or
+  rejects (`DuplicateRequest` → 409, see serving/engine.py). With
+  `retry_maybe_executed=False` such failures return 502 instead;
+- **circuit breaker**: `breaker_threshold` consecutive failures open
+  a per-replica breaker for `breaker_cooldown_s`; afterwards exactly
+  one half-open probe request (or `recovery_probes` healthy polls)
+  may close it — a black-holed replica costs one failed attempt per
+  cooldown window, not one per request;
+- **graceful drain**: `drain()` stops admission (`route_generate` and
+  the server's `/healthz` answer 503 `{"reason": "draining"}`) while
+  in-flight requests finish against their replica;
+- **loud degradation**: only when ZERO replicas are in rotation does
+  the fleet answer 503, with a structured reason JSON naming every
+  replica's state and last error — never a bare empty 503.
+
+Everything here is pure stdlib (no jax): the router must start on a
+host that has no accelerator runtime at all. Clock, sleep, and the
+HTTP transport are injectable, and the backoff jitter comes from a
+seeded `random.Random`, so every behavior above is exercisable by
+deterministic tests (`fleet/faults.py` injects kills/wedges/503s/slow
+responses at exact request indices through the same transport seam).
+
+Router-side telemetry lives in its OWN registry (rendered by the
+server's `/metrics`): `fstpu_fleet_replicas{state}`,
+`fstpu_fleet_retries_total{reason}`,
+`fstpu_fleet_request_seconds{outcome}`, plus requests/breaker-open
+counters. `fleet_state()` is the `/fleet` debug JSON — deterministic
+(sorted, rounded) given a deterministic clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from fengshen_tpu.observability import MetricsRegistry
+
+# replica rotation states (the fstpu_fleet_replicas{state} label set):
+# "draining" covers every out-by-healthz condition — warming, an
+# orderly drain, or unreachable-before-the-breaker-opens — the
+# per-replica `reason` in /fleet tells them apart
+HEALTHY, DRAINING, BROKEN = "healthy", "draining", "broken"
+
+#: request-seconds outcome labels
+OUTCOME_OK = "ok"                      # 2xx from a replica
+OUTCOME_CLIENT_ERROR = "client_error"  # 4xx passed through
+OUTCOME_ERROR = "error"                # retries exhausted on failures
+OUTCOME_UNAVAILABLE = "unavailable"    # zero replicas in rotation
+OUTCOME_DRAINING = "draining"          # router refused: drain started
+
+
+class TransportError(Exception):
+    """A request that produced no HTTP status at all (connect refused,
+    DNS failure, timeout, connection reset). `sent` is False only when
+    the transport can PROVE the request never reached the replica
+    (e.g. connect refused) — retrying such a request is always safe.
+    `sent=True` (the conservative default) means the replica may still
+    be executing it, so a retry is only safe for idempotent requests.
+    """
+
+    def __init__(self, message: str, sent: bool = True):
+        super().__init__(message)
+        self.sent = sent
+
+
+class UrllibTransport:
+    """Default HTTP transport (stdlib urllib). Returns (status, body
+    dict) for ANY HTTP status — an HTTP error response is a routing
+    signal, not an exception — and raises TransportError when no
+    status came back."""
+
+    def request(self, base_url: str, method: str, path: str,
+                body: Optional[dict], timeout_s: float
+                ) -> Tuple[int, dict]:
+        url = base_url.rstrip("/") + path
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, _parse_json(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _parse_json(e.read())
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", e)
+            # connect refused = the kernel rejected the SYN: the
+            # request provably never reached a server process
+            sent = not isinstance(reason, ConnectionRefusedError)
+            raise TransportError(str(e), sent=sent) from e
+        except (TimeoutError, ConnectionError, OSError) as e:
+            sent = not isinstance(e, ConnectionRefusedError)
+            raise TransportError(str(e), sent=sent) from e
+
+
+def _parse_json(raw: bytes) -> dict:
+    try:
+        out = json.loads(raw)
+        return out if isinstance(out, dict) else {}
+    except (ValueError, UnicodeDecodeError):
+        return {}
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Router tuning knobs (docs/fleet.md has sizing guidance)."""
+
+    replicas: Sequence[str] = ()        # "host:port" or full base URLs
+    task: str = "text_generation"       # the proxied /api/<task> route
+    request_timeout_s: float = 120.0    # per-attempt timeout
+    poll_interval_s: float = 0.5        # health/stats sweep period
+    poll_timeout_s: float = 2.0         # per-poll-request timeout
+    max_retries: int = 2                # extra attempts after the first
+    backoff_base_s: float = 0.05        # first retry's nominal delay
+    backoff_max_s: float = 2.0          # exponential backoff ceiling
+    breaker_threshold: int = 3          # consecutive failures to open
+    breaker_cooldown_s: float = 5.0     # open time before half-open
+    recovery_probes: int = 2            # healthy polls to re-enter
+    retry_maybe_executed: bool = True   # see module docstring: the
+    #   routed surface is idempotent-safe (greedy, never streamed,
+    #   request-id deduped), so maybe-executed failures retry too
+    seed: int = 0                       # backoff-jitter rng seed
+
+    def __post_init__(self):
+        if not self.replicas:
+            raise ValueError("FleetConfig needs at least one replica")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.recovery_probes < 1:
+            raise ValueError("recovery_probes must be >= 1")
+
+
+class Replica:
+    """Per-replica rotation state. All mutation happens under the
+    router's lock; reads for /fleet snapshot under the same lock."""
+
+    def __init__(self, index: int, target: str):
+        self.index = index
+        self.name = target if "://" not in target else \
+            target.split("://", 1)[1].rstrip("/")
+        self.base_url = target if "://" in target \
+            else f"http://{target}"
+        # out of rotation until the first healthy poll: routing to an
+        # unprobed replica would race its warmup window
+        self.state = DRAINING
+        self.reason: Optional[str] = "unprobed"
+        self.consecutive_failures = 0
+        self.healthy_streak = 0
+        self.breaker_open_until: Optional[float] = None
+        self.half_open_inflight = False
+        self.last_error: Optional[dict] = None   # {"detail", "at"}
+        self.in_flight = 0
+        self.slots_active = 0
+        self.num_slots = 0
+        self.queue_depth = 0
+        self.draining_reported = False
+
+    def occupancy(self) -> float:
+        """Polled load plus the router's own not-yet-visible dispatches
+        (each charged as one slot's worth of work)."""
+        denom = max(self.num_slots, 1)
+        return (self.slots_active + self.queue_depth
+                + self.in_flight) / denom
+
+
+class FleetRouter:
+    """The routing core. HTTP-free by itself: `fleet/server.py` wraps
+    it in the router process's own stdlib server, tests drive
+    `route_generate()` / `poll_once()` directly."""
+
+    def __init__(self, config: FleetConfig,
+                 transport: Any = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 log: Optional[Callable[[dict], None]] = None):
+        self.config = config
+        self.transport = transport if transport is not None \
+            else UrllibTransport()
+        self._clock = clock
+        self._sleep = sleep
+        self._log = log or (lambda entry: None)
+        self._lock = threading.Lock()
+        self._rng = random.Random(config.seed)
+        self.replicas: List[Replica] = [
+            Replica(i, t) for i, t in enumerate(config.replicas)]
+        if len({r.base_url for r in self.replicas}) != len(self.replicas):
+            raise ValueError("duplicate replica targets in FleetConfig")
+        self._draining = False
+        self._seq = 0
+        # per-process token in assigned request ids: a restarted router
+        # must never reuse a previous router's id while a replica still
+        # holds it live (the dedupe would 409 a brand-new request)
+        self._id_token = uuid.uuid4().hex[:8]
+        self._t0 = clock()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+
+        r = self.registry = MetricsRegistry()
+        self._g_replicas = r.gauge(
+            "fstpu_fleet_replicas",
+            "replicas per rotation state", labelnames=("state",))
+        self._c_retries = r.counter(
+            "fstpu_fleet_retries_total",
+            "generate retries by cause of the failed attempt",
+            labelnames=("reason",))
+        self._h_request = r.histogram(
+            "fstpu_fleet_request_seconds",
+            "fleet-level generate wall seconds by outcome",
+            labelnames=("outcome",))
+        self._c_requests = r.counter(
+            "fstpu_fleet_requests_total",
+            "generate requests admitted by the router")
+        self._c_breaker = r.counter(
+            "fstpu_fleet_breaker_opens_total",
+            "circuit-breaker open transitions", labelnames=("replica",))
+        self._c_polls = r.counter(
+            "fstpu_fleet_polls_total", "health/stats poll sweeps")
+        self._update_state_gauge_locked()
+
+    # ---- health polling ---------------------------------------------
+
+    def start_polling(self) -> None:
+        """Background health/stats sweeps every poll_interval_s."""
+        if self._poll_thread is not None:
+            return
+        self._poll_stop.clear()
+
+        def loop():
+            while not self._poll_stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — a poll bug must
+                    # not kill the sweeper and silently freeze rotation
+                    # state; log and keep sweeping
+                    self._log({"event": "fleet_poll_error",
+                               "error": str(e)[:200]})
+                self._poll_stop.wait(self.config.poll_interval_s)
+
+        self._poll_thread = threading.Thread(
+            target=loop, daemon=True, name="fstpu-fleet-poll")
+        self._poll_thread.start()
+
+    def stop(self) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+            self._poll_thread = None
+
+    def poll_once(self) -> None:
+        """One sweep: /healthz (rotation gating) then, for in-rotation
+        replicas, /stats (occupancy). Replicas are polled on PARALLEL
+        threads joined before returning — a black-holed replica costs
+        one poll_timeout_s, not poll_timeout_s x dead_replicas of
+        staleness for the healthy ones. Per-replica outcomes are
+        deterministic given a deterministic transport (each replica's
+        state is touched only by its own poll), which is what the
+        fault-plan tests rely on when calling this directly."""
+        self._c_polls.inc()
+        if len(self.replicas) == 1:
+            self._poll_replica(self.replicas[0])
+            return
+        threads = [threading.Thread(target=self._poll_replica,
+                                    args=(rep,), daemon=True)
+                   for rep in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _poll_replica(self, rep: Replica) -> None:
+        try:
+            code, body = self.transport.request(
+                rep.base_url, "GET", "/healthz", None,
+                self.config.poll_timeout_s)
+        except TransportError as e:
+            self._note_poll_down(rep, "unreachable", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — a transport bug on one
+            # replica's poll thread must not skip the rest of the sweep
+            self._log({"event": "fleet_poll_error",
+                       "replica": rep.name, "error": str(e)[:200]})
+            return
+        if code != 200:
+            reason = str(body.get("reason") or body.get("status")
+                         or f"http_{code}")
+            self._note_poll_down(rep, reason, f"healthz {code}",
+                                 orderly=reason in ("draining",
+                                                    "warmup",
+                                                    "warming"))
+            return
+        # healthz is 200 — refresh load numbers BEFORE deciding the
+        # state: engine.begin_drain() without the API-layer event flips
+        # /stats `draining` first, and the router must route around the
+        # replica on that signal alone (serving/engine.py begin_drain)
+        fresh_draining = False
+        try:
+            scode, stats = self.transport.request(
+                rep.base_url, "GET", "/stats", None,
+                self.config.poll_timeout_s)
+        except Exception:  # noqa: BLE001 — healthz just answered;
+            scode = None   # keep the stale load numbers
+        if scode == 200:
+            with self._lock:
+                rep.slots_active = int(
+                    stats.get("slots_active") or 0)
+                rep.num_slots = int(stats.get("num_slots") or 0)
+                rep.queue_depth = int(
+                    stats.get("queue_depth") or 0)
+                rep.draining_reported = fresh_draining = bool(
+                    stats.get("draining") or False)
+        if fresh_draining:
+            self._note_poll_down(rep, "draining", "stats draining",
+                                 orderly=True)
+        else:
+            self._note_poll_healthy(rep)
+
+    def _note_poll_healthy(self, rep: Replica) -> None:
+        with self._lock:
+            now = self._clock()
+            if rep.state == BROKEN:
+                # healthy polls past the cooldown count as half-open
+                # probes: recovery_probes of them close the breaker
+                # without risking a real request
+                if (rep.breaker_open_until is not None
+                        and now < rep.breaker_open_until):
+                    return
+                rep.healthy_streak += 1
+                if rep.healthy_streak >= self.config.recovery_probes:
+                    self._close_breaker_locked(rep)
+                return
+            if rep.state == HEALTHY:
+                rep.healthy_streak = 0
+                return
+            # DRAINING → eased re-entry
+            rep.healthy_streak += 1
+            if rep.healthy_streak >= self.config.recovery_probes:
+                rep.state = HEALTHY
+                rep.reason = None
+                rep.healthy_streak = 0
+                self._log({"event": "fleet_replica_in",
+                           "replica": rep.name})
+                self._update_state_gauge_locked()
+
+    def _note_poll_down(self, rep: Replica, reason: str, detail: str,
+                        orderly: bool = False) -> None:
+        with self._lock:
+            rep.healthy_streak = 0
+            rep.last_error = {"detail": detail[:200],
+                              "at": self._clock()}
+            if rep.state == BROKEN:
+                return          # the breaker already holds it out
+            if not orderly:
+                # an unreachable replica found by polling counts toward
+                # the breaker exactly like a failed request — a dead
+                # process must not need real traffic to trip it
+                self._count_failure_locked(rep, f"poll_{reason}")
+                if rep.state == BROKEN:
+                    return
+            if rep.state != DRAINING or rep.reason != reason:
+                self._log({"event": "fleet_replica_out",
+                           "replica": rep.name, "reason": reason})
+            rep.state = DRAINING
+            rep.reason = reason
+            self._update_state_gauge_locked()
+
+    # ---- breaker ----------------------------------------------------
+
+    def _count_failure_locked(self, rep: Replica, reason: str) -> None:
+        rep.consecutive_failures += 1
+        if (rep.state != BROKEN and rep.consecutive_failures
+                >= self.config.breaker_threshold):
+            rep.state = BROKEN
+            rep.reason = "breaker_open"
+            rep.breaker_open_until = (self._clock()
+                                      + self.config.breaker_cooldown_s)
+            rep.healthy_streak = 0
+            rep.half_open_inflight = False
+            self._c_breaker.labels(rep.name).inc()
+            self._log({"event": "fleet_breaker_open",
+                       "replica": rep.name, "reason": reason,
+                       "consecutive_failures":
+                           rep.consecutive_failures})
+            self._update_state_gauge_locked()
+
+    def _close_breaker_locked(self, rep: Replica) -> None:
+        rep.state = HEALTHY
+        rep.reason = None
+        rep.consecutive_failures = 0
+        rep.breaker_open_until = None
+        rep.half_open_inflight = False
+        rep.healthy_streak = 0
+        self._log({"event": "fleet_breaker_close", "replica": rep.name})
+        self._update_state_gauge_locked()
+
+    def _update_state_gauge_locked(self) -> None:
+        counts = {HEALTHY: 0, DRAINING: 0, BROKEN: 0}
+        for rep in self.replicas:
+            counts[rep.state] += 1
+        for state, n in counts.items():
+            self._g_replicas.labels(state).set(n)
+
+    # ---- placement --------------------------------------------------
+
+    def _pick_locked(self, exclude: Sequence[Replica]
+                     ) -> Optional[Replica]:
+        now = self._clock()
+        best: Optional[Replica] = None
+        for rep in self.replicas:
+            if rep in exclude:
+                continue
+            if rep.state == HEALTHY:
+                if best is None or rep.occupancy() < best.occupancy():
+                    best = rep
+        if best is not None:
+            return best
+        # no healthy candidate: offer ONE half-open probe to a broken
+        # replica whose cooldown expired (lowest index — deterministic)
+        for rep in self.replicas:
+            if (rep not in exclude and rep.state == BROKEN
+                    and not rep.half_open_inflight
+                    and rep.breaker_open_until is not None
+                    and now >= rep.breaker_open_until):
+                rep.half_open_inflight = True
+                return rep
+        return None
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == HEALTHY)
+
+    def in_flight_total(self) -> int:
+        with self._lock:
+            return sum(r.in_flight for r in self.replicas)
+
+    # ---- drain ------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting; in-flight requests keep their replica."""
+        self._draining = True
+        self._log({"event": "fleet_drain",
+                   "in_flight": self.in_flight_total()})
+
+    def wait_drained(self, timeout_s: float = 30.0,
+                     poll_s: float = 0.05) -> bool:
+        """True once every in-flight request finished (or immediately
+        if none); False on timeout."""
+        deadline = self._clock() + timeout_s
+        while self.in_flight_total() > 0:
+            if self._clock() >= deadline:
+                return False
+            self._sleep(poll_s)
+        return True
+
+    # ---- the request path -------------------------------------------
+
+    def _backoff_s(self, attempt: int) -> float:
+        """Jittered exponential backoff before retry `attempt` (1-based):
+        nominal base*2^(attempt-1) capped at backoff_max_s, scaled by a
+        seeded-uniform 0.5..1.0 jitter so synchronized clients spread
+        out while tests stay deterministic."""
+        nominal = min(self.config.backoff_base_s * (2 ** (attempt - 1)),
+                      self.config.backoff_max_s)
+        with self._lock:
+            jitter = 0.5 + self._rng.random() / 2.0
+        return nominal * jitter
+
+    def _finish_attempt(self, rep: Replica, ok: bool,
+                        reason: Optional[str] = None,
+                        detail: str = "") -> None:
+        with self._lock:
+            rep.in_flight = max(rep.in_flight - 1, 0)
+            if ok:
+                rep.consecutive_failures = 0
+                if rep.state == BROKEN:
+                    # the half-open probe came back clean
+                    self._close_breaker_locked(rep)
+                return
+            rep.half_open_inflight = False
+            rep.last_error = {"detail": detail[:200],
+                              "at": self._clock()}
+            if rep.state == BROKEN:
+                # a failed half-open probe re-opens the window
+                rep.breaker_open_until = (
+                    self._clock() + self.config.breaker_cooldown_s)
+                return
+            self._count_failure_locked(rep, reason or "request")
+
+    def _mark_out_locked(self, rep: Replica, reason: str) -> None:
+        if rep.state == HEALTHY:
+            rep.state = DRAINING
+            rep.reason = reason
+            rep.healthy_streak = 0
+            self._log({"event": "fleet_replica_out",
+                       "replica": rep.name, "reason": reason})
+            self._update_state_gauge_locked()
+
+    def _no_replicas_payload(self) -> dict:
+        """The loud structured degradation body: the fleet only ever
+        503s with a reason naming every replica's state."""
+        with self._lock:
+            now = self._clock()
+            states = {}
+            for rep in self.replicas:
+                err = None
+                if rep.last_error is not None:
+                    err = {"detail": rep.last_error["detail"],
+                           "age_s": round(now - rep.last_error["at"], 3)}
+                states[rep.name] = {"state": rep.state,
+                                    "reason": rep.reason,
+                                    "last_error": err}
+        return {"error": "no healthy replicas",
+                "reason": "no_healthy_replicas",
+                "replicas": states}
+
+    def route_generate(self, body: dict) -> Tuple[int, dict]:
+        """Proxy one generate request: pick → attempt → (on connect/5xx
+        failure) retry on a different replica with jittered backoff.
+        Returns (status, response body) — the server layer writes them
+        verbatim. Never raises."""
+        t0 = time.perf_counter()
+        if self._draining:
+            self._h_request.labels(OUTCOME_DRAINING).observe(
+                time.perf_counter() - t0)
+            return 503, {"error": "router draining",
+                         "reason": "draining"}
+        with self._lock:
+            rid = body.get("request_id")
+            if not rid:
+                rid = f"fleet-{self._id_token}-{self._seq}"
+            self._seq += 1
+        body = dict(body, request_id=str(rid))
+        self._c_requests.inc()
+
+        attempts = self.config.max_retries + 1
+        tried: List[Replica] = []
+        last: Optional[Tuple[int, dict]] = None
+        for attempt in range(attempts):
+            with self._lock:
+                rep = self._pick_locked(tried)
+                if rep is not None:
+                    rep.in_flight += 1
+            if rep is None:
+                break
+            tried.append(rep)
+            path = f"/api/{self.config.task}"
+            try:
+                status, resp = self.transport.request(
+                    rep.base_url, "POST", path, body,
+                    self.config.request_timeout_s)
+            except TransportError as e:
+                reason = "connect" if not e.sent else "timeout"
+                # charge the breaker but leave rotation state to it
+                # (and to the health poll): one flaky connect must not
+                # empty the rotation below breaker_threshold
+                self._finish_attempt(rep, ok=False, reason=reason,
+                                     detail=str(e))
+                last = (502, {"error": f"replica {rep.name}: {e}",
+                              "reason": reason,
+                              "request_id": body["request_id"]})
+                if e.sent and not self.config.retry_maybe_executed:
+                    # the replica may still be executing and the
+                    # deployment opted out of idempotent-safe retries
+                    self._log({"event": "fleet_request_error",
+                               "replica": rep.name, "reason": reason,
+                               "retried": False})
+                    break
+                self._maybe_retry(attempt, attempts, reason, rep)
+                continue
+            if status >= 500:
+                reason = f"http_{status}"
+                # 503 is the replica saying "not me right now"
+                # (draining / warming) — orderly: it leaves rotation
+                # immediately WITHOUT charging the breaker; other 5xx
+                # are real failures that count toward it (rotation is
+                # then the breaker's + the health poll's concern)
+                self._finish_attempt(rep, ok=(status == 503),
+                                     reason=reason,
+                                     detail=f"HTTP {status}")
+                if status == 503:
+                    with self._lock:
+                        self._mark_out_locked(
+                            rep, str(resp.get("reason") or reason))
+                last = (status, resp)
+                self._maybe_retry(attempt, attempts, reason, rep)
+                continue
+            # 2xx/3xx/4xx: final — 4xx is the client's to handle
+            self._finish_attempt(rep, ok=True)
+            outcome = OUTCOME_OK if status < 400 else \
+                OUTCOME_CLIENT_ERROR
+            self._h_request.labels(outcome).observe(
+                time.perf_counter() - t0)
+            if attempt > 0:
+                self._log({"event": "fleet_request_recovered",
+                           "request_id": body["request_id"],
+                           "attempts": attempt + 1,
+                           "replica": rep.name})
+            return status, resp
+
+        dt = time.perf_counter() - t0
+        if last is None:
+            self._h_request.labels(OUTCOME_UNAVAILABLE).observe(dt)
+            return 503, self._no_replicas_payload()
+        self._h_request.labels(OUTCOME_ERROR).observe(dt)
+        status, resp = last
+        self._log({"event": "fleet_request_failed",
+                   "request_id": body["request_id"],
+                   "attempts": len(tried), "status": status})
+        return status, resp
+
+    def _maybe_retry(self, attempt: int, attempts: int, reason: str,
+                     rep: Replica) -> None:
+        """Count + back off for the retry that will follow this failed
+        attempt (only when one WILL follow — an exhausted request is a
+        failure, not a retry)."""
+        if attempt + 1 >= attempts:
+            return
+        self._c_retries.labels(reason).inc()
+        self._log({"event": "fleet_retry", "reason": reason,
+                   "replica": rep.name, "attempt": attempt + 1})
+        self._sleep(self._backoff_s(attempt + 1))
+
+    # ---- introspection ----------------------------------------------
+
+    def fleet_state(self) -> dict:
+        """The `/fleet` debug JSON: per-replica rotation + breaker +
+        occupancy + last error. Deterministic (sorted keys downstream,
+        rounded floats) given a deterministic clock."""
+        with self._lock:
+            now = self._clock()
+            reps = []
+            counts = {HEALTHY: 0, DRAINING: 0, BROKEN: 0}
+            for rep in self.replicas:
+                counts[rep.state] += 1
+                err = None
+                if rep.last_error is not None:
+                    err = {"detail": rep.last_error["detail"],
+                           "age_s": round(now - rep.last_error["at"],
+                                          3)}
+                cooldown = None
+                if rep.breaker_open_until is not None:
+                    cooldown = round(
+                        max(rep.breaker_open_until - now, 0.0), 3)
+                reps.append({
+                    "name": rep.name,
+                    "url": rep.base_url,
+                    "state": rep.state,
+                    "reason": rep.reason,
+                    "breaker": {
+                        "consecutive_failures":
+                            rep.consecutive_failures,
+                        "open": rep.state == BROKEN,
+                        "cooldown_remaining_s": cooldown,
+                        "half_open_inflight": rep.half_open_inflight,
+                    },
+                    "occupancy": {
+                        "slots_active": rep.slots_active,
+                        "num_slots": rep.num_slots,
+                        "queue_depth": rep.queue_depth,
+                        "in_flight": rep.in_flight,
+                        "draining_reported": rep.draining_reported,
+                    },
+                    "last_error": err,
+                })
+            return {
+                "replicas": reps,
+                "healthy": counts[HEALTHY],
+                "draining": counts[DRAINING],
+                "broken": counts[BROKEN],
+                "router_draining": self._draining,
+                "requests_total": int(self._c_requests.value()),
+                "retries_total": self.retries_total(),
+                "uptime_s": round(now - self._t0, 3),
+            }
+
+    def retries_total(self) -> Dict[str, int]:
+        """{reason: count} over fstpu_fleet_retries_total (sorted)."""
+        return {values[0]: int(child.value)
+                for values, child in self._c_retries.children()}

@@ -1,0 +1,161 @@
+"""The router process's own HTTP surface (pure stdlib).
+
+Mirrors the replica server's conventions (`api/main.py`):
+
+- ``POST /api/<task>``: proxied through `FleetRouter.route_generate`
+  (the router adds a `request_id` the replica dedupes — see
+  docs/fleet.md "Retries and idempotency");
+- ``GET /healthz``: 200 `{"ready": true}` iff the router is not
+  draining AND at least one replica is in rotation; otherwise 503 with
+  `{"ready": false, "reason": "draining" | "no_healthy_replicas"}` —
+  the same body contract the replicas answer, so an outer balancer can
+  stack routers;
+- ``GET /metrics``: Prometheus text over the router's own registry
+  (`fstpu_fleet_*`) plus the process-global one;
+- ``GET /fleet``: the per-replica debug JSON (`fleet_state()`).
+
+`install_router_sigterm` wires graceful drain: SIGTERM stops admission
+(healthz flips to draining-503, new generates answer 503), in-flight
+requests finish against their replica, then the server shuts down.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import signal
+import threading
+from typing import Optional
+
+from fengshen_tpu.fleet.router import FleetRouter
+
+
+def healthz_payload(router: FleetRouter) -> tuple:
+    """(code, body) for the router's /healthz."""
+    if router.draining:
+        return 503, {"ready": False, "reason": "draining",
+                     "healthy_replicas": router.healthy_count()}
+    n = router.healthy_count()
+    if n < 1:
+        body = {"ready": False, "reason": "no_healthy_replicas"}
+        # the loud part: name every replica's state, not a bare 503
+        body["replicas"] = {
+            r["name"]: {"state": r["state"], "reason": r["reason"]}
+            for r in router.fleet_state()["replicas"]}
+        return 503, body
+    return 200, {"ready": True, "healthy_replicas": n}
+
+
+def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
+                       port: int = 8080):
+    """ThreadingHTTPServer over the router; `serve_forever()` to run.
+    The returned server carries `.router` and an in-flight counter the
+    drain handler consults."""
+    route_prefix = "/api/"
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload, content_type: str =
+                  "application/json") -> None:
+            body = payload if isinstance(payload, bytes) else \
+                json.dumps(payload, ensure_ascii=False,
+                           sort_keys=True).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                code, body = healthz_payload(router)
+                self._send(code, body)
+            elif self.path == "/fleet":
+                self._send(200, router.fleet_state())
+            elif self.path == "/metrics":
+                from fengshen_tpu.observability import (
+                    CONTENT_TYPE_LATEST, get_registry,
+                    render_prometheus)
+                text = render_prometheus(get_registry(),
+                                         router.registry)
+                self._send(200, text.encode(), CONTENT_TYPE_LATEST)
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if not self.path.startswith(route_prefix):
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._send(422, {"error": f"invalid json: {e}"})
+                return
+            if "input_text" not in req:
+                self._send(422, {"error": "input_text required"})
+                return
+            code, body = router.route_generate(req)
+            self._send(code, body)
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    server.router = router
+    return server
+
+
+def install_router_sigterm(router: FleetRouter, server,
+                           drain_timeout_s: float = 30.0,
+                           on_drained=None) -> bool:
+    """SIGTERM → drain → (in-flight finish) → server shutdown.
+    Deliberately REPLACES (does not chain) any prior SIGTERM handler,
+    exactly like the replica side's `install_drain_handler`: the
+    repo's flight-recorder handler re-delivers the default disposition
+    after dumping — immediate death — which is what a drain must
+    prevent. A second SIGTERM while a drain is underway is a no-op.
+    Returns False off the main thread."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def handler(signum, frame):
+        if router.draining:
+            return      # second SIGTERM: drain already underway
+        router.drain()
+
+        def waiter():
+            router.wait_drained(timeout_s=drain_timeout_s)
+            router.stop()
+            if on_drained is not None:
+                try:
+                    on_drained()
+                except Exception:  # noqa: BLE001 — the shutdown path
+                    # must reach server.shutdown() regardless
+                    pass
+            server.shutdown()
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="fstpu-fleet-drain").start()
+
+    signal.signal(signal.SIGTERM, handler)
+    return True
+
+
+def serve(router: FleetRouter, host: str, port: int,
+          drain_timeout_s: float = 30.0,
+          on_drained=None) -> None:
+    """Blocking entry: poll, install drain, serve until shutdown."""
+    server = build_fleet_server(router, host, port)
+    router.start_polling()
+    install_router_sigterm(router, server,
+                           drain_timeout_s=drain_timeout_s,
+                           on_drained=on_drained)
+    bound = server.server_address
+    print(f"[fleet] router on {bound[0]}:{bound[1]} over "
+          f"{len(router.replicas)} replica(s): "
+          f"{', '.join(r.name for r in router.replicas)}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        router.stop()

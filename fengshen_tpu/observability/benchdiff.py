@@ -24,10 +24,12 @@ optionally "mfu", "degraded", ...}). The comparator:
 - flags ``regression`` / ``improvement`` when |delta| exceeds
   ``--threshold`` (relative), ``flat`` otherwise, and ``incomparable``
   when exactly one side is a degraded CPU-fallback number (a rescue
-  row must never read as a hardware regression) or when the two sides
+  row must never read as a hardware regression), when the two sides
   ran at different memory placements (the ``offload`` +
   ``memory_kind`` row fields, docs/offload.md — an offloaded-update
-  row is a different program from a device-resident one);
+  row is a different program from a device-resident one), or when two
+  fleet rows (docs/fleet.md) carry different ``replicas`` counts — a
+  3-replica aggregate must never diff against a 2-replica one;
 - prints a deterministic report (sorted rounds, sorted metrics,
   ``sort_keys`` JSON) and an overall verdict: ``REGRESSED`` /
   ``OK`` / ``NO_SIGNAL`` (no parseable rounds at all — five wedges).
@@ -104,6 +106,18 @@ def _placement(row: dict) -> str:
     return f"{level}:{kind}" if level != "none" else "none"
 
 
+def _identity(row: dict) -> str:
+    """The full comparison identity of a BENCH row: memory placement
+    plus — for fleet rows (docs/fleet.md) — the replica count. Two
+    fleet rounds at different N measure different deployments exactly
+    like two offload rounds at different placements measure different
+    programs; they diff as ``incomparable``, never regression/flat."""
+    parts = [_placement(row)]
+    if "replicas" in row:
+        parts.append(f"replicas={int(row['replicas'])}")
+    return "|".join(parts)
+
+
 def _compare(metric: str, round_n: int, value: float, degraded: bool,
              placement: str, prev_round, prev_value: float,
              prev_degraded: bool, prev_placement: str,
@@ -167,7 +181,7 @@ def diff_rounds(rounds: List[Tuple[int, str, dict]],
             metric = str(row["metric"])
             value = float(row["value"])
             degraded = bool(row.get("degraded"))
-            placement = _placement(row)
+            placement = _identity(row)
             prev = last_seen.get(metric)
             if prev is not None:
                 comparisons.append(_compare(

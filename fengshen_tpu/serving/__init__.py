@@ -11,6 +11,7 @@ from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
 from fengshen_tpu.serving.engine import (CANCELLED, EXPIRED, FINISHED,
                                          QUEUED, REJECTED, RUNNING,
                                          ContinuousBatchingEngine,
+                                         Draining, DuplicateRequest,
                                          EngineConfig, PromptTooLong,
                                          QueueFull, Request)
 from fengshen_tpu.serving.metrics import EngineMetrics
@@ -21,7 +22,8 @@ from fengshen_tpu.serving.paged_cache import (NULL_BLOCK, BlockAllocator,
 
 __all__ = [
     "BlockAllocator", "BucketLadder", "DEFAULT_BUCKETS",
-    "ContinuousBatchingEngine", "EngineConfig", "EngineMetrics",
+    "ContinuousBatchingEngine", "Draining", "DuplicateRequest",
+    "EngineConfig", "EngineMetrics",
     "NULL_BLOCK", "PromptTooLong", "QueueFull", "Request",
     "assign_paged", "assign_slot", "assign_slot_quantized",
     "init_pool_cache", "init_slot_cache", "reset_free_slots",

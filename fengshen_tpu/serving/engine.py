@@ -96,6 +96,19 @@ class PromptTooLong(Exception):
     """Prompt outgrows the bucket ladder or the cache headroom."""
 
 
+class Draining(Exception):
+    """Engine is draining (begin_drain): in-flight work finishes, new
+    submissions are refused — API layer maps this to 503 with reason
+    "draining" so a fleet router re-places the request."""
+
+
+class DuplicateRequest(Exception):
+    """An explicit request_id matching a live (queued/running) request.
+    The replica-side half of the fleet router's idempotent-safe retry
+    contract (docs/fleet.md): a retried id must never execute twice
+    concurrently on one replica — API layer maps this to 409."""
+
+
 # request lifecycle states
 QUEUED, RUNNING, FINISHED, CANCELLED, EXPIRED, REJECTED = (
     "queued", "running", "finished", "cancelled", "expired", "rejected")
@@ -338,6 +351,7 @@ class ContinuousBatchingEngine:
         self._slot_req: list[Optional[Request]] = [None] * S
 
         self._queue: deque[Request] = deque()
+        self._draining = False
         self._cv = threading.Condition()
         self._rng = jax.random.PRNGKey(config.seed)
         self._zero_key = jax.random.PRNGKey(0)
@@ -551,6 +565,12 @@ class ContinuousBatchingEngine:
         PromptTooLong (no bucket / no cache headroom). `deadline_s` is
         seconds from now; an expired request frees its slot and
         finishes with reason "deadline"."""
+        if self._draining:
+            # checked again under the lock below; this early exit just
+            # spares rejected requests the bucket/blocks math
+            self.metrics.count("rejected_draining")
+            self._log({"event": "serving_reject", "reason": "draining"})
+            raise Draining("engine is draining; not admitting")
         if max_new_tokens is not None and int(max_new_tokens) < 1:
             # a bad request field, not a too-long prompt — the API
             # layer maps this to 422, not 413
@@ -611,6 +631,29 @@ class ContinuousBatchingEngine:
                       None if deadline_s is None else now + deadline_s,
                       now)
         with span("serving/admit"), self._cv:
+            if self._draining:
+                self.metrics.count("rejected_draining")
+                self._log({"event": "serving_reject",
+                           "reason": "draining"})
+                raise Draining("engine is draining; not admitting")
+            if request_id is not None:
+                # idempotent-safe retry contract (docs/fleet.md): an
+                # explicit id may never run twice concurrently here —
+                # a router retrying a request this replica may still
+                # be executing must be REJECTED, not doubled. (No
+                # debug-ring entry: the ORIGINAL request owns the id
+                # there; the counter + log line carry the 409s.)
+                for live in list(self._queue) + [
+                        r for r in self._slot_req if r is not None]:
+                    if live.request_id == request_id:
+                        self.metrics.count("rejected_duplicate")
+                        self._log({"event": "serving_reject",
+                                   "reason": "duplicate_request_id",
+                                   "request_id": request_id,
+                                   "live_state": live.state})
+                        raise DuplicateRequest(
+                            f"request_id {request_id!r} is already "
+                            f"{live.state} on this replica")
             if len(self._queue) >= self.config.max_queue:
                 self.metrics.count("rejected_queue_full")
                 self._log({"event": "serving_reject",
@@ -1015,6 +1058,30 @@ class ContinuousBatchingEngine:
             self._thread.join(timeout=5.0)
             self._thread = None
 
+    # ---- drain (docs/fleet.md "Drain runbook") ----------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting (submit raises `Draining`); queued + running
+        requests finish normally. `/stats` flips `draining` to true so
+        a fleet router's poll routes around this replica even before
+        the API layer's healthz does."""
+        if self._draining:
+            return
+        self._draining = True
+        self._log({"event": "serving_drain",
+                   "queued": len(self._queue),
+                   "active": int(self._active.sum())})
+
+    def idle(self) -> bool:
+        """True when nothing is queued or decoding (the drain handler's
+        exit condition)."""
+        with self._cv:
+            return not self._queue and not bool(self._active.any())
+
     # ---- observability ----------------------------------------------
 
     def warmup(self) -> float:
@@ -1132,7 +1199,8 @@ class ContinuousBatchingEngine:
                        "gamma": self.config.spec_gamma}
                       if self.spec else None),
                 uptime_s=now - self._t0_clock,
-                last_error=last_error)
+                last_error=last_error,
+                draining=self._draining)
 
     # ---- debug introspection (docs/serving.md "Debug endpoints") ----
 

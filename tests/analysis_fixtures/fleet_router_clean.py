@@ -1,0 +1,88 @@
+"""known-clean fixture: the fleet-router idiom (ISSUE 10,
+docs/fleet.md) — ALL routing state lives on the host. The router
+itself is pure stdlib (clocks, seeded backoff jitter, threading,
+per-replica counters), which is only safe because none of it ever
+enters a traced program: the replicas' jitted decode stays a pure
+device function, and the router talks to it over HTTP from outside
+every jit boundary. The tempting regressions this fixture guards:
+leaking the backoff `random.Random` or `time.monotonic()` into traced
+code (host-divergence), pulling a device value per routed request to
+compute occupancy (blocking-transfer), or bumping the
+`fstpu_fleet_*` counters inside a traced helper
+(metrics-in-traced-code).
+
+Mirrors `fengshen_tpu/fleet/router.py`'s pick/retry/breaker loop
+around `fengshen_tpu/serving/engine.py`'s tick: if a rule fires here,
+it would also flag the real modules and block the merge gate.
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+RETRIES = REG.counter("fx_fleet_retries_total", "retries by reason",
+                      labelnames=("reason",))
+REPLICAS = REG.gauge("fx_fleet_replicas", "replicas by state",
+                     labelnames=("state",))
+
+
+@jax.jit
+def replica_decode_tick(cache, tokens, phys, active):
+    """What a replica runs per tick: pure gathers/scatters — the
+    router never adds clocks, rng, or metric mutation in here."""
+    n = tokens.shape[0]
+    cache = cache.at[jnp.arange(n), phys].set(tokens)
+    nxt = jnp.where(active, tokens + 1, 0).astype(jnp.int32)
+    return cache, nxt
+
+
+def pick_replica(replicas):
+    """Host-side placement: least occupancy from POLLED stats (plain
+    dict math — never a device read), ties by index."""
+    best = None
+    for rep in replicas:
+        occ = (rep["slots_active"] + rep["queue_depth"]) / max(
+            rep["num_slots"], 1)
+        if rep["healthy"] and (best is None or occ < best[0]):
+            best = (occ, rep)
+    return None if best is None else best[1]
+
+
+def route_with_retries(replicas, send, max_retries=2,
+                       rng=random.Random(0), clock=time.monotonic,
+                       sleep=time.sleep):
+    """Host-side retry loop: the seeded jitter rng and the clock live
+    OUT here, between HTTP calls — nothing below is traced."""
+    tried = []
+    for attempt in range(max_retries + 1):
+        rep = pick_replica([r for r in replicas if r not in tried])
+        if rep is None:
+            break
+        tried.append(rep)
+        t0 = clock()
+        ok = send(rep)
+        if ok:
+            return clock() - t0
+        rep["consecutive_failures"] += 1
+        if rep["consecutive_failures"] >= 3:
+            rep["healthy"] = False      # breaker opens, host-side
+            REPLICAS.labels("broken").set(
+                sum(1 for r in replicas if not r["healthy"]))
+        if attempt < max_retries:
+            RETRIES.labels("connect").inc()
+            sleep(0.01 * (0.5 + rng.random() / 2))
+    return None
+
+
+def drive_replica(state, tokens):
+    """The replica-side driver the router's request lands on: one
+    traced tick, host sync strictly after the jit boundary."""
+    cache, phys, active = state
+    cache, nxt = replica_decode_tick(cache, tokens, phys, active)
+    return cache, np.array(nxt)        # host sync OUTSIDE the jit

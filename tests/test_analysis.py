@@ -475,6 +475,27 @@ def test_flight_recorder_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_fleet_router_internals_are_clean():
+    """Regression fixture for the fleet router (ISSUE 10,
+    docs/fleet.md): the router is pure host-side stdlib — clocks,
+    seeded backoff jitter, breaker counters, fleet metrics — and must
+    STAY outside every traced program. Neither `host-divergence`,
+    `blocking-transfer` nor `metrics-in-traced-code` may fire on the
+    fixture or on the real `fengshen_tpu/fleet/` package. A hit means
+    routing state leaked into a traced program (a real SPMD hazard) or
+    a rule lost precision."""
+    fixture = os.path.join(FIXTURES, "fleet_router_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    fleet_pkg = os.path.join(PKG, "fleet")
+    findings = check_paths([fleet_pkg], make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_paged_cache_internals_are_clean():
     """Regression fixture for the paged KV cache (ISSUE 6): block
     free-list math stays host-side, the traced gather/scatter decode

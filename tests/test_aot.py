@@ -409,9 +409,13 @@ def test_healthz_503_until_ready_stdlib():
     try:
         code, body = _get(f"http://127.0.0.1:{port}/healthz")
         assert code == 503 and body["status"] == "warming"
+        # ISSUE 10: the 503 body names the ready/reason contract the
+        # fleet router keys on (warmup = the way in, vs draining)
+        assert body["ready"] is False and body["reason"] == "warmup"
         ready.set()
         code, body = _get(f"http://127.0.0.1:{port}/healthz")
         assert code == 200 and body["status"] == "ok"
+        assert body["ready"] is True
     finally:
         server.shutdown()
 
@@ -446,8 +450,11 @@ def test_healthz_503_until_ready_fastapi():
     client = TestClient(app)
     r = client.get("/healthz")
     assert r.status_code == 503 and r.json()["status"] == "warming"
+    # the fastapi path mirrors the stdlib ready/reason body (ISSUE 10)
+    assert r.json()["ready"] is False and r.json()["reason"] == "warmup"
     ready.set()
-    assert client.get("/healthz").status_code == 200
+    r = client.get("/healthz")
+    assert r.status_code == 200 and r.json()["ready"] is True
 
 
 # ---- warmup + build-info gauges ----------------------------------------

@@ -752,6 +752,36 @@ def test_benchdiff_never_compares_across_placements(tmp_path):
     assert by_round[4]["status"] == "incomparable"
 
 
+def test_benchdiff_never_compares_across_replica_counts(tmp_path):
+    """ISSUE 10 satellite: fleet rows carry their replica count
+    (docs/fleet.md) and rows at different N are INCOMPARABLE — a
+    2-replica aggregate dropping below a 3-replica one is a deployment
+    change, not a perf regression. Same N still diffs normally."""
+    from fengshen_tpu.observability import benchdiff
+
+    d = str(tmp_path)
+    base = {"metric": "fleet_router_tokens_per_sec", "unit": "tok/s"}
+    _write_round(d, 1, [dict(base, value=300.0, vs_baseline=2.3,
+                             replicas=3)])
+    # fewer replicas: lower aggregate is a different deployment
+    _write_round(d, 2, [dict(base, value=210.0, vs_baseline=1.6,
+                             replicas=2)])
+    # back at N=3: still incomparable (prev round carried N=2)
+    _write_round(d, 3, [dict(base, value=290.0, vs_baseline=2.2,
+                             replicas=3)])
+    # same N as the previous round: compares normally — a regression
+    _write_round(d, 4, [dict(base, value=150.0, vs_baseline=1.1,
+                             replicas=3)])
+    report = benchdiff.diff_rounds(benchdiff.load_rounds(d),
+                                   threshold=0.15)
+    by_round = {c["round"]: c for c in report["comparisons"]}
+    assert by_round[2]["status"] == "incomparable"
+    assert by_round[2]["delta_pct"] is None
+    assert by_round[3]["status"] == "incomparable"  # vs round 2 (N=2)
+    assert by_round[4]["status"] == "regression"
+    assert report["verdict"] == "REGRESSED"
+
+
 def test_benchdiff_report_deterministic_across_hashseed(tmp_path):
     d = str(tmp_path)
     _write_round(d, 1, [{"metric": f"m{i}", "value": float(i + 1),

@@ -1,0 +1,103 @@
+"""`make serve-bench-fleet` harness guard (ISSUE 10): the fleet bench
+must emit its one BENCH-schema JSON line — with the replica count in
+the row, benchdiff's comparison identity — and its kill rung must
+finish with zero failed requests.
+
+The fast lane runs the harness in FAKE mode: in-process stdlib replica
+servers with a deterministic token function and a per-token sleep
+standing in for decode, so the whole three-phase flow (one replica →
+N replicas → kill-one-mid-run) exercises the REAL router, transport,
+retry, and kill paths in a couple of seconds without a model. The
+≥2x-at-3-replicas acceptance number comes from the real-subprocess
+mode on the default weight-memory-bound shape — slow lane.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+import pytest
+
+FAKE = {"FLEET_BENCH_FAKE": "1", "FLEET_BENCH_REPLICAS": "3",
+        "FLEET_BENCH_SLOTS": "2", "FLEET_BENCH_REQUESTS": "24",
+        "FLEET_BENCH_NEW_TOKENS": "16",
+        "FLEET_BENCH_FAKE_TOKEN_S": "0.003"}
+
+
+def _run(monkeypatch, env: dict, base: dict = FAKE) -> dict:
+    from fengshen_tpu.fleet import bench
+
+    for key in list(os.environ):
+        if key.startswith(("FLEET_BENCH_", "BENCH_DEGRADED")):
+            monkeypatch.delenv(key)
+    for key, val in {**base, **env}.items():
+        monkeypatch.setenv(key, val)
+    out = io.StringIO()
+    with redirect_stdout(out):
+        bench.main([])
+    lines = [l for l in out.getvalue().splitlines()
+             if l.startswith("{")]
+    assert lines, out.getvalue()
+    return json.loads(lines[-1])
+
+
+def test_fleet_bench_fake_schema_and_kill_rung(monkeypatch):
+    row = _run(monkeypatch, {})
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline",
+                        "replicas", "kill", "tokens_per_sec_1",
+                        "requests", "fake"}
+    assert row["metric"] == "fleet_router_tokens_per_sec"
+    assert row["unit"] == "tokens/s"
+    assert row["value"] > 0 and row["tokens_per_sec_1"] > 0
+    # the comparison identity benchdiff keys on
+    assert row["replicas"] == 3
+    assert row["fake"] is True and row["backend"] == "fake"
+    # no request may fail in ANY phase; N-replica outputs must equal
+    # the single-replica outputs (deterministic fake decode)
+    assert row["failed"] == 0
+    assert row["token_identical_n_vs_1"] is True
+    # the kill rung: one replica dies mid-run, zero failed requests,
+    # outputs identical to the un-killed run, and the recovery cost is
+    # visible as retries
+    kill = row["kill"]
+    assert kill["enabled"] is True
+    assert kill["failed"] == 0
+    assert kill["completed"] == row["requests"]
+    assert kill["token_identical"] is True
+    assert kill["retries"] >= 1
+    # fake decode is sleep-bound, so 3 replicas over 1 is a real
+    # capacity ratio even in the fast lane (loose bar: timing)
+    assert row["vs_baseline"] >= 1.3
+    assert "degraded" not in row
+
+
+def test_fleet_bench_kill_rung_disabled(monkeypatch):
+    row = _run(monkeypatch, {"FLEET_BENCH_KILL": "0"})
+    assert row["kill"] == {"enabled": False}
+    assert row["failed"] == 0
+
+
+def test_fleet_bench_degraded_flag(monkeypatch):
+    row = _run(monkeypatch, {"BENCH_DEGRADED": "1",
+                             "FLEET_BENCH_KILL": "0",
+                             "FLEET_BENCH_REQUESTS": "6"})
+    assert row["degraded"] is True
+
+
+@pytest.mark.slow
+def test_fleet_bench_real_default_shape_2x_and_zero_failed(monkeypatch):
+    """The acceptance bars (ISSUE 10) on the real path: 3 replica
+    subprocesses (random-init llama, weight-memory-bound shape),
+    aggregate tokens/s ≥ 2x one replica, and the SIGKILL-one-mid-run
+    rung completes every request with zero failures, token-identical
+    to the un-killed run. ~3-4 min on CPU."""
+    row = _run(monkeypatch, {"FLEET_BENCH_BASE_PORT": "8390"}, base={})
+    assert row["fake"] is False
+    assert row["replicas"] == 3
+    assert row["vs_baseline"] >= 2.0, row
+    assert row["failed"] == 0
+    assert row["kill"]["enabled"] is True
+    assert row["kill"]["failed"] == 0
+    assert row["kill"]["completed"] == row["requests"]
+    assert row["kill"]["token_identical"] is True, row

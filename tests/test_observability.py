@@ -371,7 +371,9 @@ def test_engine_metrics_snapshot_shape_pinned():
     assert snap == {
         "queue_depth": 1, "slots_active": 3, "num_slots": 8,
         "admitted": 2, "rejected_queue_full": 0,
-        "rejected_prompt_too_long": 0, "completed": 1,
+        "rejected_prompt_too_long": 0, "rejected_draining": 0,
+        "rejected_duplicate": 0,
+        "completed": 1,
         "cancelled": 0, "expired": 0,
         "deferred_admissions": 0, "slots_active_peak": 3,
         "kv_layout": "paged", "kv_dtype": "int8",
@@ -387,6 +389,9 @@ def test_engine_metrics_snapshot_shape_pinned():
         # age — never a traceback); every pre-existing key above is
         # unrenamed
         "uptime_s": 0.0, "last_error": None,
+        # ISSUE 10: drain visibility for the fleet router's /stats
+        # poll (plus the rejected_draining counter above)
+        "draining": False,
     }
     # a spec engine (ISSUE 7) ADDS exactly its five keys — the
     # non-spec payload above stays byte-identical

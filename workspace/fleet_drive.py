@@ -1,0 +1,172 @@
+"""PR 10 verify drive: the REAL fleet surface end to end.
+
+Spawns two real replica subprocesses (fleet.bench --replica, tiny
+shapes), fronts them with the REAL router process
+(`python -m fengshen_tpu.fleet --replicas ...`), and proves over HTTP:
+token-exact generate through the router, /fleet + /metrics + /healthz,
+routing around a SIGTERMed (draining) replica, structured 503 at zero
+healthy, and the router's own SIGTERM drain (exit 0).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, "/root/repo")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "FLEET_BENCH_VOCAB": "256", "FLEET_BENCH_HIDDEN": "64",
+       "FLEET_BENCH_INTER": "128", "FLEET_BENCH_LAYERS": "2",
+       "FLEET_BENCH_HEADS": "4", "FLEET_BENCH_BUCKETS": "16,32",
+       "FLEET_BENCH_NEW_TOKENS": "8", "FLEET_BENCH_SLOTS": "2"}
+
+P1, P2, RP = 8461, 8462, 8460
+
+
+def get(url, timeout=5):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def post(url, body, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_200(url, deadline_s=120):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        try:
+            if get(url)[0] == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return False
+
+
+reps = [subprocess.Popen(
+    [sys.executable, "-m", "fengshen_tpu.fleet.bench", "--replica",
+     "--port", str(p)], env=ENV) for p in (P1, P2)]
+router = subprocess.Popen(
+    [sys.executable, "-m", "fengshen_tpu.fleet",
+     "--replicas", f"127.0.0.1:{P1},127.0.0.1:{P2}",
+     "--host", "127.0.0.1", "--port", str(RP),
+     "--poll-interval", "0.2", "--recovery-probes", "1",
+     "--breaker-threshold", "1"], env=ENV)
+
+try:
+    assert wait_200(f"http://127.0.0.1:{RP}/healthz"), "router not up"
+    # both replicas in rotation
+    t0 = time.time()
+    while time.time() - t0 < 30:
+        code, fleet = get(f"http://127.0.0.1:{RP}/fleet")
+        if fleet["healthy"] == 2:
+            break
+        time.sleep(0.2)
+    assert fleet["healthy"] == 2, fleet
+    print("OK router up, 2 healthy")
+
+    # token-exact generate THROUGH the router
+    import jax.numpy as jnp
+    import numpy as np
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.utils.generate import generate
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=40, dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+    prompt = [5, 7, 9, 11]
+    ref = np.asarray(generate(
+        model, params, jnp.asarray(prompt)[None],
+        max_new_tokens=8))[0, len(prompt):].tolist()
+    code, body = post(f"http://127.0.0.1:{RP}/api/text_generation",
+                      {"input_text": "5 7 9 11"})
+    assert code == 200, (code, body)
+    assert body["result"] == " ".join(str(t) for t in ref), body
+    assert body["request_id"].startswith("fleet-")
+    print("OK token-exact through router:", body["result"])
+
+    # /metrics renders the fleet registry
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{RP}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'fstpu_fleet_replicas{state="healthy"} 2' in text, text[:500]
+    assert "fstpu_fleet_requests_total 1" in text
+    print("OK /metrics")
+
+    # SIGTERM replica 1: graceful drain -> router routes around it.
+    # (An IDLE replica drains and exits almost immediately, so its
+    # draining-503 window may already be over by the time we probe —
+    # the while-in-flight healthz body is pinned deterministically in
+    # tests/test_fleet.py; here we assert the fleet-level effect.)
+    reps[0].send_signal(signal.SIGTERM)
+    try:
+        code, body = get(f"http://127.0.0.1:{P1}/healthz")
+        assert code == 503 and body["reason"] == "draining", body
+        print("OK caught replica draining-503 window")
+    except OSError:
+        print("OK replica already drained+exited (idle)")
+    t0 = time.time()
+    while time.time() - t0 < 15:
+        code, fleet = get(f"http://127.0.0.1:{RP}/fleet")
+        if fleet["healthy"] == 1:
+            break
+        time.sleep(0.2)
+    assert fleet["healthy"] == 1, fleet
+    for i in range(3):
+        code, body = post(
+            f"http://127.0.0.1:{RP}/api/text_generation",
+            {"input_text": "5 7 9 11"})
+        assert code == 200, (code, body)
+        assert body["result"] == " ".join(str(t) for t in ref)
+    print("OK routed around draining replica; replica1 exits",
+          reps[0].wait(timeout=30))
+
+    # kill replica 2 hard: zero healthy -> structured 503
+    reps[1].kill()
+    reps[1].wait()
+    t0 = time.time()
+    while time.time() - t0 < 20:
+        code, body = get(f"http://127.0.0.1:{RP}/healthz")
+        if code == 503:
+            break
+        time.sleep(0.2)
+    assert code == 503 and body["reason"] == "no_healthy_replicas", body
+    assert f"127.0.0.1:{P2}" in body["replicas"], body
+    code, body = post(f"http://127.0.0.1:{RP}/api/text_generation",
+                      {"input_text": "5 7"})
+    assert code == 503 and body["reason"] == "no_healthy_replicas"
+    assert body["replicas"], body
+    print("OK structured zero-healthy 503")
+
+    # router SIGTERM drain: healthz flips, process exits 0
+    router.send_signal(signal.SIGTERM)
+    rc = router.wait(timeout=60)
+    assert rc == 0, rc
+    print("OK router drained and exited 0")
+    print("FLEET DRIVE PASSED")
+finally:
+    for p in reps + [router]:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
