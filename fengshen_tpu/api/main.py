@@ -152,20 +152,15 @@ def _count_http(route: str, code: int) -> None:
     """`fstpu_http_requests_total{route,code}` in the global registry.
     Routes are the fixed server surface (bounded label cardinality);
     anything else counts as "other"."""
-    from fengshen_tpu.observability import get_registry
-    get_registry().counter(
-        "fstpu_http_requests_total", "REST requests by route and status",
-        labelnames=("route", "code")).labels(route, code).inc()
+    from fengshen_tpu.observability.httpmetrics import http_requests_total
+    http_requests_total().labels(route, code).inc()
 
 
 def _observe_http(route: str, seconds: float) -> None:
     """`fstpu_http_request_seconds{route}` beside the counter: the
     request-latency histogram both API paths feed (docs/observability.md)."""
-    from fengshen_tpu.observability import get_registry
-    get_registry().histogram(
-        "fstpu_http_request_seconds",
-        "REST request wall seconds by route",
-        labelnames=("route",)).labels(route).observe(seconds)
+    from fengshen_tpu.observability.httpmetrics import http_request_seconds
+    http_request_seconds().labels(route).observe(seconds)
 
 
 def _classify_route(path: str, api_route: str) -> str:
@@ -277,41 +272,57 @@ def _engine_generate(engine, pipeline, req: dict,
     Backpressure maps to HTTP: queue full → 429, prompt too long → 413,
     engine timeout/eviction → 503, draining replica → 503 with reason,
     duplicate request_id → 409 (the fleet router's idempotent-safe
-    retry contract, docs/fleet.md)."""
+    retry contract, docs/fleet.md). A `traceparent` (body field, or the
+    HTTP header lifted into the body by the server layer) flows into
+    `engine.submit` so the request's timeline and debug-ring entry
+    carry the fleet trace ids (docs/observability.md "Distributed
+    tracing"); traced responses echo `trace_id` back."""
+    from fengshen_tpu.observability import parse_traceparent
     from fengshen_tpu.serving import (FINISHED, Draining,
                                       DuplicateRequest, PromptTooLong,
                                       QueueFull)
     rid = req.get("request_id")
+    ctx = parse_traceparent(req.get("traceparent"))
+
+    def _body(payload: dict) -> dict:
+        # only traced requests grow the trace_id key: the untraced
+        # response shape stays byte-identical to the pre-trace one
+        if ctx is not None:
+            payload["trace_id"] = ctx.trace_id
+        return payload
+
     try:
         request = engine.submit(
             pipeline.encode(req["input_text"]),
             max_new_tokens=req.get("max_new_tokens"),
-            request_id=None if rid is None else str(rid))
+            request_id=None if rid is None else str(rid),
+            trace_id=None if ctx is None else ctx.trace_id,
+            parent_span_id=None if ctx is None else ctx.span_id)
     except Draining as e:
-        return 503, {"error": str(e), "reason": "draining"}
+        return 503, _body({"error": str(e), "reason": "draining"})
     except DuplicateRequest as e:
-        return 409, {"error": str(e)}
+        return 409, _body({"error": str(e)})
     except QueueFull as e:
-        return 429, {"error": str(e)}
+        return 429, _body({"error": str(e)})
     except PromptTooLong as e:
-        return 413, {"error": str(e)}
+        return 413, _body({"error": str(e)})
     except (ValueError, TypeError) as e:
         # bad request payload (unencodable input, max_new_tokens < 1)
-        return 422, {"error": str(e)}
+        return 422, _body({"error": str(e)})
     if not request.wait(timeout=timeout_s):
         engine.cancel(request.request_id)
         # the request may have completed in the wait→cancel window; a
         # finished result must not be discarded as a timeout
         if request.state != FINISHED:
-            return 503, {"error":
-                         f"request timed out after {timeout_s}s"}
+            return 503, _body({"error":
+                               f"request timed out after {timeout_s}s"})
     if request.state != FINISHED:
-        return 503, {"error": f"request {request.state} "
-                              f"({request.finish_reason})"}
-    return 200, {"result": pipeline.decode(request.tokens),
-                 "request_id": request.request_id,
-                 "ttft_s": request.ttft_s,
-                 "finish_reason": request.finish_reason}
+        return 503, _body({"error": f"request {request.state} "
+                                    f"({request.finish_reason})"})
+    return 200, _body({"result": pipeline.decode(request.tokens),
+                       "request_id": request.request_id,
+                       "ttft_s": request.ttft_s,
+                       "finish_reason": request.finish_reason})
 
 
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
@@ -324,7 +335,7 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
     event for the way OUT: once set, `/healthz` answers 503 with reason
     "draining" and new generate requests get 503 while in-flight ones
     finish (docs/fleet.md). `recorder` enables `POST /debug/dump`."""
-    from fastapi import FastAPI
+    from fastapi import FastAPI, Header
     from fastapi.middleware.cors import CORSMiddleware
     from fastapi.responses import JSONResponse, Response
     from pydantic import BaseModel
@@ -344,6 +355,11 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         # field pydantic silently DROPS the router-assigned id and the
         # engine dedupe (409 contract) never sees it
         request_id: Optional[str] = None
+        # distributed-trace context (docs/observability.md): the
+        # router sends it BOTH as this body field and as the
+        # `traceparent` HTTP header; the body form survives proxies
+        # that strip unknown headers
+        traceparent: Optional[str] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
 
@@ -358,7 +374,8 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         return response
 
     @app.post(api_route)
-    def run(req: Request) -> Any:
+    def run(req: Request,
+            traceparent: Optional[str] = Header(None)) -> Any:
         if draining is not None and draining.is_set():
             # the engine path would answer the same via Draining; this
             # ALSO covers the simple path, and spares encode work
@@ -368,8 +385,14 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
                 content={"error": "replica draining",
                          "reason": "draining"})
         if engine is not None:
+            payload = req.model_dump()
+            if traceparent and not payload.get("traceparent"):
+                # header form of the trace context (the body field
+                # wins when both are present — they are identical
+                # when the fleet router sent them)
+                payload["traceparent"] = traceparent
             code, body = _engine_generate(
-                engine, pipeline, req.model_dump(),
+                engine, pipeline, payload,
                 server_cfg.request_timeout_s)
             _count_http(api_route, code)
             return JSONResponse(status_code=code, content=body)
@@ -558,6 +581,11 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 # the pipeline must surface as 500, not as this 422
                 self._send(422, {"error": "input_text required"})
                 return
+            tp = self.headers.get("traceparent")
+            if tp and not req.get("traceparent"):
+                # lift the header form of the trace context into the
+                # body dict _engine_generate reads (body field wins)
+                req["traceparent"] = tp
             if draining is not None and draining.is_set():
                 # admission edge of the drain: requests already past
                 # it (counted in-flight below) finish normally

@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-cooldown", type=float, default=5.0)
     p.add_argument("--recovery-probes", type=int, default=2)
     p.add_argument("--drain-timeout", type=float, default=30.0)
+    p.add_argument("--dump-dir", type=str, default=None,
+                   help="flight-recorder dir: post-mortem bundles "
+                        "(incl. traces.json, the last-N distributed "
+                        "traces) land here on drain")
     return p
 
 
@@ -68,6 +72,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from fengshen_tpu.fleet.router import FleetConfig, FleetRouter
     from fengshen_tpu.fleet.server import serve
+    recorder = None
+    if args.dump_dir:
+        # router-side flight recorder: the event ring plus a
+        # traces.json provider (the last-N distributed traces) in
+        # every post-mortem bundle (docs/observability.md)
+        from fengshen_tpu.observability import FlightRecorder
+        recorder = FlightRecorder(dump_dir=args.dump_dir)
     router = FleetRouter(FleetConfig(
         replicas=targets, task=args.task,
         request_timeout_s=args.request_timeout,
@@ -75,9 +86,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_retries=args.max_retries,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown,
-        recovery_probes=args.recovery_probes))
+        recovery_probes=args.recovery_probes),
+        recorder=recorder)
 
     def on_drained():
+        if recorder is not None:
+            try:
+                recorder.dump(reason="router_drain")
+            except Exception:  # noqa: BLE001 — a failed dump must not
+                pass           # block replica teardown on the way out
         if procs:
             from fengshen_tpu.fleet.launcher import terminate_replicas
             terminate_replicas(procs)

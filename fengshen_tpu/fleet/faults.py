@@ -18,7 +18,13 @@ Fault kinds (each keyed `{request_index: replica_name}`):
 - ``wedge_at``: like ``kill_at`` but the process is WEDGED, not gone:
   requests raise `TransportError(sent=True)` (hang-until-timeout — the
   replica may still be executing), the dangerous failure mode that
-  exercises the idempotent-safe retry rule.
+  exercises the idempotent-safe retry rule. A wedged GENERATE attempt
+  is actually DELIVERED to the replica first (its response is then
+  discarded): the replica really does execute work whose answer the
+  router never sees — which is exactly what "may still be executing"
+  means, and what gives the killed-request's trace a real waterfall on
+  the wedged replica. Polls are not delivered (a wedged healthz just
+  times out).
 - ``error_503_at``: that ONE attempt, if it targets the replica,
   answers `503 {"error": "injected 503"}` — a transient warming/
   draining window.
@@ -28,7 +34,10 @@ Fault kinds (each keyed `{request_index: replica_name}`):
 
 ``fired`` records every (kind, index, replica) that actually triggered,
 so tests can pin that the injected fault count matches the router's
-`fstpu_fleet_retries_total` exactly.
+`fstpu_fleet_retries_total` exactly. ``revive(replica)`` clears a
+sticky kill/wedge — the restarted-process move — without re-arming the
+already-fired coordinate, so post-mortem reads (trace assembly, debug
+endpoints) can reach the replica again deterministically.
 """
 
 from __future__ import annotations
@@ -61,11 +70,19 @@ class FleetFaultPlan:
         self._lock = threading.Lock()
         self._index = 0
         self._dead: Dict[str, str] = {}    # name -> "kill" | "wedge"
+        self._armed: set = set()           # (at, name) already applied
 
     @property
     def fault_count(self) -> int:
         """Faults that actually fired (the retries-must-match pin)."""
         return len(self.fired)
+
+    def revive(self, replica: str) -> None:
+        """Clear a sticky kill/wedge for `replica` (the process was
+        restarted/unstuck). The coordinate that armed it stays
+        consumed, so the fault does NOT re-fire on the next attempt."""
+        with self._lock:
+            self._dead.pop(replica, None)
 
     def wrap(self, transport, sleep: Callable[[float], None] = time.sleep
              ) -> "FaultInjectingTransport":
@@ -78,11 +95,13 @@ class FleetFaultPlan:
         idx = self._index
         self._index += 1
         for at, name in self.kill_at.items():
-            if at <= idx and name not in self._dead:
-                self._dead[name] = "kill"
+            if at <= idx and (at, name) not in self._armed:
+                self._armed.add((at, name))
+                self._dead.setdefault(name, "kill")
         for at, name in self.wedge_at.items():
-            if at <= idx and name not in self._dead:
-                self._dead[name] = "wedge"
+            if at <= idx and (at, name) not in self._armed:
+                self._armed.add((at, name))
+                self._dead.setdefault(name, "wedge")
         if self.error_503_at.get(idx) == replica:
             self.fired.append(("error_503", idx, replica))
             return "error_503"
@@ -139,6 +158,17 @@ class FaultInjectingTransport:
             raise TransportError(
                 f"injected kill: connect to {name} refused", sent=False)
         if mode == "wedge":
+            if is_generate:
+                # sent=True for real: deliver the request, lose the
+                # response — the replica executes work the router
+                # never hears about (the danger the idempotent-safe
+                # retry rule exists for, and the reason the wedged
+                # replica HAS a waterfall when the trace is assembled)
+                try:
+                    self.inner.request(base_url, method, path, body,
+                                       timeout_s)
+                except Exception:  # noqa: BLE001 — the response is
+                    pass           # discarded either way
             raise TransportError(
                 f"injected wedge: request to {name} timed out",
                 sent=True)

@@ -46,9 +46,20 @@ responses at exact request indices through the same transport seam).
 Router-side telemetry lives in its OWN registry (rendered by the
 server's `/metrics`): `fstpu_fleet_replicas{state}`,
 `fstpu_fleet_retries_total{reason}`,
-`fstpu_fleet_request_seconds{outcome}`, plus requests/breaker-open
-counters. `fleet_state()` is the `/fleet` debug JSON — deterministic
-(sorted, rounded) given a deterministic clock.
+`fstpu_fleet_request_seconds{outcome}`, a per-attempt
+`fstpu_fleet_attempt_seconds{outcome}` histogram, plus
+requests/breaker-open and `fstpu_trace_*` counters. `fleet_state()`
+is the `/fleet` debug JSON — deterministic (sorted, rounded) given a
+deterministic clock.
+
+Distributed tracing (docs/observability.md "Distributed tracing"):
+every routed request mints (or joins) a trace; the router's
+`SpanLedger` records enqueue / placement / per-attempt / total spans,
+each attempt propagates `traceparent` to its replica (header + body
+field), and `assemble()` stitches the ledger with the involved
+replicas' `/debug/requests/<id>` waterfalls into the ONE
+cross-process timeline `GET /debug/traces/<trace_id>` serves — clock
+skew reported per replica, never hidden.
 """
 
 from __future__ import annotations
@@ -63,7 +74,10 @@ import urllib.request
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from fengshen_tpu.observability import MetricsRegistry
+from fengshen_tpu.observability import (MetricsRegistry, SpanLedger,
+                                        TraceContext, TraceIds,
+                                        assemble_trace,
+                                        parse_traceparent)
 
 # replica rotation states (the fstpu_fleet_replicas{state} label set):
 # "draining" covers every out-by-healthz condition — warming, an
@@ -104,9 +118,16 @@ class UrllibTransport:
                 ) -> Tuple[int, dict]:
         url = base_url.rstrip("/") + path
         data = None if body is None else json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if body is not None and body.get("traceparent"):
+            # the trace context crosses the wire BOTH ways: as the
+            # standard header (for anything W3C-aware in between) and
+            # as the body field already in `data` (survives proxies
+            # that strip unknown headers) — the replica prefers the
+            # body form and they are identical here
+            headers["traceparent"] = str(body["traceparent"])
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            url, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as r:
                 return r.status, _parse_json(r.read())
@@ -150,6 +171,11 @@ class FleetConfig:
     #   routed surface is idempotent-safe (greedy, never streamed,
     #   request-id deduped), so maybe-executed failures retry too
     seed: int = 0                       # backoff-jitter rng seed
+    trace_ring: int = 128               # traces the span ledger keeps
+    trace_seed: Optional[int] = None    # trace-id seed — tests ONLY:
+    #   None (the default) draws ids from OS entropy; a fixed seed
+    #   would make every router with the same config mint the SAME
+    #   id stream, colliding across restarts and sibling routers
 
     def __post_init__(self):
         if not self.replicas:
@@ -181,6 +207,11 @@ class Replica:
         self.breaker_open_until: Optional[float] = None
         self.half_open_inflight = False
         self.last_error: Optional[dict] = None   # {"detail", "at"}
+        #: when the health sweep last COMPLETED a poll of this replica
+        #: (any outcome incl. unreachable); None until the first one —
+        #: /fleet renders it as last_poll_age_s so a stuck poll loop
+        #: is visible without reading logs
+        self.last_poll_at: Optional[float] = None
         self.in_flight = 0
         self.slots_active = 0
         self.num_slots = 0
@@ -204,7 +235,9 @@ class FleetRouter:
                  transport: Any = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 log: Optional[Callable[[dict], None]] = None):
+                 log: Optional[Callable[[dict], None]] = None,
+                 wall: Callable[[], float] = time.time,
+                 recorder: Any = None):
         self.config = config
         self.transport = transport if transport is not None \
             else UrllibTransport()
@@ -213,6 +246,22 @@ class FleetRouter:
         self._log = log or (lambda entry: None)
         self._lock = threading.Lock()
         self._rng = random.Random(config.seed)
+        # the distributed-tracing tier (docs/observability.md): every
+        # routed request gets (or joins) a trace; the ledger records
+        # the router's own spans — admit, placement, each attempt,
+        # total — on the request thread, host-side only. Ids draw OS
+        # entropy unless config.trace_seed pins them (deterministic
+        # tests); `wall` is the epoch anchor clock the assembler's
+        # skew math rests on (injectable, like everything else here).
+        self.tracer = SpanLedger("router", clock=clock, wall=wall,
+                                 max_traces=config.trace_ring,
+                                 ids=TraceIds(config.trace_seed))
+        self._recorder = recorder
+        if recorder is not None:
+            # router events enter the post-mortem ring, and bundles
+            # carry the last-N traces as traces.json
+            self._log = recorder.wrap_sink(self._log)
+            recorder.attach("traces", self.tracer.provider)
         self.replicas: List[Replica] = [
             Replica(i, t) for i, t in enumerate(config.replicas)]
         if len({r.base_url for r in self.replicas}) != len(self.replicas):
@@ -247,6 +296,19 @@ class FleetRouter:
             "circuit-breaker open transitions", labelnames=("replica",))
         self._c_polls = r.counter(
             "fstpu_fleet_polls_total", "health/stats poll sweeps")
+        self._h_attempt = r.histogram(
+            "fstpu_fleet_attempt_seconds",
+            "per-attempt wall seconds by attempt outcome",
+            labelnames=("outcome",))
+        self._c_traces = r.counter(
+            "fstpu_trace_started_total",
+            "traces minted or joined by the router")
+        self._c_trace_assembled = r.counter(
+            "fstpu_trace_assembled_total",
+            "cross-process trace assemblies served")
+        self._c_trace_fetch_errors = r.counter(
+            "fstpu_trace_fetch_errors_total",
+            "replica waterfall fetches that failed during assembly")
         self._update_state_gauge_locked()
 
     # ---- health polling ---------------------------------------------
@@ -349,6 +411,7 @@ class FleetRouter:
     def _note_poll_healthy(self, rep: Replica) -> None:
         with self._lock:
             now = self._clock()
+            rep.last_poll_at = now
             if rep.state == BROKEN:
                 # healthy polls past the cooldown count as half-open
                 # probes: recovery_probes of them close the breaker
@@ -377,6 +440,7 @@ class FleetRouter:
                         orderly: bool = False) -> None:
         with self._lock:
             rep.healthy_streak = 0
+            rep.last_poll_at = self._clock()
             rep.last_error = {"detail": detail[:200],
                               "at": self._clock()}
             if rep.state == BROKEN:
@@ -552,39 +616,86 @@ class FleetRouter:
         """Proxy one generate request: pick → attempt → (on connect/5xx
         failure) retry on a different replica with jittered backoff.
         Returns (status, response body) — the server layer writes them
-        verbatim. Never raises."""
+        verbatim. Never raises.
+
+        Every admitted request gets a distributed trace
+        (docs/observability.md "Distributed tracing"): a fresh trace id
+        is minted (or an incoming `traceparent` joined), the router's
+        span ledger records admit / placement / every attempt (with
+        replica, outcome, and the backoff that followed) / total, and
+        each attempt propagates `traceparent` to its replica — parented
+        to THAT attempt's span, so retries show as siblings under one
+        trace. The response body carries `trace_id` for later
+        `GET /debug/traces/<trace_id>` assembly. All of it is host-side
+        dict work on this thread — zero per-token overhead."""
         t0 = time.perf_counter()
         if self._draining:
             self._h_request.labels(OUTCOME_DRAINING).observe(
                 time.perf_counter() - t0)
             return 503, {"error": "router draining",
                          "reason": "draining"}
+        incoming = parse_traceparent(body.get("traceparent"))
         with self._lock:
             rid = body.get("request_id")
             if not rid:
                 rid = f"fleet-{self._id_token}-{self._seq}"
             self._seq += 1
         body = dict(body, request_id=str(rid))
+        ctx = self.tracer.start_trace(
+            "fleet/request",
+            trace_id=None if incoming is None else incoming.trace_id,
+            parent_span_id=None if incoming is None
+            else incoming.span_id,
+            request_id=body["request_id"], task=self.config.task)
+        tid, root = ctx.trace_id, ctx.span_id
+        self._c_traces.inc()
+        s_admit = self.tracer.start_span(tid, "router/enqueue", root)
+        self.tracer.end_span(tid, s_admit,
+                             healthy=self.healthy_count())
         self._c_requests.inc()
 
         attempts = self.config.max_retries + 1
         tried: List[Replica] = []
         last: Optional[Tuple[int, dict]] = None
         for attempt in range(attempts):
+            s_place = self.tracer.start_span(
+                tid, "router/placement", root, attempt=attempt + 1)
             with self._lock:
                 rep = self._pick_locked(tried)
                 if rep is not None:
                     rep.in_flight += 1
+            self.tracer.end_span(
+                tid, s_place,
+                replica=None if rep is None else rep.name)
             if rep is None:
                 break
             tried.append(rep)
             path = f"/api/{self.config.task}"
+            # the attempt span carries its OWN request_id: a joined
+            # trace (one caller traceparent over many requests) must
+            # let assemble() fetch each replica's actual request, not
+            # the first request the trace ever saw
+            s_att = self.tracer.start_span(
+                tid, "router/attempt", root, attempt=attempt + 1,
+                replica=rep.name, request_id=body["request_id"])
+            send_body = body
+            if s_att is not None:
+                # the replica's timeline parents to THIS attempt's
+                # span — a retried request's two executions hang off
+                # two sibling spans of one trace
+                send_body = dict(
+                    body,
+                    traceparent=TraceContext(tid, s_att)
+                    .to_traceparent())
+            t_att = time.perf_counter()
             try:
                 status, resp = self.transport.request(
-                    rep.base_url, "POST", path, body,
+                    rep.base_url, "POST", path, send_body,
                     self.config.request_timeout_s)
             except TransportError as e:
                 reason = "connect" if not e.sent else "timeout"
+                self._h_attempt.labels(reason).observe(
+                    time.perf_counter() - t_att)
                 # charge the breaker but leave rotation state to it
                 # (and to the health poll): one flaky connect must not
                 # empty the rotation below breaker_threshold
@@ -596,14 +707,26 @@ class FleetRouter:
                 if e.sent and not self.config.retry_maybe_executed:
                     # the replica may still be executing and the
                     # deployment opted out of idempotent-safe retries
+                    self.tracer.end_span(tid, s_att, outcome=reason,
+                                         error=str(e)[:200],
+                                         retried=False)
                     self._log({"event": "fleet_request_error",
                                "replica": rep.name, "reason": reason,
                                "retried": False})
                     break
-                self._maybe_retry(attempt, attempts, reason, rep)
+                backoff = self._maybe_retry(attempt, attempts, reason,
+                                            rep)
+                self.tracer.end_span(
+                    tid, s_att, outcome=reason, error=str(e)[:200],
+                    **({} if backoff is None
+                       else {"backoff_s": backoff}))
+                if backoff is not None:
+                    self._sleep(backoff)
                 continue
             if status >= 500:
                 reason = f"http_{status}"
+                self._h_attempt.labels("http_5xx").observe(
+                    time.perf_counter() - t_att)
                 # 503 is the replica saying "not me right now"
                 # (draining / warming) — orderly: it leaves rotation
                 # immediately WITHOUT charging the breaker; other 5xx
@@ -617,43 +740,145 @@ class FleetRouter:
                         self._mark_out_locked(
                             rep, str(resp.get("reason") or reason))
                 last = (status, resp)
-                self._maybe_retry(attempt, attempts, reason, rep)
+                backoff = self._maybe_retry(attempt, attempts, reason,
+                                            rep)
+                self.tracer.end_span(
+                    tid, s_att, outcome=reason, status=status,
+                    **({} if backoff is None
+                       else {"backoff_s": backoff}))
+                if backoff is not None:
+                    self._sleep(backoff)
                 continue
             # 2xx/3xx/4xx: final — 4xx is the client's to handle
             self._finish_attempt(rep, ok=True)
             outcome = OUTCOME_OK if status < 400 else \
                 OUTCOME_CLIENT_ERROR
+            self._h_attempt.labels(outcome).observe(
+                time.perf_counter() - t_att)
+            self.tracer.end_span(tid, s_att, outcome=outcome,
+                                 status=status)
+            self.tracer.end_span(tid, root, outcome=outcome,
+                                 status=status, attempts=attempt + 1)
             self._h_request.labels(outcome).observe(
                 time.perf_counter() - t0)
             if attempt > 0:
                 self._log({"event": "fleet_request_recovered",
                            "request_id": body["request_id"],
                            "attempts": attempt + 1,
-                           "replica": rep.name})
-            return status, resp
+                           "replica": rep.name,
+                           "trace_id": tid})
+            return status, dict(resp, trace_id=tid)
 
         dt = time.perf_counter() - t0
         if last is None:
+            self.tracer.end_span(tid, root,
+                                 outcome=OUTCOME_UNAVAILABLE,
+                                 attempts=len(tried))
             self._h_request.labels(OUTCOME_UNAVAILABLE).observe(dt)
-            return 503, self._no_replicas_payload()
+            return 503, dict(self._no_replicas_payload(),
+                             trace_id=tid)
         self._h_request.labels(OUTCOME_ERROR).observe(dt)
         status, resp = last
+        self.tracer.end_span(tid, root, outcome=OUTCOME_ERROR,
+                             status=status, attempts=len(tried))
         self._log({"event": "fleet_request_failed",
                    "request_id": body["request_id"],
-                   "attempts": len(tried), "status": status})
-        return status, resp
+                   "attempts": len(tried), "status": status,
+                   "trace_id": tid})
+        return status, dict(resp, trace_id=tid)
 
     def _maybe_retry(self, attempt: int, attempts: int, reason: str,
-                     rep: Replica) -> None:
-        """Count + back off for the retry that will follow this failed
-        attempt (only when one WILL follow — an exhausted request is a
-        failure, not a retry)."""
+                     rep: Replica) -> Optional[float]:
+        """Count + compute backoff for the retry that will follow this
+        failed attempt (only when one WILL follow — an exhausted
+        request is a failure, not a retry). Returns the backoff to
+        sleep, or None when no retry follows. The caller sleeps AFTER
+        ending the attempt span: the span measures the attempt, and
+        the wait rides along as its ``backoff_s`` attr — otherwise the
+        span's duration and the attempt histogram would disagree about
+        the same attempt."""
         if attempt + 1 >= attempts:
-            return
+            return None
         self._c_retries.labels(reason).inc()
         self._log({"event": "fleet_retry", "reason": reason,
                    "replica": rep.name, "attempt": attempt + 1})
-        self._sleep(self._backoff_s(attempt + 1))
+        return self._backoff_s(attempt + 1)
+
+    # ---- trace assembly (docs/observability.md) ---------------------
+
+    def assemble(self, trace_id: str) -> Optional[dict]:
+        """`GET /debug/traces/<trace_id>`: stitch the router's span
+        ledger with the involved replicas' `/debug/requests/<id>`
+        waterfalls into ONE cross-process trace. Replicas are the ones
+        the attempt spans name; each attachment carries the clock
+        anchoring (`offset_in_trace_s`, `clock_skew_s` — skew reported,
+        never hidden) and a fetch failure degrades to an `error` entry:
+        a dead replica must not make its trace unreadable. None when
+        the trace id is unknown (never minted, or aged out of the
+        ledger ring)."""
+        trace = self.tracer.get_trace(trace_id)
+        if trace is None:
+            return None
+        request_id = None
+        involved: List[str] = []
+        rids: Dict[str, List[str]] = {}
+        for span in trace["spans"]:
+            attrs = span.get("attrs", {})
+            if request_id is None and "request_id" in attrs:
+                request_id = attrs["request_id"]
+            if span["name"] == "router/attempt":
+                name = attrs.get("replica")
+                if name and name not in involved:
+                    involved.append(name)
+                rid = attrs.get("request_id")
+                if name and rid is not None and \
+                        rid not in rids.setdefault(name, []):
+                    rids[name].append(rid)
+        by_name = {r.name: r for r in self.replicas}
+        fetches: Dict[str, dict] = {}
+        for name in involved:
+            rep = by_name.get(name)
+            # prefer the request id the attempt span itself recorded
+            # (a joined trace can span several requests); fall back to
+            # the trace-level first for ledgers predating the attr
+            seen = rids.get(name, [])
+            rid = seen[0] if seen else request_id
+            if rep is None or rid is None:
+                fetches[name] = {"error": "unknown_replica"}
+                self._c_trace_fetch_errors.inc()
+                continue
+            try:
+                code, payload = self.transport.request(
+                    rep.base_url, "GET",
+                    f"/debug/requests/{rid}", None,
+                    self.config.poll_timeout_s)
+            except TransportError as e:
+                fetches[name] = {
+                    "error": f"unreachable: {str(e)[:200]}"}
+                self._c_trace_fetch_errors.inc()
+                continue
+            except Exception as e:  # noqa: BLE001 — assembly is a
+                # debug read; a transport bug must degrade to an error
+                # entry, never 500 the whole trace
+                fetches[name] = {
+                    "error": f"{type(e).__name__}: {str(e)[:200]}"}
+                self._c_trace_fetch_errors.inc()
+                continue
+            if code == 200:
+                fetches[name] = {"waterfall": payload}
+            else:
+                # 404: the replica never executed it (a connect-level
+                # failure) or its debug ring aged the entry out
+                fetches[name] = {"error": f"http_{code}"}
+                self._c_trace_fetch_errors.inc()
+        for name, seen in rids.items():
+            # one attachment per replica: when a joined trace routed
+            # SEVERAL requests to the same replica, the later ones are
+            # named rather than silently invisible
+            if len(seen) > 1 and name in fetches:
+                fetches[name]["other_request_ids"] = seen[1:]
+        self._c_trace_assembled.inc()
+        return assemble_trace(trace, fetches)
 
     # ---- introspection ----------------------------------------------
 
@@ -676,11 +901,21 @@ class FleetRouter:
                 if rep.breaker_open_until is not None:
                     cooldown = round(
                         max(rep.breaker_open_until - now, 0.0), 3)
+                poll_age = None
+                if rep.last_poll_at is not None:
+                    poll_age = round(max(now - rep.last_poll_at, 0.0),
+                                     3)
                 reps.append({
                     "name": rep.name,
                     "url": rep.base_url,
                     "state": rep.state,
                     "reason": rep.reason,
+                    # a stuck poll loop reads as a growing age here
+                    # (None = never completed a poll), and the failure
+                    # streak is visible without opening the breaker
+                    # sub-dict
+                    "last_poll_age_s": poll_age,
+                    "consecutive_failures": rep.consecutive_failures,
                     "breaker": {
                         "consecutive_failures":
                             rep.consecutive_failures,

@@ -11,8 +11,17 @@ Mirrors the replica server's conventions (`api/main.py`):
   the same body contract the replicas answer, so an outer balancer can
   stack routers;
 - ``GET /metrics``: Prometheus text over the router's own registry
-  (`fstpu_fleet_*`) plus the process-global one;
-- ``GET /fleet``: the per-replica debug JSON (`fleet_state()`).
+  (`fstpu_fleet_*`, `fstpu_trace_*`) plus the process-global one;
+- ``GET /fleet``: the per-replica debug JSON (`fleet_state()`);
+- ``GET /debug/traces/<trace_id>``: the assembled cross-process trace
+  (`FleetRouter.assemble` — the router's span ledger stitched with the
+  involved replicas' waterfalls, docs/observability.md "Distributed
+  tracing"), deterministic sorted JSON like `/fleet`.
+
+Every endpoint times itself into the same
+`fstpu_http_request_seconds{route}` histogram (+ per-route/status
+counter) the replica servers feed, so router-side latency and
+replica-side latency read on one dashboard.
 
 `install_router_sigterm` wires graceful drain: SIGTERM stops admission
 (healthz flips to draining-503, new generates answer 503), in-flight
@@ -25,9 +34,30 @@ import http.server
 import json
 import signal
 import threading
+import time
 from typing import Optional
 
 from fengshen_tpu.fleet.router import FleetRouter
+
+
+def _observe_http(route: str, code: int, seconds: float) -> None:
+    """The replica servers' request telemetry, fed from the router's
+    own endpoints too — the shared families in
+    `observability.httpmetrics`, so router/replica latency read on one
+    dashboard."""
+    from fengshen_tpu.observability.httpmetrics import (
+        http_request_seconds, http_requests_total)
+    http_requests_total().labels(route, code).inc()
+    http_request_seconds().labels(route).observe(seconds)
+
+
+def _classify_route(path: str, api_route: str) -> str:
+    """Bounded label cardinality: a trace id must not become one label
+    value per request."""
+    if path.startswith("/debug/traces/"):
+        return "/debug/traces/<id>"
+    return path if path in (api_route, "/healthz", "/fleet",
+                            "/metrics") else "other"
 
 
 def healthz_payload(router: FleetRouter) -> tuple:
@@ -52,6 +82,7 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
     The returned server carries `.router` and an in-flight counter the
     drain handler consults."""
     route_prefix = "/api/"
+    api_route = f"/api/{router.config.task}"
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -62,6 +93,12 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
             body = payload if isinstance(payload, bytes) else \
                 json.dumps(payload, ensure_ascii=False,
                            sort_keys=True).encode()
+            # the router's own endpoints time themselves like the
+            # replica servers' do (same histogram + counter families)
+            t0 = getattr(self, "_t_start", None)
+            if t0 is not None:
+                _observe_http(_classify_route(self.path, api_route),
+                              code, time.perf_counter() - t0)
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
@@ -69,11 +106,20 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
             self.wfile.write(body)
 
         def do_GET(self):
+            self._t_start = time.perf_counter()
             if self.path == "/healthz":
                 code, body = healthz_payload(router)
                 self._send(code, body)
             elif self.path == "/fleet":
                 self._send(200, router.fleet_state())
+            elif self.path.startswith("/debug/traces/"):
+                trace_id = self.path[len("/debug/traces/"):]
+                assembled = router.assemble(trace_id)
+                if assembled is None:
+                    self._send(404, {"error":
+                                     f"unknown trace_id {trace_id!r}"})
+                else:
+                    self._send(200, assembled)
             elif self.path == "/metrics":
                 from fengshen_tpu.observability import (
                     CONTENT_TYPE_LATEST, get_registry,
@@ -85,6 +131,7 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            self._t_start = time.perf_counter()
             if not self.path.startswith(route_prefix):
                 self._send(404, {"error": "not found"})
                 return
@@ -97,6 +144,12 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
             if "input_text" not in req:
                 self._send(422, {"error": "input_text required"})
                 return
+            tp = self.headers.get("traceparent")
+            if tp and not req.get("traceparent"):
+                # an upstream caller's trace context arrives header-
+                # first here too; the router JOINS it instead of
+                # minting a fresh trace
+                req["traceparent"] = tp
             code, body = router.route_generate(req)
             self._send(code, body)
 

@@ -30,15 +30,21 @@ from fengshen_tpu.observability.sink import JsonlSink
 from fengshen_tpu.observability.stepstats import StepStats
 from fengshen_tpu.observability.timeline import (PHASE_NAMES,
                                                  RequestTimeline)
+from fengshen_tpu.observability.tracectx import (SpanLedger,
+                                                 TraceContext, TraceIds,
+                                                 assemble_trace,
+                                                 parse_traceparent)
 from fengshen_tpu.observability.tracing import (current_span_stack, span)
 
 __all__ = [
     "BUILD_INFO_METRIC", "CONTENT_TYPE_LATEST", "Counter",
     "FlightRecorder", "Gauge", "Histogram", "JsonlSink",
     "MetricsRegistry", "MetricsServer", "NOMINAL_FALLBACK_FLOPS",
-    "PEAK_FLOPS", "PHASE_NAMES", "RequestTimeline", "StepStats",
-    "WARMUP_METRIC", "current_span_stack", "estimate_flops_per_token",
-    "get_flight_recorder", "get_registry", "peak_flops_per_chip",
-    "percentile", "record_build_info", "record_warmup_seconds",
-    "render_prometheus", "span", "start_metrics_server",
+    "PEAK_FLOPS", "PHASE_NAMES", "RequestTimeline", "SpanLedger",
+    "StepStats", "TraceContext", "TraceIds", "WARMUP_METRIC",
+    "assemble_trace", "current_span_stack", "estimate_flops_per_token",
+    "get_flight_recorder", "get_registry", "parse_traceparent",
+    "peak_flops_per_chip", "percentile", "record_build_info",
+    "record_warmup_seconds", "render_prometheus", "span",
+    "start_metrics_server",
 ]

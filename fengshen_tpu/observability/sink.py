@@ -20,13 +20,19 @@ single-process).
 when the next line would push past the cap, the file rotates
 ``path -> path.1 -> ... -> path.<backups>`` (oldest dropped). Rotation
 only renames files — the event names and the line format stay
-byte-identical, so anything tailing the jsonl keeps parsing.
+byte-identical, so anything tailing the jsonl keeps parsing. A sink is
+shared by concurrent writers (the serving engine's scheduler thread,
+HTTP handler threads, the fleet router's poll sweep), so the
+rotate-then-append step runs under a lock: without it two threads
+racing a rotation boundary can interleave half-written lines or lose a
+freshly rotated file's first entries.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Any, Optional, TextIO
 
 
@@ -57,6 +63,9 @@ class JsonlSink:
         self.only_process_zero = only_process_zero
         self.max_bytes = max_bytes
         self.backups = max(int(backups), 1)
+        # one writer at a time: rotation is a multi-step rename chain
+        # and concurrent callers must not interleave inside it
+        self._lock = threading.Lock()
 
     def _maybe_rotate(self, incoming: int) -> None:
         """Size-based rotation (opt-in via ``max_bytes``): shift the
@@ -85,16 +94,20 @@ class JsonlSink:
             return
         line = json.dumps(entry)
         if self.path is not None:
-            parent = os.path.dirname(self.path)
-            if parent:
-                os.makedirs(parent, exist_ok=True)
-            if self.max_bytes is not None:
-                self._maybe_rotate(len(line) + 1)
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+            with self._lock:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                if self.max_bytes is not None:
+                    self._maybe_rotate(len(line) + 1)
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
         if self.stream is not None:
-            self.stream.write(line + "\n")
-            self.stream.flush()
+            # same lock as the file path: shared streams get the same
+            # no-interleaved-lines guarantee the rotation test pins
+            with self._lock:
+                self.stream.write(line + "\n")
+                self.stream.flush()
         if self.echo:
             print(f"{self.echo_prefix}{self.format_echo(entry)}",
                   flush=True)

@@ -34,6 +34,7 @@ injectable monotonic clock), so tests drive deterministic waterfalls.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 #: terminal lifecycle event names (mirror serving.engine's states)
@@ -52,9 +53,11 @@ class RequestTimeline:
     """Bounded timestamped event list for one request's lifecycle."""
 
     __slots__ = ("t0", "events", "dropped", "dropped_tick_s",
-                 "max_events")
+                 "max_events", "trace_id", "parent_span_id",
+                 "epoch_unix_s")
 
-    def __init__(self, t0: float, max_events: int = DEFAULT_MAX_EVENTS):
+    def __init__(self, t0: float, max_events: int = DEFAULT_MAX_EVENTS,
+                 epoch: Optional[float] = None):
         self.t0 = float(t0)
         #: (seconds since t0, event name, attrs dict or None)
         self.events: List[Tuple[float, str, Optional[dict]]] = []
@@ -63,6 +66,16 @@ class RequestTimeline:
         #: capped timeline's decode_stall_s stays honest
         self.dropped_tick_s = 0.0
         self.max_events = int(max_events)
+        #: distributed-trace correlation (docs/observability.md
+        #: "Distributed tracing"): set by the submitter when the
+        #: request arrived with a traceparent; None otherwise
+        self.trace_id: Optional[str] = None
+        self.parent_span_id: Optional[str] = None
+        #: wall-clock anchor for the monotonic t0 — what lets the
+        #: fleet assembler place this process's relative times on the
+        #: router's axis (skew reported, not hidden)
+        self.epoch_unix_s = round(
+            time.time() if epoch is None else float(epoch), 6)
 
     def add(self, t: float, event: str, **attrs) -> None:
         """Append one event at absolute clock time `t`; counts (instead
@@ -127,4 +140,7 @@ class RequestTimeline:
             if attrs:
                 e.update(attrs)
             events.append(e)
-        return {"events": events, "dropped_events": self.dropped}
+        return {"events": events, "dropped_events": self.dropped,
+                "trace_id": self.trace_id,
+                "parent_span_id": self.parent_span_id,
+                "epoch_unix_s": self.epoch_unix_s}

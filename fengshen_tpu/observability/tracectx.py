@@ -1,0 +1,332 @@
+"""Distributed trace context: W3C-traceparent-style ids, a per-process
+span ledger, and cross-process trace assembly (docs/observability.md
+"Distributed tracing").
+
+PR 8 gave each replica an exact per-request waterfall and PR 10 put a
+retrying router in front of N replicas — so one user-visible latency
+now spans processes, and no single timeline explains it: a retried
+request's story lives half in the router (placement, backoff, the
+failed attempt) and half in two replicas (the wedged execution, the
+successful one). This module is the correlation layer:
+
+- **ids**: `TraceIds` mints 128-bit trace ids and 64-bit span ids in
+  lowercase hex, W3C trace-context shaped. Seedable (`TraceIds(seed)`)
+  so tests get deterministic id streams; unseeded instances draw from
+  OS entropy via `random.Random()`. `TraceContext.to_traceparent()` /
+  `parse_traceparent()` round-trip the `00-<trace>-<span>-01` header
+  form that crosses process boundaries (as an HTTP header AND a JSON
+  body field — proxies that strip unknown headers don't break the
+  chain).
+
+- **ledger**: `SpanLedger` is a bounded host-side record of spans per
+  trace. Every span stores its start on the process's MONOTONIC clock
+  (relative to the trace's first span) and the trace stores one
+  `epoch_unix_s` wall-clock anchor taken at trace start — that pair is
+  what lets `assemble` place two processes' monotonic timelines on one
+  axis while REPORTING the residual clock skew instead of hiding it.
+  All bookkeeping is plain-dict host work on the caller's thread
+  (router / scheduler threads only — never traced code; the
+  `trace_context_clean.py` fslint fixture pins this idiom).
+
+- **assembly**: `assemble_trace` stitches a router-side ledger trace
+  with the involved replicas' `/debug/requests/<id>` waterfalls into
+  ONE cross-process JSON document. Per-replica attachments carry
+  `offset_in_trace_s` (the replica's wall anchor minus the router's)
+  and `clock_skew_s` (that offset minus when the router actually
+  dispatched the attempt — network delay plus NTP error; a large value
+  means the hosts disagree about time and the waterfall positions are
+  only as trustworthy as that number). Unreachable replicas attach an
+  `error` entry — a dead process must not make the trace un-renderable.
+
+Everything is pure stdlib and deterministic given injected clocks:
+rendering rounds floats to 6 dp and relies on `sort_keys` dumping, so
+the `/debug/traces/<id>` payload is byte-identical across
+PYTHONHASHSEED (pinned by subprocess test, like `/fleet`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+#: the only traceparent version this repo emits
+TRACEPARENT_VERSION = "00"
+
+#: traces the ledger retains (oldest evicted); the flight-recorder
+#: `traces.json` provider reports at most this many
+DEFAULT_MAX_TRACES = 128
+
+#: spans ONE trace record retains: a client may legitimately reuse one
+#: traceparent across many requests (one client trace spanning N
+#: calls), and joining must not grow a single record without bound —
+#: past the cap new spans are dropped and counted (`spans_dropped` in
+#: the rendered trace), like the timeline's per-request event cap
+DEFAULT_MAX_SPANS_PER_TRACE = 512
+
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+_SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+_VERSION_RE = re.compile(r"^[0-9a-f]{2}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop's identity: WHICH trace, and WHO the parent span is."""
+
+    trace_id: str
+    span_id: str
+
+    def to_traceparent(self) -> str:
+        """The wire form (W3C trace-context header shape)."""
+        return f"{TRACEPARENT_VERSION}-{self.trace_id}-{self.span_id}-01"
+
+
+def parse_traceparent(value: Any) -> Optional[TraceContext]:
+    """Parse a traceparent string; None on anything malformed — an
+    unparseable header must degrade to "start a fresh trace", never to
+    an error on the request path."""
+    if not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if version == "ff" or not _VERSION_RE.match(version):
+        return None      # ff (and any non-hex) is forbidden by the spec
+    if not _TRACE_ID_RE.match(trace_id) or set(trace_id) == {"0"}:
+        return None
+    if not _SPAN_ID_RE.match(span_id) or set(span_id) == {"0"}:
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+class TraceIds:
+    """Id mint. Seeded → deterministic stream (tests); unseeded →
+    OS-entropy-seeded. All-zero ids are invalid per the W3C spec, so
+    the mint maps a zero draw to 1."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def trace_id(self) -> str:
+        with self._lock:
+            v = self._rng.getrandbits(128)
+        return f"{v or 1:032x}"
+
+    def span_id(self) -> str:
+        with self._lock:
+            v = self._rng.getrandbits(64)
+        return f"{v or 1:016x}"
+
+
+class SpanLedger:
+    """Bounded per-process span records keyed by trace id.
+
+    One ledger per process role (the router owns one; replicas' request
+    timelines already serve the same purpose on their side). Spans are
+    host-side dicts appended under a lock — cheap enough for the
+    request path, and NEVER called from traced code.
+    """
+
+    def __init__(self, service: str,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 max_traces: int = DEFAULT_MAX_TRACES,
+                 max_spans_per_trace: int = DEFAULT_MAX_SPANS_PER_TRACE,
+                 ids: Optional[TraceIds] = None):
+        self.service = service
+        self._clock = clock
+        self._wall = wall
+        self._ids = ids if ids is not None else TraceIds()
+        self._lock = threading.Lock()
+        self.max_traces = int(max_traces)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        #: trace_id -> {"epoch_unix_s", "_t0", "parent_span_id",
+        #:              "spans": [span dicts w/ internal "_abs" start]}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+
+    # -- recording ----------------------------------------------------
+
+    def start_trace(self, name: str, trace_id: Optional[str] = None,
+                    parent_span_id: Optional[str] = None,
+                    **attrs) -> TraceContext:
+        """Open a trace (or join an incoming one when `trace_id` came
+        off the wire) with its root span; returns the context whose
+        span_id children should parent to. The wall-clock epoch is
+        anchored HERE — every later span is monotonic-relative."""
+        tid = trace_id or self._ids.trace_id()
+        sid = self._ids.span_id()
+        now = self._clock()
+        with self._lock:
+            rec = self._traces.get(tid)
+            if rec is None:
+                rec = {"epoch_unix_s": round(self._wall(), 6),
+                       "_t0": now, "spans": []}
+                self._traces[tid] = rec
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            self._append_locked(rec, self._span(rec, sid, name,
+                                                parent_span_id, now,
+                                                attrs))
+        return TraceContext(trace_id=tid, span_id=sid)
+
+    def start_span(self, trace_id: str, name: str,
+                   parent_span_id: Optional[str], **attrs
+                   ) -> Optional[str]:
+        """Open a child span; None when the trace was already evicted
+        (recording must degrade, never raise, on the request path)."""
+        sid = self._ids.span_id()
+        now = self._clock()
+        with self._lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return None
+            if not self._append_locked(rec, self._span(
+                    rec, sid, name, parent_span_id, now, attrs)):
+                return None
+        return sid
+
+    def end_span(self, trace_id: str, span_id: Optional[str],
+                 **attrs) -> None:
+        """Close a span: stamp duration, merge closing attrs (outcome,
+        status, backoff...). Unknown trace/span is a no-op."""
+        if span_id is None:
+            return
+        now = self._clock()
+        with self._lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return
+            for span in reversed(rec["spans"]):
+                if span["span_id"] == span_id:
+                    span["duration_s"] = round(now - span["_abs"], 6)
+                    span["attrs"].update(self._clean(attrs))
+                    return
+
+    def _append_locked(self, rec: dict, span: dict) -> bool:
+        """Append under the per-record span cap; a dropped span is
+        counted, never an error (recording degrades on the request
+        path — `start_span` returning None makes `end_span` a no-op)."""
+        if len(rec["spans"]) >= self.max_spans_per_trace:
+            rec["dropped"] = rec.get("dropped", 0) + 1
+            return False
+        rec["spans"].append(span)
+        return True
+
+    @staticmethod
+    def _span(rec: dict, sid: str, name: str,
+              parent_span_id: Optional[str], now: float,
+              attrs: dict) -> dict:
+        return {"span_id": sid, "parent_span_id": parent_span_id,
+                "name": name,
+                "t_start_s": round(now - rec["_t0"], 6),
+                "duration_s": None, "_abs": now,
+                "attrs": SpanLedger._clean(attrs)}
+
+    @staticmethod
+    def _clean(attrs: dict) -> dict:
+        """JSON-ready attrs: floats rounded so rendering is
+        byte-deterministic."""
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in attrs.items()}
+
+    # -- reading ------------------------------------------------------
+
+    def get_trace(self, trace_id: str) -> Optional[dict]:
+        """One trace's JSON-ready record (spans in creation order)."""
+        with self._lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return None
+            return self._render_locked(trace_id, rec)
+
+    def recent(self, n: Optional[int] = None) -> List[dict]:
+        """The last `n` traces (default: all retained), oldest first."""
+        with self._lock:
+            ids = list(self._traces)
+            if n is not None:
+                ids = ids[-int(n):]
+            return [self._render_locked(t, self._traces[t])
+                    for t in ids]
+
+    def provider(self) -> dict:
+        """The flight-recorder `traces.json` payload: post-mortem
+        bundles carry the last-N traces this process handled."""
+        return {"service": self.service, "traces": self.recent()}
+
+    def _render_locked(self, trace_id: str, rec: dict) -> dict:
+        out = {
+            "trace_id": trace_id,
+            "service": self.service,
+            "epoch_unix_s": rec["epoch_unix_s"],
+            "spans": [{k: v for k, v in span.items() if k != "_abs"}
+                      for span in rec["spans"]],
+        }
+        if rec.get("dropped"):
+            out["spans_dropped"] = rec["dropped"]
+        return out
+
+
+def assemble_trace(router_trace: dict,
+                   replica_fetches: Dict[str, dict]) -> dict:
+    """Stitch one router ledger trace with the involved replicas'
+    per-process waterfalls into ONE cross-process document.
+
+    `replica_fetches` maps replica name to either
+    ``{"waterfall": <GET /debug/requests/<id> payload>}`` or
+    ``{"error": <why the fetch failed>}`` — the caller (the router)
+    owns the HTTP; this function owns the clock math:
+
+    - each process recorded its own monotonic timeline anchored by one
+      wall-clock ``epoch_unix_s``;
+    - a replica attachment's ``offset_in_trace_s`` places its t=0 on
+      the router's axis (replica epoch − router epoch);
+    - ``clock_skew_s`` is that offset minus the router-side start of
+      the FIRST attempt to that replica: network delay + host clock
+      disagreement, reported rather than hidden (a negative value
+      means the replica's clock runs behind the router's).
+
+    The per-process phase invariant (queue_wait + prefill + decode ==
+    total, PR 8) is preserved untouched: waterfalls are attached
+    verbatim, never re-timed.
+    """
+    spans = router_trace.get("spans", [])
+    request_id = None
+    for span in spans:
+        rid = span.get("attrs", {}).get("request_id")
+        if rid is not None:
+            request_id = rid
+            break
+    attempt_start: Dict[str, float] = {}
+    for span in spans:
+        if span.get("name") != "router/attempt":
+            continue
+        rep = span.get("attrs", {}).get("replica")
+        if rep is not None and rep not in attempt_start:
+            attempt_start[rep] = span.get("t_start_s", 0.0)
+    epoch = router_trace.get("epoch_unix_s")
+    replicas = {}
+    for name in sorted(replica_fetches):
+        entry = dict(replica_fetches[name])
+        waterfall = entry.get("waterfall")
+        if isinstance(waterfall, dict):
+            rep_epoch = waterfall.get("epoch_unix_s")
+            if isinstance(rep_epoch, (int, float)) and \
+                    isinstance(epoch, (int, float)):
+                offset = float(rep_epoch) - float(epoch)
+                entry["offset_in_trace_s"] = round(offset, 6)
+                entry["clock_skew_s"] = round(
+                    offset - float(attempt_start.get(name, 0.0)), 6)
+        replicas[name] = entry
+    return {
+        "schema": 1,
+        "trace_id": router_trace.get("trace_id"),
+        "request_id": request_id,
+        "router": router_trace,
+        "replicas": replicas,
+    }

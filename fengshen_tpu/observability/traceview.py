@@ -1,0 +1,195 @@
+"""traceview: convert assembled traces (or flight-recorder trace
+bundles) to Chrome trace-event JSON loadable in Perfetto /
+chrome://tracing (docs/observability.md "Distributed tracing").
+
+    # an assembled /debug/traces/<id> payload saved to a file
+    python -m fengshen_tpu.observability.traceview trace.json -o out.json
+
+    # a flight-recorder bundle directory (reads its traces.json)
+    python -m fengshen_tpu.observability.traceview fstpu_dumps/dump-0000-sigterm
+
+The output is the Chrome trace-event "JSON object format":
+``{"traceEvents": [...], "displayTimeUnit": "ms"}`` where each
+complete-span event is ``{"name", "cat", "ph": "X", "ts", "dur",
+"pid", "tid", "args"}`` (ts/dur in MICROSECONDS) plus ``"M"``
+process_name metadata rows naming each process. One pid per process:
+the router is pid 1, each attached replica the next pid in sorted
+order — Perfetto then draws the cross-process waterfall as stacked
+tracks on one time axis.
+
+Clock anchoring follows the assembler's math: a replica's events are
+shifted by its ``offset_in_trace_s`` onto the router's axis; if any
+event would land before t=0 (a replica clock running behind the
+router's), the WHOLE view is shifted right so every timestamp is
+non-negative — relative ordering, which is what the view is for, is
+unaffected, and the per-replica ``clock_skew_s`` rides along in the
+attachment's args so the viewer can judge how much to trust the
+alignment.
+
+Pure stdlib, deterministic output (sorted keys, integer microseconds):
+the same input bytes produce the same output bytes under any
+PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from fengshen_tpu.observability.timeline import PHASE_NAMES
+
+
+def _us(seconds: float) -> int:
+    return int(round(float(seconds) * 1e6))
+
+
+def _meta(pid: int, name: str) -> dict:
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def _span_events(spans: List[dict], pid: int, cat: str) -> List[dict]:
+    events = []
+    for span in spans:
+        dur = span.get("duration_s")
+        args = dict(span.get("attrs") or {})
+        args["span_id"] = span.get("span_id")
+        if span.get("parent_span_id"):
+            args["parent_span_id"] = span["parent_span_id"]
+        events.append({
+            "name": span.get("name", "span"), "cat": cat, "ph": "X",
+            "ts": _us(span.get("t_start_s", 0.0)),
+            "dur": _us(dur if dur is not None else 0.0),
+            "pid": pid, "tid": 1, "args": args,
+        })
+    return events
+
+
+def _waterfall_events(entry: dict, pid: int) -> List[dict]:
+    """One replica attachment → phase spans + instant lifecycle
+    marks, shifted onto the router's axis by offset_in_trace_s."""
+    base = float(entry.get("offset_in_trace_s") or 0.0)
+    args_common = {}
+    if "clock_skew_s" in entry:
+        args_common["clock_skew_s"] = entry["clock_skew_s"]
+    if "waterfall" not in entry:
+        # a dead replica degraded to an {"error": ...} attachment:
+        # render the diagnostic, not a healthy-looking empty track
+        return [{
+            "name": "fetch_error", "cat": "replica", "ph": "i",
+            "s": "t", "ts": _us(base), "pid": pid, "tid": 1,
+            "args": dict(args_common, error=entry.get("error")),
+        }]
+    waterfall = entry.get("waterfall") or {}
+    events = []
+    phases = waterfall.get("phases") or {}
+    cursor = base
+    for phase in PHASE_NAMES:
+        dur = float(phases.get(phase) or 0.0)
+        events.append({
+            "name": phase[:-2], "cat": "replica", "ph": "X",
+            "ts": _us(cursor), "dur": _us(dur), "pid": pid, "tid": 1,
+            "args": dict(args_common,
+                         request_id=waterfall.get("request_id")),
+        })
+        cursor += dur
+    for ev in waterfall.get("events") or []:
+        args = {k: v for k, v in ev.items() if k not in ("t_s", "event")}
+        events.append({
+            "name": ev.get("event", "event"), "cat": "replica",
+            "ph": "i", "s": "t",
+            "ts": _us(base + float(ev.get("t_s") or 0.0)),
+            "pid": pid, "tid": 2, "args": args,
+        })
+    return events
+
+
+def chrome_trace(payload: dict) -> dict:
+    """Convert ONE of the three input shapes to trace-event JSON:
+    an assembled `/debug/traces/<id>` document ({"router", "replicas"}),
+    a ledger/provider dump ({"service", "traces": [...]}), or a single
+    raw ledger trace ({"trace_id", "spans"})."""
+    events: List[dict] = []
+    other = {}
+    if "router" in payload:                      # assembled document
+        router = payload.get("router") or {}
+        service = router.get("service") or "router"
+        events.append(_meta(1, service))
+        events.extend(_span_events(router.get("spans") or [], 1,
+                                   service))
+        for i, name in enumerate(sorted(payload.get("replicas") or {})):
+            pid = 2 + i
+            events.append(_meta(pid, name))
+            events.extend(_waterfall_events(
+                payload["replicas"][name], pid))
+        other = {"trace_id": payload.get("trace_id"),
+                 "request_id": payload.get("request_id")}
+    elif "traces" in payload:                    # provider dump
+        service = payload.get("service") or "service"
+        events.append(_meta(1, service))
+        for trace in payload.get("traces") or []:
+            events.extend(_span_events(trace.get("spans") or [], 1,
+                                       service))
+        other = {"service": service,
+                 "traces": len(payload.get("traces") or [])}
+    else:                                        # one raw ledger trace
+        service = payload.get("service") or "service"
+        events.append(_meta(1, service))
+        events.extend(_span_events(payload.get("spans") or [], 1,
+                                   service))
+        other = {"trace_id": payload.get("trace_id")}
+    # Perfetto dislikes negative timestamps (a replica clock running
+    # behind the router's): shift everything right, keep ordering
+    min_ts = min((e["ts"] for e in events if e["ph"] != "M"),
+                 default=0)
+    if min_ts < 0:
+        for e in events:
+            if e["ph"] != "M":
+                e["ts"] -= min_ts
+        other["shifted_us"] = -min_ts
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def _load(path: str) -> Optional[dict]:
+    """A json file, or a flight-recorder bundle dir (its traces.json)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "traces.json")
+    try:
+        with open(path) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fengshen_tpu.observability.traceview",
+        description="assembled trace / trace bundle -> Chrome "
+                    "trace-event JSON (Perfetto, chrome://tracing)")
+    parser.add_argument("input", type=str,
+                        help="assembled-trace json file, ledger dump, "
+                             "or flight-recorder bundle directory")
+    parser.add_argument("-o", "--output", type=str, default=None,
+                        help="output path (default: stdout)")
+    args = parser.parse_args(argv)
+    payload = _load(args.input)
+    if payload is None:
+        print(f"traceview: cannot read a trace from {args.input!r}",
+              file=sys.stderr)
+        return 2
+    text = json.dumps(chrome_trace(payload), sort_keys=True, indent=1)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

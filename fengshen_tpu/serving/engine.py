@@ -219,7 +219,7 @@ class Request:
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  request_id: Optional[str], deadline: Optional[float],
-                 submit_time: float):
+                 submit_time: float, epoch: Optional[float] = None):
         self.request_id = request_id if request_id is not None else \
             f"req-{next(Request._ids)}"
         self.prompt = prompt
@@ -235,8 +235,10 @@ class Request:
         self._done = threading.Event()
         #: host-side lifecycle events (docs/observability.md "Request
         #: tracing") — appended on the scheduler thread only, never
-        #: inside traced code
-        self.timeline = RequestTimeline(submit_time)
+        #: inside traced code. `epoch` is the wall-clock anchor for
+        #: `submit_time`'s monotonic axis (the engine's injectable
+        #: wall clock) — what the fleet assembler's skew math reads.
+        self.timeline = RequestTimeline(submit_time, epoch=epoch)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request leaves the engine (finished /
@@ -264,7 +266,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model: Any, params: Any, config: EngineConfig,
                  log: Optional[Callable[[dict], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 aot: Any = None, recorder: Any = None):
+                 aot: Any = None, recorder: Any = None,
+                 wall: Callable[[], float] = time.time):
         self.model = model
         self.params = params
         self.config = config
@@ -272,6 +275,10 @@ class ContinuousBatchingEngine:
         self.metrics = EngineMetrics()
         self._log = log or (lambda entry: None)
         self._clock = clock
+        # wall-clock anchor for request timelines: pairs with the
+        # injectable monotonic `clock` so the fleet assembler's
+        # cross-process skew math is deterministic under test
+        self._wall = wall
         # debug introspection state (docs/serving.md "Debug endpoints"):
         # a bounded ring of finished-request timelines, engine start
         # time for /stats uptime, and the last serve-loop error (type +
@@ -548,23 +555,35 @@ class ContinuousBatchingEngine:
         self._recent.append(self._request_dict(req))
 
     def _reject_prompt(self, ids: np.ndarray, reason: str,
-                       request_id: Optional[str], **attrs) -> None:
+                       request_id: Optional[str],
+                       trace_id: Optional[str] = None,
+                       parent_span_id: Optional[str] = None,
+                       **attrs) -> None:
         """413-class rejections happen before a Request enters the
         queue, but their timelines still belong in the debug ring — a
         burst of 413s must be diagnosable from `GET /debug/requests`
         and the post-mortem bundle, like the 429s are."""
-        req = Request(ids, 0, request_id, None, self._clock())
+        req = Request(ids, 0, request_id, None, self._clock(),
+                      epoch=self._wall())
+        req.timeline.trace_id = trace_id
+        req.timeline.parent_span_id = parent_span_id
         with self._cv:
             self._record_rejection_locked(
                 req, reason, prompt_tokens=int(len(ids)), **attrs)
 
     def submit(self, input_ids, max_new_tokens: Optional[int] = None,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None) -> Request:
         """Queue a prompt. Raises QueueFull (backpressure) or
         PromptTooLong (no bucket / no cache headroom). `deadline_s` is
         seconds from now; an expired request frees its slot and
-        finishes with reason "deadline"."""
+        finishes with reason "deadline". `trace_id`/`parent_span_id`
+        are the distributed-trace correlation ids carried in off the
+        wire (docs/observability.md "Distributed tracing") — pure
+        host-side bookkeeping stamped onto the request's timeline and
+        debug-ring entry, never an input to any traced program."""
         if self._draining:
             # checked again under the lock below; this early exit just
             # spares rejected requests the bucket/blocks math
@@ -582,7 +601,9 @@ class ContinuousBatchingEngine:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
-            self._reject_prompt(ids, "prompt_too_long", request_id)
+            self._reject_prompt(ids, "prompt_too_long", request_id,
+                                trace_id=trace_id,
+                                parent_span_id=parent_span_id)
             raise PromptTooLong(
                 f"prompt of {len(ids)} tokens exceeds the largest "
                 f"bucket {self.ladder.max_bucket}")
@@ -599,6 +620,8 @@ class ContinuousBatchingEngine:
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
             self._reject_prompt(ids, "prompt_too_long", request_id,
+                                trace_id=trace_id,
+                                parent_span_id=parent_span_id,
                                 bucket=int(bucket))
             raise PromptTooLong(
                 f"bucket {bucket} leaves no decode headroom in the "
@@ -621,6 +644,7 @@ class ContinuousBatchingEngine:
                                self._allocator.total_blocks})
                 self._reject_prompt(
                     ids, "kv_pool_too_small", request_id,
+                    trace_id=trace_id, parent_span_id=parent_span_id,
                     blocks_needed=int(need),
                     blocks_total=int(self._allocator.total_blocks))
                 raise PromptTooLong(
@@ -629,7 +653,9 @@ class ContinuousBatchingEngine:
         now = self._clock()
         req = Request(ids, max_new, request_id,
                       None if deadline_s is None else now + deadline_s,
-                      now)
+                      now, epoch=self._wall())
+        req.timeline.trace_id = trace_id
+        req.timeline.parent_span_id = parent_span_id
         with span("serving/admit"), self._cv:
             if self._draining:
                 self.metrics.count("rejected_draining")
@@ -1225,11 +1251,13 @@ class ContinuousBatchingEngine:
 
     @staticmethod
     def _request_summary(d: dict) -> dict:
-        """The list-endpoint row: the waterfall minus its event log."""
+        """The list-endpoint row: the waterfall minus its event log.
+        trace_id rides along so a fleet trace can be followed from the
+        list without fetching every full timeline."""
         return {k: d[k] for k in
                 ("request_id", "state", "finish_reason",
                  "prompt_tokens", "generated_tokens", "slot",
-                 "ttft_s", "phases")}
+                 "ttft_s", "phases", "trace_id")}
 
     def _live_summary_locked(self, req: Request) -> dict:
         """Summary for a LIVE request without materializing its event
@@ -1242,7 +1270,8 @@ class ContinuousBatchingEngine:
                 "slot": req.slot,
                 "ttft_s": (None if req.ttft_s is None
                            else round(req.ttft_s, 6)),
-                "phases": req.timeline.phases(self._clock())}
+                "phases": req.timeline.phases(self._clock()),
+                "trace_id": req.timeline.trace_id}
 
     def _live_requests_locked(self) -> list:
         return list(self._queue) + [r for r in self._slot_req

@@ -1,0 +1,85 @@
+"""known-clean fixture: the distributed-tracing idiom (ISSUE 11,
+docs/observability.md "Distributed tracing") — ALL trace bookkeeping
+lives on the host, on the router/scheduler threads, between jit
+boundaries. Trace ids come from a (seedable) `random.Random`, span
+starts from `time.monotonic()` with a `time.time()` wall anchor, and
+the ledger appends plain dicts under a lock — which is only safe
+because none of it ever enters a traced program: the decode tick the
+spans DESCRIBE stays a pure device function. The tempting regressions
+this fixture guards: minting a trace/span id inside traced code
+(host-divergence: `random`/`uuid` under trace), stamping a span's wall
+anchor inside a jitted step (host-divergence: `time.*` under trace),
+pulling a device value per request to enrich span attrs
+(blocking-transfer), or bumping the `fstpu_trace_*` counters from a
+traced helper (metrics-in-traced-code).
+
+Mirrors `fengshen_tpu/observability/tracectx.py`'s ledger around
+`fengshen_tpu/fleet/router.py`'s attempt loop: if a rule fires here,
+it would also flag the real modules and block the merge gate.
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+TRACES = REG.counter("fx_trace_started_total", "traces minted")
+ATTEMPTS = REG.histogram("fx_fleet_attempt_seconds",
+                         "attempt seconds by outcome",
+                         labelnames=("outcome",))
+
+
+@jax.jit
+def traced_decode_tick(cache, tokens, phys, active):
+    """The work a span DESCRIBES: pure gathers/scatters — no clock,
+    no rng-for-ids, no counter mutation ever lands in here."""
+    n = tokens.shape[0]
+    cache = cache.at[jnp.arange(n), phys].set(tokens)
+    nxt = jnp.where(active, tokens + 1, 0).astype(jnp.int32)
+    return cache, nxt
+
+
+def mint_ids(rng=random.Random(0)):
+    """Host-side id mint (the seedable test form): W3C-shaped hex ids
+    drawn OUTSIDE every traced program."""
+    trace_id = f"{rng.getrandbits(128) or 1:032x}"
+    span_id = f"{rng.getrandbits(64) or 1:016x}"
+    return trace_id, span_id
+
+
+def record_attempt(ledger, trace_id, replica, send,
+                   clock=time.monotonic, wall=time.time):
+    """The router's attempt span: start/end stamps from the HOST
+    monotonic clock, the wall anchor taken once at span start, the
+    outcome histogram bumped after the HTTP round-trip returns —
+    none of it inside a jit boundary."""
+    _, span_id = mint_ids()
+    span = {"span_id": span_id, "replica": replica,
+            "epoch_unix_s": round(wall(), 6), "t0": clock()}
+    ok = send(replica)
+    span["duration_s"] = round(clock() - span["t0"], 6)
+    span["outcome"] = "ok" if ok else "connect"
+    ATTEMPTS.labels(span["outcome"]).observe(span["duration_s"])
+    ledger.setdefault(trace_id, []).append(span)
+    return span
+
+
+def drive_traced_request(state, tokens, ledger):
+    """One traced tick bracketed by host-side spans: the jit boundary
+    is crossed exactly once, and the host sync (np.array) happens
+    strictly AFTER it — the span end stamp reads the host clock, not a
+    device value."""
+    trace_id, _ = mint_ids()
+    TRACES.inc()
+    cache, phys, active = state
+    t0 = time.monotonic()
+    cache, nxt = traced_decode_tick(cache, tokens, phys, active)
+    out = np.array(nxt)            # host sync OUTSIDE the jit
+    ledger.setdefault(trace_id, []).append(
+        {"name": "decode", "duration_s": time.monotonic() - t0})
+    return cache, out

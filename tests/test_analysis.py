@@ -496,6 +496,34 @@ def test_fleet_router_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_trace_context_internals_are_clean():
+    """Regression fixture for the distributed-tracing tier (ISSUE 11,
+    docs/observability.md "Distributed tracing"): trace/span ids come
+    from a host-side `random.Random`, span stamps from host clocks,
+    and the ledger/assembly are plain-dict work on the router and
+    scheduler threads — neither `host-divergence`,
+    `blocking-transfer` nor `metrics-in-traced-code` may fire on the
+    fixture or on the real modules (the observability package that
+    owns the ledger, the fleet package that records the spans, and
+    the serving+api layers the context flows through). A hit means a
+    trace id mint / wall anchor / counter leaked into a traced
+    program (a real hazard: tracing must add ZERO per-token work) or
+    a rule lost precision."""
+    fixture = os.path.join(FIXTURES, "trace_context_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "observability"),
+             os.path.join(PKG, "fleet"),
+             os.path.join(PKG, "serving"),
+             os.path.join(PKG, "api")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_paged_cache_internals_are_clean():
     """Regression fixture for the paged KV cache (ISSUE 6): block
     free-list math stays host-side, the traced gather/scatter decode

@@ -468,6 +468,55 @@ def test_jsonl_sink_size_rotation(tmp_path):
     assert not os.path.exists(p2 + ".1")
 
 
+def test_jsonl_sink_rotation_under_concurrent_writers(tmp_path):
+    """ISSUE 11 satellite: two threads logging across rotation
+    boundaries — every surviving line parses (no interleaved/corrupt
+    writes), no line is lost from the retained window, and every
+    backup in the chain is well-formed jsonl. The sink's internal lock
+    is what makes the multi-step rotate-then-append atomic; without it
+    a racing writer can append to the file mid-rename and lose its
+    line."""
+    path = str(tmp_path / "metrics.jsonl")
+    # small cap + a backup chain deep enough for the WHOLE stream:
+    # every line survives somewhere, so lost writes are detectable,
+    # not masked by legitimate aging-out (2x100 lines x ~60 B ≈ 12 KB
+    # « 64 backups x 256 B + slack)
+    sink = JsonlSink(path=path, max_bytes=256, backups=64)
+    n_per_thread = 100
+    errors = []
+
+    def writer(tag):
+        try:
+            for i in range(n_per_thread):
+                sink({"event": "step", "writer": tag, "i": i,
+                      "pad": "x" * (i % 7)})
+        except Exception as e:  # noqa: BLE001 — surface in-thread
+            errors.append(e)    # failures as test failures
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    kept = []
+    chain = [path] + [f"{path}.{i}" for i in range(1, 65)]
+    for name in chain:
+        if not os.path.exists(name):
+            continue
+        with open(name) as f:
+            for line in f:
+                entry = json.loads(line)     # well-formed or it raises
+                assert entry["event"] == "step"
+                kept.append(entry)
+    # zero lost lines: both writers' full sequences are present
+    assert len(kept) == 2 * n_per_thread
+    for tag in ("a", "b"):
+        seq = sorted(e["i"] for e in kept if e["writer"] == tag)
+        assert seq == list(range(n_per_thread))
+
+
 # ---- API surface (stdlib path) ------------------------------------------
 
 def test_debug_endpoints_and_http_latency_stdlib(tiny, tmp_path):

@@ -1,0 +1,204 @@
+"""PR 11 verify drive: the REAL distributed-tracing surface end to end.
+
+Spawns two real replica subprocesses + the REAL router process (the
+PR-10 fleet_drive recipe), then proves over HTTP: a routed generate is
+token-exact AND returns a trace_id; GET /debug/traces/<trace_id>
+assembles ONE cross-process document (router span ledger + the
+replica's waterfall, phases summing exactly, clock offset/skew
+reported); an incoming traceparent is JOINED; /fleet carries the new
+poll-staleness fields; /metrics renders the attempt histogram + trace
+counters; and `python -m fengshen_tpu.observability.traceview`
+converts the assembled doc to loadable Chrome trace-event JSON.
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, "/root/repo")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+       "FLEET_BENCH_VOCAB": "256", "FLEET_BENCH_HIDDEN": "64",
+       "FLEET_BENCH_INTER": "128", "FLEET_BENCH_LAYERS": "2",
+       "FLEET_BENCH_HEADS": "4", "FLEET_BENCH_BUCKETS": "16,32",
+       "FLEET_BENCH_NEW_TOKENS": "8", "FLEET_BENCH_SLOTS": "2"}
+
+P1, P2, RP = 8471, 8472, 8470
+
+
+def get(url, timeout=5, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def post(url, body, timeout=60, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_200(url, deadline_s=120):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        try:
+            if get(url)[0] == 200:
+                return True
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return False
+
+
+reps = [subprocess.Popen(
+    [sys.executable, "-m", "fengshen_tpu.fleet.bench", "--replica",
+     "--port", str(p)], env=ENV) for p in (P1, P2)]
+router = subprocess.Popen(
+    [sys.executable, "-m", "fengshen_tpu.fleet",
+     "--replicas", f"127.0.0.1:{P1},127.0.0.1:{P2}",
+     "--host", "127.0.0.1", "--port", str(RP),
+     "--poll-interval", "0.2", "--recovery-probes", "1"], env=ENV)
+
+try:
+    assert wait_200(f"http://127.0.0.1:{RP}/healthz"), "router not up"
+    t0 = time.time()
+    while time.time() - t0 < 30:
+        code, fleet = get(f"http://127.0.0.1:{RP}/fleet")
+        if fleet["healthy"] == 2:
+            break
+        time.sleep(0.2)
+    assert fleet["healthy"] == 2, fleet
+    print("OK router up, 2 healthy")
+
+    # ---- satellite: /fleet poll-staleness fields --------------------
+    for rep in fleet["replicas"]:
+        assert isinstance(rep["last_poll_age_s"], (int, float)), rep
+        assert rep["last_poll_age_s"] < 5.0, rep
+        assert rep["consecutive_failures"] == 0, rep
+    print("OK /fleet last_poll_age_s + consecutive_failures")
+
+    # ---- traced, token-exact generate through the router ------------
+    import jax.numpy as jnp
+    import numpy as np
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.utils.generate import generate
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=40, dtype="float32")
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(0))
+    prompt = [5, 7, 9, 11]
+    ref = np.asarray(generate(
+        model, params, jnp.asarray(prompt)[None],
+        max_new_tokens=8))[0, len(prompt):].tolist()
+    code, body = post(f"http://127.0.0.1:{RP}/api/text_generation",
+                      {"input_text": "5 7 9 11"})
+    assert code == 200, (code, body)
+    assert body["result"] == " ".join(str(t) for t in ref), body
+    tid, rid = body["trace_id"], body["request_id"]
+    assert re.fullmatch(r"[0-9a-f]{32}", tid), tid
+    print("OK token-exact through router, trace_id", tid)
+
+    # ---- cross-process assembly at the router -----------------------
+    code, doc = get(f"http://127.0.0.1:{RP}/debug/traces/{tid}")
+    assert code == 200, (code, doc)
+    assert doc["schema"] == 1 and doc["trace_id"] == tid, doc
+    assert doc["request_id"] == rid, doc
+    names = [s["name"] for s in doc["router"]["spans"]]
+    for want in ("fleet/request", "router/enqueue",
+                 "router/placement", "router/attempt"):
+        assert want in names, names
+    att = [s for s in doc["router"]["spans"]
+           if s["name"] == "router/attempt"]
+    assert len(att) == 1 and att[0]["attrs"]["outcome"] == "ok", att
+    assert len(doc["replicas"]) == 1, list(doc["replicas"])
+    (rep_name, entry), = doc["replicas"].items()
+    wf = entry["waterfall"]
+    assert wf["trace_id"] == tid, wf
+    ph = wf["phases"]
+    total = ph["queue_wait_s"] + ph["prefill_s"] + ph["decode_s"]
+    assert abs(total - ph["total_s"]) < 1e-3, ph
+    assert isinstance(entry["offset_in_trace_s"], float), entry
+    assert isinstance(entry["clock_skew_s"], float), entry
+    print("OK assembled trace: 1 attempt span on", rep_name,
+          "phases sum", round(total, 4), "skew",
+          entry["clock_skew_s"])
+
+    # the replica's own debug ring carries the correlation too
+    port = int(rep_name.rsplit(":", 1)[1])
+    code, payload = get(f"http://127.0.0.1:{port}/debug/requests/{rid}")
+    assert code == 200 and payload["trace_id"] == tid, payload
+    # unknown trace id -> 404
+    code, _ = get(f"http://127.0.0.1:{RP}/debug/traces/{'0' * 32}")
+    assert code == 404, code
+    print("OK replica ring correlation + unknown-trace 404")
+
+    # ---- joining an incoming traceparent ----------------------------
+    incoming = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    code, body = post(f"http://127.0.0.1:{RP}/api/text_generation",
+                      {"input_text": "5 7 9 11"},
+                      headers={"traceparent": incoming})
+    assert code == 200 and body["trace_id"] == "ab" * 16, body
+    print("OK joined caller traceparent")
+
+    # ---- router metrics: attempt histogram + trace counters ---------
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{RP}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert 'fstpu_fleet_attempt_seconds_bucket{outcome="ok"' in text
+    assert "fstpu_trace_started_total 2" in text, text[:500]
+    assert "fstpu_trace_assembled_total 1" in text
+    assert 'fstpu_http_request_seconds_bucket{route="/fleet"' in text
+    print("OK /metrics attempt histogram + trace counters")
+
+    # ---- traceview: assembled doc -> Chrome trace-event JSON --------
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "trace.json")
+        out = os.path.join(d, "out.json")
+        with open(src, "w") as f:
+            json.dump(doc, f)
+        rc = subprocess.run(
+            [sys.executable, "-m",
+             "fengshen_tpu.observability.traceview", src, "-o", out],
+            env=ENV, capture_output=True, text=True)
+        assert rc.returncode == 0, rc.stderr
+        with open(out) as f:
+            chrome = json.load(f)
+    assert chrome["displayTimeUnit"] == "ms", chrome.keys()
+    evs = chrome["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} >= {"router", rep_name}
+    for e in spans:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["ts"] >= 0, e
+    assert any(e["name"] == "router/attempt" for e in spans)
+    assert any(e["name"] == "decode" for e in spans)
+    print("OK traceview:", len(spans), "spans,", len(metas),
+          "process rows")
+
+    print("TRACE DRIVE PASSED")
+finally:
+    for p in reps + [router]:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
