@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: lint lint-baseline test test-fast serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
-	serve-fleet aot-bench benchdiff
+	serve-bench-disagg serve-fleet aot-bench benchdiff
 
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
@@ -40,6 +40,16 @@ serve-bench-spec:
 # requests) — one BENCH-schema JSON line carrying the replica count
 serve-bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.fleet.bench
+
+# prefill/decode disaggregation microbench (docs/disaggregation.md):
+# aggregate tokens/s of a prefill-tier + decode-tier fleet (KV handoff
+# through the real router placement + redirect/collect path) vs a
+# homogeneous 3-replica fleet on a long-prompt/short-decode workload,
+# plus the adopt-decline fallback rung — one BENCH-schema JSON line
+# carrying the phase topology
+serve-bench-disagg:
+	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=disagg \
+		$(PY) -m fengshen_tpu.disagg.bench
 
 # local fleet: spawn $(N) stdlib api replicas from the api config
 # $(CONFIG) and front them with the router on port $(PORT)

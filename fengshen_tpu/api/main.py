@@ -83,6 +83,11 @@ class ServerConfig:
     engine: str = "simple"
     warmup: bool = True
     request_timeout_s: float = 120.0
+    # prefill/decode disaggregation role (docs/disaggregation.md):
+    # "prefill" | "decode" | "both". Surfaced in /stats so the fleet
+    # router's phase-aware placement can split the two tiers; "both"
+    # keeps the replica in the homogeneous rotation.
+    phase: str = "both"
     # SIGTERM drain (docs/fleet.md "Drain runbook"): how long the
     # stdlib server waits for in-flight requests before shutting down
     drain_timeout_s: float = 30.0
@@ -98,6 +103,8 @@ class ServerConfig:
             # batch-1 legacy path under a continuous-looking config
             raise ValueError(f"unknown engine {self.engine!r}; expected "
                              "'simple' or 'continuous'")
+        from fengshen_tpu.disagg.policy import validate_phase
+        self.phase = validate_phase(self.phase)
 
 
 @dataclasses.dataclass
@@ -136,15 +143,18 @@ def _healthz_payload(task: str, ready, draining) -> tuple[int, dict]:
     return 200, {"status": "ok", "task": task, "ready": True}
 
 
-def _render_metrics(engine=None) -> str:
+def _render_metrics(engine=None, disagg=None) -> str:
     """Prometheus text over the process-global registry plus (when the
-    continuous engine is up) the engine's own registry; `engine.stats()`
-    runs first so the pool gauges are scrape-fresh."""
+    continuous engine is up) the engine's own registry and the disagg
+    coordinator's (`fstpu_disagg_*`); `engine.stats()` runs first so
+    the pool gauges are scrape-fresh."""
     from fengshen_tpu.observability import get_registry, render_prometheus
     registries = [get_registry()]
     if engine is not None:
         engine.stats()
         registries.append(engine.metrics.registry)
+    if disagg is not None:
+        registries.append(disagg.registry)
     return render_prometheus(*registries)
 
 
@@ -168,6 +178,10 @@ def _classify_route(path: str, api_route: str) -> str:
         # one label for every id — request ids must not become a
         # per-request label cardinality leak
         return "/debug/requests/<id>"
+    if path.startswith("/kv/"):
+        # KV-handoff endpoints (docs/disaggregation.md), same
+        # cardinality rule as the debug routes
+        return "/kv/<id>"
     return path if path in (api_route, "/healthz", "/stats", "/metrics",
                             "/debug/requests", "/debug/dump") else "other"
 
@@ -266,8 +280,8 @@ def start_continuous_engine(pipeline, engine_args: dict, log=None,
     return engine
 
 
-def _engine_generate(engine, pipeline, req: dict,
-                     timeout_s: float) -> tuple[int, dict]:
+def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
+                     disagg=None) -> tuple[int, dict]:
     """Submit one HTTP request to the engine; returns (status, body).
     Backpressure maps to HTTP: queue full → 429, prompt too long → 413,
     engine timeout/eviction → 503, draining replica → 503 with reason,
@@ -276,7 +290,14 @@ def _engine_generate(engine, pipeline, req: dict,
     HTTP header lifted into the body by the server layer) flows into
     `engine.submit` so the request's timeline and debug-ring entry
     carry the fleet trace ids (docs/observability.md "Distributed
-    tracing"); traced responses echo `trace_id` back."""
+    tracing"); traced responses echo `trace_id` back.
+
+    When the fleet router tagged the body with a `disagg_push_to`
+    target and a `disagg` coordinator is wired, the primed lane is
+    handed to that decode replica and the 200 body is a
+    `disagg_redirect` marker the router collects from the peer
+    (docs/disaggregation.md). A failed handoff falls through to the
+    plain local wait below — never a client-visible error."""
     from fengshen_tpu.observability import parse_traceparent
     from fengshen_tpu.serving import (FINISHED, Draining,
                                       DuplicateRequest, PromptTooLong,
@@ -309,6 +330,11 @@ def _engine_generate(engine, pipeline, req: dict,
     except (ValueError, TypeError) as e:
         # bad request payload (unencodable input, max_new_tokens < 1)
         return 422, _body({"error": str(e)})
+    if disagg is not None and req.get("disagg_push_to"):
+        redirect = disagg.handoff(request, str(req["disagg_push_to"]))
+        if redirect is not None:
+            return 200, _body(dict(redirect))
+        # fallback: the lane keeps decoding locally; wait as usual
     if not request.wait(timeout=timeout_s):
         engine.cancel(request.request_id)
         # the request may have completed in the wait→cancel window; a
@@ -327,14 +353,16 @@ def _engine_generate(engine, pipeline, req: dict,
 
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
               server_cfg: Optional[ServerConfig] = None, engine=None,
-              ready=None, recorder=None, draining=None):
+              ready=None, recorder=None, draining=None, disagg=None):
     """Create the FastAPI app around a pipeline instance. `ready` is an
     optional `threading.Event`: until set, `GET /healthz` answers 503
     ("warming") so load balancers keep routing around a replica that is
     still compiling; None means always ready. `draining` is the mirror
     event for the way OUT: once set, `/healthz` answers 503 with reason
     "draining" and new generate requests get 503 while in-flight ones
-    finish (docs/fleet.md). `recorder` enables `POST /debug/dump`."""
+    finish (docs/fleet.md). `recorder` enables `POST /debug/dump`.
+    `disagg` is an optional `DisaggCoordinator` enabling the KV-handoff
+    surface (`PUT/GET/DELETE /kv/<id>`, docs/disaggregation.md)."""
     from fastapi import FastAPI, Header
     from fastapi.middleware.cors import CORSMiddleware
     from fastapi.responses import JSONResponse, Response
@@ -360,6 +388,10 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         # `traceparent` HTTP header; the body form survives proxies
         # that strip unknown headers
         traceparent: Optional[str] = None
+        # phase-aware placement directive (docs/disaggregation.md):
+        # the router names the decode replica this prefill replica
+        # should push the primed lane to; pydantic must not drop it
+        disagg_push_to: Optional[str] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
 
@@ -393,7 +425,7 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
                 payload["traceparent"] = traceparent
             code, body = _engine_generate(
                 engine, pipeline, payload,
-                server_cfg.request_timeout_s)
+                server_cfg.request_timeout_s, disagg=disagg)
             _count_http(api_route, code)
             return JSONResponse(status_code=code, content=body)
         if req.max_new_tokens is not None and \
@@ -418,15 +450,53 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
     def stats():
         _count_http("/stats", 200)
         if engine is not None:
-            return engine.stats()
-        return {"engine": "simple", "task": pipeline_cfg.task}
+            # the replica's disaggregation role EXTENDS the pinned
+            # engine payload (same precedent as uptime_s/draining) —
+            # the fleet router's poll keys phase-aware placement on it
+            return dict(engine.stats(), phase=server_cfg.phase)
+        return {"engine": "simple", "task": pipeline_cfg.task,
+                "phase": server_cfg.phase}
 
     @app.get("/metrics")
     def metrics():
         from fengshen_tpu.observability import CONTENT_TYPE_LATEST
         _count_http("/metrics", 200)
-        return Response(content=_render_metrics(engine),
+        return Response(content=_render_metrics(engine, disagg=disagg),
                         media_type=CONTENT_TYPE_LATEST)
+
+    @app.put("/kv/{request_id}")
+    def kv_put(request_id: str, payload: dict):
+        if disagg is None:
+            _count_http("/kv/<id>", 409)
+            return JSONResponse(
+                status_code=409,
+                content={"adopted": False, "reason": "no_engine"})
+        code, body = disagg.handle_put(request_id, payload)
+        _count_http("/kv/<id>", code)
+        return JSONResponse(status_code=code, content=body)
+
+    @app.get("/kv/{request_id}")
+    def kv_get(request_id: str):
+        if disagg is None:
+            _count_http("/kv/<id>", 404)
+            return JSONResponse(
+                status_code=404,
+                content={"error": "no disagg coordinator"})
+        code, body = disagg.handle_get(request_id,
+                                       server_cfg.request_timeout_s)
+        _count_http("/kv/<id>", code)
+        return JSONResponse(status_code=code, content=body)
+
+    @app.delete("/kv/{request_id}")
+    def kv_delete(request_id: str):
+        if disagg is None:
+            _count_http("/kv/<id>", 404)
+            return JSONResponse(
+                status_code=404,
+                content={"error": "no disagg coordinator"})
+        code, body = disagg.handle_delete(request_id)
+        _count_http("/kv/<id>", code)
+        return JSONResponse(status_code=code, content=body)
 
     @app.get("/debug/requests")
     def debug_requests():
@@ -475,7 +545,7 @@ def _resolve_pipeline(pipeline_cfg: PipelineConfig):
 def build_stdlib_server(server_cfg: ServerConfig,
                         pipeline_cfg: PipelineConfig, pipeline=None,
                         engine=None, ready=None, recorder=None,
-                        draining=None):
+                        draining=None, disagg=None):
     """Dependency-free fallback server (http.server) exposing the SAME
     surface as the FastAPI app: `POST /api/<task>` with
     `{"input_text": ...}`, `GET /healthz` (503 `{"ready": false,
@@ -528,15 +598,30 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 self._send(code, body)
             elif self.path == "/stats":
                 if engine is not None:
-                    self._send(200, engine.stats())
+                    # phase EXTENDS the pinned engine payload (same
+                    # precedent as uptime_s/draining): the fleet
+                    # router's phase-aware placement polls it
+                    self._send(200, dict(engine.stats(),
+                                         phase=server_cfg.phase))
                 else:
                     self._send(200, {"engine": "simple",
-                                     "task": pipeline_cfg.task})
+                                     "task": pipeline_cfg.task,
+                                     "phase": server_cfg.phase})
             elif self.path == "/metrics":
                 from fengshen_tpu.observability import \
                     CONTENT_TYPE_LATEST
-                self._send_bytes(200, _render_metrics(engine).encode(),
-                                 CONTENT_TYPE_LATEST)
+                self._send_bytes(
+                    200, _render_metrics(engine, disagg=disagg).encode(),
+                    CONTENT_TYPE_LATEST)
+            elif self.path.startswith("/kv/"):
+                rid = self.path[len("/kv/"):]
+                if disagg is None:
+                    self._send(404,
+                               {"error": "no disagg coordinator"})
+                else:
+                    code, body = disagg.handle_get(
+                        rid, server_cfg.request_timeout_s)
+                    self._send(code, body)
             elif self.path == "/debug/requests":
                 self._send(200, _debug_requests_payload(engine))
             elif self.path.startswith("/debug/requests/"):
@@ -598,7 +683,7 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 if engine is not None:
                     code, body = _engine_generate(
                         engine, pipeline, req,
-                        server_cfg.request_timeout_s)
+                        server_cfg.request_timeout_s, disagg=disagg)
                     self._send(code, body)
                 elif req.get("max_new_tokens") is not None and \
                         _accepts_max_new_tokens(pipeline):
@@ -615,6 +700,47 @@ def build_stdlib_server(server_cfg: ServerConfig,
             finally:
                 with inflight_lock:
                     inflight[0] -= 1
+
+        def do_PUT(self):
+            # KV-handoff adopt endpoint (docs/disaggregation.md): a
+            # prefill peer pushes an exported lane; the ack tells it
+            # whether to detach (200) or decode locally (decline)
+            self._t_start = time.perf_counter()
+            if not self.path.startswith("/kv/"):
+                self._send(404, {"error": "not found"})
+                return
+            rid = self.path[len("/kv/"):]
+            if disagg is None:
+                self._send(409, {"adopted": False,
+                                 "reason": "no_engine"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._send(422, {"adopted": False,
+                                 "reason": "payload_invalid",
+                                 "error": f"invalid json: {e}"})
+                return
+            try:
+                code, body = disagg.handle_put(rid, payload)
+            except Exception as e:  # noqa: BLE001 — answer, don't die
+                code, body = 500, {"adopted": False,
+                                   "reason": "internal",
+                                   "error": str(e)[:200]}
+            self._send(code, body)
+
+        def do_DELETE(self):
+            self._t_start = time.perf_counter()
+            if not self.path.startswith("/kv/"):
+                self._send(404, {"error": "not found"})
+                return
+            rid = self.path[len("/kv/"):]
+            if disagg is None:
+                self._send(404, {"error": "no disagg coordinator"})
+                return
+            code, body = disagg.handle_delete(rid)
+            self._send(code, body)
 
     server = http.server.ThreadingHTTPServer(
         (server_cfg.host, server_cfg.port), Handler)
@@ -731,6 +857,7 @@ def main(argv=None) -> None:
     recorder.install_sigterm()
     pipeline = _resolve_pipeline(pipeline_cfg)
     engine = None
+    disagg = None
     if server_cfg.engine == "continuous":
         # warmup (all prefill buckets + the decode step) runs in the
         # background thread below; construction itself is compile-free
@@ -738,6 +865,10 @@ def main(argv=None) -> None:
                                           server_cfg.engine_args,
                                           aot_args=server_cfg.aot_args,
                                           recorder=recorder)
+        # every continuous replica can play either side of a KV
+        # handoff; the router's phase-aware placement decides which
+        from fengshen_tpu.disagg.coordinator import DisaggCoordinator
+        disagg = DisaggCoordinator(engine, pipeline)
     ready = _start_warmup_thread(server_cfg, pipeline_cfg, pipeline,
                                  engine)
     import os
@@ -755,7 +886,7 @@ def main(argv=None) -> None:
             app = build_app(pipeline_cfg, pipeline=pipeline,
                             server_cfg=server_cfg, engine=engine,
                             ready=ready, recorder=recorder,
-                            draining=draining)
+                            draining=draining, disagg=disagg)
             import uvicorn
         except ModuleNotFoundError:
             app = None
@@ -763,7 +894,7 @@ def main(argv=None) -> None:
         server = build_stdlib_server(server_cfg, pipeline_cfg,
                                      pipeline=pipeline, engine=engine,
                                      ready=ready, recorder=recorder,
-                                     draining=draining)
+                                     draining=draining, disagg=disagg)
         # graceful drain replaces the recorder's dump-then-die SIGTERM
         # chain installed above (the dump still happens, post-drain)
         install_drain_handler(server, draining, engine=engine,

@@ -1,0 +1,250 @@
+"""Replica-side disaggregation coordinator: the glue between the api
+layer, the engine's lane handoff (`serving/handoff.py`) and the
+transfer plane (`disagg/transfer.py`).
+
+One coordinator rides on every continuous-engine replica and plays
+both sides of a handoff:
+
+- **prefill side** (`handoff()`): after the api layer submits a
+  request the router tagged with a `disagg_push_to` target, wait for
+  the lane to prime (prefill + first token), export it, PUT it to the
+  decode peer, and — only once the peer ACKs adoption — detach the
+  local lane and hand the router a redirect body. EVERY failure mode
+  (lane never primed, export refused, connect/timeout, size cap,
+  adopt-decline, local decode winning the race) degrades to plain
+  local decode: the method returns None, the api layer falls through
+  to its normal wait, and the client sees an ordinary 200. Fallbacks
+  are counted per reason in `fstpu_disagg_fallbacks_total{reason}` and
+  stamped onto the request timeline, so the assembled fleet trace
+  shows exactly where a handoff died.
+- **decode side** (`handle_put`/`handle_get`/`handle_delete`): adopt
+  pushed lanes into the local engine, park the resumed Request in a
+  bounded registry, and serve the router's collect long-poll with the
+  same generate-shaped body the prefill replica would have produced.
+
+The coordinator owns its own `MetricsRegistry` (`fstpu_disagg_*`
+series), which the api layer concatenates into `GET /metrics` — the
+same pattern as the engine's registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from fengshen_tpu.disagg import transfer
+from fengshen_tpu.observability import MetricsRegistry, span
+from fengshen_tpu.serving import handoff
+from fengshen_tpu.serving.engine import FINISHED, RUNNING
+
+#: adopted requests kept for collection; a decode replica whose
+#: collects all die still bounds its registry
+MAX_ADOPTED = 256
+
+
+class DisaggCoordinator:
+    """Per-replica handoff orchestration (see module docstring)."""
+
+    def __init__(self, engine, pipeline,
+                 push_timeout_s: float = 10.0,
+                 max_payload_bytes: int =
+                 transfer.DEFAULT_MAX_PAYLOAD_BYTES,
+                 transport=None,
+                 log: Optional[Callable[[dict], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 registry: Optional[MetricsRegistry] = None):
+        self.engine = engine
+        self.pipeline = pipeline
+        self.push_timeout_s = float(push_timeout_s)
+        self.max_payload_bytes = int(max_payload_bytes)
+        #: fleet-style ``request(base_url, method, path, body,
+        #: timeout_s)`` override; None = the stdlib urllib push (the
+        #: fault-injection seam)
+        self.transport = transport
+        self._log = log or (lambda entry: None)
+        self._clock = clock
+        self._sleep = sleep
+        r = self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._c_handoffs = r.counter(
+            "fstpu_disagg_handoffs_total",
+            "prefill-side handoff attempts by outcome "
+            "(redirected / local_finish / fallback)",
+            labelnames=("outcome",))
+        self._c_fallbacks = r.counter(
+            "fstpu_disagg_fallbacks_total",
+            "handoffs degraded to local decode, by failure reason",
+            labelnames=("reason",))
+        self._c_adopted = r.counter(
+            "fstpu_disagg_adopted_total",
+            "lanes adopted into this replica's engine")
+        self._c_declined = r.counter(
+            "fstpu_disagg_adopt_declined_total",
+            "adoption refusals by reason", labelnames=("reason",))
+        self._c_payload_bytes = r.counter(
+            "fstpu_disagg_payload_bytes_total",
+            "encoded bytes of exported lane payloads")
+        self._h_push = r.histogram(
+            "fstpu_disagg_push_seconds",
+            "wall seconds of the replica-to-replica KV push")
+        self._lock = threading.Lock()
+        self._adopted: Dict[str, Any] = {}
+
+    # ---- prefill side ----------------------------------------------
+
+    def handoff(self, request, push_to: str) -> Optional[dict]:
+        """Try to hand `request` (already submitted to the local
+        engine) to the decode replica at base url `push_to`. Returns
+        the redirect body for the router on success, None when the
+        request should (keep) running locally — which is NEVER a
+        client-visible error."""
+        rid = request.request_id
+        deadline = self._clock() + self.push_timeout_s
+        # the lane must prime first: prefill + first token happen on
+        # the scheduler thread; a paged engine may also defer admission
+        # on block pressure, which this wait absorbs up to the budget
+        while (not request.done and request.state != RUNNING and
+               self._clock() < deadline):
+            self._sleep(0.002)
+        if request.done:
+            self._c_handoffs.labels("local_finish").inc()
+            return None
+        if request.state != RUNNING:
+            return self._fallback(request, "not_running")
+        try:
+            with span("disagg/export"):
+                payload = handoff.export_lane(self.engine, rid)
+        except handoff.HandoffError as e:
+            return self._fallback(request, "export", error=str(e))
+        self._c_payload_bytes.inc(transfer.payload_nbytes(payload))
+        t0 = self._clock()
+        try:
+            with span("disagg/push"):
+                transfer.push_payload(
+                    push_to, rid, payload,
+                    timeout_s=max(deadline - self._clock(), 0.05),
+                    max_bytes=self.max_payload_bytes,
+                    transport=self.transport)
+        except transfer.KvPushError as e:
+            if e.sent:
+                # the peer MAY hold an adopted twin (wedged push,
+                # declined-after-adopt races): cancel it so one request
+                # never decodes twice to completion
+                self._delete_twin(push_to, rid)
+            return self._fallback(request, e.reason, error=str(e))
+        self._h_push.observe(self._clock() - t0)
+        if not handoff.detach_lane(self.engine, rid, target=push_to):
+            # local decode finished during the push; the local result
+            # stands and the adopted twin is cancelled
+            self._delete_twin(push_to, rid)
+            self._c_handoffs.labels("local_finish").inc()
+            return None
+        self._c_handoffs.labels("redirected").inc()
+        self._log({"event": "disagg_redirect", "request_id": rid,
+                   "target": push_to})
+        return {"disagg_redirect": True, "request_id": rid,
+                "target": push_to}
+
+    def _fallback(self, request, reason: str,
+                  error: Optional[str] = None) -> None:
+        self._c_fallbacks.labels(reason).inc()
+        self._c_handoffs.labels("fallback").inc()
+        # the fallback mark joins the request's own timeline, so the
+        # assembled fleet trace shows the failed handoff inline with
+        # the decode that absorbed it
+        request.timeline.add(self.engine._clock(), "handoff_fallback",
+                             reason=reason)
+        self._log({"event": "disagg_fallback", "reason": reason,
+                   "request_id": request.request_id,
+                   "error": (error or "")[:200]})
+        return None
+
+    def _delete_twin(self, push_to: str, rid: str) -> None:
+        """Best-effort DELETE of a possibly-adopted twin; failures are
+        logged and swallowed (the twin also dies at its deadline)."""
+        try:
+            if self.transport is not None:
+                self.transport.request(push_to, "DELETE", f"/kv/{rid}",
+                                       body=None, timeout_s=2.0)
+            else:
+                import urllib.request
+                req = urllib.request.Request(
+                    push_to.rstrip("/") + f"/kv/{rid}", method="DELETE")
+                urllib.request.urlopen(req, timeout=2.0).read()
+        except Exception as e:  # noqa: BLE001 — best-effort cleanup
+            self._log({"event": "disagg_twin_delete_failed",
+                       "request_id": rid, "error": str(e)[:200]})
+
+    # ---- decode side -----------------------------------------------
+
+    def handle_put(self, rid: str, payload: Any) -> tuple[int, dict]:
+        """PUT /kv/<rid>: adopt a pushed lane. 200 + adopted ack, or a
+        409 decline with the reason the source's fallback counter
+        labels."""
+        if not isinstance(payload, dict):
+            self._c_declined.labels("payload_invalid").inc()
+            return 409, {"adopted": False, "reason": "payload_invalid",
+                         "error": "payload must be a JSON object"}
+        if payload.get("request_id") != rid:
+            self._c_declined.labels("payload_invalid").inc()
+            return 409, {"adopted": False, "reason": "payload_invalid",
+                         "error": "request_id mismatch with path"}
+        try:
+            with span("disagg/adopt"):
+                req = handoff.adopt_lane(self.engine, payload)
+        except handoff.AdoptDecline as e:
+            self._c_declined.labels(e.reason).inc()
+            self._log({"event": "disagg_adopt_declined",
+                       "request_id": rid, "reason": e.reason})
+            return 409, {"adopted": False, "reason": e.reason,
+                         "error": str(e)}
+        except Exception as e:  # noqa: BLE001 — an adopt crash must
+            # answer (the source falls back to local decode), not
+            # drop the socket
+            self._c_declined.labels("internal").inc()
+            return 500, {"adopted": False, "reason": "internal",
+                         "error": str(e)[:200]}
+        with self._lock:
+            if len(self._adopted) >= MAX_ADOPTED:
+                # evict the oldest uncollected entry (its engine-side
+                # request keeps running to its own finish)
+                self._adopted.pop(next(iter(self._adopted)))
+            self._adopted[rid] = req
+        self._c_adopted.inc()
+        return 200, {"adopted": True, "request_id": rid}
+
+    def handle_get(self, rid: str,
+                   timeout_s: float) -> tuple[int, dict]:
+        """GET /kv/<rid>: long-poll for an adopted request's result —
+        the generate-shaped body the router forwards to the client."""
+        with self._lock:
+            req = self._adopted.get(rid)
+        if req is None:
+            return 404, {"error": f"unknown adopted request {rid!r}"}
+        if not req.wait(timeout=timeout_s):
+            return 504, {"error": f"adopted request {rid!r} still "
+                                  f"decoding after {timeout_s}s"}
+        with self._lock:
+            self._adopted.pop(rid, None)
+        if req.state != FINISHED:
+            return 503, {"error": f"adopted request {req.state} "
+                                  f"({req.finish_reason})"}
+        return 200, {"result": self.pipeline.decode(req.tokens),
+                     "request_id": req.request_id,
+                     "ttft_s": req.ttft_s,
+                     "finish_reason": req.finish_reason,
+                     "adopted": True}
+
+    def handle_delete(self, rid: str) -> tuple[int, dict]:
+        """DELETE /kv/<rid>: cancel an adopted twin (the source won
+        the race or gave up on a wedged push)."""
+        with self._lock:
+            req = self._adopted.pop(rid, None)
+        cancelled = self.engine.cancel(rid) if req is not None else False
+        return 200, {"cancelled": bool(cancelled)}
+
+    def adopted_count(self) -> int:
+        with self._lock:
+            return len(self._adopted)

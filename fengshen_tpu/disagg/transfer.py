@@ -1,0 +1,140 @@
+"""KV-handoff transfer plane: push one sealed lane payload replica to
+replica over plain HTTP.
+
+Pure stdlib by design — this module rides in the SAME process as the
+fleet router's placement policy (`disagg/policy.py`) and must never
+drag jax into the router (the fleet package's no-jax subprocess test
+extends to `fengshen_tpu.disagg`). The payload itself is built and
+consumed by `fengshen_tpu.serving.handoff` on the replicas, which do
+hold jax; here it is an opaque JSON dict.
+
+Three integrity guards, all enforced on BOTH ends:
+
+- ``checksum``: sha256 over the canonical JSON of the payload minus
+  the checksum field (`seal()`/`verify_checksum()`), so a truncated or
+  bit-flipped transfer is an adopt-decline, never a corrupted lane;
+- ``max_bytes``: a size cap on the encoded payload (prefill replicas
+  must not buffer unbounded lanes for a slow decode peer);
+- ``timeout_s``: the push is a blocking host-side HTTP call on the
+  coordinator thread — bounded, and any failure maps to ONE
+  `KvPushError` with a `reason` the fallback counter can label.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import socket
+import urllib.error
+import urllib.request
+from typing import Optional
+
+#: default encoded-payload cap: generous for int8-quantized lanes of
+#: the supported model sizes, small enough to bound coordinator memory
+DEFAULT_MAX_PAYLOAD_BYTES = 256 * 1024 * 1024
+
+
+class KvPushError(Exception):
+    """One failed push attempt. `reason` is the fallback label
+    (connect / timeout / too_large / adopt_declined / http_<status>);
+    `sent` mirrors the fleet transport contract — False means the
+    payload provably never reached the peer, True means it may have."""
+
+    def __init__(self, message: str, reason: str, sent: bool = True):
+        super().__init__(message)
+        self.reason = reason
+        self.sent = sent
+
+
+def canonical_bytes(payload: dict) -> bytes:
+    """Deterministic encoding of the payload WITHOUT its checksum
+    field — the hashed representation and the size-cap denominator."""
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    return json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def payload_checksum(payload: dict) -> str:
+    return hashlib.sha256(canonical_bytes(payload)).hexdigest()
+
+
+def payload_nbytes(payload: dict) -> int:
+    return len(canonical_bytes(payload))
+
+
+def seal(payload: dict) -> dict:
+    """Stamp the checksum onto a freshly exported payload (in place,
+    and returned for chaining)."""
+    payload["checksum"] = payload_checksum(payload)
+    return payload
+
+
+def verify_checksum(payload: dict) -> bool:
+    want = payload.get("checksum")
+    return isinstance(want, str) and payload_checksum(payload) == want
+
+
+def push_payload(base_url: str, request_id: str, payload: dict,
+                 timeout_s: float = 10.0,
+                 max_bytes: int = DEFAULT_MAX_PAYLOAD_BYTES,
+                 transport=None) -> dict:
+    """PUT the sealed payload to ``<base_url>/kv/<request_id>`` and
+    return the peer's adopt-ack body. Raises `KvPushError` on every
+    failure mode; never raises anything else.
+
+    `transport` optionally substitutes a fleet-style
+    ``request(base_url, method, path, body, timeout_s)`` callable —
+    the seam the fault-injection tests wedge/kill the push through.
+    """
+    nbytes = payload_nbytes(payload)
+    if nbytes > max_bytes:
+        raise KvPushError(
+            f"payload of {nbytes} bytes exceeds the transfer cap "
+            f"{max_bytes}", reason="too_large", sent=False)
+    path = f"/kv/{request_id}"
+    if transport is not None:
+        try:
+            status, body = transport.request(
+                base_url, "PUT", path, body=payload, timeout_s=timeout_s)
+        except Exception as e:  # noqa: BLE001 — transport failures
+            # collapse to the one typed error the fallback path labels
+            sent = bool(getattr(e, "sent", True))
+            reason = "connect" if not sent else "timeout"
+            raise KvPushError(str(e), reason=reason, sent=sent) from e
+        return _check_ack(status, body)
+    data = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        base_url.rstrip("/") + path, data=data, method="PUT",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return _check_ack(resp.status,
+                              json.loads(resp.read().decode("utf-8")))
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            body = {"error": str(e)}
+        return _check_ack(e.code, body)
+    except (socket.timeout, TimeoutError) as e:
+        raise KvPushError(f"push timed out after {timeout_s}s",
+                          reason="timeout", sent=True) from e
+    except (urllib.error.URLError, ConnectionError, OSError) as e:
+        raise KvPushError(f"push failed: {e}", reason="connect",
+                          sent=False) from e
+
+
+def _check_ack(status: int, body: dict) -> dict:
+    """Adopt-ack contract: 200 + ``{"adopted": true}`` is the ONLY
+    success. A well-formed decline (any status with an ``adopted``
+    field) carries the peer's reason; anything else is transport
+    noise."""
+    body = body if isinstance(body, dict) else {}
+    if status == 200 and body.get("adopted") is True:
+        return body
+    if "adopted" in body:
+        raise KvPushError(
+            f"peer declined adoption: {body.get('reason', 'unknown')}",
+            reason="adopt_declined", sent=True)
+    raise KvPushError(f"push got HTTP {status}",
+                      reason=f"http_{status}", sent=True)

@@ -32,6 +32,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="api/main.py config json for --spawn")
     p.add_argument("--base-port", type=int, default=8100,
                    help="first spawned replica's port (default 8100)")
+    p.add_argument("--phases", type=str, default=None,
+                   help="comma list of per-replica serving phases for "
+                        "--spawn (prefill|decode|both, e.g. "
+                        "'prefill,decode,decode'); omitted replicas "
+                        "default to 'both' (docs/disaggregation.md)")
     p.add_argument("--host", type=str, default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080,
                    help="the router's own port (default 8080)")
@@ -56,14 +61,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if bool(args.replicas) == bool(args.spawn):
         build_parser().error(
             "exactly one of --replicas or --spawn is required")
+    if args.phases and not args.spawn:
+        build_parser().error("--phases needs --spawn (already-running "
+                             "replicas advertise their own phase)")
     procs = []
     if args.spawn:
         if not args.config:
             build_parser().error("--spawn needs --config")
         from fengshen_tpu.fleet.launcher import (spawn_replicas,
                                                  terminate_replicas)
+        phases = [] if not args.phases else \
+            [p.strip() for p in args.phases.split(",") if p.strip()]
         targets, procs = spawn_replicas(args.config, args.spawn,
-                                        args.base_port)
+                                        args.base_port, phases=phases)
         print(f"[fleet] spawned {len(procs)} replica(s): "
               f"{', '.join(targets)}", flush=True)
     else:

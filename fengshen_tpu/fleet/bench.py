@@ -221,13 +221,14 @@ def _spawn_real_replicas(n: int, base_port: int
 
 # ---- the driver -----------------------------------------------------
 
-def _make_router(targets, timeout_s: float = 180.0) -> FleetRouter:
+def _make_router(targets, timeout_s: float = 180.0,
+                 poll_interval_s: float = 0.2) -> FleetRouter:
     """Router over `targets`, polled until every replica is healthy
     (replica warmup bounds the wait)."""
     router = FleetRouter(FleetConfig(
         replicas=targets, max_retries=3, breaker_threshold=2,
         breaker_cooldown_s=2.0, recovery_probes=1,
-        poll_interval_s=0.2, request_timeout_s=300.0))
+        poll_interval_s=poll_interval_s, request_timeout_s=300.0))
     deadline = time.monotonic() + timeout_s
     while router.healthy_count() < len(targets):
         if time.monotonic() > deadline:
@@ -334,7 +335,11 @@ def main(argv=None) -> None:
         # 3. kill rung: replica #1 dies mid-run; zero failures allowed
         kill_section = {"enabled": False}
         if kill_enabled:
-            rk = _make_router(targets)
+            # poll slower than the rung lasts: the router must discover
+            # the death through a FAILED REQUEST (breaker + retry), not
+            # through a lucky health poll — otherwise `retries >= 1` is
+            # a race against the poll thread
+            rk = _make_router(targets, poll_interval_s=60.0)
 
             def kill_victim():
                 if fake:

@@ -32,6 +32,25 @@ Fault kinds (each keyed `{request_index: replica_name}`):
   injectable sleep) before proceeding normally — tail-latency, not
   failure.
 
+KV-handoff faults (docs/disaggregation.md) use their OWN coordinate
+axis — **KV-push indices**, counting `PUT /kv/*` attempts in dispatch
+order through whichever transport the plan wraps (the prefill-side
+coordinator's push seam). Each is keyed `{push_index: replica_name}`
+where the name is the DECODE replica being pushed to, and each is
+one-shot (the push either fails or it doesn't; the source's fallback
+to local decode is the behavior under test, not a sticky outage):
+
+- ``kv_kill_at``: the push raises `TransportError(sent=False)` — the
+  payload provably never arrived, the source falls back locally with
+  no twin to clean up.
+- ``kv_wedge_at``: the push is DELIVERED (the decode replica really
+  adopts the lane) and then raises `TransportError(sent=True)` — the
+  dangerous mode: the source must DELETE the adopted twin before
+  falling back, or one request decodes twice.
+- ``kv_decline_at``: the push answers `409 {"adopted": false}` without
+  being delivered — an adopt-decline (capacity, version skew) as the
+  decode replica would phrase it.
+
 ``fired`` records every (kind, index, replica) that actually triggered,
 so tests can pin that the injected fault count matches the router's
 `fstpu_fleet_retries_total` exactly. ``revive(replica)`` clears a
@@ -56,7 +75,10 @@ class FleetFaultPlan:
                  wedge_at: Optional[Dict[int, str]] = None,
                  error_503_at: Optional[Dict[int, str]] = None,
                  slow_at: Optional[Dict[int, str]] = None,
-                 slow_s: float = 0.05):
+                 slow_s: float = 0.05,
+                 kv_kill_at: Optional[Dict[int, str]] = None,
+                 kv_wedge_at: Optional[Dict[int, str]] = None,
+                 kv_decline_at: Optional[Dict[int, str]] = None):
         self.kill_at = {int(k): str(v)
                         for k, v in (kill_at or {}).items()}
         self.wedge_at = {int(k): str(v)
@@ -66,9 +88,16 @@ class FleetFaultPlan:
         self.slow_at = {int(k): str(v)
                         for k, v in (slow_at or {}).items()}
         self.slow_s = slow_s
+        self.kv_kill_at = {int(k): str(v)
+                           for k, v in (kv_kill_at or {}).items()}
+        self.kv_wedge_at = {int(k): str(v)
+                            for k, v in (kv_wedge_at or {}).items()}
+        self.kv_decline_at = {int(k): str(v)
+                              for k, v in (kv_decline_at or {}).items()}
         self.fired: List[Tuple[str, int, str]] = []
         self._lock = threading.Lock()
         self._index = 0
+        self._kv_index = 0
         self._dead: Dict[str, str] = {}    # name -> "kill" | "wedge"
         self._armed: set = set()           # (at, name) already applied
 
@@ -110,6 +139,19 @@ class FleetFaultPlan:
             return "slow"
         return None
 
+    def _advance_kv_locked(self, replica: str) -> Optional[str]:
+        """Account one KV push targeting `replica` (its own index
+        axis); returns the one-shot fault to apply (or None)."""
+        idx = self._kv_index
+        self._kv_index += 1
+        for kind, table in (("kv_kill", self.kv_kill_at),
+                            ("kv_wedge", self.kv_wedge_at),
+                            ("kv_decline", self.kv_decline_at)):
+            if table.get(idx) == replica:
+                self.fired.append((kind, idx, replica))
+                return kind
+        return None
+
     def _dead_mode_locked(self, replica: str,
                           idx: Optional[int]) -> Optional[str]:
         mode = self._dead.get(replica)
@@ -146,11 +188,16 @@ class FaultInjectingTransport:
         name = self._name(base_url)
         is_generate = method.upper() == "POST" and \
             path.startswith("/api/")
+        is_kv_push = method.upper() == "PUT" and \
+            path.startswith("/kv/")
         with self.plan._lock:
             if is_generate:
                 one_shot = self.plan._advance_locked(name)
                 idx = self.plan._index - 1
                 mode = self.plan._dead_mode_locked(name, idx)
+            elif is_kv_push:
+                one_shot = self.plan._advance_kv_locked(name)
+                mode = self.plan._dead_mode_locked(name, None)
             else:
                 one_shot = None
                 mode = self.plan._dead_mode_locked(name, None)
@@ -176,5 +223,23 @@ class FaultInjectingTransport:
             return 503, {"error": "injected 503", "reason": "injected"}
         if one_shot == "slow":
             self._sleep(self.plan.slow_s)
+        if one_shot == "kv_kill":
+            raise TransportError(
+                f"injected kv kill: connect to {name} refused",
+                sent=False)
+        if one_shot == "kv_wedge":
+            # deliver for real — the decode replica ADOPTS the lane —
+            # then lose the ack, so the source must twin-delete before
+            # its local fallback (the one-request-decodes-twice hazard)
+            try:
+                self.inner.request(base_url, method, path, body,
+                                   timeout_s)
+            except Exception:  # noqa: BLE001 — the ack is discarded
+                pass           # either way
+            raise TransportError(
+                f"injected kv wedge: push to {name} timed out",
+                sent=True)
+        if one_shot == "kv_decline":
+            return 409, {"adopted": False, "reason": "injected"}
         return self.inner.request(base_url, method, path, body,
                                   timeout_s)

@@ -19,21 +19,33 @@ import signal
 import subprocess
 import sys
 import tempfile
-from typing import List, Tuple
+from typing import List, Sequence, Tuple
+
+from fengshen_tpu.disagg.policy import validate_phase
 
 
 def spawn_replicas(config_path: str, n: int, base_port: int,
                    host: str = "127.0.0.1",
-                   workdir: str = None) -> Tuple[List[str], list]:
+                   workdir: str = None,
+                   phases: Sequence[str] = ()
+                   ) -> Tuple[List[str], list]:
     """Write derived configs and start N replica subprocesses. Returns
     (targets, processes) where targets are "host:port" strings for
     `FleetConfig.replicas`. Replicas inherit this process's env (so
     `JAX_PLATFORMS` etc. flow through) plus `FSTPU_API_SERVER=stdlib`:
     only the stdlib server path has the SIGTERM graceful drain the
     fleet's rolling restarts depend on — a uvicorn replica would die
-    with its in-flight requests instead of draining."""
+    with its in-flight requests instead of draining.
+
+    `phases` assigns replica i the serving phase `phases[i]`
+    (`prefill` | `decode` | `both`, docs/disaggregation.md) via its
+    derived config's `SERVER.phase`; replicas past the end of the list
+    stay homogeneous (`both`)."""
     if n < 1:
         raise ValueError("need at least one replica")
+    phases = [validate_phase(p) for p in phases]
+    if len(phases) > n:
+        raise ValueError(f"{len(phases)} phases for {n} replicas")
     with open(config_path) as f:
         raw = json.load(f)
     workdir = workdir or tempfile.mkdtemp(prefix="fstpu_fleet_")
@@ -44,6 +56,8 @@ def spawn_replicas(config_path: str, n: int, base_port: int,
         port = base_port + i
         server["host"] = host
         server["port"] = port
+        if i < len(phases):
+            server["phase"] = phases[i]
         # per-replica dump dirs: two replicas sharing one flight-
         # recorder directory would interleave their bundle sequences
         server["dump_dir"] = os.path.join(

@@ -11,6 +11,13 @@ fleet survives every single-replica failure mode (docs/fleet.md):
   `/stats` (`slots_active + queue_depth` over `num_slots`) plus the
   router's own not-yet-visible in-flight count; ties break by replica
   index, so placement is deterministic under a deterministic clock;
+- **phase-aware disaggregation** (docs/disaggregation.md): replicas
+  advertise a serving `phase` in /stats; when the healthy rotation
+  holds dedicated `prefill` AND `decode` tiers, admissions prime on
+  the least-occupied prefill replica, its coordinator pushes the KV
+  lane to the chosen decode replica, and the router collects the
+  decode tail via `GET /kv/<id>` — every handoff failure degrades to
+  local decode on the prefill replica, never a client error;
 - **health gating**: a background poll hits every replica's
   `/healthz`; a replica is OUT while it answers anything but 200
   (warming, draining, unreachable) and is eased back in only after
@@ -74,6 +81,7 @@ import urllib.request
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from fengshen_tpu.disagg import policy as disagg_policy
 from fengshen_tpu.observability import (MetricsRegistry, SpanLedger,
                                         TraceContext, TraceIds,
                                         assemble_trace,
@@ -217,6 +225,11 @@ class Replica:
         self.num_slots = 0
         self.queue_depth = 0
         self.draining_reported = False
+        #: the replica's advertised serving phase (`prefill` | `decode`
+        #: | `both`, from its polled /stats — docs/disaggregation.md);
+        #: "both" until the first stats poll, so an unprobed fleet
+        #: routes homogeneously
+        self.phase = "both"
 
     def occupancy(self) -> float:
         """Polled load plus the router's own not-yet-visible dispatches
@@ -279,7 +292,11 @@ class FleetRouter:
         r = self.registry = MetricsRegistry()
         self._g_replicas = r.gauge(
             "fstpu_fleet_replicas",
-            "replicas per rotation state", labelnames=("state",))
+            "replicas per rotation state and serving phase",
+            labelnames=("state", "phase"))
+        # (state, phase) combos ever exported — a replica that switches
+        # phase must leave a 0 behind, not a frozen stale sample
+        self._gauge_combos: set = set()
         self._c_retries = r.counter(
             "fstpu_fleet_retries_total",
             "generate retries by cause of the failed attempt",
@@ -402,6 +419,10 @@ class FleetRouter:
                     stats.get("queue_depth") or 0)
                 rep.draining_reported = fresh_draining = bool(
                     stats.get("draining") or False)
+                phase = str(stats.get("phase") or "both")
+                if phase != rep.phase:
+                    rep.phase = phase
+                    self._update_state_gauge_locked()
         if fresh_draining:
             self._note_poll_down(rep, "draining", "stats draining",
                                  orderly=True)
@@ -489,11 +510,17 @@ class FleetRouter:
         self._update_state_gauge_locked()
 
     def _update_state_gauge_locked(self) -> None:
-        counts = {HEALTHY: 0, DRAINING: 0, BROKEN: 0}
+        counts: Dict[Tuple[str, str], int] = {}
+        for phase in {rep.phase for rep in self.replicas}:
+            for state in (HEALTHY, DRAINING, BROKEN):
+                counts[(state, phase)] = 0
         for rep in self.replicas:
-            counts[rep.state] += 1
-        for state, n in counts.items():
-            self._g_replicas.labels(state).set(n)
+            counts[(rep.state, rep.phase)] += 1
+        for (state, phase), n in counts.items():
+            self._g_replicas.labels(state, phase).set(n)
+        for combo in self._gauge_combos - set(counts):
+            self._g_replicas.labels(*combo).set(0)
+        self._gauge_combos |= set(counts)
 
     # ---- placement --------------------------------------------------
 
@@ -519,6 +546,20 @@ class FleetRouter:
                 rep.half_open_inflight = True
                 return rep
         return None
+
+    def _plan_disagg_locked(self, exclude: Sequence[Replica]
+                            ) -> Optional[disagg_policy.HandoffPlan]:
+        """Phase-aware placement (docs/disaggregation.md): when the
+        healthy rotation holds BOTH a dedicated prefill and a dedicated
+        decode tier, plan a handoff — prime the lane on the
+        least-occupied prefill replica and push its KV to the
+        least-occupied decode replica. Every other topology (all-both,
+        one tier missing or fully out of rotation) returns None and
+        placement falls through to plain `_pick_locked` least-occupancy
+        — disaggregation is an optimization, never a new way to 503."""
+        candidates = [r for r in self.replicas
+                      if r not in exclude and r.state == HEALTHY]
+        return disagg_policy.plan_handoff(candidates)
 
     def healthy_count(self) -> int:
         with self._lock:
@@ -660,13 +701,23 @@ class FleetRouter:
         for attempt in range(attempts):
             s_place = self.tracer.start_span(
                 tid, "router/placement", root, attempt=attempt + 1)
+            push_to: Optional[str] = None
+            decode_name: Optional[str] = None
             with self._lock:
-                rep = self._pick_locked(tried)
+                plan = self._plan_disagg_locked(tried)
+                if plan is not None:
+                    rep = plan.prefill
+                    push_to = plan.decode.base_url
+                    decode_name = plan.decode.name
+                else:
+                    rep = self._pick_locked(tried)
                 if rep is not None:
                     rep.in_flight += 1
             self.tracer.end_span(
                 tid, s_place,
-                replica=None if rep is None else rep.name)
+                replica=None if rep is None else rep.name,
+                **({} if decode_name is None
+                   else {"decode": decode_name}))
             if rep is None:
                 break
             tried.append(rep)
@@ -679,12 +730,17 @@ class FleetRouter:
                 tid, "router/attempt", root, attempt=attempt + 1,
                 replica=rep.name, request_id=body["request_id"])
             send_body = body
+            if push_to is not None:
+                # the prefill replica's coordinator primes the lane
+                # and pushes it here (docs/disaggregation.md); any
+                # failure degrades to local decode on that replica
+                send_body = dict(send_body, disagg_push_to=push_to)
             if s_att is not None:
                 # the replica's timeline parents to THIS attempt's
                 # span — a retried request's two executions hang off
                 # two sibling spans of one trace
                 send_body = dict(
-                    body,
+                    send_body,
                     traceparent=TraceContext(tid, s_att)
                     .to_traceparent())
             t_att = time.perf_counter()
@@ -757,6 +813,14 @@ class FleetRouter:
                 time.perf_counter() - t_att)
             self.tracer.end_span(tid, s_att, outcome=outcome,
                                  status=status)
+            if status == 200 and resp.get("disagg_redirect"):
+                # the prefill replica handed the lane to a decode
+                # peer: collect the final generation from it
+                status, resp = self._collect_redirect(tid, root, resp)
+                if status >= 500:
+                    outcome = OUTCOME_ERROR
+                elif status >= 400:
+                    outcome = OUTCOME_CLIENT_ERROR
             self.tracer.end_span(tid, root, outcome=outcome,
                                  status=status, attempts=attempt + 1)
             self._h_request.labels(outcome).observe(
@@ -786,6 +850,70 @@ class FleetRouter:
                    "attempts": len(tried), "status": status,
                    "trace_id": tid})
         return status, dict(resp, trace_id=tid)
+
+    def _collect_redirect(self, tid: str, root: Optional[str],
+                          resp: dict) -> Tuple[int, dict]:
+        """A generate answered with `disagg_redirect`: the prefill
+        replica primed the lane and pushed it to `target`, which now
+        owns the decode tail. Long-poll the target's `GET /kv/<rid>`
+        for the final generation-shaped body. The collect runs inside
+        spans NAMED "router/attempt" (with replica + request_id attrs)
+        on purpose: `assemble()` keys its waterfall fetches off that
+        span name, so the decode replica's timeline joins the
+        assembled trace with zero assembler changes. The GET is
+        idempotent, so transport failures and 504 still-decoding
+        answers retry in place (reason "collect"); any other failure
+        is final — the request DID execute, so a silent re-route
+        would risk decoding it twice."""
+        rid = str(resp.get("request_id") or "")
+        target = str(resp.get("target") or "")
+        by_url = {r.base_url: r for r in self.replicas}
+        rep = by_url.get(target)
+        name = rep.name if rep is not None else target
+        attempts = self.config.max_retries + 1
+        last_err = "no collect attempt made"
+        for attempt in range(attempts):
+            s_col = self.tracer.start_span(
+                tid, "router/attempt", root, replica=name,
+                request_id=rid, kind="disagg_collect",
+                attempt=attempt + 1)
+            t_col = time.perf_counter()
+            try:
+                status, out = self.transport.request(
+                    target, "GET", f"/kv/{rid}", None,
+                    self.config.request_timeout_s)
+            except TransportError as e:
+                self._h_attempt.labels("collect_error").observe(
+                    time.perf_counter() - t_col)
+                self.tracer.end_span(tid, s_col,
+                                     outcome="collect_error",
+                                     error=str(e)[:200])
+                last_err = str(e)
+                if attempt + 1 < attempts:
+                    self._c_retries.labels("collect").inc()
+                continue
+            if status == 200:
+                self._h_attempt.labels(OUTCOME_OK).observe(
+                    time.perf_counter() - t_col)
+                self.tracer.end_span(tid, s_col, outcome=OUTCOME_OK,
+                                     status=status)
+                return 200, dict(out)
+            self._h_attempt.labels("collect_error").observe(
+                time.perf_counter() - t_col)
+            self.tracer.end_span(tid, s_col, outcome="collect_error",
+                                 status=status)
+            last_err = f"HTTP {status}"
+            if status != 504:
+                break
+            if attempt + 1 < attempts:
+                self._c_retries.labels("collect").inc()
+        self._log({"event": "fleet_collect_failed",
+                   "request_id": rid, "replica": name,
+                   "detail": last_err[:200]})
+        return 502, {"error": f"disagg collect from {name}: "
+                              f"{last_err[:200]}",
+                     "reason": "collect_failed",
+                     "request_id": rid}
 
     def _maybe_retry(self, attempt: int, attempts: int, reason: str,
                      rep: Replica) -> Optional[float]:
@@ -909,6 +1037,7 @@ class FleetRouter:
                     "name": rep.name,
                     "url": rep.base_url,
                     "state": rep.state,
+                    "phase": rep.phase,
                     "reason": rep.reason,
                     # a stuck poll loop reads as a growing age here
                     # (None = never completed a poll), and the failure
@@ -937,6 +1066,8 @@ class FleetRouter:
                 "healthy": counts[HEALTHY],
                 "draining": counts[DRAINING],
                 "broken": counts[BROKEN],
+                "topology": disagg_policy.topology(
+                    [r.phase for r in self.replicas]),
                 "router_draining": self._draining,
                 "requests_total": int(self._c_requests.value()),
                 "retries_total": self.retries_total(),

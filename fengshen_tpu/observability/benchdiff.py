@@ -108,13 +108,19 @@ def _placement(row: dict) -> str:
 
 def _identity(row: dict) -> str:
     """The full comparison identity of a BENCH row: memory placement
-    plus — for fleet rows (docs/fleet.md) — the replica count. Two
-    fleet rounds at different N measure different deployments exactly
-    like two offload rounds at different placements measure different
-    programs; they diff as ``incomparable``, never regression/flat."""
+    plus — for fleet rows (docs/fleet.md) — the replica count, plus —
+    for disaggregated rows (docs/disaggregation.md) — the phase
+    topology. Two fleet rounds at different N measure different
+    deployments exactly like two offload rounds at different
+    placements measure different programs, and a
+    ``prefill=1,decode=2`` topology is a different deployment from a
+    ``homogeneous`` 3-replica one even at equal N; all of them diff as
+    ``incomparable``, never regression/flat."""
     parts = [_placement(row)]
     if "replicas" in row:
         parts.append(f"replicas={int(row['replicas'])}")
+    if "topology" in row:
+        parts.append(f"topology={row['topology']}")
     return "|".join(parts)
 
 

@@ -1,0 +1,82 @@
+"""known-clean fixture: the KV-handoff idiom (ISSUE 13,
+docs/disaggregation.md) — lane export/adopt is EAGER host-orchestrated
+array work between jit boundaries, and the transfer plane is pure
+stdlib. The exported prefix is gathered eagerly (no new jitted
+programs: the engine's pinned compile counts must survive handoffs),
+the payload is checksummed and base64-framed on the host, the push is
+a blocking HTTP call on the coordinator thread (NEVER inside traced
+code), and the `fstpu_disagg_*` counters mutate only around those
+host steps. The tempting regressions this fixture guards: jitting the
+gather/scatter of the lane (a new program per shape — compile-count
+drift), hashing or pushing a payload inside a traced helper
+(blocking-transfer), bumping the fallback counters in traced code
+(metrics-in-traced-code), or branching traced code on a device value
+of the lane cursor (host-divergence).
+
+Mirrors `fengshen_tpu/serving/handoff.py`'s export/adopt around
+`fengshen_tpu/disagg/transfer.py`'s seal/push: if a rule fires here,
+it would also flag the real modules and block the merge gate.
+"""
+
+import base64
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+FALLBACKS = REG.counter("fx_disagg_fallbacks_total",
+                        "handoffs degraded to local decode",
+                        labelnames=("reason",))
+PAYLOAD_BYTES = REG.counter("fx_disagg_payload_bytes_total",
+                            "encoded lane payload bytes")
+
+
+@jax.jit
+def decode_tick(cache, tokens, phys):
+    """What both tiers run per tick: pure scatters — export/adopt
+    never add hashing, HTTP, or counter mutation in here."""
+    n = tokens.shape[0]
+    cache = cache.at[jnp.arange(n), phys].set(tokens)
+    return cache, (tokens + 1).astype(jnp.int32)
+
+
+def export_lane(cache, slot, phys):
+    """EAGER gather of the committed prefix: host-side jnp outside any
+    jit (zero new compiled programs), then base64 framing + checksum —
+    all plain host bytes work on the coordinator thread."""
+    lane = np.asarray(jax.lax.slice_in_dim(
+        jnp.take(cache, slot, axis=0), 0, phys, axis=0))
+    body = {"shape": list(lane.shape), "dtype": str(lane.dtype),
+            "data": base64.b64encode(lane.tobytes()).decode("ascii")}
+    raw = json.dumps(body, sort_keys=True).encode()
+    body["checksum"] = hashlib.sha256(raw).hexdigest()
+    PAYLOAD_BYTES.inc(len(raw))
+    return body
+
+
+def adopt_lane(cache, payload, slot):
+    """EAGER scatter of the wire lane into a free slot: the pool
+    update is a host-orchestrated `.at[].set` outside every jit."""
+    lane = jnp.asarray(np.frombuffer(
+        base64.b64decode(payload["data"]),
+        dtype=np.dtype(payload["dtype"])).reshape(payload["shape"]))
+    return cache.at[slot, : lane.shape[0]].set(lane)
+
+
+def push_with_fallback(payload, push, decode_locally):
+    """The coordinator's prefill-side loop: the blocking push and the
+    fallback counter live on the request thread, strictly between jit
+    boundaries — a failed handoff is a counted local decode, never a
+    client error."""
+    try:
+        push(payload)
+        return "redirected"
+    except OSError:
+        FALLBACKS.labels("connect").inc()
+        decode_locally()
+        return "fallback"

@@ -496,6 +496,31 @@ def test_fleet_router_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_disagg_internals_are_clean():
+    """Regression fixture for the prefill/decode disaggregation tier
+    (ISSUE 13, docs/disaggregation.md): lane export/adopt is EAGER
+    host-orchestrated array work between jit boundaries (zero new
+    compiled programs), the transfer plane is blocking stdlib HTTP on
+    the coordinator thread, and the `fstpu_disagg_*` counters mutate
+    only around those host steps — neither `host-divergence`,
+    `blocking-transfer` nor `metrics-in-traced-code` may fire on the
+    fixture or on the real disagg package + `serving/handoff.py`. A
+    hit means a lane gather/scatter or a KV push leaked into a traced
+    program (a real hazard: compile-count drift or a device-blocking
+    decode tick) or a rule lost precision."""
+    fixture = os.path.join(FIXTURES, "disagg_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "disagg"),
+             os.path.join(PKG, "serving", "handoff.py")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_trace_context_internals_are_clean():
     """Regression fixture for the distributed-tracing tier (ISSUE 11,
     docs/observability.md "Distributed tracing"): trace/span ids come

@@ -831,6 +831,38 @@ def test_benchdiff_never_compares_across_replica_counts(tmp_path):
     assert report["verdict"] == "REGRESSED"
 
 
+def test_benchdiff_never_compares_across_phase_topologies(tmp_path):
+    """ISSUE 13 satellite: disaggregated rows carry their phase
+    topology (docs/disaggregation.md) and rows at different topologies
+    are INCOMPARABLE even at equal replica counts — a
+    prefill=1,decode=2 split measuring below a homogeneous 3-replica
+    fleet is a deployment change, not a perf regression. The same
+    topology still diffs normally."""
+    from fengshen_tpu.observability import benchdiff
+
+    d = str(tmp_path)
+    base = {"metric": "disagg_tokens_per_sec", "unit": "tok/s",
+            "replicas": 3}
+    _write_round(d, 1, [dict(base, value=300.0, vs_baseline=1.4,
+                             topology="prefill=1,decode=2")])
+    # same N, homogeneous topology: a different deployment
+    _write_round(d, 2, [dict(base, value=220.0, vs_baseline=1.0,
+                             topology="homogeneous")])
+    # back at the split: still incomparable (prev was homogeneous)
+    _write_round(d, 3, [dict(base, value=290.0, vs_baseline=1.35,
+                             topology="prefill=1,decode=2")])
+    # same topology as the previous round: a real regression
+    _write_round(d, 4, [dict(base, value=150.0, vs_baseline=0.7,
+                             topology="prefill=1,decode=2")])
+    report = benchdiff.diff_rounds(benchdiff.load_rounds(d),
+                                   threshold=0.15)
+    by_round = {c["round"]: c for c in report["comparisons"]}
+    assert by_round[2]["status"] == "incomparable"
+    assert by_round[2]["delta_pct"] is None
+    assert by_round[3]["status"] == "incomparable"
+    assert by_round[4]["status"] == "regression"
+
+
 def test_benchdiff_report_deterministic_across_hashseed(tmp_path):
     d = str(tmp_path)
     _write_round(d, 1, [{"metric": f"m{i}", "value": float(i + 1),

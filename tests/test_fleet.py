@@ -319,7 +319,8 @@ class T:
             if path == "/healthz": return 200, {"ready": True}
             if path == "/stats": return 200, {"slots_active": 1,
                                               "num_slots": 4,
-                                              "queue_depth": 0}
+                                              "queue_depth": 0,
+                                              "phase": "prefill"}
             return 200, {"result": "ok",
                          "request_id": body["request_id"]}
         raise TransportError("dead", sent=False)
@@ -343,6 +344,11 @@ print(json.dumps(r.fleet_state(), sort_keys=True))
     assert outs[0] == outs[1]
     state = json.loads(outs[0])
     assert state["healthy"] == 1 and state["broken"] == 1
+    # phase flows from polled /stats into /fleet; the unpolled dead
+    # replica stays "both", and the topology label reflects the mix
+    phases = {r["name"]: r["phase"] for r in state["replicas"]}
+    assert phases == {"a:1": "prefill", "b:2": "both"}
+    assert state["topology"] == "prefill=1,decode=0,both=1"
 
 
 def test_fault_plan_coordinates():
