@@ -4,12 +4,19 @@
 
 PY ?= python
 
-.PHONY: lint lint-baseline test test-fast serve-bench \
+.PHONY: lint lint-changed lint-baseline test test-fast serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
 	serve-bench-disagg serve-fleet aot-bench benchdiff
 
+# whole package, all rules (per-file + the cross-module concurrency
+# tier); the project index is cached in .fslint_cache.json
 lint:
 	$(PY) -m fengshen_tpu.analysis --json
+
+# hot-loop variant: lint only files dirty vs HEAD (plus untracked) —
+# the concurrency rules still index the whole package for context
+lint-changed:
+	$(PY) -m fengshen_tpu.analysis --changed
 
 # offline serving-throughput microbench (docs/serving.md): continuous
 # batching vs sequential per-request decode, one JSON line on CPU so

@@ -13,10 +13,13 @@ CLI: ``python -m fengshen_tpu.analysis [paths] [--select/--ignore]
 from fengshen_tpu.analysis.engine import (Finding, check_file,
                                           check_paths,
                                           default_project_root)
-from fengshen_tpu.analysis.registry import (Rule, all_rule_ids,
-                                            make_rules, register)
+from fengshen_tpu.analysis.project import ProjectIndex, build_index
+from fengshen_tpu.analysis.registry import (ProjectRule, Rule,
+                                            all_rule_ids, make_rules,
+                                            register)
 
 __all__ = [
-    "Finding", "Rule", "all_rule_ids", "check_file", "check_paths",
+    "Finding", "ProjectIndex", "ProjectRule", "Rule", "all_rule_ids",
+    "build_index", "check_file", "check_paths",
     "default_project_root", "make_rules", "register",
 ]

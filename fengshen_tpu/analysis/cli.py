@@ -6,8 +6,15 @@ Exit codes: 0 = clean (everything baselined or nothing found),
 1 = non-baselined findings, 2 = bad invocation (unknown rule id,
 unreadable baseline).
 
-``--json`` emits a machine-readable report sorted by (path, line, col,
-rule) — byte-stable across hosts, so CI can diff runs directly.
+``--format=json`` (alias: ``--json``) emits a machine-readable report
+sorted by (path, line, col, rule) — byte-stable across hosts, so CI
+can diff runs directly. ``--format=github`` emits one
+``::error file=...`` workflow annotation per finding.
+
+``--changed`` lints only files touched in the working tree (``git
+diff --name-only HEAD`` plus untracked files), but the project rules
+still index the whole package — cross-module context is never
+narrowed, only where findings may be reported.
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -44,7 +52,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated rule ids to skip")
     parser.add_argument(
         "--json", action="store_true",
-        help="emit a sorted machine-readable JSON report")
+        help="alias for --format=json")
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"), default=None,
+        help="output format (default: text; 'github' emits workflow "
+             "::error annotations)")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs HEAD (plus untracked files); "
+             "project rules still index the whole package")
+    parser.add_argument(
+        "--index-cache", default=None, metavar="PATH",
+        help="project-index cache file (default: "
+             "<repo>/.fslint_cache.json; content-hash keyed, only "
+             "ever a speedup)")
+    parser.add_argument(
+        "--no-index-cache", action="store_true",
+        help="build the project index from scratch, no cache file")
     parser.add_argument(
         "--baseline", default=None, metavar="PATH",
         help="baseline file (default: fengshen_tpu/analysis/"
@@ -61,12 +85,39 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _changed_py_files(root: str) -> List[str]:
+    """Working-tree changes vs HEAD plus untracked files, .py only,
+    sorted and deduplicated. Raises RuntimeError when git is absent
+    or the root is not a repository."""
+    rels: List[str] = []
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(str(e))
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip() or
+                               f"{' '.join(cmd)} failed")
+        rels.extend(proc.stdout.splitlines())
+    out = []
+    for rel in sorted({r.strip() for r in rels}):
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(root, rel.replace("/", os.sep))
+        if os.path.isfile(path):   # deleted files stay listed by diff
+            out.append(path)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for rid in all_rule_ids():
             print(rid)
         return 0
+    fmt = args.format or ("json" if args.json else "text")
 
     root = engine.default_project_root()
     paths = args.paths or [os.path.join(root, "fengshen_tpu")]
@@ -76,8 +127,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"fslint: {e}", file=sys.stderr)
         return 2
 
+    cache_path: Optional[str] = None
+    if not args.no_index_cache:
+        cache_path = args.index_cache or \
+            os.path.join(root, ".fslint_cache.json")
+
+    index = None
+    if args.changed:
+        try:
+            changed = _changed_py_files(root)
+        except RuntimeError as e:
+            print(f"fslint: --changed needs git: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            if fmt == "text":
+                print("fslint: no changed python files")
+            elif fmt == "json":
+                print(json.dumps({"findings": [], "baselined": 0,
+                                  "stale_baseline": []},
+                                 indent=2, sort_keys=True))
+            return 0
+        paths = changed
+        if any(r.PROJECT for r in rules):
+            # cross-module rules always see the full package; only the
+            # reporting surface narrows to the changed files
+            from fengshen_tpu.analysis import project as project_mod
+            index = project_mod.build_index(
+                list(engine.iter_py_files(
+                    [os.path.join(root, "fengshen_tpu")])),
+                root, cache_path=cache_path)
+
     try:
-        findings = engine.check_paths(paths, rules, project_root=root)
+        findings = engine.check_paths(paths, rules, project_root=root,
+                                      index=index,
+                                      index_cache=cache_path)
     except FileNotFoundError as e:
         print(f"fslint: {e}", file=sys.stderr)
         return 2
@@ -123,7 +206,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings, baselined, stale = baseline_mod.split_by_baseline(
             findings, entries)
 
-    if args.json:
+    if fmt == "json":
         report = {
             "findings": [f.to_dict() for f in findings],
             "baselined": len(baselined),
@@ -132,6 +215,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 for e in stale],
         }
         print(json.dumps(report, indent=2, sort_keys=True))
+    elif fmt == "github":
+        for f in findings:
+            # workflow-command annotation; messages are single-line by
+            # construction, but escape the reserved characters anyway
+            msg = f"{f.message} (fix: {f.hint})".replace(
+                "%", "%25").replace("\n", "%0A")
+            print(f"::error file={f.path},line={f.line},"
+                  f"col={f.col + 1},title=fslint {f.rule}::{msg}")
     else:
         for f in findings:
             print(f.render())

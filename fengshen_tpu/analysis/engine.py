@@ -1,32 +1,40 @@
-"""fslint engine: one AST walk per file, shared trace-context analysis.
+"""fslint engine: two-phase analysis over per-file and project rules.
 
 Pure stdlib — the analyzer never imports jax (or the package under
 analysis), so ``python -m fengshen_tpu.analysis`` starts in
 milliseconds and runs identically on a dev laptop, CI, and a TPU host.
 
-The engine owns everything rules share:
+Two tiers of rules share this engine:
 
-- parsing + a parent map (``ctx.parent``) over each file's tree
-- import-alias resolution (``ctx.qualname`` turns ``jnp.zeros`` /
-  ``P(...)`` / ``device_get(...)`` back into dotted origins like
-  ``jax.numpy.zeros`` regardless of local import spelling)
-- traced-context analysis (``ctx.in_traced_context``): which functions
-  are jitted / grad-transformed / scan-cond-while bodies, including
-  functions reached transitively by name from a traced one
-- per-line suppressions: ``# fslint: disable=<rule>[,<rule>]`` (or a
-  bare ``# fslint: disable`` for all rules) on the finding's line
+- **per-file rules** (the original contract): one AST walk per file,
+  every node dispatched to the rules subscribed to its type. The
+  engine provides parsing + a parent map (``ctx.parent``),
+  import-alias resolution (``ctx.qualname``), and traced-context
+  analysis (``ctx.in_traced_context``).
+- **project rules** (``registry.ProjectRule``): run once per
+  invocation over the whole-package ``ProjectIndex`` built by
+  ``analysis/project.py`` (phase 1) — lock inventories, guard scopes,
+  and the cross-module call graph the concurrency rules need. Their
+  findings are filtered to the analyzed paths, so ``--changed`` stays
+  fast while the rules still see the full package.
+
+Both tiers honour per-line suppressions: ``# fslint:
+disable=<rule>[,<rule>]`` (or a bare ``# fslint: disable`` for all
+rules) on the finding's line.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
-import io
 import os
-import re
-import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
+from fengshen_tpu.analysis import project as project_mod
+from fengshen_tpu.analysis.project import (collect_aliases,
+                                           collect_comments,
+                                           collect_suppressions,
+                                           iter_py_files)
 from fengshen_tpu.analysis.registry import Rule
 
 #: calls whose function-valued arguments are traced by JAX. Matched
@@ -49,10 +57,6 @@ TRACED_BY_NAME = frozenset({
     "train_step", "eval_step", "training_loss", "validation_loss",
     "predict_step",
 })
-
-_SUPPRESS_RE = re.compile(
-    r"#\s*fslint:\s*disable(?:=(?P<rules>[\w,\- ]+))?")
-
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -96,9 +100,9 @@ class FileContext:
         for parent in ast.walk(tree):
             for child in ast.iter_child_nodes(parent):
                 self._parents[child] = parent
-        self.aliases = _collect_aliases(tree)
-        self.comments = _collect_comments(source)
-        self.suppressions = _collect_suppressions(self.comments)
+        self.aliases = collect_aliases(tree)
+        self.comments = collect_comments(source)
+        self.suppressions = collect_suppressions(self.comments)
         self._traced = _traced_functions(self)
 
     # -- structure ---------------------------------------------------
@@ -150,48 +154,6 @@ class FileContext:
 
     def line_comment(self, line: int) -> str:
         return self.comments.get(line, "")
-
-
-def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
-    aliases: Dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = \
-                    a.name if a.asname else a.name.split(".")[0]
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            prefix = ("." * node.level) + node.module
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                aliases[a.asname or a.name] = f"{prefix}.{a.name}"
-    return aliases
-
-
-def _collect_comments(source: str) -> Dict[int, str]:
-    comments: Dict[int, str] = {}
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                comments[tok.start[0]] = tok.string
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        pass  # ast.parse already succeeded; comment map is best-effort
-    return comments
-
-
-def _collect_suppressions(
-        comments: Dict[int, str]) -> Dict[int, frozenset]:
-    """line -> suppressed rule ids (empty frozenset = all rules)."""
-    out: Dict[int, frozenset] = {}
-    for line, text in comments.items():
-        m = _SUPPRESS_RE.search(text)
-        if not m:
-            continue
-        rules = m.group("rules")
-        out[line] = frozenset(
-            r.strip() for r in rules.split(",") if r.strip()) \
-            if rules else frozenset()
-    return out
 
 
 def _function_nodes(tree: ast.Module) -> List[ast.AST]:
@@ -282,33 +244,15 @@ def _traced_functions(ctx: "FileContext") -> Set[ast.AST]:
 # ---------------------------------------------------------------------------
 
 
-def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
-    for path in paths:
-        if os.path.isfile(path):
-            yield path
-            continue
-        if not os.path.isdir(path):
-            # a typo'd path must fail LOUDLY, not lint nothing and
-            # report the tree clean (a vacuous CI gate)
-            raise FileNotFoundError(f"no such file or directory: {path}")
-        for dirpath, dirnames, filenames in os.walk(path):
-            dirnames[:] = sorted(
-                d for d in dirnames
-                if d not in ("__pycache__", ".git", ".venv"))
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
-
-
 def default_project_root() -> str:
     """The repo root: parent of the fengshen_tpu package directory."""
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     return os.path.dirname(pkg)
 
 
-def check_file(path: str, rules: List[Rule],
-               project_root: Optional[str] = None) -> List[Finding]:
-    project_root = project_root or default_project_root()
+def _check_one_file(path: str, rules: List[Rule],
+                    project_root: str) -> List[Finding]:
+    """Phase 2a: the per-file walk (per-file rules only)."""
     try:
         with open(path, encoding="utf-8") as f:
             source = f.read()
@@ -352,12 +296,88 @@ def check_file(path: str, rules: List[Rule],
     return findings
 
 
-def check_paths(paths: Iterable[str], rules: List[Rule],
-                project_root: Optional[str] = None) -> List[Finding]:
-    project_root = project_root or default_project_root()
+def run_project_rules(rules: List[Rule],
+                      index: "project_mod.ProjectIndex",
+                      project_root: str,
+                      restrict: Optional[Set[str]] = None,
+                      ) -> List[Finding]:
+    """Phase 2b: project rules over the index. ``restrict`` limits
+    emission to the analyzed relpaths (``--changed`` lints a subset
+    of the files the index was built from)."""
     findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        findings.extend(check_file(path, rules, project_root))
+    line_cache: Dict[str, List[str]] = {}
+
+    def code_line(relpath: str, line: int) -> str:
+        if relpath not in line_cache:
+            try:
+                with open(os.path.join(project_root, relpath),
+                          encoding="utf-8") as f:
+                    line_cache[relpath] = f.read().splitlines()
+            except (OSError, UnicodeDecodeError):
+                line_cache[relpath] = []
+        lines = line_cache[relpath]
+        return lines[line - 1].strip() if 0 < line <= len(lines) \
+            else ""
+
+    for rule in rules:
+        for relpath, line, col, message in rule.check_project(index):
+            if restrict is not None and relpath not in restrict:
+                continue
+            if index.is_suppressed(relpath, line, rule.id):
+                continue
+            findings.append(Finding(
+                path=relpath, line=line, col=col, rule=rule.id,
+                message=message, hint=rule.hint,
+                code=code_line(relpath, line)))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def check_file(path: str, rules: List[Rule],
+               project_root: Optional[str] = None,
+               index: Optional["project_mod.ProjectIndex"] = None,
+               ) -> List[Finding]:
+    project_root = project_root or default_project_root()
+    file_rules = [r for r in rules if not r.PROJECT]
+    proj_rules = [r for r in rules if r.PROJECT]
+    findings = _check_one_file(path, file_rules, project_root)
+    if proj_rules:
+        if index is None:
+            index = project_mod.build_index([path], project_root)
+        findings.extend(run_project_rules(
+            proj_rules, index, project_root,
+            restrict={_relpath(path, project_root)}))
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def check_paths(paths: Iterable[str], rules: List[Rule],
+                project_root: Optional[str] = None,
+                index: Optional["project_mod.ProjectIndex"] = None,
+                index_cache: Optional[str] = None) -> List[Finding]:
+    """Two-phase run over ``paths``.
+
+    When ``index`` is given (e.g. built over the whole package for a
+    ``--changed`` subset run), project rules use it for cross-module
+    context but only report inside the analyzed paths; otherwise the
+    index is built from ``paths`` themselves."""
+    project_root = project_root or default_project_root()
+    file_rules = [r for r in rules if not r.PROJECT]
+    proj_rules = [r for r in rules if r.PROJECT]
+    findings: List[Finding] = []
+    analyzed: Set[str] = set()
+    files = list(iter_py_files(paths))
+    for path in files:
+        analyzed.add(_relpath(path, project_root))
+        findings.extend(_check_one_file(path, file_rules,
+                                        project_root))
+    if proj_rules:
+        if index is None:
+            index = project_mod.build_index(files, project_root,
+                                            cache_path=index_cache)
+        findings.extend(run_project_rules(proj_rules, index,
+                                          project_root,
+                                          restrict=analyzed))
     findings.sort(key=Finding.sort_key)
     return findings
 

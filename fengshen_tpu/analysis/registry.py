@@ -36,6 +36,8 @@ class Rule:
     id: str = ""
     hint: str = ""
     NODE_TYPES: Tuple[type, ...] = ()
+    #: True for whole-package rules (see ProjectRule below)
+    PROJECT: bool = False
 
     def begin_file(self, ctx) -> None:  # noqa: B027 - optional hook
         pass
@@ -44,6 +46,24 @@ class Rule:
         return iter(())
 
     def end_file(self, ctx) -> Iterator[Tuple[ast.AST, str]]:
+        return iter(())
+
+
+class ProjectRule(Rule):
+    """Base class for phase-2b rules that need the whole-package view.
+
+    Instead of per-node dispatch, a project rule implements
+    ``check_project(index)`` — called once per run with the
+    ``ProjectIndex`` (class/lock inventories, guard scopes, the
+    cross-module call graph and its closures; see
+    ``analysis/project.py``) — and yields ``(relpath, line, col,
+    message)`` tuples. The engine turns those into ``Finding``s,
+    honouring per-line suppressions exactly like per-file rules."""
+
+    PROJECT = True
+
+    def check_project(self, index) -> Iterator[
+            Tuple[str, int, int, str]]:
         return iter(())
 
 

@@ -7,9 +7,12 @@ To add rule 7: drop a module here with a ``@register``-decorated
 from fengshen_tpu.analysis.rules import (  # noqa: F401
     blanket_except,
     blocking_transfer,
+    blocking_under_lock,
     host_divergence,
+    lock_order,
     metrics_in_traced_code,
     nondet_iteration,
     partition_spec_axes,
     retrace_hazard,
+    unguarded_shared_state,
 )

@@ -1,0 +1,77 @@
+"""blocking-under-lock: slow/blocking work inside a critical section.
+
+A ``with lock:`` body is a convoy point: every thread that touches the
+same lock stalls for as long as the holder runs. Sleeping, socket or
+HTTP I/O, child processes, queue waits, device syncs
+(``block_until_ready`` / ``jax.device_get``) and jit-compiled
+dispatches all turn a microsecond critical section into a
+milliseconds-to-unbounded one — the fleet router holding its placement
+lock across a replica HTTP call would serialise the whole fleet on one
+slow replica. The rule flags blocking operations lexically inside a
+guard scope AND — through the cross-module call graph — calls whose
+resolved callee chain reaches one (``with self._lock:
+self._flush()`` where ``_flush`` eventually does ``urlopen``).
+
+``Condition.wait`` on the condition currently held is exempt (waiting
+releases it — that is the point of a condition variable); waiting on
+a *different* lock's condition or an ``Event`` while holding a lock
+still fires.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Set, Tuple
+
+from fengshen_tpu.analysis.registry import ProjectRule, register
+
+
+def _offending(guards: Tuple[str, ...], exempt: str) -> Set[str]:
+    held = set(guards)
+    if exempt:
+        held.discard(exempt)
+    return held
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+@register
+class BlockingUnderLock(ProjectRule):
+    id = "blocking-under-lock"
+    hint = ("move the blocking call outside the `with lock:` body — "
+            "snapshot state under the lock, do the slow work after "
+            "releasing it")
+
+    def check_project(self, index) -> Iterator[Tuple[str, int, int,
+                                                     str]]:
+        closure = index.blocking_closure()
+        edges = index.edges()
+        for fn_id in sorted(index.functions):
+            fsum, fs = index.functions[fn_id]
+            # direct blocking ops under a lexical guard
+            for line, col, desc, exempt, guards in sorted(fs.blocking):
+                bad = _offending(guards, exempt)
+                if bad:
+                    locks = ", ".join(sorted(_short(b) for b in bad))
+                    yield (fsum.relpath, line, col,
+                           f"{desc} while holding `{locks}` — every "
+                           "contender on the lock stalls behind it")
+            # calls under a lexical guard whose callee chain blocks
+            seen_lines: Set[int] = set()
+            for callee, line, col, guards in sorted(edges[fn_id]):
+                if not guards or line in seen_lines:
+                    continue
+                for desc, exempt, chain in closure.get(callee, ()):
+                    bad = _offending(guards, exempt)
+                    if not bad:
+                        continue
+                    locks = ", ".join(sorted(_short(b) for b in bad))
+                    site = index.describe_site(chain[-1])
+                    via = index.functions[callee][1].qual
+                    yield (fsum.relpath, line, col,
+                           f"call into `{via}` reaches {desc} (at "
+                           f"{site}) while holding `{locks}` — the "
+                           "critical section blocks on it")
+                    seen_lines.add(line)
+                    break

@@ -1,0 +1,79 @@
+"""lock-order: inconsistent nested acquisition order across the package.
+
+Two threads taking the same two locks in opposite orders is the
+classic ABBA deadlock, and it is invisible per-file: the engine
+scheduler holding its condition while bumping a metrics counter
+(registry lock) is fine until some exporter thread holds the registry
+lock while calling back into the engine. Phase 1 records every
+acquisition (``with lock:`` nesting and ``.acquire()``) together with
+the locks already held, and follows resolved calls made under a guard
+into their transitive acquisitions — so the pair (engine._cv →
+registry._lock) is observed even though the two ``with`` statements
+live in different modules. The rule then reports every site of an
+order that some other site inverts.
+
+Fix direction: pick one global order (document it in
+docs/static_analysis.md "Concurrency doctrine") and release the outer
+lock before taking the inner one on the minority path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from fengshen_tpu.analysis.registry import ProjectRule, register
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+@register
+class LockOrder(ProjectRule):
+    id = "lock-order"
+    hint = ("acquire these locks in one consistent order everywhere "
+            "(or drop the outer lock before taking the inner one)")
+
+    def check_project(self, index) -> Iterator[Tuple[str, int, int,
+                                                     str]]:
+        acquired = index.acquired_closure()
+        edges = index.edges()
+        # (outer, inner) -> [(relpath, line, col, how)]
+        pairs: Dict[Tuple[str, str],
+                    List[Tuple[str, int, int, str]]] = {}
+
+        for fn_id in sorted(index.functions):
+            fsum, fs = index.functions[fn_id]
+            for lock, line, col, held in sorted(fs.acquisitions):
+                for outer in held:
+                    if outer != lock:
+                        pairs.setdefault((outer, lock), []).append(
+                            (fsum.relpath, line, col, "acquired here"))
+            for callee, line, col, guards in sorted(edges[fn_id]):
+                if not guards:
+                    continue
+                via = index.functions[callee][1].qual
+                for lock in sorted(acquired.get(callee, ())):
+                    for outer in guards:
+                        if outer == lock:
+                            continue
+                        pairs.setdefault((outer, lock), []).append(
+                            (fsum.relpath, line, col,
+                             f"acquired via `{via}`"))
+
+        emitted = set()
+        for outer, inner in sorted(pairs):
+            if (inner, outer) not in pairs:
+                continue
+            other = sorted(pairs[(inner, outer)])[0]
+            for relpath, line, col, how in sorted(pairs[(outer,
+                                                         inner)]):
+                key = (relpath, line, col, outer, inner)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                yield (relpath, line, col,
+                       f"`{_short(inner)}` {how} while holding "
+                       f"`{_short(outer)}`, but the reverse order is "
+                       f"taken at {other[0]}:{other[1]} — ABBA "
+                       "deadlock hazard")

@@ -724,7 +724,10 @@ class ContinuousBatchingEngine:
         """One tick: reclaim → admit → one jitted decode over the pool.
         Returns the number of lanes still active after the tick."""
         with self._cv:
-            return self._step_locked()
+            # the tick IS the critical section: the scheduler owns all
+            # device state under _cv by design; admission threads wait
+            # at most one tick (docs/serving.md "Threading")
+            return self._step_locked()  # fslint: disable=blocking-under-lock; deliberate scheduler design
 
     def _step_locked(self) -> int:
         now = self._clock()
@@ -990,7 +993,7 @@ class ContinuousBatchingEngine:
             with self._cv:
                 if not self._queue and not self._active.any():
                     return
-                self._step_locked()
+                self._step_locked()  # fslint: disable=blocking-under-lock; offline driver, same tick-owns-lock design as step()
         raise RuntimeError(f"engine still busy after {max_ticks} ticks")
 
     def generate_all(self, prompts,
@@ -1153,7 +1156,10 @@ class ContinuousBatchingEngine:
                         continue
                     ids = np.ones((1, bucket), np.int32)
                     mask = np.ones((1, bucket), np.int32)
-                    jax.block_until_ready(self._prefill_jit(
+                    # warmup compiles under _cv on purpose: no request
+                    # may tick mid-warmup or it would pay (and double-
+                    # compile) the very programs being primed
+                    jax.block_until_ready(self._prefill_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
                         self.params, ids, mask, self._zero_key))
                 # cache/history are donated, so reassign them; with
                 # every lane free the warmup tick is a no-op on pool
@@ -1161,12 +1167,12 @@ class ContinuousBatchingEngine:
                 # overwritten by the next assignment anyway); the spec
                 # tick returns (cache, history, n_r, win), the plain
                 # one (cache, history, nxt) — slice the shared prefix
-                out = self._decode_jit(
+                out = self._decode_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
                     self.params, self._cache, self._history, self._mask,
                     self._last_tok, self._pos, self._phys, self._active,
                     self._zero_key)
                 self._cache, self._history = out[0], out[1]
-                jax.block_until_ready(self._cache)
+                jax.block_until_ready(self._cache)  # fslint: disable=blocking-under-lock; warmup must exclude ticks
         dt = time.perf_counter() - t0
         self.metrics.warmup_compile_s = round(dt, 3)
         record_warmup_seconds("engine", dt)

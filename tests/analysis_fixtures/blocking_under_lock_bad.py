@@ -1,0 +1,39 @@
+"""Known-bad fixture for `blocking-under-lock`.
+
+Seeded from the fleet-router shape: holding the placement lock across
+a replica HTTP round-trip serialises the whole fleet on one slow
+replica. Includes the transitive chain the project call graph must
+follow: with-lock -> local helper -> module helper -> urlopen.
+"""
+
+import threading
+import time
+import urllib.request
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = []
+
+    def probe(self, url):
+        with self._lock:
+            # BUG: direct network I/O inside the critical section
+            return urllib.request.urlopen(url)
+
+    def rebalance(self):
+        with self._lock:
+            # BUG: transitive — _refresh() ends in a blocking fetch
+            self._refresh()
+
+    def _refresh(self):
+        for rep in self._replicas:
+            _fetch_health(rep)
+
+    def throttle(self):
+        with self._lock:
+            time.sleep(0.5)  # BUG: sleeping while others wait
+
+
+def _fetch_health(url):
+    return urllib.request.urlopen(url)

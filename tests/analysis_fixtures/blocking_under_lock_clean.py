@@ -1,0 +1,32 @@
+"""Clean fixture for `blocking-under-lock`: the snapshot-then-block
+idiom, and the Condition.wait exemption (waiting RELEASES the held
+condition — that is what condition variables are for)."""
+
+import threading
+import urllib.request
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._replicas = []
+
+    def rebalance(self):
+        with self._lock:
+            targets = list(self._replicas)  # snapshot under the lock
+        for url in targets:                 # slow work outside it
+            _fetch_health(url)
+
+    def wait_for_work(self):
+        with self._cv:
+            # waiting the condition you hold releases it: not a stall
+            self._cv.wait(timeout=1.0)
+
+    def note(self, url):
+        with self._lock:
+            self._replicas.append(url)      # cheap host work only
+
+
+def _fetch_health(url):
+    return urllib.request.urlopen(url)

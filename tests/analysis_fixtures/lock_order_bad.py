@@ -1,0 +1,43 @@
+"""Known-bad fixture for `lock-order`.
+
+Seeded from the engine/recorder shape: the scheduler holds its
+condition and calls into the recorder (recorder lock), while the
+recorder's dump path holds its own lock and calls back into the
+engine — the two-lock inversion is only visible across the pair of
+classes, one hop of the call graph apart.
+"""
+
+import threading
+
+
+class Engine:
+    def __init__(self, recorder: "Recorder"):
+        self._cv = threading.Condition()
+        self.recorder = recorder
+        self.ticks = 0
+
+    def tick(self):
+        with self._cv:
+            self.ticks += 1
+            # order A->B: engine cv, then recorder lock
+            self.recorder.record(self.ticks)
+
+    def snapshot(self):
+        with self._cv:
+            return self.ticks
+
+
+class Recorder:
+    def __init__(self, engine: "Engine"):
+        self._lock = threading.Lock()
+        self.engine = engine
+        self.events = []
+
+    def record(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def dump(self):
+        with self._lock:
+            # order B->A: recorder lock, then engine cv — ABBA
+            return (list(self.events), self.engine.snapshot())

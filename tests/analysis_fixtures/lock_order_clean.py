@@ -1,0 +1,38 @@
+"""Clean fixture for `lock-order`: the same two-class shape as the bad
+twin, deadlock-free because the dump path snapshots under its own lock
+and crosses into the engine only AFTER releasing it — one consistent
+engine-before-recorder order package-wide."""
+
+import threading
+
+
+class Engine:
+    def __init__(self, recorder: "Recorder"):
+        self._cv = threading.Condition()
+        self.recorder = recorder
+        self.ticks = 0
+
+    def tick(self):
+        with self._cv:
+            self.ticks += 1
+            self.recorder.record(self.ticks)
+
+    def snapshot(self):
+        with self._cv:
+            return self.ticks
+
+
+class Recorder:
+    def __init__(self, engine: "Engine"):
+        self._lock = threading.Lock()
+        self.engine = engine
+        self.events = []
+
+    def record(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def dump(self):
+        with self._lock:
+            events = list(self.events)   # snapshot, then release
+        return (events, self.engine.snapshot())

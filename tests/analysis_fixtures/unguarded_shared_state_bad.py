@@ -1,0 +1,45 @@
+"""Known-bad fixture for `unguarded-shared-state`.
+
+Seeded from the PR 11 JsonlSink bug shape: a sink whose writer list
+is appended under its lock on the hot path, but swapped/cleared with
+no lock from a maintenance method called off the flush thread —
+interleaved writers corrupted the JSONL stream until review caught it.
+"""
+
+import threading
+
+
+class Sink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._dropped = 0
+
+    def emit(self, rec):
+        with self._lock:
+            self._buffer.append(rec)
+
+    def flush(self):
+        with self._lock:
+            out, self._buffer = self._buffer, []
+            self._dropped = 0
+        return out
+
+    def trim(self, keep):
+        # BUG: races emit()/flush() — mutates the buffer and the
+        # dropped counter with no lock
+        self._dropped += max(0, len(self._buffer) - keep)
+        self._buffer = self._buffer[-keep:]
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0  # BUG: unguarded store races bump()'s RMW

@@ -1,0 +1,46 @@
+"""Clean fixture for `unguarded-shared-state`: every escape hatch the
+rule promises — __init__ writes, guard inference through call chains,
+the `*_locked` convention, and scheduler-thread confinement."""
+
+import threading
+
+
+class DisciplinedQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []          # __init__ writes need no lock
+        self._accepted = 0
+        self._ticks = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._bump_accepted_locked()
+
+    def _bump_accepted_locked(self):
+        # convention: caller holds self._lock
+        self._accepted += 1
+
+    def drain(self):
+        with self._lock:
+            return self._drain_inner()
+
+    def _drain_inner(self):
+        # guard inference: only ever called under the lock
+        out, self._items = self._items, []
+        return out
+
+    def _loop(self):
+        # scheduler-thread confinement: _ticks is only ever touched
+        # on the thread this class owns
+        while True:
+            self._ticks += 1
+            self._tick_once()
+
+    def _tick_once(self):
+        self._ticks += 1
