@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: lint lint-changed lint-baseline test test-fast serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
-	serve-bench-disagg serve-fleet aot-bench benchdiff
+	serve-bench-disagg serve-bench-evac serve-fleet aot-bench \
+	benchdiff
 
 # whole package, all rules (per-file + the cross-module concurrency
 # tier); the project index is cached in .fslint_cache.json
@@ -57,6 +58,16 @@ serve-bench-fleet:
 serve-bench-disagg:
 	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=disagg \
 		$(PY) -m fengshen_tpu.disagg.bench
+
+# preemption-tolerance drills (docs/fault_tolerance.md "Preemption
+# runbook"): SIGTERM-mid-decode (live lane evacuation — every
+# in-flight request answers 200 token-identical via a peer, zero lost
+# work) and SIGKILL-mid-decode (the adopter dies; requests resume from
+# token k out of the commit journal, never from token 0) over a
+# 3-replica fleet — one BENCH-schema JSON line carrying the drill
+# identity so it never diffs against undisturbed fleet rounds
+serve-bench-evac:
+	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.fleet.evac_bench
 
 # local fleet: spawn $(N) stdlib api replicas from the api config
 # $(CONFIG) and front them with the router on port $(PORT)

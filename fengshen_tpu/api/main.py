@@ -91,6 +91,11 @@ class ServerConfig:
     # SIGTERM drain (docs/fleet.md "Drain runbook"): how long the
     # stdlib server waits for in-flight requests before shutting down
     drain_timeout_s: float = 30.0
+    # live-evacuation peers (docs/fault_tolerance.md "Preemption
+    # runbook"): base urls of sibling replicas this replica may push
+    # its in-flight lanes to when a drain begins; empty = every lane
+    # finishes locally (the pre-evacuation drain behavior)
+    peers: tuple = ()
     # flight-recorder post-mortem bundles (POST /debug/dump, engine
     # tick errors, SIGTERM) land here (docs/observability.md)
     dump_dir: str = "fstpu_dumps"
@@ -105,6 +110,8 @@ class ServerConfig:
                              "'simple' or 'continuous'")
         from fengshen_tpu.disagg.policy import validate_phase
         self.phase = validate_phase(self.phase)
+        self.peers = tuple(str(p).rstrip("/")
+                           for p in (self.peers or ()) if str(p).strip())
 
 
 @dataclasses.dataclass
@@ -182,6 +189,10 @@ def _classify_route(path: str, api_route: str) -> str:
         # KV-handoff endpoints (docs/disaggregation.md), same
         # cardinality rule as the debug routes
         return "/kv/<id>"
+    if path.startswith("/partial/"):
+        # commit-journal endpoint (docs/fault_tolerance.md "Preemption
+        # runbook"), same cardinality rule
+        return "/partial/<id>"
     return path if path in (api_route, "/healthz", "/stats", "/metrics",
                             "/debug/requests", "/debug/dump") else "other"
 
@@ -196,6 +207,23 @@ def _dump_recorder(recorder, engine, reason: str = "on_demand") -> str:
         registries.append(engine.metrics.registry)
     recorder.snapshot_metrics(registries, force=True)
     return recorder.dump(reason=reason)
+
+
+def _partial_payload(engine, pipeline, request_id: str) \
+        -> tuple[int, dict]:
+    """`GET /partial/<id>`: the commit journal's view of one request —
+    the committed-token prefix the fleet router resumes a maybe-executed
+    retry from after a replica death (`resume_tokens`,
+    docs/fault_tolerance.md "Preemption runbook"). 404 when this
+    replica never journaled the id (or runs the simple engine). A
+    finished entry additionally carries the decoded `result` so the
+    router can answer the client without any resubmit."""
+    d = engine.partial(request_id) if engine is not None else None
+    if d is None:
+        return 404, {"error": f"unknown request_id {request_id!r}"}
+    if d.get("state") == "finished" and pipeline is not None:
+        d = dict(d, result=pipeline.decode(d["tokens"]))
+    return 200, d
 
 
 def _debug_requests_payload(engine) -> dict:
@@ -302,6 +330,7 @@ def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
     from fengshen_tpu.serving import (FINISHED, Draining,
                                       DuplicateRequest, PromptTooLong,
                                       QueueFull)
+    from fengshen_tpu.serving.handoff import EVACUATED
     rid = req.get("request_id")
     ctx = parse_traceparent(req.get("traceparent"))
 
@@ -318,7 +347,9 @@ def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
             max_new_tokens=req.get("max_new_tokens"),
             request_id=None if rid is None else str(rid),
             trace_id=None if ctx is None else ctx.trace_id,
-            parent_span_id=None if ctx is None else ctx.span_id)
+            parent_span_id=None if ctx is None else ctx.span_id,
+            resume_tokens=req.get("resume_tokens"),
+            resume_source=req.get("resume_source"))
     except Draining as e:
         return 503, _body({"error": str(e), "reason": "draining"})
     except DuplicateRequest as e:
@@ -339,12 +370,28 @@ def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
         engine.cancel(request.request_id)
         # the request may have completed in the wait→cancel window; a
         # finished result must not be discarded as a timeout
-        if request.state != FINISHED:
+        if request.state not in (FINISHED, EVACUATED):
             return 503, _body({"error":
                                f"request timed out after {timeout_s}s"})
+    if request.state == EVACUATED:
+        # drain-time live evacuation moved the lane to a healthy peer
+        # (docs/fault_tolerance.md "Preemption runbook"): answer the
+        # blocked POST with the same disagg-redirect marker a phase
+        # handoff uses — the router's existing collect path long-polls
+        # the adopter and the client sees one ordinary 200
+        return 200, _body({"disagg_redirect": True,
+                           "request_id": request.request_id,
+                           "target": request.evac_target,
+                           "evacuated": True})
     if request.state != FINISHED:
-        return 503, _body({"error": f"request {request.state} "
-                                    f"({request.finish_reason})"})
+        body = {"error": f"request {request.state} "
+                         f"({request.finish_reason})"}
+        if request.finish_reason == "draining":
+            # queued-but-not-slotted at begin_drain: flushed back as an
+            # orderly 503 the router re-places immediately instead of
+            # waiting out the drain timeout
+            body["reason"] = "draining"
+        return 503, _body(body)
     return 200, _body({"result": pipeline.decode(request.tokens),
                        "request_id": request.request_id,
                        "ttft_s": request.ttft_s,
@@ -392,6 +439,12 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         # the router names the decode replica this prefill replica
         # should push the primed lane to; pydantic must not drop it
         disagg_push_to: Optional[str] = None
+        # resume-from-token-k failover (docs/fault_tolerance.md
+        # "Preemption runbook"): the router replays a dead replica's
+        # journaled prefix so the retry prefills prompt+prefix and
+        # decodes only the remainder; pydantic must not drop these
+        resume_tokens: Optional[list] = None
+        resume_source: Optional[str] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
 
@@ -497,6 +550,14 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         code, body = disagg.handle_delete(request_id)
         _count_http("/kv/<id>", code)
         return JSONResponse(status_code=code, content=body)
+
+    @app.get("/partial/{request_id}")
+    def partial(request_id: str):
+        code, body = _partial_payload(engine, pipeline, request_id)
+        _count_http("/partial/<id>", code)
+        if code != 200:
+            return JSONResponse(status_code=code, content=body)
+        return body
 
     @app.get("/debug/requests")
     def debug_requests():
@@ -622,6 +683,10 @@ def build_stdlib_server(server_cfg: ServerConfig,
                     code, body = disagg.handle_get(
                         rid, server_cfg.request_timeout_s)
                     self._send(code, body)
+            elif self.path.startswith("/partial/"):
+                rid = self.path[len("/partial/"):]
+                code, body = _partial_payload(engine, pipeline, rid)
+                self._send(code, body)
             elif self.path == "/debug/requests":
                 self._send(200, _debug_requests_payload(engine))
             elif self.path.startswith("/debug/requests/"):
@@ -750,7 +815,8 @@ def build_stdlib_server(server_cfg: ServerConfig,
 
 def install_drain_handler(server, draining, engine=None, recorder=None,
                           drain_timeout_s: float = 30.0,
-                          poll_s: float = 0.05):
+                          poll_s: float = 0.05, disagg=None,
+                          peers=()):
     """SIGTERM → graceful replica drain (docs/fleet.md "Drain
     runbook"): set the `draining` event (healthz flips to 503
     `{"reason": "draining"}`; new generates get 503), stop engine
@@ -758,6 +824,13 @@ def install_drain_handler(server, draining, engine=None, recorder=None,
     the engine is idle and no HTTP generate is in flight (bounded by
     `drain_timeout_s`), dump the flight recorder, and shut the server
     down so `serve_forever` returns and the process exits 0.
+
+    When a `disagg` coordinator and evacuation `peers` are wired
+    (docs/fault_tolerance.md "Preemption runbook"), the waiter first
+    EVACUATES every in-flight lane to a healthy peer — the blocked
+    POSTs answer with disagg-style redirects the router re-collects —
+    so the idle-wait below only covers lanes no peer would adopt
+    (which finish locally, never as an error).
 
     Deliberately REPLACES (does not chain) any prior SIGTERM handler:
     the flight recorder's own handler re-delivers the default
@@ -779,6 +852,13 @@ def install_drain_handler(server, draining, engine=None, recorder=None,
             engine.begin_drain()
 
         def waiter():
+            if disagg is not None and peers:
+                try:
+                    disagg.evacuate_all(list(peers))
+                except Exception:  # noqa: BLE001 — evacuation is
+                    # best-effort; the idle wait below still finishes
+                    # every unevacuated lane locally
+                    pass
             deadline = time.monotonic() + drain_timeout_s
             while time.monotonic() < deadline:
                 engine_idle = engine is None or engine.idle()
@@ -874,6 +954,14 @@ def main(argv=None) -> None:
     import os
     import threading
     draining = threading.Event()
+    # FSTPU_PEERS=http://host:port,... names the sibling replicas this
+    # one may evacuate live lanes to on drain (the fleet launcher sets
+    # it; docs/fault_tolerance.md "Preemption runbook")
+    peers_env = os.environ.get("FSTPU_PEERS")
+    if peers_env:
+        server_cfg.peers = tuple(
+            p.strip().rstrip("/") for p in peers_env.split(",")
+            if p.strip())
     # FSTPU_API_SERVER=stdlib forces the stdlib path even where
     # uvicorn is installed — the fleet launcher sets it because only
     # this path has the SIGTERM graceful drain (uvicorn installs its
@@ -899,7 +987,8 @@ def main(argv=None) -> None:
         # chain installed above (the dump still happens, post-drain)
         install_drain_handler(server, draining, engine=engine,
                               recorder=recorder,
-                              drain_timeout_s=server_cfg.drain_timeout_s)
+                              drain_timeout_s=server_cfg.drain_timeout_s,
+                              disagg=disagg, peers=server_cfg.peers)
         why = "FSTPU_API_SERVER=stdlib" if use_stdlib else \
             "fastapi/uvicorn not installed"
         print(f"{why} — stdlib server on "

@@ -89,6 +89,11 @@ class DisaggCoordinator:
         self._h_push = r.histogram(
             "fstpu_disagg_push_seconds",
             "wall seconds of the replica-to-replica KV push")
+        self._c_evac = r.counter(
+            "fstpu_evac_lanes_total",
+            "drain-time live lane evacuations by outcome "
+            "(adopted / fallback / local_finish)",
+            labelnames=("outcome",))
         self._lock = threading.Lock()
         self._adopted: Dict[str, Any] = {}
 
@@ -160,6 +165,126 @@ class DisaggCoordinator:
                    "request_id": request.request_id,
                    "error": (error or "")[:200]})
         return None
+
+    # ---- live evacuation (docs/fault_tolerance.md) ------------------
+
+    def evacuate_all(self, peers,
+                     probe_timeout_s: float = 2.0) -> dict:
+        """Drain-time lane rescue: export every RUNNING lane and push
+        it to the healthiest willing peer (`policy.plan_evacuation`
+        ranks the probed candidates). Runs on the drain waiter thread,
+        strictly OFF the engine lock around every HTTP call — the
+        lanes keep decoding while their snapshots travel, which is
+        safe for the same reason `handoff()` is: greedy decode from
+        the snapshot cursor reproduces the identical tail.
+
+        Per-lane outcomes (counted in
+        `fstpu_evac_lanes_total{outcome}`):
+
+        - ``adopted``: a peer adopted; the lane is detached as
+          `evacuated` and the blocked POST answers with a redirect the
+          router re-collects from the adopter;
+        - ``local_finish``: the lane finished (or left) before the
+          push landed — the local result stands;
+        - ``fallback``: no peer would take it — the lane keeps
+          decoding here to completion, NEVER an error (the drain
+          waiter simply waits for it like before).
+        """
+        lane_ids = self.engine.live_lane_ids()
+        summary = {"lanes": len(lane_ids), "adopted": 0,
+                   "fallback": 0, "local_finish": 0}
+        if not lane_ids:
+            return summary
+        candidates = []
+        for url in peers:
+            stats = self._probe_peer(url, probe_timeout_s)
+            if stats is None:
+                continue        # unreachable peers never rank
+            candidates.append({
+                "url": url,
+                "draining": bool(stats.get("draining") or False),
+                "phase": str(stats.get("phase") or "both"),
+                "slots_active": int(stats.get("slots_active") or 0),
+                "num_slots": int(stats.get("num_slots") or 0),
+                "queue_depth": int(stats.get("queue_depth") or 0)})
+        from fengshen_tpu.disagg import policy
+        targets = policy.plan_evacuation(candidates)
+        for rid in lane_ids:
+            outcome = self._evacuate_lane(rid, targets)
+            self._c_evac.labels(outcome).inc()
+            summary[outcome] += 1
+        self._log({"event": "disagg_evacuate", **summary,
+                   "targets": len(targets)})
+        return summary
+
+    def _evacuate_lane(self, rid: str, targets) -> str:
+        try:
+            with span("disagg/export"):
+                payload = handoff.export_lane(self.engine, rid)
+        except handoff.HandoffError:
+            # finished (or left the pool) between snapshot and export
+            return "local_finish"
+        self._c_payload_bytes.inc(transfer.payload_nbytes(payload))
+        for url in targets:
+            t0 = self._clock()
+            try:
+                with span("disagg/push"):
+                    transfer.push_payload(
+                        url, rid, payload,
+                        timeout_s=self.push_timeout_s,
+                        max_bytes=self.max_payload_bytes,
+                        transport=self.transport)
+            except transfer.KvPushError as e:
+                if e.sent:
+                    # same twin hazard as handoff(): the peer MAY hold
+                    # an adopted copy behind the lost ack
+                    self._delete_twin(url, rid)
+                self._log({"event": "disagg_evacuate_push_failed",
+                           "request_id": rid, "target": url,
+                           "reason": e.reason})
+                continue        # next-best peer
+            self._h_push.observe(self._clock() - t0)
+            if not handoff.detach_lane(self.engine, rid, target=url,
+                                       evacuated=True):
+                # local decode finished during the push; its result
+                # stands and the adopted twin is cancelled
+                self._delete_twin(url, rid)
+                return "local_finish"
+            self._log({"event": "disagg_evacuated", "request_id": rid,
+                       "target": url})
+            return "adopted"
+        # no willing peer: the lane keeps decoding locally — mark the
+        # degradation on its timeline so the assembled trace shows the
+        # rescue that didn't happen
+        with self.engine._cv:
+            for r in self.engine._slot_req:
+                if r is not None and r.request_id == rid:
+                    r.timeline.add(self.engine._clock(),
+                                   "evac_fallback",
+                                   peers_probed=len(targets))
+                    break
+        return "fallback"
+
+    def _probe_peer(self, url: str,
+                    timeout_s: float) -> Optional[dict]:
+        """GET /stats from one candidate peer; None when unreachable
+        or non-200 (an unreachable peer must cost one short timeout,
+        never an exception on the drain path)."""
+        try:
+            if self.transport is not None:
+                code, body = self.transport.request(
+                    url, "GET", "/stats", None, timeout_s)
+            else:
+                import json
+                import urllib.request
+                with urllib.request.urlopen(
+                        url.rstrip("/") + "/stats",
+                        timeout=timeout_s) as r:
+                    code, body = r.status, json.loads(r.read())
+            return body if code == 200 and isinstance(body, dict) \
+                else None
+        except Exception:  # noqa: BLE001 — probe failures just
+            return None    # exclude the peer from ranking
 
     def _delete_twin(self, push_to: str, rid: str) -> None:
         """Best-effort DELETE of a possibly-adopted twin; failures are

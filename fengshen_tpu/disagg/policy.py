@@ -76,6 +76,36 @@ def plan_handoff(candidates: Sequence[Any]) -> Optional[HandoffPlan]:
                        decode=_least_occupied(decodes))
 
 
+def plan_evacuation(peers: Sequence[dict]) -> List[str]:
+    """Rank evacuation targets for drain-time lane rescue
+    (docs/fault_tolerance.md "Preemption runbook"). `peers` are probed
+    `/stats` snapshots as plain dicts — at least ``url``, plus
+    ``draining`` / ``phase`` / ``slots_active`` / ``num_slots`` /
+    ``queue_depth`` when the probe answered (missing fields default
+    safe). Returns peer urls best-first; the coordinator pushes each
+    lane down the list until one adopts.
+
+    Ordering: draining peers are excluded entirely (they are leaving
+    too — an evacuated lane must not need a SECOND rescue seconds
+    later); dedicated prefill tiers rank after decode/both replicas
+    (an evacuated lane is mid-decode work); within a tier, least
+    occupancy first with input order breaking ties — the same
+    determinism contract as `plan_handoff`. An empty result means
+    every lane finishes locally, never an error."""
+    ranked = []
+    for i, peer in enumerate(peers):
+        if peer.get("draining"):
+            continue
+        phase = str(peer.get("phase") or "both")
+        denom = max(int(peer.get("num_slots") or 0), 1)
+        occ = (int(peer.get("slots_active") or 0)
+               + int(peer.get("queue_depth") or 0)) / denom
+        ranked.append((1 if phase == "prefill" else 0, occ, i,
+                       str(peer["url"])))
+    ranked.sort(key=lambda t: t[:3])
+    return [url for _, _, _, url in ranked]
+
+
 def topology(phases: Sequence[str]) -> str:
     """Canonical topology label for BENCH rows and `/fleet`:
     ``"homogeneous"`` when no replica declares a dedicated phase, else

@@ -31,6 +31,16 @@ Fault kinds (each keyed `{request_index: replica_name}`):
 - ``slow_at``: that one attempt is delayed by ``slow_s`` (through the
   injectable sleep) before proceeding normally — tail-latency, not
   failure.
+- ``preempt_at``: just BEFORE attempt `index` is dispatched, the named
+  replica receives its preemption notice — the callback registered via
+  ``preempt_with(name, fn)`` runs exactly once (delivering SIGTERM to a
+  real process, or calling `begin_drain` on an in-process engine), so
+  evacuation tests pin "the drain began at request k" as a coordinate,
+  not a sleep. The callback fires OUTSIDE the plan lock and before the
+  attempt is forwarded: a preempted replica answers that very attempt
+  503 draining and the router re-places it deterministically. A
+  coordinate with no registered callback still lands in ``fired``
+  (exactly-once bookkeeping) and is otherwise a no-op.
 
 KV-handoff faults (docs/disaggregation.md) use their OWN coordinate
 axis — **KV-push indices**, counting `PUT /kv/*` attempts in dispatch
@@ -78,7 +88,8 @@ class FleetFaultPlan:
                  slow_s: float = 0.05,
                  kv_kill_at: Optional[Dict[int, str]] = None,
                  kv_wedge_at: Optional[Dict[int, str]] = None,
-                 kv_decline_at: Optional[Dict[int, str]] = None):
+                 kv_decline_at: Optional[Dict[int, str]] = None,
+                 preempt_at: Optional[Dict[int, str]] = None):
         self.kill_at = {int(k): str(v)
                         for k, v in (kill_at or {}).items()}
         self.wedge_at = {int(k): str(v)
@@ -94,12 +105,19 @@ class FleetFaultPlan:
                             for k, v in (kv_wedge_at or {}).items()}
         self.kv_decline_at = {int(k): str(v)
                               for k, v in (kv_decline_at or {}).items()}
+        self.preempt_at = {int(k): str(v)
+                           for k, v in (preempt_at or {}).items()}
         self.fired: List[Tuple[str, int, str]] = []
         self._lock = threading.Lock()
         self._index = 0
         self._kv_index = 0
         self._dead: Dict[str, str] = {}    # name -> "kill" | "wedge"
         self._armed: set = set()           # (at, name) already applied
+        self._preempt_fn: Dict[str, Callable[[], None]] = {}
+        #: preemption callbacks armed under the lock but DELIVERED by
+        #: the wrapper outside it — a callback that drains an
+        #: in-process engine must not run under the plan lock
+        self._preempt_pending: List[str] = []
 
     @property
     def fault_count(self) -> int:
@@ -112,6 +130,15 @@ class FleetFaultPlan:
         consumed, so the fault does NOT re-fire on the next attempt."""
         with self._lock:
             self._dead.pop(replica, None)
+
+    def preempt_with(self, replica: str,
+                     fn: Callable[[], None]) -> None:
+        """Register the preemption-notice delivery for `replica` —
+        what actually happens when its ``preempt_at`` coordinate is
+        reached (send SIGTERM to the subprocess, call `begin_drain`
+        on the in-process engine, ...)."""
+        with self._lock:
+            self._preempt_fn[str(replica)] = fn
 
     def wrap(self, transport, sleep: Callable[[float], None] = time.sleep
              ) -> "FaultInjectingTransport":
@@ -131,6 +158,14 @@ class FleetFaultPlan:
             if at <= idx and (at, name) not in self._armed:
                 self._armed.add((at, name))
                 self._dead.setdefault(name, "wedge")
+        for at, name in self.preempt_at.items():
+            # exactly-once: the ("preempt", at, name) ledger key keeps
+            # a late-armed coordinate (at < idx after a quiet stretch)
+            # from re-firing on every subsequent attempt
+            if at <= idx and ("preempt", at, name) not in self._armed:
+                self._armed.add(("preempt", at, name))
+                self.fired.append(("preempt", at, name))
+                self._preempt_pending.append(name)
         if self.error_503_at.get(idx) == replica:
             self.fired.append(("error_503", idx, replica))
             return "error_503"
@@ -201,6 +236,19 @@ class FaultInjectingTransport:
             else:
                 one_shot = None
                 mode = self.plan._dead_mode_locked(name, None)
+            preempts = [self.plan._preempt_fn.get(n)
+                        for n in self.plan._preempt_pending]
+            self.plan._preempt_pending.clear()
+        for fn in preempts:
+            # the preemption notice lands BEFORE this attempt is
+            # forwarded, outside the plan lock (the callback may drain
+            # an in-process engine or signal a subprocess); a
+            # coordinate with no registered callback is a no-op
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — a broken delivery
+                    pass           # must not fail the routed request
         if mode == "kill":
             raise TransportError(
                 f"injected kill: connect to {name} refused", sent=False)

@@ -178,6 +178,11 @@ class FleetConfig:
     retry_maybe_executed: bool = True   # see module docstring: the
     #   routed surface is idempotent-safe (greedy, never streamed,
     #   request-id deduped), so maybe-executed failures retry too
+    resume_from_journal: bool = True    # before a maybe-executed retry
+    #   (or after a failed disagg collect), mine the fleet's commit
+    #   journals (`GET /partial/<id>`) and resubmit with
+    #   `resume_tokens` so the retry decodes only the remainder
+    #   (docs/fault_tolerance.md "Preemption runbook")
     seed: int = 0                       # backoff-jitter rng seed
     trace_ring: int = 128               # traces the span ledger keeps
     trace_seed: Optional[int] = None    # trace-id seed — tests ONLY:
@@ -317,6 +322,15 @@ class FleetRouter:
             "fstpu_fleet_attempt_seconds",
             "per-attempt wall seconds by attempt outcome",
             labelnames=("outcome",))
+        self._c_resume = r.counter(
+            "fstpu_resume_total",
+            "commit-journal consultations before a maybe-executed "
+            "retry (resumed / recovered / miss)",
+            labelnames=("outcome",))
+        self._c_resume_tokens = r.counter(
+            "fstpu_resume_tokens_total",
+            "committed tokens replayed via resume_tokens instead of "
+            "regenerated from token 0")
         self._c_traces = r.counter(
             "fstpu_trace_started_total",
             "traces minted or joined by the router")
@@ -772,10 +786,29 @@ class FleetRouter:
                     break
                 backoff = self._maybe_retry(attempt, attempts, reason,
                                             rep)
+                answer = None
+                if (backoff is not None and e.sent
+                        and self.config.resume_from_journal):
+                    # the attempt may have executed: mine the fleet's
+                    # commit journals so the retry resumes from token
+                    # k instead of regenerating from token 0
+                    answer, body = self._resume_from_journal(body, rep)
                 self.tracer.end_span(
                     tid, s_att, outcome=reason, error=str(e)[:200],
                     **({} if backoff is None
                        else {"backoff_s": backoff}))
+                if answer is not None:
+                    status, resp = answer
+                    self.tracer.end_span(tid, root, outcome=OUTCOME_OK,
+                                         status=status,
+                                         attempts=attempt + 1)
+                    self._h_request.labels(OUTCOME_OK).observe(
+                        time.perf_counter() - t0)
+                    self._log({"event": "fleet_request_recovered",
+                               "request_id": body["request_id"],
+                               "attempts": attempt + 1,
+                               "replica": rep.name, "trace_id": tid})
+                    return status, dict(resp, trace_id=tid)
                 if backoff is not None:
                     self._sleep(backoff)
                 continue
@@ -814,11 +847,37 @@ class FleetRouter:
             self.tracer.end_span(tid, s_att, outcome=outcome,
                                  status=status)
             if status == 200 and resp.get("disagg_redirect"):
-                # the prefill replica handed the lane to a decode
-                # peer: collect the final generation from it
+                # the replica handed the lane to a peer (phase-aware
+                # placement, or a drain-time live evacuation): collect
+                # the final generation from the adopter
+                target_rep = next(
+                    (r for r in self.replicas
+                     if r.base_url == str(resp.get("target") or "")),
+                    None)
                 status, resp = self._collect_redirect(tid, root, resp)
                 if status >= 500:
                     outcome = OUTCOME_ERROR
+                    # the adopter died mid-decode (hard preemption):
+                    # before giving up, mine the fleet's commit
+                    # journals — the evacuating source journaled the
+                    # prefix — and re-place the request as a
+                    # resume-from-token-k retry
+                    backoff = self._maybe_retry(
+                        attempt, attempts, "collect_failed", rep) \
+                        if self.config.resume_from_journal else None
+                    if backoff is not None:
+                        answer, body = self._resume_from_journal(
+                            body, target_rep)
+                        if answer is not None:
+                            status, resp = answer
+                            outcome = OUTCOME_OK
+                        else:
+                            if (target_rep is not None
+                                    and target_rep not in tried):
+                                tried.append(target_rep)
+                            last = (status, resp)
+                            self._sleep(backoff)
+                            continue
                 elif status >= 400:
                     outcome = OUTCOME_CLIENT_ERROR
             self.tracer.end_span(tid, root, outcome=outcome,
@@ -914,6 +973,77 @@ class FleetRouter:
                               f"{last_err[:200]}",
                      "reason": "collect_failed",
                      "request_id": rid}
+
+    # ---- resume-from-token-k (docs/fault_tolerance.md) --------------
+
+    def _consult_journal(self, rid: str, first: Optional[Replica]
+                         ) -> Optional[Tuple[str, Any, str]]:
+        """Ask the fleet for request `rid`'s commit journal
+        (`GET /partial/<rid>`). The failed replica is asked FIRST — a
+        replica that timed out (or evacuated the lane before dying)
+        often still serves its journal — then every other replica (the
+        adopter of an evacuated lane journals it too, so a hard-killed
+        source leaves the prefix readable on its peer). Returns
+        ("final", payload, name) when some replica already FINISHED
+        the request (answer it without any resubmit), ("resume",
+        tokens, name) for a journaled prefix of >= 1 committed token,
+        or None — nothing journaled anywhere, regenerate from 0."""
+        order = ([first] if first is not None else []) + \
+            [r for r in self.replicas if r is not first]
+        for rep in order:
+            try:
+                code, out = self.transport.request(
+                    rep.base_url, "GET", f"/partial/{rid}", None,
+                    self.config.poll_timeout_s)
+            except TransportError:
+                continue
+            except Exception:  # noqa: BLE001 — a journal probe bug
+                # must degrade to regenerate-from-0, never fail the
+                # retry that is about to recover the request
+                continue
+            if code != 200:
+                continue
+            if out.get("state") == "finished" and "result" in out:
+                return ("final", out, rep.name)
+            tokens = [int(t) for t in (out.get("tokens") or [])]
+            if tokens:
+                return ("resume", tokens, rep.name)
+        return None
+
+    def _resume_from_journal(self, body: dict, failed: Optional[Replica]
+                             ) -> Tuple[Optional[Tuple[int, dict]],
+                                        dict]:
+        """A maybe-executed attempt failed on `failed`: mine the
+        fleet's commit journals before the retry. Returns
+        (final_answer, body): a non-None final_answer short-circuits
+        the retry entirely (some replica already finished the request
+        — e.g. the evacuated lane's adopter completed it); otherwise
+        the returned body carries `resume_tokens`/`resume_source` when
+        a journaled prefix was found, so the retry prefills
+        prompt+prefix and decodes only the remainder instead of
+        regenerating from token 0."""
+        found = self._consult_journal(body["request_id"], failed)
+        if found is None:
+            self._c_resume.labels("miss").inc()
+            return None, body
+        kind, payload, name = found
+        if kind == "final":
+            self._c_resume.labels("recovered").inc()
+            self._log({"event": "fleet_resume_recovered",
+                       "request_id": body["request_id"],
+                       "source": name})
+            return (200, {"result": payload.get("result"),
+                          "request_id": body["request_id"],
+                          "ttft_s": payload.get("ttft_s"),
+                          "finish_reason":
+                              payload.get("finish_reason")}), body
+        self._c_resume.labels("resumed").inc()
+        self._c_resume_tokens.inc(len(payload))
+        self._log({"event": "fleet_resume",
+                   "request_id": body["request_id"], "source": name,
+                   "tokens": len(payload)})
+        return None, dict(body, resume_tokens=payload,
+                          resume_source=name)
 
     def _maybe_retry(self, attempt: int, attempts: int, reason: str,
                      rep: Replica) -> Optional[float]:

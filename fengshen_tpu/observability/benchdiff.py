@@ -115,12 +115,17 @@ def _identity(row: dict) -> str:
     placements measure different programs, and a
     ``prefill=1,decode=2`` topology is a different deployment from a
     ``homogeneous`` 3-replica one even at equal N; all of them diff as
-    ``incomparable``, never regression/flat."""
+    ``incomparable``, never regression/flat. Fault-drill rows
+    (docs/fault_tolerance.md) carry a ``drill`` key for the same
+    reason: a preemption round must never be compared against an
+    undisturbed one."""
     parts = [_placement(row)]
     if "replicas" in row:
         parts.append(f"replicas={int(row['replicas'])}")
     if "topology" in row:
         parts.append(f"topology={row['topology']}")
+    if "drill" in row:
+        parts.append(f"drill={row['drill']}")
     return "|".join(parts)
 
 

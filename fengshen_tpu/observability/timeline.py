@@ -37,8 +37,12 @@ from __future__ import annotations
 import time
 from typing import List, Optional, Tuple
 
-#: terminal lifecycle event names (mirror serving.engine's states)
-TERMINAL_EVENTS = ("finished", "cancelled", "expired", "rejected")
+#: terminal lifecycle event names (mirror serving.engine's states);
+#: "evacuated" ends the SOURCE replica's timeline when a live lane is
+#: exported during drain (docs/fault_tolerance.md "Preemption
+#: runbook") — the adopting replica's timeline continues the request
+TERMINAL_EVENTS = ("finished", "cancelled", "expired", "rejected",
+                   "evacuated")
 
 #: the derived waterfall phases, in lifecycle order
 PHASE_NAMES = ("queue_wait_s", "prefill_s", "decode_s")

@@ -63,7 +63,7 @@ import dataclasses
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -155,12 +155,19 @@ class EngineConfig:
     # finished-request timelines the engine retains for
     # `GET /debug/requests` and the flight-recorder bundle
     debug_ring: int = 64
+    # commit journal (docs/fault_tolerance.md "Preemption runbook"):
+    # how many requests keep their committed-token journal entry for
+    # `GET /partial/<id>` — the resume-from-token-k source a fleet
+    # router consults before regenerating a maybe-executed retry
+    journal_ring: int = 256
 
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.debug_ring < 1:
             raise ValueError("debug_ring must be >= 1")
+        if self.journal_ring < 1:
+            raise ValueError("journal_ring must be >= 1")
         if self.kv_layout not in ("slot", "paged"):
             raise ValueError(f"unknown kv_layout {self.kv_layout!r}; "
                              "expected 'slot' or 'paged'")
@@ -231,6 +238,13 @@ class Request:
         self.ttft_s: Optional[float] = None
         self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
+        #: resume-from-token-k (docs/fault_tolerance.md): tokens a
+        #: previous execution already committed — prefilled as part of
+        #: the prompt, never re-decoded — plus where they came from
+        self.resume: list[int] = []
+        self.resume_source: Optional[str] = None
+        #: peer URL a live-evacuated lane moved to (handoff.py sets it)
+        self.evac_target: Optional[str] = None
         self._cancel = False
         self._done = threading.Event()
         #: host-side lifecycle events (docs/observability.md "Request
@@ -358,6 +372,12 @@ class ContinuousBatchingEngine:
         self._slot_req: list[Optional[Request]] = [None] * S
 
         self._queue: deque[Request] = deque()
+        # commit journal: request_id -> the live Request object, a
+        # bounded insertion-ordered ring beside the debug ring. Entries
+        # are references, so the committed-token list grows in place at
+        # zero per-tick cost; `partial()` snapshots it for
+        # `GET /partial/<id>` (docs/fault_tolerance.md)
+        self._journal: "OrderedDict[str, Request]" = OrderedDict()
         self._draining = False
         self._cv = threading.Condition()
         self._rng = jax.random.PRNGKey(config.seed)
@@ -543,6 +563,16 @@ class ContinuousBatchingEngine:
 
     # ---- submission side -------------------------------------------
 
+    def _journal_add_locked(self, req: Request) -> None:
+        """Enter `req` into the bounded commit journal (caller holds
+        self._cv). A duplicate request_id replaces the older entry —
+        the LATEST execution owns the id (a resumed retry must not
+        answer `GET /partial/<id>` with its predecessor's snapshot)."""
+        self._journal[req.request_id] = req
+        self._journal.move_to_end(req.request_id)
+        while len(self._journal) > self.config.journal_ring:
+            self._journal.popitem(last=False)
+
     def _record_rejection_locked(self, req: Request, reason: str,
                                  **attrs) -> None:
         """The ONE rejection record: mark the request, stamp the
@@ -575,7 +605,9 @@ class ContinuousBatchingEngine:
                request_id: Optional[str] = None,
                deadline_s: Optional[float] = None,
                trace_id: Optional[str] = None,
-               parent_span_id: Optional[str] = None) -> Request:
+               parent_span_id: Optional[str] = None,
+               resume_tokens: Optional[Sequence[int]] = None,
+               resume_source: Optional[str] = None) -> Request:
         """Queue a prompt. Raises QueueFull (backpressure) or
         PromptTooLong (no bucket / no cache headroom). `deadline_s` is
         seconds from now; an expired request frees its slot and
@@ -583,7 +615,19 @@ class ContinuousBatchingEngine:
         are the distributed-trace correlation ids carried in off the
         wire (docs/observability.md "Distributed tracing") — pure
         host-side bookkeeping stamped onto the request's timeline and
-        debug-ring entry, never an input to any traced program."""
+        debug-ring entry, never an input to any traced program.
+
+        `resume_tokens` is the resume-from-token-k path
+        (docs/fault_tolerance.md "Preemption runbook"): tokens a
+        previous execution of this request already committed (read from
+        a replica's `GET /partial/<id>` journal). Admission prefills
+        prompt + resume_tokens[:-1] in ONE bucketed prefill — greedy
+        left-padded prefill logits are position-for-position identical
+        to incremental decode, so the remainder of the generation is
+        token-identical to the undisturbed run — and only the remaining
+        max_new - k tokens are decoded. `max_new_tokens` keeps its
+        TOTAL-generation meaning (the resumed prefix counts toward it).
+        """
         if self._draining:
             # checked again under the lock below; this early exit just
             # spares rejected requests the bucket/blocks math
@@ -595,8 +639,30 @@ class ContinuousBatchingEngine:
             # layer maps this to 422, not 413
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        resume = [int(t) for t in resume_tokens] if resume_tokens \
+            else []
+        if resume and self.spec:
+            # the verify window's cursor math is defined from a plain
+            # admission; resuming into a spec lane is untested ground —
+            # refuse loudly (422) rather than silently diverge
+            raise ValueError(
+                "resume_tokens is not supported on a speculative "
+                "engine (spec_mode != 'off')")
+        requested_new = int(max_new_tokens if max_new_tokens is not None
+                            else self.config.max_new_tokens)
+        if resume and requested_new <= len(resume):
+            # the journal already holds the whole generation — the
+            # caller should have answered from it, not resubmitted
+            raise ValueError(
+                f"resume_tokens carries {len(resume)} tokens but "
+                f"max_new_tokens={requested_new} leaves nothing to "
+                "decode")
         ids = np.asarray(input_ids, np.int32).reshape(-1)
-        bucket = self.ladder.bucket_for(len(ids))
+        # a resumed request prefills prompt + resume[:-1] (the last
+        # committed token re-enters as the decode seed, exactly where
+        # an undisturbed lane would hold it)
+        prefill_len = len(ids) + max(len(resume) - 1, 0)
+        bucket = self.ladder.bucket_for(prefill_len)
         if bucket is None:
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
@@ -607,15 +673,18 @@ class ContinuousBatchingEngine:
             raise PromptTooLong(
                 f"prompt of {len(ids)} tokens exceeds the largest "
                 f"bucket {self.ladder.max_bucket}")
-        max_new = int(max_new_tokens if max_new_tokens is not None
-                      else self.config.max_new_tokens)
+        max_new = requested_new
         # the lane must hold bucket + generated tokens + the gamma-wide
         # speculative tail (seq_capacity is max_len for the slot
         # layout, blocks x block_size for paged); clamping without the
         # gamma term would let the verify window silently walk past
-        # the lane end — the off-by-gamma the boundary test pins
-        max_new = min(max_new, self.seq_capacity - bucket - self._gamma)
-        if max_new < 1:
+        # the lane end — the off-by-gamma the boundary test pins.
+        # A resumed request only DECODES max_new - (k-1) of its total:
+        # k-1 committed tokens ride inside the prefill bucket, so they
+        # restore that much headroom to the clamp
+        max_new = min(max_new, self.seq_capacity - bucket - self._gamma
+                      + max(len(resume) - 1, 0))
+        if max_new < (len(resume) + 1 if resume else 1):
             self.metrics.count("rejected_prompt_too_long")
             self._log({"event": "serving_reject", "reason":
                        "prompt_too_long", "prompt_tokens": len(ids)})
@@ -628,11 +697,15 @@ class ContinuousBatchingEngine:
                 f"KV lane capacity {self.seq_capacity}" +
                 (f" (speculative window needs gamma={self._gamma} "
                  "extra positions)" if self._gamma else ""))
+        # tokens the lane actually DECODES past the prefill bucket —
+        # what the paged footprint is charged for (a resumed request's
+        # committed prefix lives inside the bucket)
+        decode_span = max_new - len(resume) + 1 if resume else max_new
         if self.paged:
             # a footprint the whole pool cannot hold would sit at the
             # queue head forever (nothing can free enough blocks) —
             # reject NOW instead of livelocking the FIFO
-            need = blocks_for_tokens(bucket + max_new + self._gamma,
+            need = blocks_for_tokens(bucket + decode_span + self._gamma,
                                      self.block_size)
             if need > self._allocator.total_blocks:
                 self.metrics.count("rejected_prompt_too_long")
@@ -656,6 +729,13 @@ class ContinuousBatchingEngine:
                       now, epoch=self._wall())
         req.timeline.trace_id = trace_id
         req.timeline.parent_span_id = parent_span_id
+        if resume:
+            # seed the committed prefix NOW: the journal and the debug
+            # endpoints must show the true progress from the first
+            # moment, and the finish check counts TOTAL generation
+            req.resume = resume
+            req.resume_source = resume_source
+            req.tokens = list(resume)
         with span("serving/admit"), self._cv:
             if self._draining:
                 self.metrics.count("rejected_draining")
@@ -696,6 +776,14 @@ class ContinuousBatchingEngine:
             req.timeline.add(now, "enqueued",
                              prompt_tokens=int(len(ids)), bucket=bucket,
                              queue_depth=len(self._queue))
+            if resume:
+                # the initial resume mark (the `evacuated` event's
+                # cross-replica counterpart): where the committed
+                # prefix came from and how long it is
+                req.timeline.add(now, "resumed_from",
+                                 tokens=len(resume),
+                                 source=resume_source)
+            self._journal_add_locked(req)
             self.metrics.count("admitted")
             self._log({"event": "serving_admit",
                        "request_id": req.request_id, "bucket": bucket,
@@ -857,7 +945,19 @@ class ContinuousBatchingEngine:
             if req.deadline is not None and now > req.deadline:
                 self._finish(req, EXPIRED, "deadline")
                 continue
-            bucket = self.ladder.bucket_for(len(req.prompt))
+            # resume-from-token-k admission (docs/fault_tolerance.md):
+            # the committed prefix minus its last token joins the
+            # prompt in ONE bucketed prefill — identical left-pad
+            # cumsum positions make the combined prefill's KV
+            # position-for-position equal to the incremental decode
+            # that produced those tokens, which is what keeps the
+            # remainder greedy token-identical to the unkilled run
+            resume = req.resume
+            prefill_ids = req.prompt if not resume else np.concatenate(
+                [req.prompt, np.asarray(resume[:-1], np.int32)])
+            bucket = self.ladder.bucket_for(len(prefill_ids))
+            decode_span = req.max_new_tokens - len(resume) + 1 \
+                if resume else req.max_new_tokens
             blocks = None
             if self.paged:
                 # admission switches from "free slot" to "enough free
@@ -867,7 +967,7 @@ class ContinuousBatchingEngine:
                 # the queue fills, and submit's QueueFull (429) is the
                 # backpressure surface
                 need = blocks_for_tokens(
-                    bucket + req.max_new_tokens + self._gamma,
+                    bucket + decode_span + self._gamma,
                     self.block_size)
                 blocks = self._allocator.alloc(need)
                 if blocks is None:
@@ -889,7 +989,7 @@ class ContinuousBatchingEngine:
                     return
                 self._deferred_req = None
             row, mask_row = self.ladder.pad_prompt(
-                req.prompt, bucket, self.config.pad_token_id)
+                prefill_ids, bucket, self.config.pad_token_id)
             if self.config.do_sample:
                 self._rng, key = jax.random.split(self._rng)
             else:
@@ -907,14 +1007,22 @@ class ContinuousBatchingEngine:
             req.ttft_s = t_first - req.submit_time
             self.metrics.record_ttft(req.ttft_s)
             req.timeline.add(t_first, "first_token")
-            req.tokens.append(tok)
+            if resume:
+                # the prefill-selected token is DISCARDED: a resumed
+                # lane's next decode seed is the already-committed
+                # resume[-1] (seeded into req.tokens at submit), not a
+                # re-selection — exactly the cursor the unkilled lane
+                # would hold
+                tok = resume[-1]
+            else:
+                req.tokens.append(tok)
             if self.config.eos_token_id is not None and \
                     tok == self.config.eos_token_id:
                 if blocks is not None:
                     self._allocator.free(blocks)
                 self._finish(req, FINISHED, "eos")
                 continue
-            if req.max_new_tokens <= 1:
+            if len(req.tokens) >= req.max_new_tokens:
                 if blocks is not None:
                     self._allocator.free(blocks)
                 self._finish(req, FINISHED, "length")
@@ -946,7 +1054,10 @@ class ContinuousBatchingEngine:
             self._slot_req[slot] = req
             self._active[slot] = True
             self._last_tok[slot] = tok
-            self._pos[slot] = len(req.prompt)   # logical pos of last_tok
+            # logical pos of last_tok: len(prompt) for a fresh lane
+            # (tokens == [tok]); a resumed lane holds k committed
+            # tokens, the same invariant pos = P + len(tokens) - 1
+            self._pos[slot] = len(req.prompt) + len(req.tokens) - 1
             self._phys[slot] = bucket           # physical cursor
         return
 
@@ -1094,22 +1205,74 @@ class ContinuousBatchingEngine:
         return self._draining
 
     def begin_drain(self) -> None:
-        """Stop admitting (submit raises `Draining`); queued + running
-        requests finish normally. `/stats` flips `draining` to true so
-        a fleet router's poll routes around this replica even before
-        the API layer's healthz does."""
-        if self._draining:
-            return
-        self._draining = True
-        self._log({"event": "serving_drain",
-                   "queued": len(self._queue),
-                   "active": int(self._active.sum())})
+        """Stop admitting (submit raises `Draining`) and FLUSH the
+        queued-but-unstarted requests back to their callers as orderly
+        rejections (reason "draining" → 503 at the API layer, so a
+        fleet router re-places them NOW instead of letting them wait
+        out the drain timeout). Running lanes keep decoding — they are
+        the live-evacuation candidates (docs/fault_tolerance.md
+        "Preemption runbook"). `/stats` flips `draining` to true so a
+        fleet router's poll routes around this replica even before the
+        API layer's healthz does."""
+        with self._cv:
+            if self._draining:
+                return
+            self._draining = True
+            flushed = list(self._queue)
+            self._queue.clear()
+            for req in flushed:
+                # the terminal "rejected" event + ring entry; _done
+                # wakes the API thread blocked in request.wait() so the
+                # 503 goes out immediately (no engine counter: the
+                # rejected_draining count is pinned to SUBMIT refusals)
+                self._record_rejection_locked(req, "draining")
+                req._done.set()
+            self._log({"event": "serving_drain",
+                       "queued_flushed": len(flushed),
+                       "active": int(self._active.sum())})
+            self._cv.notify_all()
 
     def idle(self) -> bool:
         """True when nothing is queued or decoding (the drain handler's
         exit condition)."""
         with self._cv:
             return not self._queue and not bool(self._active.any())
+
+    def live_lane_ids(self) -> list:
+        """Request ids of every RUNNING lane — the drain handler's
+        evacuation worklist (disagg.coordinator.evacuate_all)."""
+        with self._cv:
+            return [r.request_id for r in self._slot_req
+                    if r is not None and r.state == RUNNING]
+
+    # ---- commit journal (docs/fault_tolerance.md) -------------------
+
+    def partial(self, request_id: str) -> Optional[dict]:
+        """`GET /partial/<id>`: the committed-token journal entry a
+        fleet router consults before regenerating a maybe-executed
+        retry from token 0. None when the id never ran here or aged
+        out of the journal ring. The token list is a SNAPSHOT under
+        the engine lock — a live lane keeps committing after it."""
+        with self._cv:
+            req = self._journal.get(request_id)
+            if req is None:
+                return None
+            out = {"request_id": req.request_id,
+                   "state": req.state,
+                   "finish_reason": req.finish_reason,
+                   "prompt_tokens": int(len(req.prompt)),
+                   "generated_tokens": len(req.tokens),
+                   "tokens": [int(t) for t in req.tokens],
+                   "max_new_tokens": int(req.max_new_tokens),
+                   "ttft_s": (None if req.ttft_s is None
+                              else round(req.ttft_s, 6)),
+                   "trace_id": req.timeline.trace_id}
+            if req.evac_target is not None:
+                out["evac_target"] = req.evac_target
+            if req.resume:
+                out["resumed_tokens"] = len(req.resume)
+                out["resume_source"] = req.resume_source
+            return out
 
     # ---- observability ----------------------------------------------
 

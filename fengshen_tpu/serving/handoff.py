@@ -55,6 +55,12 @@ WIRE_VERSION = 1
 #: terminal state of a lane that left this engine via `detach_lane`
 HANDED_OFF = "handed_off"
 
+#: terminal state of a lane that left via live evacuation during drain
+#: (docs/fault_tolerance.md "Preemption runbook") — same mechanics as
+#: handed_off, but the API layer answers the blocked POST with a
+#: disagg-style redirect so the router re-collects from the adopter
+EVACUATED = "evacuated"
+
 #: EngineConfig fields that must match exactly across a handoff: the
 #: receiver resumes mid-generation, so any divergence here would
 #: silently change the sampled distribution or the stop condition
@@ -334,6 +340,10 @@ def adopt_lane(engine, payload: dict) -> Request:
         engine._last_tok[slot] = int(payload["last_tok"])
         engine._pos[slot] = pos
         engine._phys[slot] = phys
+        # the adopter journals the lane too: after a hard kill of the
+        # source, this replica's `GET /partial/<id>` carries the
+        # committed prefix the router resumes from
+        engine._journal_add_locked(req)
         engine.metrics.count("admitted")
         engine._log({"event": "serving_adopt",
                      "request_id": req.request_id, "slot": slot,
@@ -409,14 +419,23 @@ def _scatter_payload(engine, payload: dict, slot: int, phys: int,
 
 
 def detach_lane(engine, request_id: str,
-                target: Optional[str] = None) -> bool:
+                target: Optional[str] = None,
+                evacuated: bool = False) -> bool:
     """Retire a lane whose payload a decode peer has ADOPTED: free the
     slot/blocks, mark the request `handed_off` (its `wait()` unblocks;
     the coordinator returns the redirect instead of local tokens) and
     park its timeline in the debug ring. Returns False — and changes
     nothing — when the request already finished locally (the race
     where decode outran the push; the source result stands and the
-    adopted twin gets cancelled)."""
+    adopted twin gets cancelled).
+
+    `evacuated=True` is the live-evacuation flavor (drain-time lane
+    rescue, docs/fault_tolerance.md "Preemption runbook"): the state is
+    `evacuated`, the timeline gets the terminal `evacuated` event (with
+    the adopter + committed-token count), and `req.evac_target` lets
+    the API layer answer the blocked POST with a redirect the router
+    re-collects transparently."""
+    state = EVACUATED if evacuated else HANDED_OFF
     with engine._cv:
         req = None
         for r in engine._slot_req:
@@ -433,15 +452,20 @@ def detach_lane(engine, request_id: str,
         if engine.paged and engine._slot_blocks[slot]:
             engine._allocator.free(engine._slot_blocks[slot])
             engine._slot_blocks[slot] = []
-        req.state = HANDED_OFF
-        req.finish_reason = "handed_off"
+        req.state = state
+        req.finish_reason = state
         req.slot = None
+        if evacuated:
+            req.evac_target = target
         end_t = engine._clock()
-        req.timeline.add(end_t, "handed_off",
-                         **({"target": target} if target else {}))
+        req.timeline.add(end_t, state,
+                         **dict(({"target": target} if target else {}),
+                                **({"tokens": len(req.tokens)}
+                                   if evacuated else {})))
         engine._recent.append(engine._request_dict(
             req, phases=req.timeline.phases(end_t)))
-        engine._log({"event": "serving_handoff",
+        engine._log({"event": "serving_evacuate" if evacuated
+                     else "serving_handoff",
                      "request_id": req.request_id,
                      "tokens": len(req.tokens), "target": target})
         req._done.set()

@@ -1,0 +1,105 @@
+"""known-clean fixture: the preemption-tolerance idiom (ISSUE 16,
+docs/fault_tolerance.md "Preemption runbook") — drain-time lane
+evacuation and resume-from-token-k are EAGER host-orchestrated work
+between jit boundaries. The commit journal appends on the scheduler
+thread under a plain `threading.Lock` (never inside traced code), the
+evacuation export gathers the committed prefix eagerly (zero new
+jitted programs: the engine's pinned compile counts must survive a
+drain), the push to the adopting peer is a blocking HTTP call on the
+drain thread, and the resume prefill is host-side token concatenation
+feeding the SAME bucketed prefill program a fresh admission uses. The
+tempting regressions this fixture guards: journaling or bumping the
+`fstpu_evac_*`/`fstpu_resume_*` counters inside a traced tick
+(metrics-in-traced-code), pushing an evacuated lane from traced code
+(blocking-transfer), jitting the resume-prefix concat (a new program
+per cut point — compile-count drift), or branching traced code on the
+device-side cursor of the evacuating lane (host-divergence).
+
+Mirrors `fengshen_tpu/serving/engine.py`'s journal + resume admission
+and `fengshen_tpu/disagg/coordinator.py`'s `evacuate_all`: if a rule
+fires here, it would also flag the real modules and block the merge
+gate.
+"""
+
+import base64
+import hashlib
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+EVAC_LANES = REG.counter("fx_evac_lanes_total",
+                         "drain-time lane evacuations by outcome",
+                         labelnames=("outcome",))
+RESUME_TOKENS = REG.counter("fx_resume_tokens_total",
+                            "committed tokens reused by resume "
+                            "prefills instead of re-decoded")
+
+_JOURNAL_LOCK = threading.Lock()
+_JOURNAL = {}
+_JOURNAL_RING = 4
+
+
+@jax.jit
+def decode_tick(cache, tokens, phys):
+    """The per-tick decode body: pure scatters — the journal, the
+    evacuation push, and every counter stay OUT of here."""
+    n = tokens.shape[0]
+    cache = cache.at[jnp.arange(n), phys].set(tokens)
+    return cache, (tokens + 1).astype(jnp.int32)
+
+
+def journal_commit(request_id, token):
+    """Host-side commit-journal append on the scheduler thread, under
+    a plain lock, bounded like the engine's ring — a SIGKILL later
+    serves `resume_tokens` from exactly this."""
+    with _JOURNAL_LOCK:
+        _JOURNAL.setdefault(request_id, []).append(int(token))
+        while len(_JOURNAL) > _JOURNAL_RING:
+            _JOURNAL.pop(next(iter(_JOURNAL)))
+
+
+def export_evacuating_lane(cache, slot, cursor):
+    """EAGER gather of the committed prefix at drain time: host-side
+    jnp outside any jit (the drain adds zero compiled programs), then
+    checksummed base64 framing — plain bytes work on the drain
+    thread. `cursor` is a HOST int (the engine's per-lane host
+    cursor), never a device value traced code branched on."""
+    lane = np.asarray(jax.lax.slice_in_dim(
+        jnp.take(cache, slot, axis=0), 0, cursor, axis=0))
+    body = {"shape": list(lane.shape), "dtype": str(lane.dtype),
+            "data": base64.b64encode(lane.tobytes()).decode("ascii")}
+    raw = json.dumps(body, sort_keys=True).encode()
+    body["checksum"] = hashlib.sha256(raw).hexdigest()
+    return body
+
+
+def evacuate_with_fallback(payload, push, finish_locally):
+    """The drain loop's per-lane ladder: the blocking push and the
+    outcome counter live on the drain thread, strictly between jit
+    boundaries — a refused adoption is a counted local finish, never
+    a client error."""
+    try:
+        push(payload)
+        EVAC_LANES.labels("adopted").inc()
+        return "adopted"
+    except OSError:
+        EVAC_LANES.labels("fallback").inc()
+        finish_locally()
+        return "fallback"
+
+
+def resume_prefill_ids(prompt, resume_tokens):
+    """Host-side resume admission: prompt + committed-prefix concat in
+    numpy, feeding the SAME bucketed prefill program a fresh admission
+    uses (all but the last resumed token; the first tick re-commits
+    it) — recovering a request compiles nothing new."""
+    ids = np.concatenate([np.asarray(prompt, np.int32),
+                          np.asarray(resume_tokens[:-1], np.int32)])
+    RESUME_TOKENS.inc(len(resume_tokens))
+    return ids

@@ -529,6 +529,37 @@ def test_disagg_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_evac_internals_are_clean():
+    """Regression fixture for the preemption-tolerance tier (ISSUE 16,
+    docs/fault_tolerance.md "Preemption runbook"): the commit journal
+    appends on the scheduler thread under a plain lock, the drain-time
+    lane export is an EAGER host-side gather (a drain adds zero
+    compiled programs), the evacuation push is blocking HTTP on the
+    drain thread, and the resume prefill is host-side token concat
+    riding the SAME bucketed prefill program — neither
+    `host-divergence`, `blocking-transfer` nor
+    `metrics-in-traced-code` may fire on the fixture or on the real
+    evacuation/resume modules (the disagg package that owns
+    `evacuate_all`, `serving/handoff.py`'s detach-as-evacuated, and
+    the engine that owns the journal + resume admission). A hit means
+    a journal append, an evacuation push, or a resume concat leaked
+    into a traced program (a real hazard: per-token journal work must
+    cost dict-append, and a recovery must never retrace) or a rule
+    lost precision."""
+    fixture = os.path.join(FIXTURES, "evac_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "disagg"),
+             os.path.join(PKG, "serving", "handoff.py"),
+             os.path.join(PKG, "serving", "engine.py")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 def test_trace_context_internals_are_clean():
     """Regression fixture for the distributed-tracing tier (ISSUE 11,
     docs/observability.md "Distributed tracing"): trace/span ids come

@@ -1,0 +1,119 @@
+"""`make serve-bench-evac` harness guard (ISSUE 16): the preemption
+drills must emit their one BENCH-schema JSON line — with the drill in
+the row, part of benchdiff's comparison identity — the SIGTERM rung
+must finish every request 200 and token-identical through live lane
+evacuation (at least one lane adopted by the standby peer), and the
+SIGKILL rung must recover every request through resume-from-token-k
+out of the commit journal: `resumed >= 1`, zero journal misses (no
+request regenerated from token 0), recovered-request overhead strictly
+below regenerate-from-zero.
+
+The fast lane runs the harness in FAKE mode: in-process stdlib
+replicas speaking the full evacuation surface (generate + draining
+/stats + PUT/GET /kv + GET /partial) with a position-deterministic
+token function, driven through the REAL router's redirect / collect /
+journal-consult / resume machinery — the whole three-rung ladder runs
+in seconds without a model. The real-subprocess mode (actual engine
+drains, KV evacuations, and resume prefills under real signals) is
+the slow lane.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+import pytest
+
+FAKE = {"EVAC_BENCH_FAKE": "1", "EVAC_BENCH_REQUESTS": "24",
+        "EVAC_BENCH_FAKE_TOKEN_S": "0.02"}
+
+
+def _run(monkeypatch, env: dict, base: dict = FAKE) -> dict:
+    from fengshen_tpu.fleet import evac_bench
+
+    for key in list(os.environ):
+        if key.startswith(("EVAC_BENCH_", "FLEET_BENCH_",
+                           "BENCH_DEGRADED")):
+            monkeypatch.delenv(key)
+    for key, val in {**base, **env}.items():
+        monkeypatch.setenv(key, val)
+    out = io.StringIO()
+    with redirect_stdout(out):
+        evac_bench.main([])
+    lines = [l for l in out.getvalue().splitlines()
+             if l.startswith("{")]
+    assert lines, out.getvalue()
+    return json.loads(lines[-1])
+
+
+def test_evac_bench_fake_schema_and_drills(monkeypatch):
+    row = _run(monkeypatch, {})
+    assert set(row) >= {"metric", "value", "unit", "vs_baseline",
+                        "drill", "replicas", "requests", "sigterm",
+                        "sigkill", "resumed", "zero_regenerated",
+                        "fake"}
+    assert row["metric"] == "evac_tokens_per_sec"
+    assert row["unit"] == "tokens/s"
+    assert row["value"] > 0 and row["tokens_per_sec_baseline"] > 0
+    # the comparison identity benchdiff keys on: a preemption drill is
+    # never diffed against an undisturbed fleet round
+    assert row["drill"] == "preempt"
+    assert row["replicas"] == 3
+    assert row["fake"] is True and row["backend"] == "fake"
+    # the SIGTERM bar: a drain with live decodes answers EVERY request
+    # 200 token-identical — at least one lane rode an evacuation to
+    # the standby peer, and nothing fell back to regenerating from
+    # token 0 (a transient reset MAY legitimately ride the resume
+    # path, so only the miss outcome is pinned to zero)
+    assert row["failed"] == 0
+    assert row["token_identical_sigterm"] is True
+    assert row["sigterm"]["adopted"] >= 1
+    assert row["sigterm"]["resume"].get("miss", 0) == 0
+    # the SIGKILL bar: the adopter dies mid-decode and every affected
+    # request comes back through resume-from-token-k — token-identical,
+    # at least one resume, ZERO regenerated from token 0
+    assert row["token_identical_sigkill"] is True
+    assert row["resumed"] >= 1
+    assert row["zero_regenerated"] is True
+    assert row["sigkill"]["resume"].get("miss", 0) == 0
+    # a recovered request re-decodes strictly less than all of its
+    # tokens: the journal prefix is real saved work
+    assert row["recovered_overhead_vs_regenerate"] is not None
+    assert 0.0 < row["recovered_overhead_vs_regenerate"] < 1.0
+    assert "degraded" not in row
+
+
+def test_evac_bench_fleet_env_fallback(monkeypatch):
+    """EVAC_BENCH_* knobs fall back to FLEET_BENCH_* so one CI env
+    block can steer the whole fleet-bench family."""
+    row = _run(monkeypatch,
+               {"FLEET_BENCH_FAKE": "1",
+                "FLEET_BENCH_REQUESTS": "12",
+                "FLEET_BENCH_FAKE_TOKEN_S": "0.02"}, base={})
+    assert row["fake"] is True
+    assert row["requests"] == 12
+    assert row["failed"] == 0
+
+
+def test_evac_bench_degraded_flag(monkeypatch):
+    row = _run(monkeypatch, {"BENCH_DEGRADED": "1",
+                             "EVAC_BENCH_REQUESTS": "12"})
+    assert row["degraded"] is True
+
+
+@pytest.mark.slow
+def test_evac_bench_real_signals_zero_failed(monkeypatch):
+    """The real path: replica subprocesses (random-init llama,
+    continuous engines, drain handlers wired with evacuation peers)
+    under a real SIGTERM and a real SIGKILL — every request completes,
+    token-identical to the undisturbed baseline, nothing regenerated
+    from token 0. ~minutes on CPU."""
+    row = _run(monkeypatch,
+               {"EVAC_BENCH_BASE_PORT": "8470",
+                "EVAC_BENCH_REQUESTS": "12"}, base={})
+    assert row["fake"] is False
+    assert row["failed"] == 0
+    assert row["token_identical_sigterm"] is True, row
+    assert row["token_identical_sigkill"] is True, row
+    assert row["zero_regenerated"] is True, row

@@ -540,9 +540,13 @@ def test_wedged_replica_retry_is_idempotent_safe(tiny):
 
 def test_replica_sigterm_drains_while_inflight_completes(tiny):
     """Satellite (extends the PR-8 SIGTERM chain): SIGTERM to a stdlib
-    api replica flips /healthz to the draining-503 body while an
-    in-flight request still completes; new requests get 503; the
-    server then shuts itself down once idle."""
+    api replica flips /healthz to the draining-503 body; new requests
+    get 503; a request that is queued-but-not-slotted when the drain
+    lands is flushed back as the SAME orderly 503 immediately (the
+    router re-places it on a healthy replica — docs/fleet.md "Drain
+    runbook" step 2; RUNNING lanes completing or evacuating is pinned
+    in tests/test_evac.py); the server then shuts itself down once
+    idle."""
     from fengshen_tpu.api.main import install_drain_handler
     # serve loop NOT started yet: the posted request stays queued on
     # the replica — deterministically in flight when SIGTERM lands —
@@ -560,9 +564,13 @@ def test_replica_sigterm_drains_while_inflight_completes(tiny):
             base + "/api/text_generation",
             data=json.dumps({"input_text": "5 7 9"}).encode(),
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            result["code"] = r.status
-            result["body"] = json.loads(r.read())
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                result["code"] = r.status
+                result["body"] = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            result["code"] = e.code
+            result["body"] = json.loads(e.read())
 
     def _get(path):
         try:
@@ -599,22 +607,21 @@ def test_replica_sigterm_drains_while_inflight_completes(tiny):
             urllib.request.urlopen(req, timeout=30)
         assert exc.value.code == 503
         assert json.loads(exc.value.read())["reason"] == "draining"
-        # /stats exposes the drain for the router's poll
+        # /stats exposes the drain for the router's poll, and the
+        # queued-but-not-slotted request was FLUSHED, not kept waiting
         code, stats = _get("/stats")
         assert code == 200 and stats["draining"] is True
-        assert stats["queue_depth"] >= 1        # still in flight
-        # the in-flight request still completes, correct and 200,
-        # once the serve loop runs (drain never cancels queued work)
-        engine.start()
+        assert stats["queue_depth"] == 0
+        # ... flushed as the same orderly 503 the admission edge
+        # answers — the router treats it as draining (no breaker
+        # charge) and re-places it on a healthy replica
         w.join(timeout=60)
         assert not w.is_alive()
-        model, params = tiny
-        assert result["code"] == 200
-        assert result["body"]["result"] == " ".join(
-            str(t) for t in _ref(model, params,
-                                 np.asarray([5, 7, 9], np.int32), 50))
+        assert result["code"] == 503
+        assert result["body"]["reason"] == "draining"
         # and the drained server shuts itself down (serve_forever
-        # returns in the serving thread)
+        # returns in the serving thread) once the engine runs idle
+        engine.start()
         thread.join(timeout=30)
         assert not thread.is_alive()
     finally:
