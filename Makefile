@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: lint lint-changed lint-baseline test test-fast serve-bench \
+.PHONY: lint lint-changed lint-ci lint-baseline test test-fast \
+	serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
 	serve-bench-disagg serve-bench-evac serve-fleet aot-bench \
 	benchdiff
@@ -18,6 +19,15 @@ lint:
 # the concurrency rules still index the whole package for context
 lint-changed:
 	$(PY) -m fengshen_tpu.analysis --changed
+
+# CI surface: a SARIF 2.1.0 log for code-scanning upload (hashseed
+# pinned so the artifact is byte-stable run to run) plus ::error
+# workflow annotations inline in the job log; fails on any
+# non-baselined finding, like `lint`
+lint-ci:
+	PYTHONHASHSEED=0 $(PY) -m fengshen_tpu.analysis \
+		--format=sarif --stats > fslint.sarif
+	$(PY) -m fengshen_tpu.analysis --format=github
 
 # offline serving-throughput microbench (docs/serving.md): continuous
 # batching vs sequential per-request decode, one JSON line on CPU so

@@ -10,6 +10,12 @@ unreadable baseline).
 sorted by (path, line, col, rule) — byte-stable across hosts, so CI
 can diff runs directly. ``--format=github`` emits one
 ``::error file=...`` workflow annotation per finding.
+``--format=sarif`` emits a minimal SARIF 2.1.0 log (same ordering
+guarantee) for code-scanning upload. ``--stats`` reports run
+statistics — files indexed, rules run, index-cache hits/misses, wall
+time — inside the JSON report (``"stats"`` key) or on stderr for the
+other formats; wall time is the only non-deterministic field, so
+determinism tests compare reports without ``--stats``.
 
 ``--changed`` lints only files touched in the working tree (``git
 diff --name-only HEAD`` plus untracked files), but the project rules
@@ -24,10 +30,12 @@ import json
 import os
 import subprocess
 import sys
+import time
 from typing import List, Optional
 
 from fengshen_tpu.analysis import baseline as baseline_mod
 from fengshen_tpu.analysis import engine
+from fengshen_tpu.analysis import project as project_mod
 from fengshen_tpu.analysis.registry import all_rule_ids, make_rules
 
 
@@ -54,9 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="alias for --format=json")
     parser.add_argument(
-        "--format", choices=("text", "json", "github"), default=None,
+        "--format", choices=("text", "json", "github", "sarif"),
+        default=None,
         help="output format (default: text; 'github' emits workflow "
-             "::error annotations)")
+             "::error annotations; 'sarif' a SARIF 2.1.0 log)")
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="report run statistics (files, rules, index-cache "
+             "hits/misses, wall time) in the JSON report or on stderr")
     parser.add_argument(
         "--changed", action="store_true",
         help="lint only files changed vs HEAD (plus untracked files); "
@@ -111,7 +124,38 @@ def _changed_py_files(root: str) -> List[str]:
     return out
 
 
+def _sarif_report(findings, rules) -> dict:
+    """Minimal SARIF 2.1.0 log. Rules sorted by id, results in the
+    engine's (path, line, col, rule) order — byte-stable for the same
+    inputs, like the JSON report."""
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fslint",
+                "informationUri":
+                    "https://github.com/IDEA-CCNL/Fengshenbang-LM",
+                "rules": [
+                    {"id": r.id,
+                     "shortDescription": {"text": r.hint}}
+                    for r in sorted(rules, key=lambda r: r.id)],
+            }},
+            "results": [
+                {"ruleId": f.rule,
+                 "level": "error",
+                 "message": {"text": f"{f.message} (fix: {f.hint})"},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {"uri": f.path},
+                     "region": {"startLine": f.line,
+                                "startColumn": f.col + 1}}}]}
+                for f in findings],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    t0 = time.monotonic()
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for rid in all_rule_ids():
@@ -146,12 +190,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(json.dumps({"findings": [], "baselined": 0,
                                   "stale_baseline": []},
                                  indent=2, sort_keys=True))
+            elif fmt == "sarif":
+                print(json.dumps(_sarif_report([], rules),
+                                 indent=2, sort_keys=True))
             return 0
         paths = changed
         if any(r.PROJECT for r in rules):
             # cross-module rules always see the full package; only the
             # reporting surface narrows to the changed files
-            from fengshen_tpu.analysis import project as project_mod
             index = project_mod.build_index(
                 list(engine.iter_py_files(
                     [os.path.join(root, "fengshen_tpu")])),
@@ -206,6 +252,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings, baselined, stale = baseline_mod.split_by_baseline(
             findings, entries)
 
+    stats = {
+        "files": project_mod.LAST_BUILD_STATS["files"],
+        "rules": len(rules),
+        "index_cache_hits": project_mod.LAST_BUILD_STATS["cache_hits"],
+        "index_cache_misses":
+            project_mod.LAST_BUILD_STATS["cache_misses"],
+        "memo_hit": project_mod.LAST_BUILD_STATS["memo_hit"],
+        "wall_time_s": round(time.monotonic() - t0, 3),
+    }
     if fmt == "json":
         report = {
             "findings": [f.to_dict() for f in findings],
@@ -214,7 +269,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 {"path": e["path"], "rule": e["rule"], "code": e["code"]}
                 for e in stale],
         }
+        if args.stats:
+            report["stats"] = stats
         print(json.dumps(report, indent=2, sort_keys=True))
+    elif fmt == "sarif":
+        print(json.dumps(_sarif_report(findings, rules),
+                         indent=2, sort_keys=True))
     elif fmt == "github":
         for f in findings:
             # workflow-command annotation; messages are single-line by
@@ -235,4 +295,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "remove it (or --write-baseline)", file=sys.stderr)
         if not findings:
             print("fslint: clean")
+    if args.stats and fmt != "json":
+        print("fslint stats: " + json.dumps(stats, sort_keys=True),
+              file=sys.stderr)
     return 1 if findings else 0

@@ -320,6 +320,7 @@ def run_project_rules(rules: List[Rule],
             else ""
 
     for rule in rules:
+        rule.project_root = project_root
         for relpath, line, col, message in rule.check_project(index):
             if restrict is not None and relpath not in restrict:
                 continue

@@ -42,7 +42,16 @@ import re
 import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
-INDEX_CACHE_VERSION = 3
+from fengshen_tpu.analysis import dataflow
+
+INDEX_CACHE_VERSION = 4
+
+#: filled by every build_index() call — files seen, cache hit/miss
+#: split, and whether the in-process memo short-circuited the build.
+#: The CLI surfaces this via ``--stats`` (perf budget for the
+#: analyzer itself: the warm path must stay cheap as rules grow).
+LAST_BUILD_STATS: Dict[str, int] = {
+    "files": 0, "cache_hits": 0, "cache_misses": 0, "memo_hit": 0}
 
 #: constructor qualnames that make an attribute/variable a *guard*
 LOCK_FACTORIES = {
@@ -248,6 +257,18 @@ class FileSummary:
     module_thread_targets: List[str]        # fns run on module threads
     suppressions: Dict[int, frozenset]
     parse_error: Optional[str] = None
+    # dataflow-tier facts (analysis/dataflow.py), computed at
+    # summarise time so warm-cache runs never re-parse:
+    # (var, callee, bind_line, call_line, read_line, read_col)
+    donation_findings: List[Tuple] = dataclasses.field(
+        default_factory=list)
+    # (kind, protocol, var, line, col, other_line, detail)
+    lifecycle_findings: List[Tuple] = dataclasses.field(
+        default_factory=list)
+    # (surface, METHOD, raw_path, line, col)
+    routes: List[Tuple] = dataclasses.field(default_factory=list)
+    # (name, kind, labelnames, line, col)
+    metrics: List[Tuple] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -266,6 +287,13 @@ class FileSummary:
             "suppressions": {str(k): sorted(v) for k, v in
                              sorted(self.suppressions.items())},
             "parse_error": self.parse_error,
+            "donation_findings": [list(t) for t in
+                                  self.donation_findings],
+            "lifecycle_findings": [list(t) for t in
+                                   self.lifecycle_findings],
+            "routes": [list(t) for t in self.routes],
+            "metrics": [[t[0], t[1], list(t[2]), t[3], t[4]]
+                        for t in self.metrics],
         }
 
     @classmethod
@@ -283,7 +311,14 @@ class FileSummary:
             module_thread_targets=list(d["module_thread_targets"]),
             suppressions={int(k): frozenset(v) for k, v in
                           d["suppressions"].items()},
-            parse_error=d["parse_error"])
+            parse_error=d["parse_error"],
+            donation_findings=[tuple(t) for t in
+                               d["donation_findings"]],
+            lifecycle_findings=[tuple(t) for t in
+                                d["lifecycle_findings"]],
+            routes=[tuple(t) for t in d["routes"]],
+            metrics=[(t[0], t[1], tuple(t[2]), t[3], t[4])
+                     for t in d["metrics"]])
 
 
 # -- per-file summarisation -------------------------------------------
@@ -781,7 +816,11 @@ def summarize_file(path: str, relpath: str) -> FileSummary:
         module_jit_vars=sorted(set(s.module_jit_vars)),
         module_var_types=s.module_var_types,
         module_thread_targets=sorted(set(s.module_thread_targets)),
-        suppressions=s.suppressions)
+        suppressions=s.suppressions,
+        donation_findings=dataflow.analyze_donation_use(tree),
+        lifecycle_findings=dataflow.analyze_lifecycle(tree),
+        routes=dataflow.extract_routes(tree),
+        metrics=dataflow.extract_metrics(tree))
 
 
 # -- the index --------------------------------------------------------
@@ -1112,8 +1151,11 @@ def build_index(paths: Iterable[str], project_root: str,
     files = sorted(set(iter_py_files(paths)))
     sig = tuple((p, os.path.getmtime(p), os.path.getsize(p))
                 for p in files) + (project_root,)
+    LAST_BUILD_STATS.update(files=len(files), cache_hits=0,
+                            cache_misses=0, memo_hit=0)
     memo = _MEMO.get(sig)
     if memo is not None and cache_path is None:
+        LAST_BUILD_STATS["memo_hit"] = 1
         return memo
 
     cache: Dict[str, dict] = {}
@@ -1137,12 +1179,14 @@ def build_index(paths: Iterable[str], project_root: str,
                 summaries[rel] = FileSummary.from_dict(
                     entry["summary"])
                 out_cache[rel] = entry
+                LAST_BUILD_STATS["cache_hits"] += 1
                 continue
             except (KeyError, TypeError, ValueError):
                 pass  # corrupt entry: fall through to re-summarise
         summary = summarize_file(path, rel)
         summaries[rel] = summary
         out_cache[rel] = {"sha": sha, "summary": summary.to_dict()}
+        LAST_BUILD_STATS["cache_misses"] += 1
 
     if cache_path:
         tmp = cache_path + ".tmp"

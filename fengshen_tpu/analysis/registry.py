@@ -58,9 +58,14 @@ class ProjectRule(Rule):
     cross-module call graph and its closures; see
     ``analysis/project.py``) — and yields ``(relpath, line, col,
     message)`` tuples. The engine turns those into ``Finding``s,
-    honouring per-line suppressions exactly like per-file rules."""
+    honouring per-line suppressions exactly like per-file rules.
+
+    The engine sets ``project_root`` before ``check_project`` so rules
+    that diff the index against on-disk artifacts (the metric-contract
+    docs tables) can find them; rules must tolerate it being ""."""
 
     PROJECT = True
+    project_root: str = ""
 
     def check_project(self, index) -> Iterator[
             Tuple[str, int, int, str]]:

@@ -1,0 +1,60 @@
+"""api-surface-parity: the twin API implementations must expose the
+same routes.
+
+``api/main.py`` carries two complete HTTP surfaces — the fastapi app
+(``@app.get("/healthz")`` decorators) and the dependency-free stdlib
+``BaseHTTPRequestHandler`` (``do_GET`` comparing ``self.path``). Every
+endpoint must exist on BOTH, a "BOTH paths" invariant that used to be
+enforced by N hand-pinned tests. This rule checks it at lint time:
+the dataflow tier extracts each file's route set per surface
+(decorator paths; ``self.path`` equality and ``.startswith`` prefix
+dispatch), normalises path parameters and f-string prefixes to ``*``,
+and diffs the ``(METHOD, path)`` sets whenever one file carries both
+surfaces. A file with a single surface (``fleet/server.py``'s
+stdlib-only router front) has nothing to diff and is skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Set, Tuple
+
+from fengshen_tpu.analysis.dataflow import normalize_route
+from fengshen_tpu.analysis.registry import ProjectRule, register
+
+
+@register
+class ApiSurfaceParity(ProjectRule):
+    id = "api-surface-parity"
+    hint = ("register the route on both the fastapi app and the "
+            "stdlib dispatcher (or remove it from both) — the twin "
+            "surfaces must stay interchangeable")
+
+    def check_project(self, index) -> Iterator[
+            Tuple[str, int, int, str]]:
+        for rel in sorted(index.files):
+            fsum = index.files[rel]
+            surfaces: Dict[str, Dict[Tuple[str, str],
+                                     Tuple[int, int]]] = {
+                "fastapi": {}, "stdlib": {}}
+            for surface, method, raw, line, col in fsum.routes:
+                key = (method, normalize_route(raw))
+                surfaces[surface].setdefault(key, (line, col))
+            fa, sl = surfaces["fastapi"], surfaces["stdlib"]
+            if not fa or not sl:
+                continue  # single-surface file: nothing to diff
+            for key in sorted(set(fa) - set(sl)):
+                line, col = fa[key]
+                yield (rel, line, col,
+                       f"route {key[0]} {key[1]} is registered on "
+                       f"the fastapi surface but has no stdlib "
+                       f"dispatcher match — witness: fastapi "
+                       f"{len(fa)} routes vs stdlib {len(sl)} in "
+                       f"{rel}")
+            for key in sorted(set(sl) - set(fa)):
+                line, col = sl[key]
+                yield (rel, line, col,
+                       f"route {key[0]} {key[1]} is dispatched on "
+                       f"the stdlib surface but has no fastapi "
+                       f"decorator match — witness: stdlib "
+                       f"{len(sl)} routes vs fastapi {len(fa)} in "
+                       f"{rel}")

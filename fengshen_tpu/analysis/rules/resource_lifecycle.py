@@ -1,0 +1,62 @@
+"""resource-lifecycle: typestate over the package's declared
+acquire/release protocols.
+
+The paged-KV ``BlockAllocator`` (``alloc``/``free``), the serving slot
+pool (``assign_slot``/``assign_paged`` vs ``rollback_slots``/
+``reset_free_slots``), lane handoff (``export_lane`` vs
+``detach_lane`` after the peer ACKs), engine drain
+(``begin_drain``/``idle``) and bare file handles (``open``/``close``)
+all pair an acquire with a hand-written release. The dataflow tier
+(``analysis/dataflow.py``, ``PROTOCOLS``) walks every function with a
+small typestate engine and flags:
+
+- **leak-on-exception-path**: a raising call runs while the resource
+  is held and no ``finally`` (or broad ``except`` that releases)
+  covers it — the release is skipped when that call raises. The
+  witness names the acquire site and the first unprotected call.
+- **double-release**: the same resource released twice along a single
+  path.
+
+Conservatism runs toward silence: ``with``-managed acquires, escaped
+resources (stored on ``self``, returned, aliased), and the
+allocator's ``if blocks is None`` exhaustion/null-block branch are
+never flagged. Findings are computed at index time and cached in each
+``FileSummary``; this rule re-emits them with witness chains like
+``blocking-under-lock``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from fengshen_tpu.analysis.registry import ProjectRule, register
+
+
+@register
+class ResourceLifecycle(ProjectRule):
+    id = "resource-lifecycle"
+    hint = ("release in a `finally` (or a broad `except` that "
+            "releases and re-raises) so an exception between acquire "
+            "and release cannot leak the resource; for deliberate "
+            "ownership transfer, suppress with a rationale")
+
+    def check_project(self, index) -> Iterator[
+            Tuple[str, int, int, str]]:
+        for rel in sorted(index.files):
+            fsum = index.files[rel]
+            for (kind, protocol, var, line, col, other_line,
+                 detail) in fsum.lifecycle_findings:
+                if kind == "leak":
+                    yield (rel, line, col,
+                           f"`{var}` ({protocol} acquire at "
+                           f"{rel}:{line}) has no release on the "
+                           f"path where `{detail}(...)` at "
+                           f":{other_line} raises — witness: "
+                           f"acquire :{line} -> raising call "
+                           f":{other_line} -> release skipped")
+                else:
+                    yield (rel, line, col,
+                           f"`{var}` ({protocol}) is released twice "
+                           f"on one path — witness: first release "
+                           f"at {rel}:{other_line} -> released "
+                           f"again at :{line}")

@@ -24,20 +24,22 @@ def shard_corpus(input_path: str, output_dir: str,
     shards: list[str] = []
     out = None
     written = 0
-    with open(input_path) as f:
-        for line in f:
-            if out is None or written >= limit:
-                if out is not None:
-                    out.close()
-                path = os.path.join(output_dir,
-                                    f"shard_{len(shards):05d}.jsonl")
-                shards.append(path)
-                out = open(path, "w")
-                written = 0
-            out.write(line)
-            written += len(line.encode())
-    if out is not None:
-        out.close()
+    try:
+        with open(input_path) as f:
+            for line in f:
+                if out is None or written >= limit:
+                    if out is not None:
+                        out.close()
+                    path = os.path.join(output_dir,
+                                        f"shard_{len(shards):05d}.jsonl")
+                    shards.append(path)
+                    out = open(path, "w")
+                    written = 0
+                out.write(line)
+                written += len(line.encode())
+    finally:
+        if out is not None:
+            out.close()
     return shards
 
 
@@ -155,21 +157,23 @@ def auto_split(data_dir: str, threshold_mb: int = 1024,
                     itertools.product(string.ascii_lowercase, repeat=2))
         limit = chunk_mb * 1024 * 1024
         out, written = None, 0
-        with open(path, encoding="utf-8") as fin:
-            for line in fin:
-                size = len(line.encode())
-                if out is None or written + size > limit:
-                    if out is not None:
-                        out.close()
-                    chunk = os.path.join(
-                        data_dir, f"{stem}-{next(suffixes)}{suffix}")
-                    new_paths.append(chunk)
-                    out = open(chunk, "w", encoding="utf-8")
-                    written = 0
-                out.write(line)
-                written += size
-        if out is not None:
-            out.close()
+        try:
+            with open(path, encoding="utf-8") as fin:
+                for line in fin:
+                    size = len(line.encode())
+                    if out is None or written + size > limit:
+                        if out is not None:
+                            out.close()
+                        chunk = os.path.join(
+                            data_dir, f"{stem}-{next(suffixes)}{suffix}")
+                        new_paths.append(chunk)
+                        out = open(chunk, "w", encoding="utf-8")
+                        written = 0
+                    out.write(line)
+                    written += size
+        finally:
+            if out is not None:
+                out.close()
         os.remove(path)
     return new_paths
 

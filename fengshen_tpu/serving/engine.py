@@ -988,56 +988,67 @@ class ContinuousBatchingEngine:
                                        self._allocator.free_blocks})
                     return
                 self._deferred_req = None
-            row, mask_row = self.ladder.pad_prompt(
-                prefill_ids, bucket, self.config.pad_token_id)
-            if self.config.do_sample:
-                self._rng, key = jax.random.split(self._rng)
-            else:
-                key = self._zero_key
-            req.timeline.add(self._clock(), "admitted", slot=slot,
-                             bucket=int(bucket))
-            req.timeline.add(self._clock(), "prefill_start",
-                             bucket=int(bucket))
-            with span("serving/prefill"):
-                primed, tok = self._prefill_jit(
-                    self.params, row[None], mask_row[None], key)
-                tok = int(np.asarray(tok)[0])
-            self.metrics.record_prefill(bucket)
-            t_first = self._clock()
-            req.ttft_s = t_first - req.submit_time
-            self.metrics.record_ttft(req.ttft_s)
-            req.timeline.add(t_first, "first_token")
-            if resume:
-                # the prefill-selected token is DISCARDED: a resumed
-                # lane's next decode seed is the already-committed
-                # resume[-1] (seeded into req.tokens at submit), not a
-                # re-selection — exactly the cursor the unkilled lane
-                # would hold
-                tok = resume[-1]
-            else:
-                req.tokens.append(tok)
-            if self.config.eos_token_id is not None and \
-                    tok == self.config.eos_token_id:
+            try:
+                row, mask_row = self.ladder.pad_prompt(
+                    prefill_ids, bucket, self.config.pad_token_id)
+                if self.config.do_sample:
+                    self._rng, key = jax.random.split(self._rng)
+                else:
+                    key = self._zero_key
+                req.timeline.add(self._clock(), "admitted", slot=slot,
+                                 bucket=int(bucket))
+                req.timeline.add(self._clock(), "prefill_start",
+                                 bucket=int(bucket))
+                with span("serving/prefill"):
+                    primed, tok = self._prefill_jit(
+                        self.params, row[None], mask_row[None], key)
+                    tok = int(np.asarray(tok)[0])
+                self.metrics.record_prefill(bucket)
+                t_first = self._clock()
+                req.ttft_s = t_first - req.submit_time
+                self.metrics.record_ttft(req.ttft_s)
+                req.timeline.add(t_first, "first_token")
+                if resume:
+                    # the prefill-selected token is DISCARDED: a
+                    # resumed lane's next decode seed is the
+                    # already-committed resume[-1] (seeded into
+                    # req.tokens at submit), not a re-selection —
+                    # exactly the cursor the unkilled lane would hold
+                    tok = resume[-1]
+                else:
+                    req.tokens.append(tok)
+                if self.config.eos_token_id is not None and \
+                        tok == self.config.eos_token_id:
+                    if blocks is not None:
+                        self._allocator.free(blocks)
+                        blocks = None
+                    self._finish(req, FINISHED, "eos")
+                    continue
+                if len(req.tokens) >= req.max_new_tokens:
+                    if blocks is not None:
+                        self._allocator.free(blocks)
+                        blocks = None
+                    self._finish(req, FINISHED, "length")
+                    continue
+                # history/mask lanes: padded prompt, mask open from
+                # the bucket edge on (causal validity bounds the open
+                # tail)
+                L = self.seq_capacity
+                hist_row = np.zeros((L,), np.int32)
+                hist_row[:bucket] = row
+                full_mask = np.ones((L,), np.int32)
+                full_mask[:bucket] = mask_row
+                if self.paged:
+                    table_row = np.zeros((self.max_blocks_per_slot,),
+                                         np.int32)
+                    table_row[:len(blocks)] = blocks
+            except BaseException:  # noqa: BLE001 — release + re-raise
+                # a failed prefill must not strand the request's KV
+                # blocks: return them to the pool before propagating
                 if blocks is not None:
                     self._allocator.free(blocks)
-                self._finish(req, FINISHED, "eos")
-                continue
-            if len(req.tokens) >= req.max_new_tokens:
-                if blocks is not None:
-                    self._allocator.free(blocks)
-                self._finish(req, FINISHED, "length")
-                continue
-            # history/mask lanes: padded prompt, mask open from the
-            # bucket edge on (causal validity bounds the open tail)
-            L = self.seq_capacity
-            hist_row = np.zeros((L,), np.int32)
-            hist_row[:bucket] = row
-            full_mask = np.ones((L,), np.int32)
-            full_mask[:bucket] = mask_row
+                raise
             if self.paged:
-                table_row = np.zeros((self.max_blocks_per_slot,),
-                                     np.int32)
-                table_row[:len(blocks)] = blocks
                 self._slot_blocks[slot] = blocks
                 self._cache, self._history, self._mask = \
                     self._assign_jit(self._cache, self._history,

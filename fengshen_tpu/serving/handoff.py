@@ -291,16 +291,19 @@ def adopt_lane(engine, payload: dict) -> Request:
             if blocks is None:
                 raise AdoptDecline("kv_blocks_exhausted",
                                    f"need {need} free KV blocks")
-            table_row = np.zeros((engine.max_blocks_per_slot,),
-                                 np.int32)
-            table_row[:len(blocks)] = blocks
-            positions = np.concatenate(
-                [np.arange(engine.block_size) + b * engine.block_size
-                 for b in blocks]).astype(np.int32)[:phys]
         try:
+            if engine.paged:
+                table_row = np.zeros((engine.max_blocks_per_slot,),
+                                     np.int32)
+                table_row[:len(blocks)] = blocks
+                positions = np.concatenate(
+                    [np.arange(engine.block_size) + b * engine.block_size
+                     for b in blocks]).astype(np.int32)[:phys]
             new_cache = _scatter_payload(engine, payload, slot, phys,
                                          positions, table_row)
-        except AdoptDecline:
+        except BaseException:  # noqa: BLE001 — release + re-raise
+            # any failure before the commit — a decline or an
+            # unexpected error — must return the blocks to the pool
             if blocks is not None:
                 engine._allocator.free(blocks)
             raise

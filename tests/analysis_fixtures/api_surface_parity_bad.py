@@ -1,0 +1,30 @@
+"""Known-bad fixture for `api-surface-parity`.
+
+The fastapi surface registers `/healthz` and `/infer`; the stdlib
+twin only dispatches `/healthz` — `POST /infer` would 404 on the
+dependency-free server.
+"""
+
+from http.server import BaseHTTPRequestHandler
+
+from fastapi import FastAPI
+
+app = FastAPI()
+
+
+@app.get("/healthz")
+def healthz():
+    return {"ok": True}
+
+
+@app.post("/infer")
+def infer(payload: dict):
+    return {"text": ""}
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/healthz":
+            self.send_response(200)
+        else:
+            self.send_response(404)

@@ -1,0 +1,43 @@
+"""Clean fixture for `api-surface-parity`.
+
+Both surfaces expose the same `(METHOD, path)` set, including a
+parameterised fastapi route matched by a `startswith` prefix dispatch
+on the stdlib side (both normalise to `/requests/*`).
+"""
+
+from http.server import BaseHTTPRequestHandler
+
+from fastapi import FastAPI
+
+app = FastAPI()
+
+
+@app.get("/healthz")
+def healthz():
+    return {"ok": True}
+
+
+@app.post("/infer")
+def infer(payload: dict):
+    return {"text": ""}
+
+
+@app.get("/requests/{request_id}")
+def request_status(request_id: str):
+    return {"id": request_id}
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/healthz":
+            self.send_response(200)
+        elif self.path.startswith("/requests/"):
+            self.send_response(200)
+        else:
+            self.send_response(404)
+
+    def do_POST(self):
+        if self.path == "/infer":
+            self.send_response(200)
+        else:
+            self.send_response(404)

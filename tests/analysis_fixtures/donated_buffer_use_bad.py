@@ -1,0 +1,22 @@
+"""Known-bad fixture for `donated-buffer-use`.
+
+The train step donates its state argument (`donate_argnums=(0,)`), so
+XLA may reuse the buffer for the output; reading `state` after the
+donating call observes freed/aliased memory on TPU.
+"""
+
+import jax
+
+
+def _step_impl(state, batch):
+    return state + batch
+
+
+class Stepper:
+    def __init__(self):
+        self._step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def run(self, state, batch):
+        new_state = self._step(state, batch)
+        stale = state.sum()        # BAD: reads the donated buffer
+        return new_state, stale

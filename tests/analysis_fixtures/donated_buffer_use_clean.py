@@ -1,0 +1,22 @@
+"""Clean fixture for `donated-buffer-use`.
+
+The donate-and-rebind idiom: the caller's name for the donated buffer
+is reassigned to the result of the donating call, so the dead buffer
+is unreachable afterwards — including across loop iterations.
+"""
+
+import jax
+
+
+def _step_impl(state, batch):
+    return state + batch
+
+
+class Stepper:
+    def __init__(self):
+        self._step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def run(self, state, batches):
+        for batch in batches:
+            state = self._step(state, batch)   # rebind: old buffer dead
+        return state

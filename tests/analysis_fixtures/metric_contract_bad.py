@@ -1,0 +1,22 @@
+"""Known-bad fixture for `metric-contract`.
+
+One family, two schemas: the relay path registers
+`fstpu_fixture_requests_total` with an extra `shard` label, which the
+registry rejects at runtime — but only on the relay code path.
+"""
+
+from fengshen_tpu.observability import registry
+
+
+def serve_metrics(r):
+    return r.counter("fstpu_fixture_requests_total",
+                     "requests seen", labelnames=("route",))
+
+
+def relay_metrics(r):
+    return r.counter("fstpu_fixture_requests_total",
+                     "requests seen", labelnames=("route", "shard"))
+
+
+def default_metrics():
+    return serve_metrics(registry.get_registry())

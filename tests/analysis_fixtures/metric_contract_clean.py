@@ -1,0 +1,23 @@
+"""Clean fixture for `metric-contract`.
+
+Each family is registered exactly once with a single schema; reusing
+the same get-or-create call from several sites with the SAME
+(kind, labelnames) is fine.
+"""
+
+from fengshen_tpu.observability import registry
+
+
+def tick_counter(r):
+    return r.counter("fstpu_fixture_ticks_total",
+                     "scheduler ticks", labelnames=("phase",))
+
+
+def depth_gauge(r):
+    return r.gauge("fstpu_fixture_queue_depth",
+                   "queued requests", labelnames=("lane",))
+
+
+def default_metrics():
+    r = registry.get_registry()
+    return tick_counter(r), depth_gauge(r)

@@ -1,0 +1,27 @@
+"""Known-bad fixture for `resource-lifecycle`.
+
+`admit` leaks its KV blocks when `pad_prompt` raises between the
+alloc and the free; `recycle` returns the same blocks to the pool
+twice on one path.
+"""
+
+
+class Pool:
+    def __init__(self, allocator, ladder):
+        self._allocator = allocator
+        self.ladder = ladder
+
+    def admit(self, req, need):
+        blocks = self._allocator.alloc(need)
+        if blocks is None:
+            return None
+        row = self.ladder.pad_prompt(req)   # BAD: raises -> blocks leak
+        self._allocator.free(blocks)
+        return row
+
+    def recycle(self, need):
+        blocks = self._allocator.alloc(need)
+        if blocks is None:
+            return
+        self._allocator.free(blocks)
+        self._allocator.free(blocks)        # BAD: double release

@@ -1,0 +1,37 @@
+"""Clean fixture for `resource-lifecycle`.
+
+The three sanctioned shapes: release in a `finally`; transfer
+ownership into a container (the slot table owns the blocks from then
+on); let a `with` statement manage the file handle.
+"""
+
+import json
+
+
+class Pool:
+    def __init__(self, allocator, ladder, slots):
+        self._allocator = allocator
+        self.ladder = ladder
+        self._slots = slots
+
+    def admit(self, req, need):
+        blocks = self._allocator.alloc(need)
+        if blocks is None:
+            return None                     # exhaustion: nothing held
+        try:
+            return self.ladder.pad_prompt(req)
+        finally:
+            self._allocator.free(blocks)
+
+    def adopt(self, slot, need):
+        blocks = self._allocator.alloc(need)
+        if blocks is None:
+            return False
+        self._slots[slot] = blocks          # ownership transfer
+        self.ladder.commit(slot)
+        return True
+
+
+def append_record(path, record):
+    with open(path, "a", encoding="utf-8") as out:
+        out.write(json.dumps(record) + "\n")

@@ -28,13 +28,18 @@ PKG = os.path.join(REPO, "fengshen_tpu")
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "analysis_fixtures")
 
-RULE_IDS = ("blanket-except", "blocking-transfer", "blocking-under-lock",
-            "host-divergence", "lock-order", "metrics-in-traced-code",
-            "nondet-iteration", "partition-spec-axes", "retrace-hazard",
-            "unguarded-shared-state")
+RULE_IDS = ("api-surface-parity", "blanket-except", "blocking-transfer",
+            "blocking-under-lock", "donated-buffer-use",
+            "host-divergence", "lock-order", "metric-contract",
+            "metrics-in-traced-code", "nondet-iteration",
+            "partition-spec-axes", "resource-lifecycle",
+            "retrace-hazard", "unguarded-shared-state")
 
 CONCURRENCY_RULE_IDS = ("blocking-under-lock", "lock-order",
                         "unguarded-shared-state")
+
+DATAFLOW_RULE_IDS = ("api-surface-parity", "donated-buffer-use",
+                     "metric-contract", "resource-lifecycle")
 
 
 def _fixture(rule_id: str, kind: str) -> str:
@@ -634,6 +639,57 @@ def test_concurrency_rules_clean_on_package():
         "never baselined"
 
 
+def test_dataflow_rules_clean_on_package():
+    """The dataflow gate, same policy as the concurrency gate: the
+    four PR-17 rules (`donated-buffer-use`, `resource-lifecycle`,
+    `api-surface-parity`, `metric-contract`) report ZERO findings
+    over the merged tree with an EMPTY baseline. Every real leak the
+    sweep found was fixed at the site (serving/engine.py `_admit`,
+    serving/handoff.py `adopt_lane`, the bert_dataloader shard
+    writers), every donation site uses the rebind idiom, and the
+    metrics reference table in docs/observability.md matches the
+    registrations — so a hit here is a regression, not legacy debt."""
+    rules = make_rules(select=list(DATAFLOW_RULE_IDS))
+    findings = check_paths([PKG], rules, REPO)
+    assert not findings, (
+        "dataflow rules fired on the package — fix the leak/stale "
+        "read/contract drift or suppress at the site with a "
+        "rationale:\n" + "\n".join(f.render() for f in findings))
+    entries = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path(REPO))
+    assert not [e for e in entries
+                if e["rule"] in DATAFLOW_RULE_IDS], \
+        "dataflow findings must be fixed or line-suppressed, " \
+        "never baselined"
+
+
+def test_donation_witness_chain():
+    """The bad fixture's finding carries the full witness chain:
+    binding line, donating call line, and the stale read."""
+    findings = check_file(_fixture("donated-buffer-use", "bad"),
+                          make_rules(select=["donated-buffer-use"]),
+                          REPO)
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "donate_argnums bound at" in msg
+    assert "donating call at" in msg and "read at" in msg
+
+
+def test_lifecycle_witness_chains():
+    """Both finding kinds fire on the bad fixture, each with its
+    witness: the leak names the raising call, the double-release the
+    first release site."""
+    findings = check_file(_fixture("resource-lifecycle", "bad"),
+                          make_rules(select=["resource-lifecycle"]),
+                          REPO)
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2
+    assert any("pad_prompt" in m and "release skipped" in m
+               for m in msgs)
+    assert any("released twice" in m and "first release" in m
+               for m in msgs)
+
+
 def test_cross_module_lock_discipline(tmp_path):
     """The project index resolves calls ACROSS files: a blocking call
     two modules away from the `with lock:` body is still caught."""
@@ -743,6 +799,7 @@ def test_json_deterministic_across_hash_seeds():
     report = json.loads(outs[0])
     fired = {f["rule"] for f in report["findings"]}
     assert set(CONCURRENCY_RULE_IDS) <= fired
+    assert set(DATAFLOW_RULE_IDS) <= fired
 
 
 def test_changed_file_discovery(tmp_path):
@@ -789,3 +846,107 @@ def test_cli_github_format(capsys):
                         "lock_order_bad.py,line=") and
         "title=fslint lock-order::" in line
         for line in out)
+
+
+def test_sarif_deterministic_across_hash_seeds():
+    """`--format=sarif` (the `make lint-ci` artifact) is byte-stable
+    across PYTHONHASHSEED values and structurally a SARIF 2.1.0 log:
+    one run, rules sorted by id, one result per finding with a
+    1-based startColumn."""
+    argv = [sys.executable, "-m", "fengshen_tpu.analysis", FIXTURES,
+            "--format=sarif", "--no-baseline", "--no-index-cache"]
+    outs = []
+    for seed in ("0", "4242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=120, env=env, cwd=REPO)
+        assert proc.returncode == 1, proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1], "SARIF output varies with hash seed"
+    log = json.loads(outs[0])
+    assert log["version"] == "2.1.0"
+    (run,) = log["runs"]
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    assert set(RULE_IDS) <= set(rule_ids)
+    assert run["results"], "fixtures tree must produce SARIF results"
+    for res in run["results"]:
+        assert res["level"] == "error" and res["ruleId"] in rule_ids
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+
+
+def test_cli_stats_in_json_report(capsys):
+    """`--stats` adds a stats block to the JSON report: files indexed,
+    rules run, index-cache hit/miss split, and wall time."""
+    bad = os.path.join(FIXTURES, "lock_order_bad.py")
+    rc = fslint_main([bad, "--json", "--stats", "--no-baseline",
+                      "--no-index-cache"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    stats = report["stats"]
+    assert stats["files"] == 1
+    assert stats["rules"] == len(make_rules())
+    assert stats["index_cache_hits"] == 0      # --no-index-cache
+    # no disk cache: the file is either summarised fresh or served
+    # from the in-process memo
+    assert stats["index_cache_misses"] + stats["memo_hit"] == 1
+    assert stats["wall_time_s"] >= 0
+
+    # without --stats the report carries no stats key (determinism:
+    # wall time is the one non-reproducible field)
+    rc = fslint_main([bad, "--json", "--no-baseline",
+                      "--no-index-cache"])
+    assert rc == 1
+    assert "stats" not in json.loads(capsys.readouterr().out)
+
+
+@pytest.mark.parametrize("fmt", ["text", "sarif"])
+def test_cli_stats_on_stderr_for_non_json(fmt, capsys):
+    clean = os.path.join(FIXTURES, "lock_order_clean.py")
+    rc = fslint_main([clean, f"--format={fmt}", "--stats",
+                      "--no-baseline", "--no-index-cache"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "fslint stats: " in err
+    stats = json.loads(err.split("fslint stats: ", 1)[1])
+    assert stats["files"] == 1
+
+
+def test_warm_cache_whole_package_under_budget(tmp_path):
+    """Fast-lane smoke: with a warm index cache the whole-package
+    index build serves every file summary from the cache — the
+    dataflow findings ride in the cached summaries, so nothing is
+    re-analyzed — and finishes in a fraction of the cold-build time."""
+    import time
+
+    from fengshen_tpu.analysis import engine as engine_mod
+    from fengshen_tpu.analysis import project as project_mod
+
+    cache = str(tmp_path / "cache.json")
+    files = sorted(engine_mod.iter_py_files([PKG]))
+
+    t0 = time.monotonic()
+    cold = project_mod.build_index(files, REPO, cache_path=cache)
+    cold_s = time.monotonic() - t0
+    stats = dict(project_mod.LAST_BUILD_STATS)
+    assert stats["cache_misses"] == stats["files"] > 100
+
+    t0 = time.monotonic()
+    warm = project_mod.build_index(files, REPO, cache_path=cache)
+    warm_s = time.monotonic() - t0
+    stats = dict(project_mod.LAST_BUILD_STATS)
+    assert stats["cache_hits"] == stats["files"]
+    assert stats["cache_misses"] == 0
+
+    # warm is observed ~20x cheaper than cold (~0.3s vs ~6.5s); a 3x
+    # bar with a 2s floor stays green on slow CI while still tripping
+    # if the cache stops serving (or the flow engines re-run)
+    assert warm_s < max(2.0, cold_s / 3), (cold_s, warm_s)
+
+    # and the round-tripped summaries carry the dataflow facts intact
+    rel = "fengshen_tpu/serving/engine.py"
+    assert warm.files[rel].lifecycle_findings == \
+        cold.files[rel].lifecycle_findings
+    assert warm.files[rel].metrics == cold.files[rel].metrics
