@@ -8,7 +8,7 @@ PY ?= python
 	serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
 	serve-bench-disagg serve-bench-evac serve-fleet aot-bench \
-	benchdiff
+	kernel-bench benchdiff
 
 # whole package, all rules (per-file + the cross-module concurrency
 # tier); the project index is cached in .fslint_cache.json
@@ -95,6 +95,24 @@ serve-fleet:
 # one BENCH-schema JSON line (aot_cold_s, aot_warm_s, speedup)
 aot-bench:
 	JAX_PLATFORMS=cpu $(PY) -m fengshen_tpu.aot.bench
+
+# kernel-layer microbench (docs/kernels.md): the Pallas dispatch seam
+# A/B'd against the stock XLA lowerings (paged decode read, fused CE
+# grad step) plus the configs/long_context_32k.json trainer config on
+# a sequence-sharded mesh. One BENCH-schema JSON line per rung, each
+# carrying the `kernel` dispatch decision (pallas|xla) that benchdiff
+# folds into the row identity. CPU-shrunk width; hardware rounds drop
+# the KERNEL_BENCH_* overrides for the full 32k shape.
+kernel-bench:
+	JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_DEGRADED=1 KERNEL_BENCH_SEQ=2048 \
+		KERNEL_BENCH_HIDDEN=64 KERNEL_BENCH_INTER=128 \
+		KERNEL_BENCH_LAYERS=2 KERNEL_BENCH_HEADS=4 \
+		KERNEL_BENCH_KV=4 KERNEL_BENCH_VOCAB=512 \
+		KERNEL_BENCH_FUSED_CE=4 KERNEL_BENCH_STEPS=2 \
+		KERNEL_BENCH_DTYPE=float32 \
+		$(PY) -m fengshen_tpu.ops.pallas.bench
 
 # bench trajectory comparator (docs/observability.md "benchdiff"):
 # classifies each BENCH_r*.json round (ok / wedged / failed), diffs

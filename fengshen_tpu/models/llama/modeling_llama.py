@@ -17,7 +17,7 @@ resharding scripts (reference: fengshen/utils/llama_convert/*, SURVEY.md §5.4).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
 from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.pallas.decode_attention import decode_attention
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import RMSNorm
@@ -66,6 +67,24 @@ SCAN_PARTITION_RULES: list[tuple[str, P]] = [
 
 def _dt(config: LlamaConfig):
     return jnp.dtype(config.dtype)
+
+
+class CacheView(NamedTuple):
+    """What `_update_cache` hands the decode_attention dispatch seam
+    (fengshen_tpu/ops/pallas/decode_attention.py): the cache in its
+    NATIVE layout — the paged pool stays `[num_blocks, block_size, kv,
+    hd]` behind its `block_table` (the Mosaic kernel reads it through
+    the table; the xla lowering gathers), and int8 pools stay int8
+    with their per-(token, head) scales (dequant happens inside the
+    attention read on either path)."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array]
+    v_scale: Optional[jax.Array]
+    block_table: Optional[jax.Array]
+    #: [B, Sq, L] bool over the (virtual) lane
+    valid: jax.Array
 
 
 class LlamaMLP(nn.Module):
@@ -127,9 +146,20 @@ class LlamaAttention(nn.Module):
         q, k = apply_rotary_pos_emb(q, k, position_ids, base=cfg.rope_theta)
 
         is_decode = self.has_variable("cache", "cached_key") or init_cache
+        impl = cfg.attention_impl
         if is_decode:
-            k, v, mask = self._update_cache(k, v, attention_mask)
-            mask = mask[:, None]  # [B, 1, Sq, max_len]
+            # every (layout, dtype, spec_mode) decode combo routes
+            # through ONE dispatch seam (docs/kernels.md): the Mosaic
+            # kernel reads paged pools through the block table with no
+            # gather copy and dequantizes int8 in registers; the xla
+            # lowering replays the stock gather → dequant → GQA repeat
+            # → dense chain op-for-op, so CPU tier-1 pins decode
+            # token-identical through the seam
+            view = self._update_cache(k, v, attention_mask)
+            out = decode_attention(
+                q, view.k, view.v, view.valid,
+                k_scale=view.k_scale, v_scale=view.v_scale,
+                block_table=view.block_table, dequant_dtype=_dt(cfg))
         else:
             mask = causal_mask(seq, k.shape[1])[None, None]
             if attention_mask is not None:
@@ -143,29 +173,29 @@ class LlamaAttention(nn.Module):
                     mask = mask & \
                         attention_mask[:, None, None, :].astype(bool)
 
-        impl = cfg.attention_impl
-        if n_kv != n_heads and not (impl == "flash" and not is_decode):
-            # GQA: repeat kv heads for the dense/decode/ring paths; the
-            # flash dispatch handles grouped KV natively (the Pallas
-            # kernel reads each KV head once per group from HBM)
-            rep = n_heads // n_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+            if n_kv != n_heads and impl != "flash":
+                # GQA: repeat kv heads for the dense/ring paths; the
+                # flash dispatch handles grouped KV natively (the Pallas
+                # kernel reads each KV head once per group from HBM)
+                rep = n_heads // n_kv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
 
-        if impl in ("flash", "ring", "ulysses", "sequence") and \
-                not is_decode:
-            # a padding mask maps to segment ids (pads = segment 0), so
-            # padded SFT batches stay on the fused/ring paths
-            seg = None if attention_mask is None else \
-                attention_mask.astype(jnp.int32)
-            if impl == "flash":
-                from fengshen_tpu.ops.flash_attention import flash_attention
-                out = flash_attention(q, k, v, causal=True, segment_ids=seg)
+            if impl in ("flash", "ring", "ulysses", "sequence"):
+                # a padding mask maps to segment ids (pads = segment 0),
+                # so padded SFT batches stay on the fused/ring paths
+                seg = None if attention_mask is None else \
+                    attention_mask.astype(jnp.int32)
+                if impl == "flash":
+                    from fengshen_tpu.ops.flash_attention import (
+                        flash_attention)
+                    out = flash_attention(q, k, v, causal=True,
+                                          segment_ids=seg)
+                else:
+                    out = dot_product_attention(q, k, v, impl=impl,
+                                                segment_ids=seg)
             else:
-                out = dot_product_attention(q, k, v, impl=impl,
-                                            segment_ids=seg)
-        else:
-            out = dot_product_attention(q, k, v, mask=mask)
+                out = dot_product_attention(q, k, v, mask=mask)
 
         out = with_sharding_constraint(
             out, P(BATCH_AXES, "sequence", "tensor", None))
@@ -187,6 +217,11 @@ class LlamaAttention(nn.Module):
         - `block_table` present: the paged pool
           (`fengshen_tpu/serving/paged_cache.py`) — lanes indirect
           through per-slot block lists into a shared block pool.
+
+        Returns a :class:`CacheView` in the cache's NATIVE layout; the
+        decode_attention dispatch seam owns the read (gather/dequant on
+        the xla lowering, table-indirect + in-register dequant in the
+        Mosaic kernel).
         """
         cfg = self.config
         batch, seq, n_kv, head_dim = k.shape
@@ -206,16 +241,16 @@ class LlamaAttention(nn.Module):
             valid = jnp.broadcast_to(
                 (jnp.arange(max_len) < seq)[None, None],
                 (batch, seq, max_len))
-            return k, v, valid[:, :, :seq]
+            return CacheView(k, v, None, None, None, valid[:, :, :seq])
         idx = cache_index.value
+        ks_all = vs_all = None
         if idx.ndim == 1:
             # slot-pool decode (fengshen_tpu/serving): a [B] cache_index
             # gives every lane its own write position, so concurrently
             # served requests at different progress share ONE jitted step
             quantized = self.has_variable("cache", "cached_key_scale")
             if quantized:
-                from fengshen_tpu.ops.int8_matmul import (dequantize_kv,
-                                                          quantize_kv)
+                from fengshen_tpu.ops.int8_matmul import quantize_kv
                 k_scale = self.variable(
                     "cache", "cached_key_scale", jnp.zeros,
                     (batch, max_len, n_kv), jnp.float32)
@@ -236,12 +271,9 @@ class LlamaAttention(nn.Module):
             v_all = jax.vmap(lambda c, u, i: jax.lax.dynamic_update_slice(
                 c, u, (i, 0, 0)))(cached_v.value, v, idx)
             cached_k.value, cached_v.value = k_all, v_all
-            if quantized:
-                # the attention read dequantizes in registers; the pool
-                # itself stays int8 in HBM
-                dt = _dt(cfg)
-                k_all = dequantize_kv(k_all, ks_all, dt)
-                v_all = dequantize_kv(v_all, vs_all, dt)
+            # int8 pools stay int8: the CacheView carries the raw pool
+            # + scales and the attention read dequantizes (in registers
+            # on the Mosaic kernel, via dequantize_kv on the lowering)
             cache_index.value = idx + seq
             # per-lane causal validity: lane b's query t (position
             # idx[b]+t) sees cache positions ≤ idx[b]+t
@@ -266,7 +298,7 @@ class LlamaAttention(nn.Module):
                            attention_mask.dtype)
             full = jnp.concatenate([attention_mask, pad], axis=1)
             valid = valid & full[:, None, :].astype(bool)
-        return k_all, v_all, valid
+        return CacheView(k_all, v_all, ks_all, vs_all, None, valid)
 
     def _update_paged_cache(self, k, v, attention_mask):
         """Paged decode (fengshen_tpu/serving/paged_cache.py): K/V live
@@ -277,10 +309,12 @@ class LlamaAttention(nn.Module):
         for each of the step's `seq` positions `p = idx + 0..seq-1`
         (seq == 1 for the plain decode tick; seq == gamma+1 for the
         speculative verify window, whose positions may CROSS a block
-        boundary — hence the per-position block lookup) and gathers
-        each lane's blocks back into a contiguous virtual lane with
-        `jnp.take` — the paged-attention analog in pure gather/scatter
-        ops, so the XLA-CPU tier-1 lane runs it unchanged. Inactive
+        boundary — hence the per-position block lookup). The READ moved
+        into the decode_attention dispatch seam: the Mosaic kernel
+        walks the block table directly (no gather copy), while the xla
+        lowering reconstructs the stock contiguous-virtual-lane
+        `jnp.take` gather, so the XLA-CPU tier-1 lane sees the same
+        math it always ran. Inactive
         lanes are parked on block 0 (the null block, never allocated),
         which absorbs their stray writes; the engine's admission
         charges blocks for the speculative tail too
@@ -331,8 +365,7 @@ class LlamaAttention(nn.Module):
         flat_v = cached_v.value.reshape(num_blocks * block_size,
                                         n_kv, head_dim)
         if quantized:
-            from fengshen_tpu.ops.int8_matmul import (dequantize_kv,
-                                                      quantize_kv)
+            from fengshen_tpu.ops.int8_matmul import quantize_kv
             k_scale = self.variable(
                 "cache", "cached_key_scale", jnp.zeros,
                 (num_blocks, block_size, n_kv), jnp.float32)
@@ -364,20 +397,10 @@ class LlamaAttention(nn.Module):
                                         n_kv, head_dim)
         cache_index.value = idx + seq
 
-        # gather each lane's blocks into a contiguous [B, virt_len] view
-        gather_idx = ((table.value * block_size)[:, :, None] +
-                      jnp.arange(block_size)[None, None, :]
-                      ).reshape(batch, virt_len)
-        k_all = jnp.take(flat_k, gather_idx, axis=0)
-        v_all = jnp.take(flat_v, gather_idx, axis=0)
-        if quantized:
-            dt = _dt(cfg)
-            k_all = dequantize_kv(k_all,
-                                  jnp.take(flat_ks, gather_idx, axis=0),
-                                  dt)
-            v_all = dequantize_kv(v_all,
-                                  jnp.take(flat_vs, gather_idx, axis=0),
-                                  dt)
+        # NO gather: the pool stays put and the CacheView carries the
+        # block table — the attention read resolves the indirection
+        # (the Mosaic kernel's index maps walk the table per block; the
+        # xla lowering reconstructs the stock jnp.take virtual lane)
         # per-lane causal validity over the virtual lane (same law as
         # the slot path: query at idx[b] sees positions <= idx[b])
         q_pos = idx[:, None] + jnp.arange(seq)[None, :]
@@ -388,7 +411,10 @@ class LlamaAttention(nn.Module):
                 pad = jnp.ones((batch, virt_len - m.shape[1]), m.dtype)
                 m = jnp.concatenate([m, pad], axis=1)
             valid = valid & m[:, None, :].astype(bool)
-        return k_all, v_all, valid
+        return CacheView(cached_k.value, cached_v.value,
+                         k_scale.value if quantized else None,
+                         v_scale.value if quantized else None,
+                         table.value, valid)
 
 
 class LlamaDecoderLayer(nn.Module):

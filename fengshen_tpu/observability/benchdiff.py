@@ -118,7 +118,10 @@ def _identity(row: dict) -> str:
     ``incomparable``, never regression/flat. Fault-drill rows
     (docs/fault_tolerance.md) carry a ``drill`` key for the same
     reason: a preemption round must never be compared against an
-    undisturbed one."""
+    undisturbed one. Kernel-bench rows (docs/kernels.md) carry a
+    ``kernel`` key with the dispatch decision (``pallas`` | ``xla``):
+    a Mosaic-kernel round and a stock-lowering round measure different
+    programs, so they too diff as incomparable."""
     parts = [_placement(row)]
     if "replicas" in row:
         parts.append(f"replicas={int(row['replicas'])}")
@@ -126,6 +129,8 @@ def _identity(row: dict) -> str:
         parts.append(f"topology={row['topology']}")
     if "drill" in row:
         parts.append(f"drill={row['drill']}")
+    if "kernel" in row:
+        parts.append(f"kernel={row['kernel']}")
     return "|".join(parts)
 
 

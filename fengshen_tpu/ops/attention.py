@@ -75,12 +75,14 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if impl == "sparse" and sparse_layout is not None:
         import numpy as np
+
+        from fengshen_tpu.ops.pallas import probe
         layout = np.asarray(sparse_layout)
         blk = sparse_block_size
         eligible = (
             bias is None and mask is None and
             (deterministic or dropout_rate == 0.0) and
-            jax.default_backend() == "tpu" and
+            probe().pallas_tpu and
             q.shape[1] % blk == 0 and k.shape[1] % blk == 0 and
             blk % 128 == 0 and q.shape[-1] % 128 == 0 and
             layout.shape == (q.shape[1] // blk, k.shape[1] // blk))

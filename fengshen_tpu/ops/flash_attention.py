@@ -187,7 +187,8 @@ def _pallas_eligible(q, k, v, bias, causal) -> bool:
     group — as long as the head counts divide."""
     if bias is not None:
         return False
-    if jax.default_backend() != "tpu":
+    from fengshen_tpu.ops.pallas import probe
+    if not probe().pallas_tpu:
         return False
     _, q_len, n_heads, head_dim = q.shape
     k_len, kv_heads = k.shape[1], k.shape[2]

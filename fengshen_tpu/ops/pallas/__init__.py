@@ -1,0 +1,228 @@
+"""Kernel layer: registry + capability probe + dispatch seam.
+
+Before this, the two Pallas kernels in this package (flash_attention,
+block_sparse_attention) were orphans — each caller re-derived "can the
+backend run Mosaic?" from ``jax.default_backend()`` inline, and the
+decision never reached logs, metrics, or the AOT cache key. Now every
+fused kernel registers BOTH implementations here:
+
+- ``pallas`` — the Mosaic TPU kernel (fengshen_tpu.ops.pallas.*);
+- ``xla``    — the stock lowering the kernel replaces, numerically
+  identical by construction so CPU tier-1 can pin parity.
+
+and callers route through one seam:
+
+- :func:`probe` — cached capability probe (same shape as the offload
+  ladder's ``probe_memory_capabilities``): is this backend able to run
+  Mosaic kernels at all?  ``FSTPU_KERNEL_FORCE=xla|pallas`` overrides
+  for benchmarking / debugging.  Cached per (backend, force) so the
+  decision is made ONCE per process — dispatch inside a traced function
+  reads a python bool, never a runtime branch, so it is not a
+  retrace hazard.
+- :func:`kernel_choice` — the per-op decision (``"pallas"`` or
+  ``"xla"``), and :func:`get_kernel` to fetch the callable.
+- :func:`kernel_fingerprint` — the dispatch table serialized for the
+  AOT cache key (docs/aot_cache.md): a pallas-compiled executable must
+  never be replayed on an xla-dispatch process and vice versa.
+- :func:`log_dispatch` — THE loud line (PR 9 doctrine: degrade loudly,
+  never fail) + the ``fstpu_kernel_dispatch{op,impl}`` gauge.
+
+See docs/kernels.md for the dispatch ladder and the
+writing-a-kernel checklist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Callable, Dict, Optional
+
+KERNEL_DISPATCH_METRIC = "fstpu_kernel_dispatch"
+
+#: env override: "xla" benches the fallback on TPU, "pallas" forces the
+#: kernels on (interpret-mode debugging); unset = probe the backend
+FORCE_ENV = "FSTPU_KERNEL_FORCE"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelProbe:
+    """One process-wide answer to "can this backend run Mosaic?"."""
+
+    backend: str
+    #: True when pl.pallas_call compiles to Mosaic on this backend —
+    #: the per-op shape checks still apply on top of this
+    pallas_tpu: bool
+    #: the FSTPU_KERNEL_FORCE value when it decided, else None
+    forced: Optional[str]
+    reason: str
+
+    def describe(self) -> dict:
+        return {
+            "backend": self.backend,
+            "pallas_tpu": self.pallas_tpu,
+            "forced": self.forced,
+            "reason": self.reason,
+        }
+
+
+#: (backend, force-env) -> KernelProbe; keyed on the env var so a bench
+#: that flips FSTPU_KERNEL_FORCE mid-process re-probes
+_PROBE_CACHE: Dict[tuple, KernelProbe] = {}
+
+
+def probe(refresh: bool = False) -> KernelProbe:
+    """Cached capability probe. Never raises: a backend that cannot
+    run Mosaic answers ``pallas_tpu=False`` with the reason, and every
+    op degrades to its xla lowering (loudly — see log_dispatch)."""
+    import jax
+
+    forced = os.environ.get(FORCE_ENV, "").strip().lower() or None
+    backend = jax.default_backend()
+    cache_key = (backend, forced)
+    if not refresh and cache_key in _PROBE_CACHE:
+        return _PROBE_CACHE[cache_key]
+    if forced == "xla":
+        result = KernelProbe(backend, False, forced,
+                             f"{FORCE_ENV}=xla pins the stock lowering")
+    elif forced == "pallas":
+        result = KernelProbe(backend, True, forced,
+                             f"{FORCE_ENV}=pallas pins the Mosaic "
+                             "kernels (off-TPU they must be run in "
+                             "interpret mode or will fail at call time)")
+    elif backend != "tpu":
+        result = KernelProbe(backend, False, None,
+                             f"backend={backend} cannot compile Mosaic "
+                             "kernels; xla lowering (CPU tier-1 pins "
+                             "parity against it)")
+    else:
+        try:
+            from jax.experimental import pallas as _pl  # noqa: F401
+            from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+            result = KernelProbe(backend, True, None,
+                                 "tpu backend + pallas importable")
+        except Exception as exc:  # noqa: BLE001 — a jax build without
+            # pallas still serves/trains on the stock lowering
+            result = KernelProbe(backend, False, None,
+                                 f"pallas import failed: {exc!r}")
+    _PROBE_CACHE[cache_key] = result
+    return result
+
+
+#: op -> {"pallas": fn, "xla": fn}; both impls of one op take the same
+#: signature and agree numerically (the parity tests pin it)
+_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_kernel(op: str, impl: str, fn: Callable) -> Callable:
+    """Register one implementation of ``op``; returns ``fn`` so it can
+    be used as a decorator tail."""
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"impl must be 'pallas' or 'xla', got {impl!r}")
+    _REGISTRY.setdefault(op, {})[impl] = fn
+    return fn
+
+
+def kernel_choice(op: str) -> str:
+    """The dispatch decision for ``op``: ``"pallas"`` when the probe
+    says the backend can run Mosaic AND the op registered a pallas
+    impl, else ``"xla"``."""
+    impls = _REGISTRY.get(op, {})
+    if probe().pallas_tpu and "pallas" in impls:
+        return "pallas"
+    return "xla"
+
+
+def get_kernel(op: str, impl: Optional[str] = None) -> Callable:
+    """Fetch the callable for ``op`` (``impl=None`` = probed choice)."""
+    impls = _REGISTRY.get(op)
+    if not impls:
+        raise KeyError(f"no kernel registered under {op!r}; "
+                       f"known: {sorted(_REGISTRY)}")
+    resolved = impl if impl is not None else kernel_choice(op)
+    if resolved not in impls:
+        raise KeyError(f"kernel {op!r} has no {resolved!r} impl; "
+                       f"registered: {sorted(impls)}")
+    return impls[resolved]
+
+
+def dispatch_table() -> Dict[str, str]:
+    """op -> chosen impl for every registered kernel."""
+    return {op: kernel_choice(op) for op in sorted(_REGISTRY)}
+
+
+def kernel_fingerprint() -> str:
+    """The dispatch table as a stable string for the AOT cache key
+    (docs/aot_cache.md): two processes whose kernels dispatch
+    differently must never share a compiled executable."""
+    table = ",".join(f"{op}:{impl}" for op, impl in
+                     sorted(dispatch_table().items()))
+    return f"kernels={table};backend={probe().backend}"
+
+
+def log_dispatch(log: Optional[Callable[[dict], None]] = None,
+                 registry=None) -> Dict[str, str]:
+    """THE loud line: state every kernel's dispatch decision once at
+    startup (structured sink when one exists, stderr otherwise) and set
+    the ``fstpu_kernel_dispatch{op,impl}`` gauge — 1 for the chosen
+    impl, 0 for the alternative, so a scraper can alert on a fleet
+    silently degrading to xla. Returns the dispatch table."""
+    from fengshen_tpu.observability.registry import get_registry
+
+    info = probe()
+    table = dispatch_table()
+    gauge = (registry if registry is not None else get_registry()).gauge(
+        KERNEL_DISPATCH_METRIC,
+        "1 for each op's chosen kernel impl, 0 for the alternative",
+        labelnames=("op", "impl"),
+    )
+    for op, chosen in table.items():
+        for impl in ("pallas", "xla"):
+            gauge.labels(op, impl).set(1 if impl == chosen else 0)
+    if log is not None:
+        log({"event": "kernel_dispatch", "table": table,
+             **info.describe()})
+    else:
+        summary = " ".join(f"{op}={impl}" for op, impl in table.items())
+        print(f"[fengshen-tpu] kernel dispatch: {summary} "
+              f"(backend={info.backend}) — {info.reason}",
+              file=sys.stderr, flush=True)
+    return table
+
+
+# -- registrations ------------------------------------------------------
+# Imported after the seam exists; the explicit register_kernel calls
+# are kept here so the whole table is visible in one place.
+
+from fengshen_tpu.ops.flash_attention import blockwise_attention  # noqa: E402
+# aliased: binding the bare function name here would shadow the
+# `ops.pallas.block_sparse_attention` SUBMODULE attribute that
+# `import fengshen_tpu.ops.pallas.block_sparse_attention as bsa` resolves
+from fengshen_tpu.ops.pallas.block_sparse_attention import (  # noqa: E402
+    block_sparse_attention as _block_sparse_attention)
+from fengshen_tpu.ops.pallas.decode_attention import (  # noqa: E402
+    decode_attention, pallas_decode_attention, pallas_decode_eligible,
+    xla_decode_attention)
+from fengshen_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    pallas_flash_attention)
+from fengshen_tpu.ops.pallas.fused_ce import (  # noqa: E402
+    fused_ce_loss, pallas_fused_ce, xla_fused_ce)
+
+register_kernel("flash_attention", "pallas", pallas_flash_attention)
+register_kernel("flash_attention", "xla", blockwise_attention)
+register_kernel("block_sparse_attention", "pallas", _block_sparse_attention)
+# block-sparse has no standalone xla twin here: the fallback (expand the
+# layout to a dense mask) lives in ops.attention.dot_product_attention
+register_kernel("decode_attention", "pallas", pallas_decode_attention)
+register_kernel("decode_attention", "xla", xla_decode_attention)
+register_kernel("fused_ce", "pallas", pallas_fused_ce)
+register_kernel("fused_ce", "xla", xla_fused_ce)
+
+__all__ = [
+    "KernelProbe", "probe", "register_kernel", "kernel_choice",
+    "get_kernel", "dispatch_table", "kernel_fingerprint", "log_dispatch",
+    "decode_attention", "xla_decode_attention", "pallas_decode_attention",
+    "pallas_decode_eligible", "fused_ce_loss", "pallas_fused_ce",
+    "xla_fused_ce", "pallas_flash_attention",
+    "KERNEL_DISPATCH_METRIC", "FORCE_ENV",
+]

@@ -1,0 +1,288 @@
+"""Paged decode attention: the block-table-aware fused kernel.
+
+The stock paged decode path (`modeling_llama._update_paged_cache`)
+pays a pure-bandwidth tax before attention ever runs: it gathers every
+lane's blocks out of the shared KV pool into a contiguous
+``[B, virt_len]`` virtual lane with ``jnp.take`` — a full copy of the
+KV window per tick — and, on int8 pools, dequantizes the whole gathered
+window to fp. Decode is memory-bound (arxiv 2311.03687), so that copy
+is the phase's dominant cost.
+
+This module is the ``decode_attention`` dispatch seam every decode
+shape routes through (see fengshen_tpu/ops/pallas/__init__.py):
+
+- :func:`pallas_decode_attention` — Mosaic kernel that reads the pool
+  **through the block table directly**: the block-table row rides in as
+  a scalar-prefetch operand, so each grid step's BlockSpec index map
+  picks the lane's physical block out of HBM — no gather copy, no
+  virtual-lane materialization. The int8 per-(token, head) dequant
+  (``ops/int8_matmul.quantize_kv`` scales) happens in registers on the
+  ``[block_size, head_dim]`` tile, and GQA reads each KV head once per
+  query-head group via the index map (no HBM ``jnp.repeat``). Slot-pool
+  (contiguous ``[B, max_len]``) caches reuse the same kernel by
+  reshaping into ``max_len // block_size`` blocks per lane with an
+  arange block table. Serves both the ``[B, 1]`` decode tick and the
+  ``[B, gamma+1]`` speculative verify window (one sequential grid axis
+  over blocks, online softmax across them).
+- :func:`xla_decode_attention` — the stock lowering, op-for-op the
+  sequence the model ran before this seam existed (take-gather →
+  dequantize → GQA repeat → dense attention), so CPU tier-1 pins
+  greedy decode through the dispatcher token-identical to the
+  pre-kernel path.
+
+Tiling (docs/kernels.md): the Mosaic lane dim must be a 128-multiple,
+so the pallas path requires ``head_dim % 128 == 0`` and
+``block_size % 128 == 0`` (the validity mask streams as
+``[S, block_size]`` tiles). Pools with small pages stay on the xla
+lowering — eligibility is part of the dispatch, not an error.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fengshen_tpu.ops.attention import dot_product_attention
+from fengshen_tpu.ops.int8_matmul import dequantize_kv
+
+_NEG_INF = -1e30
+
+#: longest query window the kernel serves — the decode tick (1) and
+#: any sane speculative gamma; longer windows are prefill-shaped and
+#: belong on the flash/dense paths
+_MAX_QUERY_WINDOW = 8
+
+
+def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     valid: jax.Array, *,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
+                     block_table: Optional[jax.Array] = None,
+                     dequant_dtype=None,
+                     impl: Optional[str] = None,
+                     interpret: bool = False) -> jax.Array:
+    """The dispatch seam: every (layout, dtype, spec_mode) decode combo
+    enters here and leaves as ``[B, S, H, D]`` attention output.
+
+    q: ``[B, S, H, D]`` (S = 1 decode tick or gamma+1 verify window).
+    k/v: ``[B, max_len, KVH, D]`` slot/lockstep cache, or the shared
+    ``[num_blocks, block_size, KVH, D]`` pool when ``block_table``
+    (``[B, max_blocks]`` int32) is given. int8 caches pass the
+    per-(token, head) absmax scales (``k_scale``/``v_scale``) and the
+    compute dtype ``dequant_dtype``. ``valid``: ``[B, S, L]`` bool over
+    the (virtual) lane. ``impl`` forces ``"pallas"``/``"xla"``;
+    ``None`` asks the capability probe + shape eligibility.
+    """
+    if impl is None:
+        from fengshen_tpu.ops.pallas import probe
+        use_pallas = probe().pallas_tpu and pallas_decode_eligible(
+            q, k, v, k_scale=k_scale, block_table=block_table)
+        impl = "pallas" if use_pallas else "xla"
+    if impl == "pallas":
+        return pallas_decode_attention(
+            q, k, v, valid, k_scale=k_scale, v_scale=v_scale,
+            block_table=block_table, dequant_dtype=dequant_dtype,
+            interpret=interpret)
+    return xla_decode_attention(
+        q, k, v, valid, k_scale=k_scale, v_scale=v_scale,
+        block_table=block_table, dequant_dtype=dequant_dtype)
+
+
+def pallas_decode_eligible(q, k, v, k_scale=None,
+                           block_table=None) -> bool:
+    """Shape eligibility for the Mosaic kernel (the backend capability
+    itself is the registry probe's job). Mirrors `_pallas_eligible` in
+    ops.flash_attention: tile-aligned or stay on the stock lowering."""
+    del v, k_scale
+    _, s, n_heads, head_dim = q.shape
+    kv_heads = k.shape[-2]
+    if s > _MAX_QUERY_WINDOW:
+        return False
+    if n_heads % kv_heads != 0:
+        return False
+    if head_dim % 128 != 0:
+        return False
+    if block_table is not None:
+        block_size = k.shape[1]
+        return block_size % 128 == 0
+    return k.shape[1] % 128 == 0
+
+
+def xla_decode_attention(q, k, v, valid, *, k_scale=None, v_scale=None,
+                         block_table=None, dequant_dtype=None):
+    """The stock lowering, kept op-for-op identical to the pre-seam
+    model path so greedy decode through the dispatcher is
+    token-identical on CPU tier-1: paged pools gather into the
+    contiguous virtual lane with ``jnp.take`` (then dequantize the
+    gathered window), slot int8 caches dequantize in place, GQA
+    repeats KV heads, and the dense fused softmax chain finishes."""
+    dt = dequant_dtype if dequant_dtype is not None else jnp.float32
+    if block_table is not None:
+        num_blocks, block_size = k.shape[:2]
+        batch = q.shape[0]
+        virt_len = block_table.shape[-1] * block_size
+        flat_k = k.reshape(num_blocks * block_size, *k.shape[2:])
+        flat_v = v.reshape(num_blocks * block_size, *v.shape[2:])
+        gather_idx = ((block_table * block_size)[:, :, None] +
+                      jnp.arange(block_size)[None, None, :]
+                      ).reshape(batch, virt_len)
+        k = jnp.take(flat_k, gather_idx, axis=0)
+        v = jnp.take(flat_v, gather_idx, axis=0)
+        if k_scale is not None:
+            flat_ks = k_scale.reshape(num_blocks * block_size, -1)
+            flat_vs = v_scale.reshape(num_blocks * block_size, -1)
+            k = dequantize_kv(k, jnp.take(flat_ks, gather_idx, axis=0), dt)
+            v = dequantize_kv(v, jnp.take(flat_vs, gather_idx, axis=0), dt)
+    elif k_scale is not None:
+        k = dequantize_kv(k, k_scale, dt)
+        v = dequantize_kv(v, v_scale, dt)
+    n_heads, kv_heads = q.shape[2], k.shape[2]
+    if kv_heads != n_heads:
+        rep = n_heads // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return dot_product_attention(q, k, v, mask=valid[:, None])
+
+
+def _decode_kernel(table_ref, *refs, scale, n_blocks, quantized, dt):
+    """One (lane, query head, block) grid step: the BlockSpec index
+    maps already routed the lane's j-th physical block into VMEM via
+    ``table_ref`` — the kernel only sees ``[block_size, head_dim]``
+    tiles and keeps online-softmax stats in scratch across the
+    sequential block axis (same scheme as block_sparse_attention)."""
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, mask_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # [S, D]
+    k = k_ref[0, :, 0, :]                        # [block, D]
+    v = v_ref[0, :, 0, :]
+    if quantized:
+        # in-register per-(token, head) dequant — the pool stays int8
+        # in HBM; rounding through `dt` mirrors ops.int8_matmul.
+        # dequantize_kv so margins match the xla lowering
+        k = (k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]).astype(dt)
+        v = (v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]).astype(dt)
+    scores = jax.lax.dot_general(
+        q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [S, block]
+    scores = jnp.where(mask_ref[0] > 0, scores, _NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]              # [S, 1]
+    m_new = jnp.maximum(m_prev, scores.max(-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)
+    probs = jnp.exp(scores - m_new)
+    l_ref[...] = l_prev * correction + probs.sum(-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        probs, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [S, D]
+    acc_ref[...] = acc_ref[...] * correction + pv
+    m_ref[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def pallas_decode_attention(q, k, v, valid, *, k_scale=None,
+                            v_scale=None, block_table=None,
+                            dequant_dtype=None, block_size: int = 128,
+                            interpret: bool = False):
+    """Fused paged decode attention. Same contract as
+    :func:`decode_attention`; slot caches (``block_table=None``) are
+    viewed as ``max_len // block_size`` pool blocks per lane with an
+    arange table, so one kernel serves both layouts."""
+    batch, s, n_heads, head_dim = q.shape
+    kv_heads = k.shape[-2]
+    rep = n_heads // kv_heads
+    dt = dequant_dtype if dequant_dtype is not None else jnp.float32
+    quantized = k_scale is not None
+
+    if block_table is None:
+        max_len = k.shape[1]
+        if max_len % block_size != 0:
+            raise ValueError(
+                f"slot cache length {max_len} not divisible by "
+                f"block_size {block_size}; dispatch eligibility should "
+                "have routed this shape to the xla lowering")
+        blocks_per_lane = max_len // block_size
+        k = k.reshape(batch * blocks_per_lane, block_size,
+                      kv_heads, head_dim)
+        v = v.reshape(batch * blocks_per_lane, block_size,
+                      kv_heads, head_dim)
+        if quantized:
+            k_scale = k_scale.reshape(batch * blocks_per_lane,
+                                      block_size, kv_heads)
+            v_scale = v_scale.reshape(batch * blocks_per_lane,
+                                      block_size, kv_heads)
+        block_table = (jnp.arange(batch, dtype=jnp.int32)[:, None] *
+                       blocks_per_lane +
+                       jnp.arange(blocks_per_lane, dtype=jnp.int32)[None])
+    else:
+        block_size = k.shape[1]
+        blocks_per_lane = block_table.shape[-1]
+
+    qt = q.transpose(0, 2, 1, 3)                 # [B, H, S, D]
+    mask = valid.astype(jnp.int32)               # [B, S, virt_len]
+
+    def kv_map(b, h, j, table):
+        # the whole point: the lane's j-th PHYSICAL block comes out of
+        # the pool directly — no gather into a virtual lane
+        return (table[b, j], 0, h // rep, 0)
+
+    def scale_map(b, h, j, table):
+        return (table[b, j], 0, h // rep)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, s, head_dim),
+                     lambda b, h, j, table: (b, h, 0, 0)),      # q
+        pl.BlockSpec((1, block_size, 1, head_dim), kv_map),     # k pool
+        pl.BlockSpec((1, block_size, 1, head_dim), kv_map),     # v pool
+    ]
+    operands = [qt, k, v]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, block_size, 1), scale_map),
+                     pl.BlockSpec((1, block_size, 1), scale_map)]
+        operands += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, s, block_size),
+                                 lambda b, h, j, table: (b, 0, j)))
+    operands.append(mask)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=1.0 / math.sqrt(head_dim),
+        n_blocks=blocks_per_lane, quantized=quantized, dt=dt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, n_heads, blocks_per_lane),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, s, head_dim),
+                               lambda b, h, j, table: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s, head_dim), jnp.float32),
+            pltpu.VMEM((s, 1), jnp.float32),
+            pltpu.VMEM((s, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), *operands)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,349 @@
+"""Fused LM-head + cross-entropy Mosaic kernel (logits never live).
+
+The chunked XLA lowering (`ops/fused_ce.fused_lm_head_ce`) already
+bounds peak logits memory to one sequence chunk; this kernel takes the
+same idea to its limit: the ``[T, V]`` logits never exist outside a
+``[block_t, block_v]`` VMEM tile. The forward streams vocab tiles per
+token tile, keeping online-logsumexp / gold-logit / running-argmax
+stats in scratch; the backward recomputes each tile's scores (flash
+style — nothing but per-token ``lse`` is saved) and accumulates
+``d·Kᵀ`` / ``xᵀ·d`` without materializing ``d`` beyond one tile.
+
+Dispatch (fengshen_tpu/ops/pallas/__init__.py): ``fused_ce_loss``
+routes to :func:`pallas_fused_ce` on a Mosaic-capable backend with
+tile-aligned shapes, else :func:`xla_fused_ce` — the stock chunked
+scan, so CPU tier-1 pins the loss path bit-for-bit. The vocab-SHARDED
+variant (tensor-parallel LM head) is
+``parallel.cross_entropy.fused_vocab_parallel_ce``, which runs this
+seam per shard with the mpu-style collectives outside.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fengshen_tpu.ops.fused_ce import fused_lm_head_ce
+
+_NEG_INF = -1e30
+
+
+def fused_ce_loss(hidden: jax.Array, kernel: jax.Array,
+                  labels: jax.Array, num_chunks: int = 8,
+                  ignore_index: int = -100,
+                  impl: Optional[str] = None,
+                  interpret: bool = False):
+    """Dispatch seam for the fused LM-head CE: hidden ``[B, S, H]`` @
+    kernel ``[H, V]`` scored against labels ``[B, S]`` →
+    (mean_loss, n_valid, n_correct), full logits never materialized.
+    ``impl=None`` asks the capability probe + shape eligibility."""
+    if impl is None:
+        from fengshen_tpu.ops.pallas import probe
+        use_pallas = probe().pallas_tpu and pallas_ce_eligible(hidden,
+                                                              kernel)
+        impl = "pallas" if use_pallas else "xla"
+    if impl == "pallas":
+        return pallas_fused_ce(hidden, kernel, labels,
+                               num_chunks=num_chunks,
+                               ignore_index=ignore_index,
+                               interpret=interpret)
+    return xla_fused_ce(hidden, kernel, labels, num_chunks=num_chunks,
+                        ignore_index=ignore_index)
+
+
+def pallas_ce_eligible(hidden, kernel) -> bool:
+    """Tile alignment for the Mosaic path: hidden dim and vocab must
+    split into 128-multiple lanes."""
+    return kernel.shape[0] % 128 == 0 and kernel.shape[1] % 128 == 0
+
+
+def xla_fused_ce(hidden, kernel, labels, num_chunks: int = 8,
+                 ignore_index: int = -100):
+    """The stock lowering: the seq-chunked ``lax.scan`` +
+    ``jax.checkpoint`` fused head (ops/fused_ce.py), unchanged — the
+    trainer's pre-seam loss path, so dispatch through here is
+    bit-identical on CPU tier-1."""
+    return fused_lm_head_ce(hidden, kernel, labels,
+                            num_chunks=num_chunks,
+                            ignore_index=ignore_index)
+
+
+# -- forward kernel -----------------------------------------------------
+
+def _ce_fwd_kernel(x_ref, k_ref, lab_ref, lse_ref, gold_ref, amax_ref,
+                   m_ref, l_ref, g_ref, av_ref, ai_ref, *,
+                   n_vblocks, block_v):
+    """Grid (token tiles, vocab tiles), vocab innermost sequential.
+    Scratch carries per-token online stats across vocab tiles: running
+    max/sum (logsumexp), the gold logit (exactly one tile contributes),
+    and the running argmax (value + global index, first-max tie rule
+    like ``jnp.argmax``)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        g_ref[...] = jnp.zeros_like(g_ref)
+        av_ref[...] = jnp.full_like(av_ref, _NEG_INF)
+        ai_ref[...] = jnp.zeros_like(ai_ref)
+
+    x = x_ref[...].astype(jnp.float32)               # [bt, H]
+    kb = k_ref[...].astype(jnp.float32)              # [H, bv]
+    scores = jax.lax.dot_general(
+        x, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bt, bv]
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    lab = lab_ref[0][:, None]                        # [bt, 1]
+
+    m_prev = m_ref[...]                              # [bt, 1]
+    m_new = jnp.maximum(m_prev, scores.max(-1, keepdims=True))
+    l_ref[...] = (l_ref[...] * jnp.exp(m_prev - m_new) +
+                  jnp.exp(scores - m_new).sum(-1, keepdims=True))
+    m_ref[...] = m_new
+    g_ref[...] += jnp.where(cols == lab, scores,
+                            0.0).sum(-1, keepdims=True)
+    tile_val = scores.max(-1, keepdims=True)
+    tile_arg = (jnp.argmax(scores, axis=-1)[:, None].astype(jnp.int32) +
+                j * block_v)
+    better = tile_val > av_ref[...]
+    ai_ref[...] = jnp.where(better, tile_arg, ai_ref[...])
+    av_ref[...] = jnp.maximum(av_ref[...], tile_val)
+
+    @pl.when(j == n_vblocks - 1)
+    def _finalize():
+        lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse_ref[0, :] = lse[:, 0]
+        gold_ref[0, :] = g_ref[...][:, 0]
+        amax_ref[0, :] = ai_ref[...][:, 0]
+
+
+# -- backward kernels (flash-style recompute; only lse is saved) --------
+
+def _ce_bwd_dx_kernel(x_ref, k_ref, lab_ref, lse_ref, c_lse_ref,
+                      c_gold_ref, dx_ref, acc_ref, *,
+                      n_vblocks, block_v):
+    """dlogits = c_lse·softmax + c_gold·onehot, one vocab tile at a
+    time; dx accumulates ``dlogits @ Kᵀ`` across the tiles."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    kb = k_ref[...].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        x, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(scores - lse_ref[0][:, None])
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    onehot = (cols == lab_ref[0][:, None]).astype(jnp.float32)
+    d = (p * c_lse_ref[0][:, None] +
+         onehot * c_gold_ref[0][:, None])            # [bt, bv]
+    acc_ref[...] += jax.lax.dot_general(
+        d, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bt, H]
+
+    @pl.when(j == n_vblocks - 1)
+    def _finalize():
+        dx_ref[...] = acc_ref[...].astype(dx_ref.dtype)
+
+
+def _ce_bwd_dk_kernel(x_ref, k_ref, lab_ref, lse_ref, c_lse_ref,
+                      c_gold_ref, dk_ref, acc_ref, *,
+                      n_tblocks, block_v):
+    """Same tile recompute, token tiles innermost: dK accumulates
+    ``xᵀ @ dlogits`` for one vocab stripe across all token tiles."""
+    i = pl.program_id(0)
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    kb = k_ref[...].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        x, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(scores - lse_ref[0][:, None])
+    cols = i * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    onehot = (cols == lab_ref[0][:, None]).astype(jnp.float32)
+    d = (p * c_lse_ref[0][:, None] +
+         onehot * c_gold_ref[0][:, None])
+    acc_ref[...] += jax.lax.dot_general(
+        x, d, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [H, bv]
+
+    @pl.when(t == n_tblocks - 1)
+    def _finalize():
+        dk_ref[...] = acc_ref[...].astype(dk_ref.dtype)
+
+
+def _pick_block(dim: int, candidates=(512, 256, 128)) -> int:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return dim
+
+
+def _token_stats_impl(x, kernel, labels, block_t, block_v, interpret):
+    n_t, hid = x.shape
+    vocab = kernel.shape[1]
+    n_tblocks, n_vblocks = n_t // block_t, vocab // block_v
+    lab2 = labels.astype(jnp.int32)[None]            # [1, T]
+    kernel_fn = functools.partial(_ce_fwd_kernel, n_vblocks=n_vblocks,
+                                  block_v=block_v)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_tblocks, n_vblocks),
+        in_specs=[
+            pl.BlockSpec((block_t, hid), lambda i, j: (i, 0)),
+            pl.BlockSpec((hid, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.int32),
+        ],
+    )
+    lse, gold, amax = pl.pallas_call(
+        kernel_fn, grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_t), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_t), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_t), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x, kernel, lab2)
+    return lse[0], gold[0], amax[0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _token_stats(x, kernel, labels, block_t, block_v, interpret):
+    """x [T, H], kernel [H, V], labels [T] →
+    (lse [T], gold logit [T], argmax id [T] int32)."""
+    return _token_stats_impl(x, kernel, labels, block_t, block_v,
+                             interpret)
+
+
+def _token_stats_fwd(x, kernel, labels, block_t, block_v, interpret):
+    lse, gold, amax = _token_stats_impl(x, kernel, labels, block_t,
+                                        block_v, interpret)
+    return (lse, gold, amax), (x, kernel, labels, lse)
+
+
+def _token_stats_bwd(block_t, block_v, interpret, res, cts):
+    x, kernel, labels, lse = res
+    c_lse, c_gold, _ = cts                           # amax: int, no grad
+    n_t, hid = x.shape
+    vocab = kernel.shape[1]
+    n_tblocks, n_vblocks = n_t // block_t, vocab // block_v
+    lab2 = labels.astype(jnp.int32)[None]
+    lse2 = lse[None]
+    c_lse2 = c_lse.astype(jnp.float32)[None]
+    c_gold2 = c_gold.astype(jnp.float32)[None]
+
+    row_specs = [
+        pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+        pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+        pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+        pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+    ]
+    dx_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_tblocks, n_vblocks),
+        in_specs=[
+            pl.BlockSpec((block_t, hid), lambda i, j: (i, 0)),
+            pl.BlockSpec((hid, block_v), lambda i, j: (0, j)),
+            *row_specs,
+        ],
+        out_specs=pl.BlockSpec((block_t, hid), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_t, hid), jnp.float32)],
+    )
+    dx = pl.pallas_call(
+        functools.partial(_ce_bwd_dx_kernel, n_vblocks=n_vblocks,
+                          block_v=block_v),
+        grid_spec=dx_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, kernel, lab2, lse2, c_lse2, c_gold2)
+
+    row_specs_t = [
+        pl.BlockSpec((1, block_t), lambda i, t: (0, t)),
+        pl.BlockSpec((1, block_t), lambda i, t: (0, t)),
+        pl.BlockSpec((1, block_t), lambda i, t: (0, t)),
+        pl.BlockSpec((1, block_t), lambda i, t: (0, t)),
+    ]
+    dk_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_vblocks, n_tblocks),
+        in_specs=[
+            pl.BlockSpec((block_t, hid), lambda i, t: (t, 0)),
+            pl.BlockSpec((hid, block_v), lambda i, t: (0, i)),
+            *row_specs_t,
+        ],
+        out_specs=pl.BlockSpec((hid, block_v), lambda i, t: (0, i)),
+        scratch_shapes=[pltpu.VMEM((hid, block_v), jnp.float32)],
+    )
+    dk = pl.pallas_call(
+        functools.partial(_ce_bwd_dk_kernel, n_tblocks=n_tblocks,
+                          block_v=block_v),
+        grid_spec=dk_spec,
+        out_shape=jax.ShapeDtypeStruct(kernel.shape, kernel.dtype),
+        interpret=interpret,
+    )(x, kernel, lab2, lse2, c_lse2, c_gold2)
+    return dx, dk, None
+
+
+_token_stats.defvjp(_token_stats_fwd, _token_stats_bwd)
+
+
+def pallas_fused_ce(hidden: jax.Array, kernel: jax.Array,
+                    labels: jax.Array, num_chunks: int = 8,
+                    ignore_index: int = -100,
+                    block_t: int = 256, block_v: Optional[int] = None,
+                    interpret: bool = False):
+    """Mosaic fused-head CE. Same contract as
+    ``ops.fused_ce.fused_lm_head_ce`` (``num_chunks`` is accepted for
+    signature parity and ignored — the kernel's tiling replaces it):
+    returns (mean_loss, n_valid, n_correct), differentiable w.r.t.
+    hidden and kernel."""
+    del num_chunks
+    bsz, seq, hid = hidden.shape
+    n_t = bsz * seq
+    x = hidden.reshape(n_t, hid)
+    lab = labels.reshape(n_t)
+    block_t = _pick_block(n_t, (block_t, 256, 128, 8))
+    if n_t % block_t:
+        pad = block_t - n_t % block_t
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        lab = jnp.pad(lab, (0, pad), constant_values=ignore_index)
+    if block_v is None:
+        block_v = _pick_block(kernel.shape[1])
+    lse, gold, amax = _token_stats(x, kernel, lab, block_t, block_v,
+                                   interpret)
+    valid = lab != ignore_index
+    token_loss = (lse - gold) * valid
+    n_valid = valid.sum()
+    n_correct = ((amax == lab) & valid).sum()
+    return (token_loss.sum() / jnp.maximum(n_valid, 1),
+            n_valid, n_correct)

@@ -31,7 +31,10 @@ from fengshen_tpu.parallel.partition import (
     shard_batch_spec,
     tree_paths,
 )
-from fengshen_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from fengshen_tpu.parallel.cross_entropy import (
+    fused_vocab_parallel_ce,
+    vocab_parallel_cross_entropy,
+)
 from fengshen_tpu.parallel.pipeline import (pipeline_apply,
                                             pipeline_train_step_1f1b)
 
@@ -53,6 +56,7 @@ __all__ = [
     "named_sharding",
     "shard_batch_spec",
     "tree_paths",
+    "fused_vocab_parallel_ce",
     "vocab_parallel_cross_entropy",
     "pipeline_apply",
     "pipeline_train_step_1f1b",

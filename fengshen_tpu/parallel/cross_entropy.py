@@ -16,6 +16,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from fengshen_tpu.compat import shard_map
 
@@ -126,3 +127,105 @@ def vocab_parallel_cross_entropy(logits: jax.Array, targets: jax.Array,
     token_loss = token_loss * valid
     n_valid = jnp.maximum(valid.sum(), 1)
     return token_loss.sum() / n_valid, valid.sum()
+
+
+def _fused_sharded_block(hidden: jax.Array, kernel: jax.Array,
+                         targets: jax.Array, *, axis_name: str,
+                         num_chunks: int, ignore_index: int):
+    """Per-shard fused LM-head + CE body: hidden ``[b, s, H]`` (local
+    batch/seq shard), kernel ``[H, V/t]`` (local vocab shard), targets
+    global ids. Runs the head matmul per sequence chunk inside a
+    ``lax.scan`` with ``jax.checkpoint`` (the ops/fused_ce.py scheme),
+    so only one ``[b, chunk, V/t]`` logits slice is ever live; each
+    chunk's CE reuses :func:`_sharded_ce_block` verbatim — per-token
+    reductions are row-independent, which is what keeps the chunked
+    loss bitwise equal to the whole-sequence one.
+
+    Returns per-token ``(loss, predicted id)`` — the global argmax
+    (pmax on the value, pmin on the candidate id) follows
+    ``jnp.argmax``'s lowest-index tie rule across shards."""
+    b, s, hd = hidden.shape
+    vocab_shard = kernel.shape[-1]
+    vocab_start = jax.lax.axis_index(axis_name) * vocab_shard
+    nc = min(num_chunks, s)
+    padded = s
+    if s % nc:
+        pad = nc - s % nc
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)),
+                          constant_values=ignore_index)
+        padded = s + pad
+    chunk = padded // nc
+    hidden_c = jnp.moveaxis(hidden.reshape(b, nc, chunk, hd), 1, 0)
+    targets_c = jnp.moveaxis(targets.reshape(b, nc, chunk), 1, 0)
+
+    @jax.checkpoint
+    def chunk_stats(h, t):
+        # the ONLY live logits: one [b, chunk, V/t] slice
+        logits = h @ kernel
+        token_loss = _sharded_ce_block(logits, t, axis_name,
+                                       ignore_index)
+        f32 = logits.astype(jnp.float32)
+        local_max = jax.lax.stop_gradient(f32.max(-1))
+        local_arg = f32.argmax(-1).astype(jnp.int32) + vocab_start
+        global_max = jax.lax.pmax(local_max, axis_name)
+        candidate = jnp.where(local_max == global_max, local_arg,
+                              jnp.int32(2**31 - 1))
+        pred = jax.lax.pmin(candidate, axis_name)
+        return token_loss, pred
+
+    def body(carry, xs):
+        h, t = xs
+        return carry, chunk_stats(h, t)
+
+    _, (token_loss, pred) = lax.scan(body, None, (hidden_c, targets_c))
+    token_loss = jnp.moveaxis(token_loss, 0, 1).reshape(b, padded)[:, :s]
+    pred = jnp.moveaxis(pred, 0, 1).reshape(b, padded)[:, :s]
+    return token_loss, pred
+
+
+def fused_vocab_parallel_ce(hidden: jax.Array, kernel: jax.Array,
+                            targets: jax.Array,
+                            mesh: Optional[Mesh] = None,
+                            num_chunks: int = 8,
+                            ignore_index: int = -100
+                            ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused LM-head + CE over a vocab-SHARDED head: hidden ``[B, S,
+    H]`` @ kernel ``[H, V]`` (sharded on V along 'tensor') scored
+    against targets ``[B, S]`` → (mean_loss, n_valid, n_correct).
+
+    The upgrade the kernel layer brings to this module
+    (docs/kernels.md): under tensor parallelism the trainer previously
+    had to materialize the full sharded ``[B, S, V/t]`` logits tensor
+    to feed :func:`vocab_parallel_cross_entropy`; this runs the head
+    matmul chunk-by-chunk inside the shard, so peak logits memory
+    drops by the chunk factor AND the vocab stays sharded — the mpu
+    collectives (global max / sum-exp / gold psum) are unchanged,
+    reused per chunk, which keeps the loss bitwise equal to the
+    unfused path. Falls back to the replicated fused seam
+    (``ops.pallas.fused_ce_loss``) when no mesh / no tensor axis /
+    vocab not divisible."""
+    mesh = mesh or get_mesh()
+    tensor = 0 if mesh is None else mesh.shape.get(TENSOR_AXIS, 1)
+    if mesh is None or tensor <= 1 or kernel.shape[-1] % tensor != 0:
+        from fengshen_tpu.ops.pallas.fused_ce import fused_ce_loss
+        return fused_ce_loss(hidden, kernel, targets,
+                             num_chunks=num_chunks,
+                             ignore_index=ignore_index)
+    lead = _leading_dims_spec(targets.shape, mesh)
+    batch_spec = P(*lead)
+
+    token_loss, pred = shard_map(
+        partial(_fused_sharded_block, axis_name=TENSOR_AXIS,
+                num_chunks=num_chunks, ignore_index=ignore_index),
+        mesh=mesh,
+        in_specs=(P(*lead, None), P(None, TENSOR_AXIS), batch_spec),
+        out_specs=(batch_spec, batch_spec),
+        check_vma=False,
+    )(hidden, kernel, targets)
+
+    valid = targets != ignore_index
+    token_loss = token_loss * valid
+    n_valid = jnp.maximum(valid.sum(), 1)
+    n_correct = ((pred == targets) & valid).sum()
+    return token_loss.sum() / n_valid, valid.sum(), n_correct

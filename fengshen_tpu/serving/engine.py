@@ -72,6 +72,7 @@ import numpy as np
 
 from fengshen_tpu.observability import (RequestTimeline,
                                         record_warmup_seconds, span)
+from fengshen_tpu.ops.pallas import kernel_fingerprint, log_dispatch
 from fengshen_tpu.serving.buckets import DEFAULT_BUCKETS, BucketLadder
 from fengshen_tpu.serving.cache import (assign_slot, init_slot_cache,
                                         reset_free_slots, rollback_slots)
@@ -307,6 +308,11 @@ class ContinuousBatchingEngine:
             # timelines to every post-mortem bundle
             self._log = recorder.wrap_sink(self._log)
             recorder.attach("engine", self._debug_bundle)
+        # THE loud kernel line (docs/kernels.md): state the dispatch
+        # decision for every registered kernel once at startup and set
+        # the fstpu_kernel_dispatch gauge — a fleet that silently
+        # degraded to the xla lowering must be visible to a scraper
+        log_dispatch(self._log)
         self.max_len = int(model.config.max_position_embeddings)
         self.paged = config.kv_layout == "paged"
         self.spec = config.spec_mode != "off"
@@ -531,8 +537,12 @@ class ContinuousBatchingEngine:
             # everything the closures bake into the traced programs
             # beyond argument avals — gates trusted manifest replay
             # (docs/aot_cache.md): config drift must demote replay to
-            # the verified lower-and-hash path
-            fp = f"{model.config!r}::{config!r}"
+            # the verified lower-and-hash path. The kernel dispatch
+            # table is part of that identity: a pallas-compiled decode
+            # must never be replayed on an xla-dispatch process
+            # (docs/kernels.md)
+            fp = (f"{model.config!r}::{config!r}"
+                  f"::{kernel_fingerprint()}")
             self._prefill_jit = aot.wrap(prefill_fn, "serving/prefill",
                                          fingerprint_extra=fp)
             self._assign_jit = aot.wrap(assign_fn, "serving/assign",

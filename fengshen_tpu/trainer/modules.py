@@ -43,6 +43,20 @@ class CausalLMModule(TrainModule):
         mesh = get_mesh()
         return mesh is None or mesh.shape.get("tensor", 1) == 1
 
+    def _fused_ce_mode(self) -> str:
+        """Which fused-head path training_loss takes: ``"off"`` (no
+        fused_ce_chunks — plain logits + vocab-parallel CE),
+        ``"replicated"`` (the chunked scan over a replicated head, via
+        the ops.pallas fused_ce dispatch seam), or ``"vocab_parallel"``
+        (tensor-parallel head: the chunked fused CE runs INSIDE the
+        vocab shard_map, so neither the full nor the sharded [B, S, V]
+        logits ever materialize — docs/kernels.md)."""
+        chunks = getattr(self.config, "fused_ce_chunks", 0)
+        if not chunks:
+            return "off"
+        return "replicated" if self._fused_ce_active() else \
+            "vocab_parallel"
+
     def _lm_head_kernel(self, params):
         """[H, V] head weight for the fused path. Models may publish
         their own lookup (GPT2's wte-tied head); the default covers the
@@ -59,17 +73,25 @@ class CausalLMModule(TrainModule):
         extra = {}
         if "position_ids" in batch:  # packed rows restart positions
             extra["position_ids"] = batch["position_ids"]
-        if self._fused_ce_active():
-            from fengshen_tpu.ops.fused_ce import causal_fused_loss
+        mode = self._fused_ce_mode()
+        if mode != "off":
             hidden, mutated = self.model.apply(
                 {"params": params}, batch["input_ids"],
                 attention_mask=batch.get("attention_mask"),
                 deterministic=False, mutable=["losses"],
                 rngs={"dropout": rng}, return_hidden=True, **extra)
             kernel = self._lm_head_kernel(params).astype(hidden.dtype)
-            loss, n_tokens, n_correct = causal_fused_loss(
-                hidden, kernel, labels,
-                num_chunks=self.config.fused_ce_chunks)
+            if mode == "vocab_parallel":
+                from fengshen_tpu.parallel.cross_entropy import (
+                    fused_vocab_parallel_ce)
+                loss, n_tokens, n_correct = fused_vocab_parallel_ce(
+                    hidden[:, :-1], kernel, labels[:, 1:],
+                    num_chunks=self.config.fused_ce_chunks)
+            else:
+                from fengshen_tpu.ops.fused_ce import causal_fused_loss
+                loss, n_tokens, n_correct = causal_fused_loss(
+                    hidden, kernel, labels,
+                    num_chunks=self.config.fused_ce_chunks)
             metrics = {"acc": n_correct / jnp.maximum(n_tokens, 1),
                        "n_tokens": n_tokens}
             aux_leaves = jax.tree_util.tree_leaves(

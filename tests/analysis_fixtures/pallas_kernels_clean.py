@@ -1,0 +1,101 @@
+"""known-clean fixture: the kernel dispatch seam idiom
+(docs/kernels.md) — the capability probe runs ONCE on the host and is
+cached, the pallas-vs-xla decision is a plain Python bool resolved at
+trace time (never a traced value, never re-probed inside jit), the
+dispatch gauge and the loud `kernel_dispatch` line land at import/
+startup between jit boundaries, and the traced kernel bodies are pure
+array programs.
+
+Mirrors `fengshen_tpu/ops/pallas/__init__.py` + the decode/CE seams:
+`metrics-in-traced-code`, `blocking-transfer` and `host-divergence`
+must all stay silent here — if one fires, the analyzer would also
+flag the real kernel layer and block the merge gate. The classic
+hazard this shape avoids: calling the probe (an env + backend lookup)
+from INSIDE a traced function, which would make the compiled program
+depend on ambient host state and re-trace per call.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+DISPATCH = REG.gauge("fx_kernel_dispatch", "chosen kernel impl",
+                     labelnames=("op", "impl"))
+
+_PROBE_CACHE = {}
+
+
+def probe():
+    """Host-side capability probe, cached by (backend, force env):
+    runs outside every trace, so the dispatch decision below is a
+    compile-time constant of the program."""
+    key = (jax.default_backend(), os.environ.get("FX_KERNEL_FORCE"))
+    if key not in _PROBE_CACHE:
+        forced = key[1]
+        _PROBE_CACHE[key] = (forced == "pallas") or (
+            forced != "xla" and key[0] == "tpu")
+    return _PROBE_CACHE[key]
+
+
+def _xla_softmax_attn(q, k, v):
+    """The stock lowering: pure array math, fp32 softmax stats."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores / q.shape[-1] ** 0.5, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _blocked_attn(q, k, v):
+    """Stand-in for the Mosaic kernel: same contract, online softmax
+    over k blocks — still a pure traced program, no host pulls."""
+    blk = 128
+    n = k.shape[1] // blk
+
+    def step(carry, i):
+        acc, m, l = carry
+        kb = jax.lax.dynamic_slice_in_dim(k, i * blk, blk, axis=1)
+        vb = jax.lax.dynamic_slice_in_dim(v, i * blk, blk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32)
+        new_m = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - new_m[..., None])
+        corr = jnp.exp(m - new_m)
+        l = l * corr + p.sum(-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vb.dtype), vb)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + o
+        return (acc, new_m, l), None
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((q.shape[0], q.shape[2], q.shape[1]), -1e30,
+                  jnp.float32)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], q.shape[1]), jnp.float32)
+    (acc, _, l), _ = jax.lax.scan(step, (acc0, m0, l0), jnp.arange(n))
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+# the decision is taken ONCE, on the host, while building the program —
+# the jitted fn closes over a concrete Python callable
+_IMPL = _blocked_attn if probe() else _xla_softmax_attn
+DISPATCH.labels("attention", "pallas" if probe() else "xla").set(1.0)
+
+
+@jax.jit
+def attention(q, k, v):
+    """The traced entry point: by the time tracing starts the impl is
+    already a fixed callable; nothing in here reads env, backend, or
+    metrics state."""
+    return _IMPL(q, k, v)
+
+
+def startup_report(log=None):
+    """Loud dispatch line at startup (host-side, between jits):
+    structured event when a sink exists, stderr otherwise."""
+    info = {"event": "kernel_dispatch",
+            "attention": "pallas" if probe() else "xla"}
+    if log is not None:
+        log(info)
+    return info

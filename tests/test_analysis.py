@@ -613,6 +613,32 @@ def test_paged_cache_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_pallas_internals_are_clean():
+    """Regression fixture for the kernel dispatch seam (docs/
+    kernels.md): the capability probe is cached host-side and the
+    pallas-vs-xla decision is a compile-time constant — NOT a value
+    re-read inside a traced function (the retrace hazard the seam
+    exists to avoid) — and the dispatch gauge / loud startup line stay
+    between jit boundaries. Neither `metrics-in-traced-code`,
+    `blocking-transfer` nor `host-divergence` may fire on the fixture
+    or on the real kernel layer + its two biggest consumers (the llama
+    decode path and the serving engine)."""
+    fixture = os.path.join(FIXTURES, "pallas_kernels_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    kernel_layer = [
+        os.path.join(PKG, "ops", "pallas"),
+        os.path.join(PKG, "models", "llama", "modeling_llama.py"),
+        os.path.join(PKG, "serving", "engine.py"),
+    ]
+    findings = check_paths(kernel_layer, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+
 # -- fslint v2: cross-module concurrency rules ------------------------------
 
 

@@ -1,0 +1,439 @@
+"""Kernel layer (fengshen_tpu.ops.pallas): registry/probe mechanics,
+XLA-fallback parity for every dispatch seam, and the bench row
+contract.
+
+Parity doctrine (docs/kernels.md): every Pallas kernel registers next
+to the stock XLA lowering it replaces, the xla lowering is op-for-op
+the pre-seam model code (so CPU tier-1 pins bit-identical decode), and
+the Mosaic path is checked against it in interpret mode — the same
+numerics the TPU kernel runs, executed on the CPU backend.
+"""
+
+import argparse
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fengshen_tpu.ops.pallas import (FORCE_ENV, dispatch_table,
+                                     get_kernel, kernel_choice,
+                                     kernel_fingerprint, log_dispatch,
+                                     probe)
+from fengshen_tpu.ops.pallas.decode_attention import (
+    decode_attention, pallas_decode_attention, pallas_decode_eligible,
+    xla_decode_attention)
+
+
+@pytest.fixture
+def fresh_probe(monkeypatch):
+    """Each force-env scenario re-probes; the cache key includes the
+    env var so leaving it unset afterwards restores the real answer."""
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    yield monkeypatch
+    probe(refresh=True)
+
+
+# -- registry + probe ---------------------------------------------------
+
+
+def test_probe_cached_and_forceable(fresh_probe):
+    info = probe(refresh=True)
+    assert info.backend == "cpu"
+    assert not info.pallas_tpu
+    assert "cpu" in info.reason
+    # cached: the second call answers from the dict, same object
+    assert probe() is info
+
+    fresh_probe.setenv(FORCE_ENV, "pallas")
+    forced = probe()
+    assert forced.pallas_tpu and forced.forced == "pallas"
+    fresh_probe.setenv(FORCE_ENV, "xla")
+    assert not probe().pallas_tpu
+
+
+def test_dispatch_table_and_fingerprint(fresh_probe):
+    table = dispatch_table()
+    for op in ("decode_attention", "fused_ce", "flash_attention",
+               "block_sparse_attention"):
+        assert table[op] == "xla"  # CPU backend: stock lowerings
+    fp = kernel_fingerprint()
+    assert fp.startswith("kernels=") and fp.endswith(";backend=cpu")
+    assert "decode_attention:xla" in fp
+
+    # the AOT-key contract: a forced-pallas process fingerprints
+    # differently, so it can never replay an xla-dispatch executable
+    fresh_probe.setenv(FORCE_ENV, "pallas")
+    assert "decode_attention:pallas" in kernel_fingerprint()
+
+
+def test_get_kernel_resolution(fresh_probe):
+    assert get_kernel("decode_attention") is xla_decode_attention
+    assert get_kernel("decode_attention",
+                      "pallas") is pallas_decode_attention
+    with pytest.raises(KeyError):
+        get_kernel("nonexistent_op")
+    with pytest.raises(KeyError):
+        # block-sparse's fallback lives in ops.attention, not here
+        get_kernel("block_sparse_attention", "xla")
+
+
+def test_log_dispatch_event_and_gauge(fresh_probe):
+    from fengshen_tpu.observability.registry import MetricsRegistry
+
+    events = []
+    reg = MetricsRegistry()
+    table = log_dispatch(events.append, registry=reg)
+    assert table == dispatch_table()
+    (event,) = events
+    assert event["event"] == "kernel_dispatch"
+    assert event["table"]["decode_attention"] == "xla"
+    assert event["backend"] == "cpu" and event["reason"]
+    gauge = reg.gauge("fstpu_kernel_dispatch", "",
+                      labelnames=("op", "impl"))
+    assert gauge.labels("decode_attention", "xla").value == 1.0
+    assert gauge.labels("decode_attention", "pallas").value == 0.0
+
+
+# -- decode attention: the stock-math pin -------------------------------
+
+
+def _stock_decode(q, k, v, valid, k_scale=None, v_scale=None,
+                  block_table=None, dt=jnp.float32):
+    """The pre-seam model path, inlined from what
+    `_update_paged_cache`/`_update_cache` + the attention call used to
+    do: take-gather, dequantize, GQA repeat, dense attention."""
+    from fengshen_tpu.ops.attention import dot_product_attention
+    from fengshen_tpu.ops.int8_matmul import dequantize_kv
+
+    if block_table is not None:
+        nb, bs = k.shape[:2]
+        batch = q.shape[0]
+        idx = ((block_table * bs)[:, :, None] +
+               jnp.arange(bs)[None, None, :]).reshape(batch, -1)
+        k = jnp.take(k.reshape(nb * bs, *k.shape[2:]), idx, axis=0)
+        v = jnp.take(v.reshape(nb * bs, *v.shape[2:]), idx, axis=0)
+        if k_scale is not None:
+            ks = jnp.take(k_scale.reshape(nb * bs, -1), idx, axis=0)
+            vs = jnp.take(v_scale.reshape(nb * bs, -1), idx, axis=0)
+            k, v = dequantize_kv(k, ks, dt), dequantize_kv(v, vs, dt)
+    elif k_scale is not None:
+        k = dequantize_kv(k, k_scale, dt)
+        v = dequantize_kv(v, v_scale, dt)
+    rep = q.shape[2] // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return dot_product_attention(q, k, v, mask=valid[:, None])
+
+
+def _decode_case(layout, quant, s, rng, batch=2, n_heads=4, kv_heads=2,
+                 head_dim=128, block_size=128, blocks_per_lane=2):
+    """One (layout, dtype, spec_mode) decode combo's operands."""
+    virt = block_size * blocks_per_lane
+    q = jnp.asarray(rng.randn(batch, s, n_heads, head_dim) * 0.3,
+                    jnp.float32)
+    ctx = virt - 37  # ragged fill: the last block is partial
+    valid = jnp.asarray(
+        np.broadcast_to(np.arange(virt) < ctx, (batch, s, virt)).copy())
+    kw = {}
+    if layout == "paged":
+        nb = batch * blocks_per_lane
+        shape = (nb, block_size, kv_heads, head_dim)
+        kw["block_table"] = jnp.asarray(
+            rng.permutation(nb).reshape(batch, blocks_per_lane),
+            jnp.int32)
+    else:
+        shape = (batch, virt, kv_heads, head_dim)
+    if quant:
+        k = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+        v = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+        kw["k_scale"] = jnp.asarray(rng.rand(*shape[:-1]) * 0.02 + 0.001,
+                                    jnp.float32)
+        kw["v_scale"] = jnp.asarray(rng.rand(*shape[:-1]) * 0.02 + 0.001,
+                                    jnp.float32)
+    else:
+        k = jnp.asarray(rng.randn(*shape) * 0.3, jnp.float32)
+        v = jnp.asarray(rng.randn(*shape) * 0.3, jnp.float32)
+    return q, k, v, valid, kw
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("s", [1, 4])  # decode tick / spec-verify window
+def test_xla_decode_is_the_stock_math(layout, quant, s):
+    """The dispatcher's xla lowering must be BITWISE the pre-seam
+    model sequence on every (layout, dtype, spec_mode) combo — this is
+    what makes greedy decode through the seam token-identical."""
+    rng = np.random.RandomState(hash((layout, quant, s)) % 2**31)
+    q, k, v, valid, kw = _decode_case(layout, quant, s, rng)
+    seam = decode_attention(q, k, v, valid, **kw)
+    stock = _stock_decode(q, k, v, valid,
+                          k_scale=kw.get("k_scale"),
+                          v_scale=kw.get("v_scale"),
+                          block_table=kw.get("block_table"))
+    assert seam.shape == q.shape
+    np.testing.assert_array_equal(np.asarray(seam), np.asarray(stock))
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("s", [1, 4])
+def test_pallas_decode_interpret_parity(layout, quant, s):
+    """The Mosaic kernel (interpret mode — same numerics the TPU
+    compiles, run on CPU) against the stock lowering: fp32 tight, int8
+    margin-aware (both paths round through the same dequant dtype, so
+    the tolerance covers only the online-softmax reassociation)."""
+    rng = np.random.RandomState(100 + hash((layout, quant, s)) % 2**31)
+    q, k, v, valid, kw = _decode_case(layout, quant, s, rng)
+    assert pallas_decode_eligible(q, k, v,
+                                  block_table=kw.get("block_table"))
+    ref = xla_decode_attention(q, k, v, valid, **kw)
+    out = pallas_decode_attention(q, k, v, valid, interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_dispatcher_eligibility():
+    """Ineligible shapes (tiny pages, odd head_dim, prefill-length
+    windows) stay on the xla lowering instead of erroring."""
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 1, 4, 64), jnp.float32)  # D=64
+    k = jnp.asarray(rng.randn(2, 256, 2, 64), jnp.float32)
+    assert not pallas_decode_eligible(q, k, k)
+    q2 = jnp.asarray(rng.randn(2, 16, 4, 128), jnp.float32)  # S=16
+    k2 = jnp.asarray(rng.randn(2, 256, 2, 128), jnp.float32)
+    assert not pallas_decode_eligible(q2, k2, k2)
+    # eligible shape, impl override pins each path explicitly
+    q3, k3, v3, valid, kw = _decode_case("slot", False, 1,
+                                         np.random.RandomState(8))
+    a = decode_attention(q3, k3, v3, valid, impl="xla", **kw)
+    b = decode_attention(q3, k3, v3, valid, impl="pallas",
+                         interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-5)
+
+
+# -- orphan adoption: flash + block-sparse fallback parity --------------
+
+
+def test_flash_orphan_interpret_parity():
+    """pallas_flash_attention (GQA, causal) vs the blockwise xla
+    fallback it registers next to."""
+    from fengshen_tpu.ops.flash_attention import blockwise_attention
+    from fengshen_tpu.ops.pallas.flash_attention import (
+        pallas_flash_attention)
+
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 256, 2, 128) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.randn(1, 256, 1, 128) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.randn(1, 256, 1, 128) * 0.3, jnp.float32)
+    out = pallas_flash_attention(q, k, v, causal=True, blk_q=128,
+                                 blk_k=128, interpret=True)
+    ref = blockwise_attention(q, jnp.repeat(k, 2, 2),
+                              jnp.repeat(v, 2, 2), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_block_sparse_orphan_interpret_parity():
+    """block_sparse_attention vs the dense expanded-mask fallback that
+    ops.attention.dot_product_attention uses for ineligible shapes."""
+    from fengshen_tpu.ops.attention import dot_product_attention
+    from fengshen_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+
+    rng = np.random.RandomState(10)
+    blk, n = 128, 2
+    q = jnp.asarray(rng.randn(1, blk * n, 2, 128) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.randn(1, blk * n, 2, 128) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.randn(1, blk * n, 2, 128) * 0.3, jnp.float32)
+    layout = np.tril(np.ones((n, n), bool))
+    out = block_sparse_attention(q, k, v, layout, blk, interpret=True)
+    mask = jnp.asarray(np.kron(layout, np.ones((blk, blk), bool)))
+    ref = dot_product_attention(q, k, v, mask=mask[None, None])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# -- fused CE -----------------------------------------------------------
+
+
+def _ce_case(rng, batch=2, seq=8, hidden_dim=128, vocab=256):
+    hidden = jnp.asarray(rng.randn(batch, seq, hidden_dim) * 0.1,
+                         jnp.float32)
+    kernel = jnp.asarray(rng.randn(hidden_dim, vocab) * 0.1, jnp.float32)
+    labels = np.asarray(rng.randint(0, vocab, (batch, seq)))
+    # some ignored positions + some guaranteed-correct ones (argmax
+    # labels) so n_valid AND n_correct both carry signal
+    labels[0, :2] = -100
+    greedy = np.asarray((hidden @ kernel).argmax(-1))
+    labels[1, :3] = greedy[1, :3]
+    return hidden, kernel, jnp.asarray(labels, jnp.int32)
+
+
+def test_fused_ce_dispatch_is_stock_on_cpu():
+    """fused_ce_loss through the seam == ops.fused_ce.fused_lm_head_ce
+    bitwise (the xla lowering IS that function)."""
+    from fengshen_tpu.ops.fused_ce import fused_lm_head_ce
+    from fengshen_tpu.ops.pallas.fused_ce import fused_ce_loss
+
+    hidden, kernel, labels = _ce_case(np.random.RandomState(11))
+    seam = fused_ce_loss(hidden, kernel, labels, num_chunks=4)
+    stock = fused_lm_head_ce(hidden, kernel, labels, num_chunks=4)
+    for a, b in zip(seam, stock):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_fused_ce_interpret_parity_and_grads():
+    """The Mosaic CE (interpret mode): loss/n_valid/n_correct and the
+    custom-vjp grads against the stock chunked-scan lowering."""
+    from fengshen_tpu.ops.fused_ce import fused_lm_head_ce
+    from fengshen_tpu.ops.pallas.fused_ce import pallas_fused_ce
+
+    hidden, kernel, labels = _ce_case(np.random.RandomState(12))
+    loss, n_valid, n_correct = pallas_fused_ce(hidden, kernel, labels,
+                                               interpret=True)
+    ref_loss, ref_valid, ref_correct = fused_lm_head_ce(
+        hidden, kernel, labels, num_chunks=4)
+    assert int(n_valid) == int(ref_valid)
+    assert int(n_correct) == int(ref_correct) and int(n_correct) >= 3
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+
+    g_pallas = jax.grad(
+        lambda h, w: pallas_fused_ce(h, w, labels, interpret=True)[0],
+        argnums=(0, 1))(hidden, kernel)
+    g_stock = jax.grad(
+        lambda h, w: fused_lm_head_ce(h, w, labels, num_chunks=4)[0],
+        argnums=(0, 1))(hidden, kernel)
+    for gp, gs in zip(g_pallas, g_stock):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_vocab_parallel_ce_bitwise(mesh8):
+    """The sharded-vocab fused CE against the unfused
+    vocab_parallel_cross_entropy on the tier-1 mesh (tensor=2): the
+    per-chunk mpu collectives are the SAME ops on the same rows, so
+    the loss must be bit-equal, never just close — and the full
+    [B, S, V] logits never materialize on the fused side."""
+    from fengshen_tpu.parallel.cross_entropy import (
+        fused_vocab_parallel_ce, vocab_parallel_cross_entropy)
+
+    hidden, kernel, labels = _ce_case(np.random.RandomState(13),
+                                      hidden_dim=16, vocab=64)
+    loss, n_valid, n_correct = fused_vocab_parallel_ce(
+        hidden, kernel, labels, num_chunks=4)
+    ref_loss, ref_valid = vocab_parallel_cross_entropy(
+        hidden @ kernel, labels)
+    assert float(loss) == float(ref_loss)  # bitwise
+    assert int(n_valid) == int(ref_valid)
+    greedy = np.asarray((hidden @ kernel).argmax(-1))
+    want_correct = int(((greedy == np.asarray(labels)) &
+                        (np.asarray(labels) != -100)).sum())
+    assert int(n_correct) == want_correct and want_correct >= 3
+
+    g_fused = jax.grad(lambda h: fused_vocab_parallel_ce(
+        h, kernel, labels, num_chunks=4)[0])(hidden)
+    g_ref = jax.grad(lambda h: vocab_parallel_cross_entropy(
+        h @ kernel, labels)[0])(hidden)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_trainer_routes_vocab_parallel_fused_ce(mesh8):
+    """CausalLMModule under tensor parallelism with fused_ce_chunks:
+    the pinned `_fused_ce_active` gate still reports False (replicated
+    lever off), the NEW mode routes `vocab_parallel`, and the loss
+    equals the plain unfused path."""
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from fengshen_tpu.trainer.modules import CausalLMModule
+
+    base = LlamaConfig(vocab_size=64, hidden_size=32,
+                       intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=4,
+                       max_position_embeddings=32, dtype="float32")
+    args = argparse.Namespace(max_seq_length=16)
+    ids = jnp.asarray(np.random.RandomState(14).randint(0, 63, (2, 16)),
+                      jnp.int32)
+    batch = {"input_ids": ids}
+    rng = jax.random.PRNGKey(0)
+
+    plain = CausalLMModule(args, LlamaForCausalLM(base), base)
+    params = plain.init_params(rng)
+    cfg_f = dataclasses.replace(base, fused_ce_chunks=4)
+    fused = CausalLMModule(args, LlamaForCausalLM(cfg_f), cfg_f)
+
+    assert plain._fused_ce_mode() == "off"
+    assert not fused._fused_ce_active()  # the pinned tensor-par gate
+    assert fused._fused_ce_mode() == "vocab_parallel"
+
+    l_p, m_p = plain.training_loss(params, batch, rng)
+    l_f, m_f = fused.training_loss(params, batch, rng)
+    np.testing.assert_allclose(float(l_p), float(l_f), rtol=1e-6)
+    np.testing.assert_allclose(float(m_p["acc"]), float(m_f["acc"]),
+                               rtol=1e-6)
+
+
+# -- bench rows + benchdiff identity ------------------------------------
+
+
+def test_kernel_bench_rows_smoke(monkeypatch):
+    """The decode + fused-CE rungs run in-process on CPU and emit
+    BENCH-schema rows carrying the kernel dispatch decision."""
+    from fengshen_tpu.ops.pallas.bench import (bench_fused_ce,
+                                               bench_paged_decode)
+
+    monkeypatch.setenv("KERNEL_BENCH_ITERS", "2")
+    monkeypatch.setenv("KERNEL_BENCH_BATCH", "2")
+    monkeypatch.setenv("KERNEL_BENCH_SEQ", "64")
+    monkeypatch.setenv("KERNEL_BENCH_HIDDEN", "64")
+    monkeypatch.setenv("KERNEL_BENCH_VOCAB", "256")
+    for row in (bench_paged_decode(), bench_fused_ce()):
+        for key in ("metric", "value", "unit", "vs_baseline", "kernel",
+                    "backend"):
+            assert key in row, (row["metric"], key)
+        assert row["kernel"] == "xla"  # CPU process
+        assert row["value"] > 0
+
+
+def test_benchdiff_kernel_rows_incomparable():
+    """A Mosaic round and a stock-lowering round measure different
+    programs: benchdiff must diff them as incomparable, never as a
+    regression (same contract as offload placement / fleet replicas)."""
+    from fengshen_tpu.observability.benchdiff import diff_rounds
+
+    rounds = [
+        (1, "BENCH_r01.json", {"rc": 0, "parsed": [
+            {"metric": "kernel_paged_decode_tokens_per_sec",
+             "value": 100.0, "unit": "tokens/s", "vs_baseline": 1.0,
+             "kernel": "xla"}]}),
+        (2, "BENCH_r02.json", {"rc": 0, "parsed": [
+            {"metric": "kernel_paged_decode_tokens_per_sec",
+             "value": 5000.0, "unit": "tokens/s", "vs_baseline": 3.0,
+             "kernel": "pallas"}]}),
+        (3, "BENCH_r03.json", {"rc": 0, "parsed": [
+            {"metric": "kernel_paged_decode_tokens_per_sec",
+             "value": 4000.0, "unit": "tokens/s", "vs_baseline": 2.4,
+             "kernel": "pallas"}]}),
+    ]
+    report = diff_rounds(rounds)
+    statuses = {(c["round"], c["status"])
+                for c in report["comparisons"]}
+    assert (2, "incomparable") in statuses  # xla -> pallas: new program
+    assert (3, "regression") in statuses    # pallas -> pallas: honest
+    assert report["verdict"] == "REGRESSED"
+
+
+def test_engine_aot_key_carries_kernel_fingerprint():
+    """serving/engine.py folds kernel_fingerprint() into the AOT cache
+    identity — source-level pin that a pallas-dispatch process can
+    never replay an xla-dispatch executable (docs/aot_cache.md)."""
+    import inspect
+
+    from fengshen_tpu.serving import engine
+
+    src = inspect.getsource(engine)
+    assert "kernel_fingerprint()" in src

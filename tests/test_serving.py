@@ -305,7 +305,10 @@ def test_engine_log_events(tiny):
     eng.warmup()
     eng.generate_all(_prompts((4,)))
     kinds = [e["event"] for e in events]
-    assert kinds[0] == "serving_warmup"
+    # engine startup states its kernel dispatch decision first
+    # (docs/kernels.md), then warmup reports
+    assert kinds[0] == "kernel_dispatch"
+    assert kinds[1] == "serving_warmup"
     assert "serving_admit" in kinds
     assert "serving_finish" in kinds
 
