@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: lint lint-changed lint-ci lint-baseline test test-fast \
 	serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
-	serve-bench-disagg serve-bench-evac serve-fleet aot-bench \
+	serve-bench-disagg serve-bench-evac serve-bench-multimodal \
+	serve-fleet aot-bench \
 	kernel-bench benchdiff
 
 # whole package, all rules (per-file + the cross-module concurrency
@@ -50,6 +51,15 @@ serve-bench-parity:
 serve-bench-spec:
 	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=spec \
 		SERVE_BENCH_BUCKETS=32,64 SERVE_BENCH_NEW_TOKENS=96 \
+		$(PY) -m fengshen_tpu.serving.bench
+
+# multimodal micro-batch engines (docs/serving.md "Multimodal
+# engines"): batch_image (Taiyi-SD denoise loop) and embedding
+# (Taiyi-CLIP text tower) engine requests/s vs the sequential
+# one-call-per-request path, on the small-test towers — one
+# BENCH-schema JSON line per engine type, each carrying `engine_type`
+serve-bench-multimodal:
+	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=multimodal \
 		$(PY) -m fengshen_tpu.serving.bench
 
 # fleet-router microbench (docs/fleet.md): aggregate tokens/s over

@@ -8,6 +8,19 @@ dryrun caught as an involuntary-rematerialization warning, except
 without the warning). The authoritative axis vocabulary is parsed from
 ``fengshen_tpu/parallel/mesh.py`` (the ``*_AXIS`` constants), so a new
 mesh axis is one edit away from being legal everywhere.
+
+The rule also validates the declarative sharding subsystem's tables
+(docs/sharding.md) statically:
+
+- ``*PARAM_LOGICAL_AXES`` tables (regex → logical-axis tuple): every
+  logical name must be declared in
+  ``fengshen_tpu/sharding/axes.py`` (``LOGICAL_AXES``) — an unknown
+  name would raise at resolution, but only on the code path that
+  resolves it; the fast lane catches it at definition site.
+- ``*LOGICAL_AXIS_RULES`` tables (logical axis → mesh axis): the
+  logical side must be in the vocabulary and any LITERAL mesh axis
+  must exist on the mesh (names imported from mesh.py — ``*_AXIS`` /
+  ``BATCH_AXES`` — are definitionally valid and accepted as-is).
 """
 
 from __future__ import annotations
@@ -19,8 +32,10 @@ from typing import FrozenSet, Optional
 from fengshen_tpu.analysis.registry import Rule, register
 
 MESH_FILE = os.path.join("fengshen_tpu", "parallel", "mesh.py")
+AXES_FILE = os.path.join("fengshen_tpu", "sharding", "axes.py")
 
 _AXES_CACHE: dict = {}
+_LOGICAL_CACHE: dict = {}
 
 
 def mesh_axes(project_root: str) -> Optional[FrozenSet[str]]:
@@ -50,6 +65,40 @@ def mesh_axes(project_root: str) -> Optional[FrozenSet[str]]:
     return axes
 
 
+def logical_axes(project_root: str) -> Optional[FrozenSet[str]]:
+    """Logical-axis vocabulary from sharding/axes.py (the flat literal
+    ``LOGICAL_AXES`` tuple), parsed statically. None when the file is
+    missing (the table checks stay silent)."""
+    if project_root in _LOGICAL_CACHE:
+        return _LOGICAL_CACHE[project_root]
+    path = os.path.join(project_root, AXES_FILE)
+    axes = None
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        tree = None
+    if tree is not None:
+        found = set()
+        for stmt in tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                targets = [stmt.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and \
+                        tgt.id == "LOGICAL_AXES" and \
+                        isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    for elt in stmt.value.elts:
+                        if isinstance(elt, ast.Constant) and \
+                                isinstance(elt.value, str):
+                            found.add(elt.value)
+        axes = frozenset(found) or None
+    _LOGICAL_CACHE[project_root] = axes
+    return axes
+
+
 def _is_spec_call(node: ast.Call, ctx) -> bool:
     qn = ctx.qualname(node.func)
     if qn and qn.rsplit(".", 1)[-1] == "PartitionSpec":
@@ -72,18 +121,43 @@ def _axis_strings(arg):
             yield from _axis_strings(elt)
 
 
+def _table_entries(value):
+    """2-tuples of a literal list/tuple table, skipping anything not
+    shaped like one (computed tables are out of scope)."""
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return
+    for elt in value.elts:
+        if isinstance(elt, (ast.Tuple, ast.List)) and len(elt.elts) == 2:
+            yield elt.elts[0], elt.elts[1]
+
+
+def _assign_name(node) -> Optional[str]:
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+            isinstance(node.targets[0], ast.Name):
+        return node.targets[0].id
+    if isinstance(node, ast.AnnAssign) and node.value is not None and \
+            isinstance(node.target, ast.Name):
+        return node.target.id
+    return None
+
+
 @register
 class PartitionSpecAxes(Rule):
     id = "partition-spec-axes"
     hint = ("use an axis name declared in fengshen_tpu/parallel/mesh.py "
             "(MESH_AXES) — unknown names silently replicate the "
-            "dimension")
-    NODE_TYPES = (ast.Call,)
+            "dimension; logical-axis names come from "
+            "fengshen_tpu/sharding/axes.py (LOGICAL_AXES)")
+    NODE_TYPES = (ast.Call, ast.Assign, ast.AnnAssign)
 
     def begin_file(self, ctx) -> None:
         self._axes = mesh_axes(ctx.project_root)
+        self._logical = logical_axes(ctx.project_root)
 
-    def check(self, node: ast.Call, ctx):
+    def check(self, node, ctx):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            yield from self._check_tables(node)
+            return
         if self._axes is None or not _is_spec_call(node, ctx):
             return
         for sub, value in ((s, v) for a in node.args
@@ -93,3 +167,35 @@ class PartitionSpecAxes(Rule):
                     f"PartitionSpec axis {value!r} is not a mesh axis "
                     f"({', '.join(sorted(self._axes))}) — XLA will "
                     "silently replicate this dimension")
+
+    def _check_tables(self, node):
+        """The declarative sharding tables (docs/sharding.md)."""
+        name = _assign_name(node)
+        if name is None or self._logical is None:
+            return
+        if name.endswith("PARAM_LOGICAL_AXES"):
+            for _, axes in _table_entries(node.value):
+                for sub, value in _axis_strings(axes):
+                    if value not in self._logical:
+                        yield sub, (
+                            f"logical axis {value!r} is not declared in "
+                            "fengshen_tpu/sharding/axes.py "
+                            "(LOGICAL_AXES) — resolution would raise "
+                            "at run time")
+        elif name.endswith("LOGICAL_AXIS_RULES"):
+            for logical, mesh_axis in _table_entries(node.value):
+                for sub, value in _axis_strings(logical):
+                    if value not in self._logical:
+                        yield sub, (
+                            f"logical axis {value!r} is not declared in "
+                            "fengshen_tpu/sharding/axes.py "
+                            "(LOGICAL_AXES)")
+                if self._axes is None:
+                    continue
+                for sub, value in _axis_strings(mesh_axis):
+                    if value not in self._axes:
+                        yield sub, (
+                            f"rules table maps to {value!r}, not a mesh "
+                            f"axis ({', '.join(sorted(self._axes))}) — "
+                            "XLA would silently replicate every dim "
+                            "with this role")

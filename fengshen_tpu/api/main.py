@@ -75,7 +75,9 @@ from typing import Any, Optional
 class ServerConfig:
     """Reference: fengshen/API/utils.py config dataclasses, plus the
     serving-engine selection ("simple" = one pipeline call per POST;
-    "continuous" = slot-pool continuous batching)."""
+    "continuous" = slot-pool continuous batching; "batch_image" /
+    "embedding" = micro-batched multimodal engines,
+    docs/serving.md "Multimodal engines")."""
 
     host: str = "0.0.0.0"
     port: int = 8000
@@ -103,11 +105,13 @@ class ServerConfig:
     aot_args: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
-        if self.engine not in ("simple", "continuous"):
+        if self.engine not in ("simple", "continuous", "batch_image",
+                               "embedding"):
             # a typo must fail at startup, not silently serve the
             # batch-1 legacy path under a continuous-looking config
             raise ValueError(f"unknown engine {self.engine!r}; expected "
-                             "'simple' or 'continuous'")
+                             "'simple', 'continuous', 'batch_image' or "
+                             "'embedding'")
         from fengshen_tpu.disagg.policy import validate_phase
         self.phase = validate_phase(self.phase)
         self.peers = tuple(str(p).rstrip("/")
@@ -159,7 +163,10 @@ def _render_metrics(engine=None, disagg=None) -> str:
     registries = [get_registry()]
     if engine is not None:
         engine.stats()
-        registries.append(engine.metrics.registry)
+        # micro-batch engines count through the global registry and
+        # have no engine-local one
+        if getattr(engine, "metrics", None) is not None:
+            registries.append(engine.metrics.registry)
     if disagg is not None:
         registries.append(disagg.registry)
     return render_prometheus(*registries)
@@ -204,7 +211,8 @@ def _dump_recorder(recorder, engine, reason: str = "on_demand") -> str:
     registries = [get_registry()]
     if engine is not None:
         engine.stats()      # gauges scrape-fresh, like /metrics
-        registries.append(engine.metrics.registry)
+        if getattr(engine, "metrics", None) is not None:
+            registries.append(engine.metrics.registry)
     recorder.snapshot_metrics(registries, force=True)
     return recorder.dump(reason=reason)
 
@@ -217,8 +225,10 @@ def _partial_payload(engine, pipeline, request_id: str) \
     docs/fault_tolerance.md "Preemption runbook"). 404 when this
     replica never journaled the id (or runs the simple engine). A
     finished entry additionally carries the decoded `result` so the
-    router can answer the client without any resubmit."""
-    d = engine.partial(request_id) if engine is not None else None
+    router can answer the client without any resubmit. Micro-batch
+    engines have no commit journal — same 404 as the simple path."""
+    d = engine.partial(request_id) \
+        if engine is not None and hasattr(engine, "partial") else None
     if d is None:
         return 404, {"error": f"unknown request_id {request_id!r}"}
     if d.get("state") == "finished" and pipeline is not None:
@@ -227,9 +237,10 @@ def _partial_payload(engine, pipeline, request_id: str) \
 
 
 def _debug_requests_payload(engine) -> dict:
-    if engine is None:
-        # the simple path has no request lifecycle to introspect; keep
-        # the payload shape so dashboards need no engine-type branch
+    if engine is None or not hasattr(engine, "debug_requests"):
+        # the simple path (and the micro-batch engines) have no
+        # request-lifecycle ring to introspect; keep the payload shape
+        # so dashboards need no engine-type branch
         return {"in_flight": [], "recent": [], "debug_ring": 0}
     return engine.debug_requests()
 
@@ -398,6 +409,45 @@ def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
                        "finish_reason": request.finish_reason})
 
 
+def _multimodal_generate(engine, pipeline, req: dict,
+                         timeout_s: float) -> tuple[int, dict]:
+    """Submit one HTTP request to a micro-batch engine (batch_image /
+    embedding); returns (status, body). Same backpressure → HTTP
+    mapping as `_engine_generate` — queue full → 429, draining → 503
+    with reason, duplicate request_id → 409 — so the fleet router's
+    retry contract holds across engine types. The 200 body carries the
+    pipeline's result dict (image payload or embedding) plus the
+    `engine_type` the router's heterogeneous placement keys on."""
+    from fengshen_tpu.serving import Draining, DuplicateRequest, QueueFull
+    from fengshen_tpu.serving.multimodal import MM_FINISHED
+    rid = req.get("request_id")
+    try:
+        request = engine.submit(req["input_text"],
+                                request_id=None if rid is None
+                                else str(rid))
+    except Draining as e:
+        return 503, {"error": str(e), "reason": "draining"}
+    except DuplicateRequest as e:
+        return 409, {"error": str(e)}
+    except QueueFull as e:
+        return 429, {"error": str(e)}
+    except (ValueError, TypeError) as e:
+        return 422, {"error": str(e)}
+    if not request.wait(timeout=timeout_s):
+        engine.cancel(request.request_id)
+        # the batch may have landed in the wait→cancel window; a
+        # finished result must not be discarded as a timeout
+        if request.state != MM_FINISHED:
+            return 503, {"error":
+                         f"request timed out after {timeout_s}s"}
+    if request.state != MM_FINISHED:
+        return 503, {"error": f"request {request.state} "
+                              f"({request.error})"}
+    return 200, {"result": request.result,
+                 "request_id": request.request_id,
+                 "engine_type": engine.engine_type}
+
+
 def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
               server_cfg: Optional[ServerConfig] = None, engine=None,
               ready=None, recorder=None, draining=None, disagg=None):
@@ -476,9 +526,15 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
                 # wins when both are present — they are identical
                 # when the fleet router sent them)
                 payload["traceparent"] = traceparent
-            code, body = _engine_generate(
-                engine, pipeline, payload,
-                server_cfg.request_timeout_s, disagg=disagg)
+            if getattr(engine, "engine_type",
+                       "continuous") == "continuous":
+                code, body = _engine_generate(
+                    engine, pipeline, payload,
+                    server_cfg.request_timeout_s, disagg=disagg)
+            else:
+                code, body = _multimodal_generate(
+                    engine, pipeline, payload,
+                    server_cfg.request_timeout_s)
             _count_http(api_route, code)
             return JSONResponse(status_code=code, content=body)
         if req.max_new_tokens is not None and \
@@ -566,7 +622,8 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
 
     @app.get("/debug/requests/{request_id}")
     def debug_request(request_id: str):
-        d = engine.debug_request(request_id) if engine is not None \
+        d = engine.debug_request(request_id) \
+            if engine is not None and hasattr(engine, "debug_request") \
             else None
         code = 200 if d is not None else 404
         _count_http("/debug/requests/<id>", code)
@@ -691,8 +748,9 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 self._send(200, _debug_requests_payload(engine))
             elif self.path.startswith("/debug/requests/"):
                 rid = self.path[len("/debug/requests/"):]
-                d = engine.debug_request(rid) if engine is not None \
-                    else None
+                d = engine.debug_request(rid) \
+                    if engine is not None and \
+                    hasattr(engine, "debug_request") else None
                 if d is None:
                     self._send(404, {"error":
                                      f"unknown request_id {rid!r}"})
@@ -745,10 +803,17 @@ def build_stdlib_server(server_cfg: ServerConfig,
             with inflight_lock:
                 inflight[0] += 1
             try:
-                if engine is not None:
+                if engine is not None and \
+                        getattr(engine, "engine_type",
+                                "continuous") == "continuous":
                     code, body = _engine_generate(
                         engine, pipeline, req,
                         server_cfg.request_timeout_s, disagg=disagg)
+                    self._send(code, body)
+                elif engine is not None:
+                    code, body = _multimodal_generate(
+                        engine, pipeline, req,
+                        server_cfg.request_timeout_s)
                     self._send(code, body)
                 elif req.get("max_new_tokens") is not None and \
                         _accepts_max_new_tokens(pipeline):
@@ -895,11 +960,18 @@ def _start_warmup_thread(server_cfg: ServerConfig,
         from fengshen_tpu.observability import record_build_info
         record_build_info()
         try:
-            if engine is not None:
+            if engine is not None and \
+                    getattr(engine, "engine_type",
+                            "continuous") == "continuous":
                 dt = engine.warmup()
                 print(f"[serving] continuous engine warmup "
                       f"(buckets={list(engine.ladder.buckets)}, "
                       f"num_slots={engine.config.num_slots}) ready in "
+                      f"{dt:.1f}s", flush=True)
+            elif engine is not None:
+                dt = engine.warmup()
+                print(f"[serving] {engine.engine_type} engine warmup "
+                      f"(max_batch={engine.max_batch}) ready in "
                       f"{dt:.1f}s", flush=True)
             elif server_cfg.warmup:
                 warmup_pipeline(pipeline, pipeline_cfg.task)
@@ -949,6 +1021,14 @@ def main(argv=None) -> None:
         # handoff; the router's phase-aware placement decides which
         from fengshen_tpu.disagg.coordinator import DisaggCoordinator
         disagg = DisaggCoordinator(engine, pipeline)
+    elif server_cfg.engine in ("batch_image", "embedding"):
+        # micro-batch engines (docs/serving.md "Multimodal engines"):
+        # no slot pool, no KV handoff — warmup/start also run in the
+        # background thread below
+        from fengshen_tpu.serving.multimodal import \
+            create_multimodal_engine
+        engine = create_multimodal_engine(server_cfg.engine, pipeline,
+                                          server_cfg.engine_args)
     ready = _start_warmup_thread(server_cfg, pipeline_cfg, pipeline,
                                  engine)
     import os

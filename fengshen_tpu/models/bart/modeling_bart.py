@@ -16,23 +16,25 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("shared/embedding", P("tensor", "fsdp")),
-    ("embed_positions/embedding", P(None, None)),
-    (r"(q_proj|k_proj|v_proj|fc1)/kernel", P("fsdp", "tensor")),
-    (r"(out_proj|fc2)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("shared/embedding", ("vocab", "embed")),
+    ("embed_positions/embedding", ("relpos", None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", ("embed", "heads")),
+    (r"fc1/kernel", ("embed", "mlp")),
+    (r"out_proj/kernel", ("heads", "embed")),
+    (r"fc2/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 _POS_OFFSET = 2  # HF BartLearnedPositionalEmbedding offset
 
@@ -161,8 +163,8 @@ class BartAttention(nn.Module):
 
         out = dot_product_attention(q, k, v, mask=mask,
                                     deterministic=deterministic)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         out = out.reshape(batch, q_len, cfg.d_model)
         return _dense(cfg, cfg.d_model, "out_proj")(out)
 
@@ -212,7 +214,7 @@ class BartEncoderLayer(nn.Module):
         hidden = LayerNorm(name="self_attn_layer_norm")(hidden + h)
         h = get_activation(cfg.activation_function)(
             _dense(cfg, cfg.encoder_ffn_dim, "fc1")(hidden))
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.d_model, "fc2")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return LayerNorm(name="final_layer_norm")(hidden + h)
@@ -358,7 +360,7 @@ class BartForConditionalGeneration(nn.Module):
         return logits + self.final_logits_bias.astype(logits.dtype)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class BartForTextInfill(nn.Module):
@@ -402,7 +404,7 @@ class BartForTextInfill(nn.Module):
         return lm_logits, self._encoder_logits(enc)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 def text_infill_loss(lm_logits, labels, encoder_logits, encoder_labels,

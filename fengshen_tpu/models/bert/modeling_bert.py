@@ -10,21 +10,23 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", None)),
-    (r"(query|key|value|intermediate_dense)/kernel", P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", None)),
+    (r"(query|key|value)/kernel", ("embed", "heads")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"attention_output_dense/kernel", ("heads", "embed")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -123,8 +125,8 @@ class BertLayer(nn.Module):
             q, k, v, mask=mask, dropout_rng=drop_rng,
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         out = out.reshape(batch, seq, cfg.hidden_size)
         out = _dense(cfg, cfg.hidden_size, "attention_output_dense")(out)
         out = nn.Dropout(cfg.hidden_dropout_prob)(
@@ -133,7 +135,7 @@ class BertLayer(nn.Module):
         h = out_ln(hidden) if self.pre_ln else hidden
         h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(h)
         h = get_activation(cfg.hidden_act)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
@@ -178,7 +180,7 @@ class BertModel(nn.Module):
         return hidden, pooled
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class BertForMaskedLM(nn.Module):
@@ -204,4 +206,4 @@ class BertForMaskedLM(nn.Module):
         return (logits, hidden) if return_hidden else logits
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

@@ -16,19 +16,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
+from fengshen_tpu.sharding import to_partition_rules
 
 from fengshen_tpu.models.bert import BertConfig, BertModel
 from fengshen_tpu.ops.norms import LayerNorm
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", None)),
-    (r"(query|key|value|q_proj|k_proj|v_proj|fc1|intermediate_dense)"
-     r"/kernel", P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense|out_proj|fc2)/kernel",
-     P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", None)),
+    (r"(query|key|value|q_proj|k_proj|v_proj)/kernel", ("embed", "heads")),
+    (r"(fc1|intermediate_dense)/kernel", ("embed", "mlp")),
+    (r"(attention_output_dense|out_proj)/kernel", ("heads", "embed")),
+    (r"(output_dense|fc2)/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -185,7 +186,7 @@ class TaiyiCLIPModel(nn.Module):
         return proj / jnp.linalg.norm(proj, axis=-1, keepdims=True)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 def clip_contrastive_loss(text_emb, image_emb, logit_scale):

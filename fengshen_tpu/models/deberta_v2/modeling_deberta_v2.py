@@ -18,21 +18,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", None)),
-    (r"(query_proj|key_proj|value_proj|intermediate_dense)/kernel",
-     P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", None)),
+    (r"(query_proj|key_proj|value_proj)/kernel", ("embed", "heads")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"attention_output_dense/kernel", ("heads", "embed")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -230,7 +231,7 @@ class DebertaV2Layer(nn.Module):
                            name="attention_ln")(hidden + h)
         h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
         h = get_activation(cfg.hidden_act)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
@@ -306,7 +307,7 @@ class DebertaV2Model(nn.Module):
         return hidden
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class DebertaV2ForMaskedLM(nn.Module):
@@ -329,7 +330,7 @@ class DebertaV2ForMaskedLM(nn.Module):
         return logits + bias
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class DebertaV2ForSequenceClassification(nn.Module):
@@ -351,4 +352,4 @@ class DebertaV2ForSequenceClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier")(pooled)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

@@ -18,22 +18,24 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.bart.modeling_bart import BartAttention
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("shared/embedding", P("tensor", "fsdp")),
-    ("embed_positions/embedding", P(None, None)),
-    (r"(q_proj|k_proj|v_proj|fc1|fc3)/kernel", P("fsdp", "tensor")),
-    (r"(out_proj|fc2|fc4)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("shared/embedding", ("vocab", "embed")),
+    ("embed_positions/embedding", ("relpos", None)),
+    (r"(q_proj|k_proj|v_proj)/kernel", ("embed", "heads")),
+    (r"(fc1|fc3)/kernel", ("embed", "mlp")),
+    (r"out_proj/kernel", ("heads", "embed")),
+    (r"(fc2|fc4)/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 _POS_OFFSET = 2
 
@@ -101,7 +103,7 @@ def _ffn(cfg, hidden, prefix_fc1, prefix_fc2, deterministic):
         nn.Dense(cfg.decoder_ffn_dim, dtype=_dt(cfg),
                  param_dtype=jnp.dtype(cfg.param_dtype),
                  name=prefix_fc1)(hidden))
-    h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+    h = with_logical_constraint(h, ("batch", "seq", "mlp"))
     return nn.Dense(cfg.d_model, dtype=_dt(cfg),
                     param_dtype=jnp.dtype(cfg.param_dtype),
                     name=prefix_fc2)(h)
@@ -224,4 +226,4 @@ class DeltaLMForConditionalGeneration(nn.Module):
                             init_cache=init_cache)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

@@ -14,7 +14,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.gpt2.configuration_gpt2 import GPT2Config
 from fengshen_tpu.ops.activations import get_activation
@@ -22,26 +21,32 @@ from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("wte/embedding", P("tensor", "fsdp")),
-    ("wpe/embedding", P(None, None)),
-    (r"(c_attn|c_fc)/kernel", P("fsdp", "tensor")),
-    (r"c_proj/kernel", P("tensor", "fsdp")),
-    ("ln_", P(None)),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("wte/embedding", ("vocab", "embed")),
+    ("wpe/embedding", ("relpos", None)),
+    (r"c_attn/kernel", ("embed", "heads")),
+    (r"c_fc/kernel", ("embed", "mlp")),
+    (r"attn/c_proj/kernel", ("heads", "embed")),
+    (r"c_proj/kernel", ("mlp", "embed")),
+    ("ln_", ("norm",)),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
-SCAN_PARTITION_RULES: list[tuple[str, P]] = [
-    ("wte/embedding", P("tensor", "fsdp")),
-    ("wpe/embedding", P(None, None)),
-    (r"h/.*(c_attn|c_fc)/kernel", P(None, "fsdp", "tensor")),
-    (r"h/.*c_proj/kernel", P(None, "tensor", "fsdp")),
-    ("ln_", P(None)),
-    (".*", P(None)),
+SCAN_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("wte/embedding", ("vocab", "embed")),
+    ("wpe/embedding", ("relpos", None)),
+    (r"h/.*c_attn/kernel", ("layers", "embed", "heads")),
+    (r"h/.*c_fc/kernel", ("layers", "embed", "mlp")),
+    (r"h/.*attn/c_proj/kernel", ("layers", "heads", "embed")),
+    (r"h/.*c_proj/kernel", ("layers", "mlp", "embed")),
+    ("ln_", ("norm",)),
+    (".*", (None,)),
 ]
+SCAN_PARTITION_RULES = to_partition_rules(SCAN_PARAM_LOGICAL_AXES)
 
 
 def _dt(config: GPT2Config):
@@ -83,8 +88,8 @@ class GPT2Attention(nn.Module):
         out = dot_product_attention(
             q, k, v, mask=mask, dropout_rng=drop_rng,
             dropout_rate=cfg.attn_pdrop, deterministic=deterministic)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         out = out.reshape(batch, seq, cfg.n_embd)
         out = dense(cfg.n_embd, "c_proj")(out)
         return nn.Dropout(cfg.resid_pdrop)(out, deterministic=deterministic)
@@ -142,7 +147,7 @@ class GPT2Block(nn.Module):
             name=name)
         h = dense(cfg.inner_dim, "c_fc")(h)
         h = get_activation(cfg.activation_function)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = dense(cfg.n_embd, "c_proj")(h)
         h = nn.Dropout(cfg.resid_pdrop)(h, deterministic=deterministic)
         return hidden + h
@@ -180,8 +185,8 @@ class GPT2Model(nn.Module):
         hidden = wte(input_ids) + wpe(position_ids)
         hidden = nn.Dropout(cfg.embd_pdrop)(hidden,
                                             deterministic=deterministic)
-        hidden = with_sharding_constraint(
-            hidden, P(BATCH_AXES, "sequence", None))
+        hidden = with_logical_constraint(
+            hidden, ("batch", "seq", None))
 
         if cfg.scan_layers:
             body = _ScanGPT2Block
@@ -234,5 +239,6 @@ class GPT2LMHeadModel(nn.Module):
         return params["transformer"]["wte"]["embedding"].T
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else PARAM_LOGICAL_AXES)

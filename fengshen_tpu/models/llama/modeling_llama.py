@@ -22,8 +22,6 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
-
 from fengshen_tpu.models.llama.configuration_llama import LlamaConfig
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.pallas.decode_attention import decode_attention
@@ -31,38 +29,47 @@ from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import causal_mask
 from fengshen_tpu.ops.norms import RMSNorm
 from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import to_partition_rules, with_logical_constraint
 
 #: Megatron-equivalent sharding layout (reference: mpu/layers.py:55-470 —
 #: vocab-parallel embedding, column-parallel QKV/gate/up, row-parallel
-#: o_proj/down). flax Dense kernels are [in, out]: column-parallel shards
-#: out ('tensor'), row-parallel shards in ('tensor'); 'fsdp' takes the
-#: other dim (ZeRO-3-style param sharding).
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("embed_tokens/embedding", P("tensor", "fsdp")),
-    (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel", P("fsdp", "tensor")),
-    (r"(o_proj|down_proj)/kernel", P("tensor", "fsdp")),
-    (r"experts_(gate|up)", P("expert", None, "tensor")),
-    (r"experts_down", P("expert", "tensor", None)),
-    ("lm_head/kernel", P("fsdp", "tensor")),
-    ("norm", P(None)),
-    (".*", P(None)),
+#: o_proj/down) expressed as LOGICAL axes; the active rules table
+#: (fengshen_tpu/sharding/rules.py) maps them onto the mesh. flax Dense
+#: kernels are [in, out]: column-parallel shards out, row-parallel
+#: shards in; 'embed' picks up ZeRO-3-style param sharding.
+LLAMA_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("embed_tokens/embedding", ("vocab", "embed")),
+    (r"(q_proj|k_proj|v_proj)/kernel", ("embed", "heads")),
+    (r"(gate_proj|up_proj)/kernel", ("embed", "mlp")),
+    (r"o_proj/kernel", ("heads", "embed")),
+    (r"down_proj/kernel", ("mlp", "embed")),
+    (r"experts_(gate|up)", ("expert", None, "mlp")),
+    (r"experts_down", ("expert", "mlp", None)),
+    ("lm_head/kernel", ("embed", "vocab")),
+    ("norm", ("norm",)),
+    (".*", (None,)),
 ]
 
 #: rules for scan_layers=True — stacked layer params carry a leading [L]
-#: dim, so the layer-internal dims shift right by one
-SCAN_PARTITION_RULES: list[tuple[str, P]] = [
-    ("embed_tokens/embedding", P("tensor", "fsdp")),
-    (r"layers/.*(q_proj|k_proj|v_proj|gate_proj|up_proj)/kernel",
-     P(None, "fsdp", "tensor")),
-    (r"layers/.*(o_proj|down_proj)/kernel", P(None, "tensor", "fsdp")),
-    (r"layers/.*experts_(gate|up)", P(None, "expert", None, "tensor")),
-    (r"layers/.*experts_down", P(None, "expert", "tensor", None)),
-    ("lm_head/kernel", P("fsdp", "tensor")),
-    ("norm", P(None)),
-    (".*", P(None)),
+#: dim ('layers', never mesh-sharded), so layer-internal dims shift right
+SCAN_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("embed_tokens/embedding", ("vocab", "embed")),
+    (r"layers/.*(q_proj|k_proj|v_proj)/kernel", ("layers", "embed", "heads")),
+    (r"layers/.*(gate_proj|up_proj)/kernel", ("layers", "embed", "mlp")),
+    (r"layers/.*o_proj/kernel", ("layers", "heads", "embed")),
+    (r"layers/.*down_proj/kernel", ("layers", "mlp", "embed")),
+    (r"layers/.*experts_(gate|up)", ("layers", "expert", None, "mlp")),
+    (r"layers/.*experts_down", ("layers", "expert", "mlp", None)),
+    ("lm_head/kernel", ("embed", "vocab")),
+    ("norm", ("norm",)),
+    (".*", (None,)),
 ]
+
+#: resolved against the default rules table at import time for callers
+#: that want concrete PartitionSpecs; `partition_rules()` re-resolves so
+#: a `use_rules(...)` scope takes effect
+PARTITION_RULES = to_partition_rules(LLAMA_PARAM_LOGICAL_AXES)
+SCAN_PARTITION_RULES = to_partition_rules(SCAN_PARAM_LOGICAL_AXES)
 
 
 def _dt(config: LlamaConfig):
@@ -110,7 +117,7 @@ class LlamaMLP(nn.Module):
         gate = dense(inter, "gate_proj")(x)
         up = dense(inter, "up_proj")(x)
         h = nn.silu(gate) * up
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         return dense(cfg.hidden_size, "down_proj")(h)
 
 
@@ -197,8 +204,8 @@ class LlamaAttention(nn.Module):
             else:
                 out = dot_product_attention(q, k, v, mask=mask)
 
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         out = out.reshape(batch, seq, n_heads * head_dim)
         return dense(cfg.hidden_size, "o_proj")(out)
 
@@ -485,8 +492,8 @@ class LlamaModel(nn.Module):
                                        cfg.initializer_range),
                                    name="embed_tokens")
         hidden = embed(input_ids)
-        hidden = with_sharding_constraint(
-            hidden, P(BATCH_AXES, "sequence", None))
+        hidden = with_logical_constraint(
+            hidden, ("batch", "seq", None))
 
         remat_policy = {
             "nothing": jax.checkpoint_policies.nothing_saveable,
@@ -587,8 +594,9 @@ class LlamaForCausalLM(nn.Module):
         return self.init(rng, ids)["params"]
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else LLAMA_PARAM_LOGICAL_AXES)
 
 
 def resize_token_embeddings(params: dict, config, new_num_tokens: int,

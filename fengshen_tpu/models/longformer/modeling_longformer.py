@@ -26,23 +26,25 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import sliding_window_mask
 from fengshen_tpu.ops.norms import LayerNorm
 from fengshen_tpu.ops.rotary import apply_rotary_pos_emb
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", None)),
-    (r"(query|key|value|query_global|key_global|value_global|"
-     r"intermediate_dense)/kernel", P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", None)),
+    (r"(query|key|value|query_global|key_global|value_global)/kernel",
+     ("embed", "heads")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"attention_output_dense/kernel", ("heads", "embed")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -243,8 +245,8 @@ class LongformerSelfAttention(nn.Module):
         out_global = out_global.at[bidx, g_idx].set(out_g_rows)
 
         out = jnp.where(is_gathered[:, :, None, None], out_global, out_local)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         return out.reshape(batch, seq, cfg.hidden_size)
 
 
@@ -264,7 +266,7 @@ class LongformerLayer(nn.Module):
                            name="attention_ln")(hidden + h)
         h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
         h = get_activation(cfg.hidden_act)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
@@ -318,7 +320,7 @@ class LongformerModel(nn.Module):
         return hidden, pooled
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class LongformerForMaskedLM(nn.Module):
@@ -343,7 +345,7 @@ class LongformerForMaskedLM(nn.Module):
         return logits + bias
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class LongformerForSequenceClassification(nn.Module):
@@ -361,4 +363,4 @@ class LongformerForSequenceClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier")(pooled)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

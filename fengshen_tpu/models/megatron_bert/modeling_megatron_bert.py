@@ -16,7 +16,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.megatron_bert.configuration_megatron_bert import (
     MegatronBertConfig)
@@ -24,32 +23,36 @@ from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", "fsdp")),
-    ("(position|token_type)_embeddings/embedding", P(None, None)),
-    (r"(query|key|value)/kernel", P("fsdp", "tensor")),
-    (r"attention/output_dense/kernel", P("tensor", "fsdp")),
-    (r"intermediate_dense/kernel", P("fsdp", "tensor")),
-    (r"output_dense/kernel", P("tensor", "fsdp")),
-    (r"(pooler|transform|seq_relationship|classifier)", P(None)),
-    ("ln", P(None)),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", "embed")),
+    ("position_embeddings/embedding", ("relpos", None)),
+    ("token_type_embeddings/embedding", (None, None)),
+    (r"(query|key|value)/kernel", ("embed", "heads")),
+    (r"attention/output_dense/kernel", ("heads", "embed")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (r"(pooler|transform|seq_relationship|classifier)", (None,)),
+    ("ln", ("norm",)),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
-SCAN_PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", "fsdp")),
-    ("(position|token_type)_embeddings/embedding", P(None, None)),
-    (r"layer/.*(query|key|value)/kernel", P(None, "fsdp", "tensor")),
-    (r"layer/.*attention/output_dense/kernel", P(None, "tensor", "fsdp")),
-    (r"layer/.*intermediate_dense/kernel", P(None, "fsdp", "tensor")),
-    (r"layer/.*output_dense/kernel", P(None, "tensor", "fsdp")),
-    (r"(pooler|transform|seq_relationship|classifier)", P(None)),
-    ("ln", P(None)),
-    (".*", P(None)),
+SCAN_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", "embed")),
+    ("position_embeddings/embedding", ("relpos", None)),
+    ("token_type_embeddings/embedding", (None, None)),
+    (r"layer/.*(query|key|value)/kernel", ("layers", "embed", "heads")),
+    (r"layer/.*attention/output_dense/kernel", ("layers", "heads", "embed")),
+    (r"layer/.*intermediate_dense/kernel", ("layers", "embed", "mlp")),
+    (r"layer/.*output_dense/kernel", ("layers", "mlp", "embed")),
+    (r"(pooler|transform|seq_relationship|classifier)", (None,)),
+    ("ln", ("norm",)),
+    (".*", (None,)),
 ]
+SCAN_PARTITION_RULES = to_partition_rules(SCAN_PARAM_LOGICAL_AXES)
 
 
 def _dt(config):
@@ -93,8 +96,8 @@ class MegatronBertSelfAttention(nn.Module):
             q, k, v, mask=mask, dropout_rng=drop_rng,
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         return out.reshape(batch, seq, cfg.hidden_size)
 
 
@@ -116,7 +119,7 @@ class MegatronBertLayer(nn.Module):
         h = LayerNorm(epsilon=cfg.layer_norm_eps, name="ln")(hidden)
         h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(h)
         h = get_activation(cfg.hidden_act)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
@@ -160,8 +163,8 @@ class MegatronBertModel(nn.Module):
                     "token_type_embeddings")(token_type_ids)
         hidden = nn.Dropout(cfg.hidden_dropout_prob)(
             hidden, deterministic=deterministic)
-        hidden = with_sharding_constraint(
-            hidden, P(BATCH_AXES, "sequence", None))
+        hidden = with_logical_constraint(
+            hidden, ("batch", "seq", None))
 
         if cfg.scan_layers:
             body = _ScanBertLayer
@@ -229,8 +232,9 @@ class MegatronBertForPreTraining(nn.Module):
         return mlm_logits, sop_logits
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else PARAM_LOGICAL_AXES)
 
 
 class MegatronBertForMaskedLM(nn.Module):
@@ -250,8 +254,9 @@ class MegatronBertForMaskedLM(nn.Module):
         return (logits, hidden) if return_hidden else logits
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else PARAM_LOGICAL_AXES)
 
 
 class MegatronBertForSequenceClassification(nn.Module):
@@ -269,8 +274,9 @@ class MegatronBertForSequenceClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier")(pooled)
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else PARAM_LOGICAL_AXES)
 
 
 class MegatronBertForTokenClassification(nn.Module):
@@ -289,5 +295,6 @@ class MegatronBertForTokenClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier")(hidden)
 
     def partition_rules(self):
-        return SCAN_PARTITION_RULES if self.config.scan_layers \
-            else PARTITION_RULES
+        return to_partition_rules(
+            SCAN_PARAM_LOGICAL_AXES if self.config.scan_layers
+            else PARAM_LOGICAL_AXES)

@@ -17,21 +17,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.bart.modeling_bart import BartAttention
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("shared/embedding", P("tensor", "fsdp")),
-    (r"(q_proj|k_proj|v_proj|fc1)/kernel", P("fsdp", "tensor")),
-    (r"(out_proj|fc2)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("shared/embedding", ("vocab", "embed")),
+    (r"(q_proj|k_proj|v_proj)/kernel", ("embed", "heads")),
+    (r"fc1/kernel", ("embed", "mlp")),
+    (r"out_proj/kernel", ("heads", "embed")),
+    (r"fc2/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -125,7 +127,7 @@ class _PegasusEncoderLayer(nn.Module):
             nn.Dense(cfg.encoder_ffn_dim, dtype=_dt(cfg),
                      param_dtype=jnp.dtype(cfg.param_dtype),
                      name="fc1")(h))
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = nn.Dense(cfg.d_model, dtype=_dt(cfg),
                      param_dtype=jnp.dtype(cfg.param_dtype), name="fc2")(h)
         return hidden + h
@@ -232,4 +234,4 @@ class PegasusForConditionalGeneration(nn.Module):
                             init_cache=init_cache)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

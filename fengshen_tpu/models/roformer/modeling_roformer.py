@@ -16,22 +16,24 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.attention import dot_product_attention
 from fengshen_tpu.ops.norms import LayerNorm
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("word_embeddings/embedding", P("tensor", "fsdp")),
-    ("token_type_embeddings/embedding", P(None, None)),
-    (r"(query|key|value|intermediate_dense)/kernel", P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("word_embeddings/embedding", ("vocab", "embed")),
+    ("token_type_embeddings/embedding", (None, None)),
+    (r"(query|key|value)/kernel", ("embed", "heads")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"attention_output_dense/kernel", ("heads", "embed")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -136,8 +138,8 @@ class RoFormerLayer(nn.Module):
             q, k, v, mask=mask, dropout_rng=drop_rng,
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic)
-        out = with_sharding_constraint(
-            out, P(BATCH_AXES, "sequence", "tensor", None))
+        out = with_logical_constraint(
+            out, ("batch", "seq", "heads", None))
         out = out.reshape(batch, seq, cfg.hidden_size)
         out = _dense(cfg, cfg.hidden_size, "attention_output_dense")(out)
         out = nn.Dropout(cfg.hidden_dropout_prob)(
@@ -147,7 +149,7 @@ class RoFormerLayer(nn.Module):
 
         h = _dense(cfg, cfg.intermediate_size, "intermediate_dense")(hidden)
         h = get_activation(cfg.hidden_act)(h)
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = _dense(cfg, cfg.hidden_size, "output_dense")(h)
         h = nn.Dropout(cfg.hidden_dropout_prob)(h,
                                                 deterministic=deterministic)
@@ -182,8 +184,8 @@ class RoFormerModel(nn.Module):
         if cfg.embedding_size != cfg.hidden_size:
             hidden = _dense(cfg, cfg.hidden_size, "embeddings_project")(
                 hidden)
-        hidden = with_sharding_constraint(
-            hidden, P(BATCH_AXES, "sequence", None))
+        hidden = with_logical_constraint(
+            hidden, ("batch", "seq", None))
         for i in range(cfg.num_hidden_layers):
             hidden = RoFormerLayer(cfg, name=f"layer_{i}")(
                 hidden, attention_mask, deterministic)
@@ -194,7 +196,7 @@ class RoFormerModel(nn.Module):
         return hidden, pooled
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class RoFormerForMaskedLM(nn.Module):
@@ -218,7 +220,7 @@ class RoFormerForMaskedLM(nn.Module):
         return logits + bias
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class RoFormerForSequenceClassification(nn.Module):
@@ -240,4 +242,4 @@ class RoFormerForSequenceClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier_out")(h)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

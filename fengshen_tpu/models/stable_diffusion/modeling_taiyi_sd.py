@@ -74,6 +74,11 @@ class TaiyiStableDiffusion(nn.Module):
     def denoise(self, noisy_latents, timesteps, text_states):
         return self.unet(noisy_latents, timesteps, text_states)
 
+    def decode_image(self, latents):
+        """Scaled latents → pixels (the inference tail the serving
+        pipeline jits after its denoise loop)."""
+        return self.vae.decode(latents / SCALING_FACTOR)
+
     def __call__(self, input_ids, pixels, timesteps, noise,
                  attention_mask=None, rng=None, deterministic=True):
         latents = self.encode_image(pixels, rng)

@@ -29,28 +29,34 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
-from jax.sharding import PartitionSpec as P
+from fengshen_tpu.sharding import (to_partition_rules,
+                                   with_logical_constraint)
 
 #: fsdp/tensor sharding for the SD towers (the reference trains SD under
 #: DeepSpeed ZeRO; here the fsdp axis shards the big conv out-channels
 #: and the transformer/ff matmuls ride the tensor axis). `_spec_fits`
 #: drops any axis a tiny channel count cannot divide, so small test
-#: configs degrade to replicated instead of failing.
-SD_PARTITION_RULES: list[tuple[str, P]] = [
-    (r"(to_q|to_k|to_v)/kernel", P(None, "tensor")),
-    (r"to_out_0/kernel", P("tensor", None)),
-    (r"ff/net_0/proj/kernel", P(None, "tensor")),
-    (r"ff/net_2/kernel", P("tensor", None)),
-    (r"time_emb_proj/kernel", P(None, "fsdp")),
-    (r"(linear_1|linear_2)/kernel", P(None, "fsdp")),
+#: configs degrade to replicated instead of failing. Dimension roles
+#: are declared as logical axes (docs/sharding.md); the active rules
+#: table resolves them to mesh axes.
+SD_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    (r"(to_q|to_k|to_v)/kernel", (None, "heads")),
+    (r"to_out_0/kernel", ("heads", None)),
+    (r"ff/net_0/proj/kernel", (None, "mlp")),
+    (r"ff/net_2/kernel", ("mlp", None)),
+    (r"time_emb_proj/kernel", (None, "conv_out")),
+    (r"(linear_1|linear_2)/kernel", (None, "conv_out")),
     # `(^|/)conv` anchors the down/upsampler convs without catching
     # quant_conv/post_quant_conv (4- and 8-channel 1x1s that must stay
     # replicated)
     (r"(conv1|conv2|conv_shortcut|(^|/)conv)/kernel",
-     P(None, None, None, "fsdp")),
-    (r"(proj_in|proj_out)/kernel", P(None, None, None, "fsdp")),
-    (".*", P(None)),
+     ("conv_kernel", "conv_kernel", "conv_in", "conv_out")),
+    (r"(proj_in|proj_out)/kernel",
+     ("conv_kernel", "conv_kernel", "conv_in", "conv_out")),
+    (".*", (None,)),
 ]
+
+SD_PARTITION_RULES = to_partition_rules(SD_PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -100,7 +106,12 @@ def sd_timestep_embedding(timesteps: jax.Array, dim: int,
     emb = jnp.concatenate([jnp.sin(emb), jnp.cos(emb)], axis=-1)
     if flip_sin_to_cos:
         emb = jnp.concatenate([emb[:, half:], emb[:, :half]], axis=-1)
-    return emb
+    # the sin|cos concat must stay replicated on its feature dim: GSPMD
+    # back-propagates downstream weight shards onto it, and a
+    # concatenate consumed through a sharded matmul contraction
+    # mispartitions on the CPU XLA build (docs/sharding.md "Root
+    # cause") — this constraint is the fix for NOTES.md item 3
+    return with_logical_constraint(emb, ("batch", "relpos"))
 
 
 class TimestepEmbedding(nn.Module):
@@ -327,6 +338,12 @@ class _UpBlock(nn.Module):
         cfg, dt = self.cfg, jnp.dtype(self.cfg.dtype)
         for j in range(cfg.layers_per_block + 1):
             h = jnp.concatenate([h, skips.pop()], axis=-1)
+            # the skip concat's channel dim is the very next conv's
+            # contraction: keep it replicated (docs/sharding.md "Root
+            # cause" — same concat-contraction hazard as the timestep
+            # embedding; the conv weights stay sharded on conv_out)
+            h = with_logical_constraint(
+                h, ("batch", None, None, "conv_in"))
             h = ResnetBlock2D(self.channels, cfg.norm_num_groups,
                               cfg.norm_eps, dtype=dt,
                               name=f"resnets_{j}")(h, temb)
@@ -387,4 +404,5 @@ class SDUNet2DConditionModel(nn.Module):
                        dtype=dt, name="conv_out")(jax.nn.silu(h))
 
     def partition_rules(self):
-        return SD_PARTITION_RULES
+        # resolved at call time so a `use_rules` scope takes effect
+        return to_partition_rules(SD_PARAM_LOGICAL_AXES)

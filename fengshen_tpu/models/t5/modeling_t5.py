@@ -17,24 +17,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
 from fengshen_tpu.models.t5.configuration_t5 import T5Config
 from fengshen_tpu.ops.activations import get_activation
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
 from fengshen_tpu.ops.masks import causal_mask
-from fengshen_tpu.parallel.mesh import BATCH_AXES
-from fengshen_tpu.parallel.partition import with_sharding_constraint
+from fengshen_tpu.sharding import (to_partition_rules,
+                                    with_logical_constraint)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("shared/embedding", P("tensor", "fsdp")),
-    ("relative_attention_bias/embedding", P(None, None)),
-    (r"(q|k|v|wi|wi_0|wi_1)/kernel", P("fsdp", "tensor")),
-    (r"(o|wo)/kernel", P("tensor", "fsdp")),
-    ("lm_head/kernel", P("fsdp", "tensor")),
-    ("layer_norm", P(None)),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("shared/embedding", ("vocab", "embed")),
+    ("relative_attention_bias/embedding", ("relpos", None)),
+    (r"(q|k|v)/kernel", ("embed", "heads")),
+    (r"(wi|wi_0|wi_1)/kernel", ("embed", "mlp")),
+    (r"wo/kernel", ("mlp", "embed")),
+    (r"o/kernel", ("heads", "embed")),
+    ("lm_head/kernel", ("embed", "vocab")),
+    ("layer_norm", ("norm",)),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 def _dt(config):
@@ -219,7 +221,7 @@ class T5FF(nn.Module):
                 dense(cfg.d_ff, "wi_1")(hidden)
         else:
             h = act(dense(cfg.d_ff, "wi")(hidden))
-        h = with_sharding_constraint(h, P(BATCH_AXES, "sequence", "tensor"))
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         return dense(cfg.d_model, "wo")(h)
 
@@ -368,7 +370,7 @@ class T5ForConditionalGeneration(nn.Module):
         return self._logits(dec)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class T5EncoderModel(nn.Module):
@@ -381,4 +383,4 @@ class T5EncoderModel(nn.Module):
         return self.model.encode(input_ids, attention_mask, deterministic)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

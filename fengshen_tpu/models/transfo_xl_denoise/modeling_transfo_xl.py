@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from jax.sharding import PartitionSpec as P
+from fengshen_tpu.sharding import (to_partition_rules,
+                                   with_logical_constraint)
 
 
 @dataclasses.dataclass
@@ -68,7 +69,11 @@ def xl_positional_embedding(pos_seq: jnp.ndarray,
                                           dtype=np.float32) /
                                 hidden_size))
     ang = pos_seq[:, None] * jnp.asarray(inv_freq)[None, :]
-    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    # keep the sin|cos concat replicated: GSPMD must never turn it into
+    # a sharded matmul contraction (docs/sharding.md "Root cause")
+    return with_logical_constraint(
+        jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1),
+        ("seq", "relpos"))
 
 
 def rel_shift(bd: jnp.ndarray) -> jnp.ndarray:
@@ -270,14 +275,28 @@ class TransfoXLModel(nn.Module):
         return logits, new_mems
 
     def partition_rules(self):
-        return XL_PARTITION_RULES
+        # resolved at call time so a `use_rules` scope takes effect
+        return to_partition_rules(XL_PARAM_LOGICAL_AXES)
 
 
-XL_PARTITION_RULES = [
-    (r"word_embeddings/embedding", P("tensor", "fsdp")),
-    (r"layer_\d+/attention/query_key_value/kernel", P("fsdp", "tensor")),
-    (r"layer_\d+/attention/(relative|dense)/kernel", P("tensor", "fsdp")),
-    (r"layer_\d+/dense_h_to_4h/kernel", P("fsdp", "tensor")),
-    (r"layer_\d+/dense_4h_to_h/kernel", P("tensor", "fsdp")),
-    (r".*", P(None)),
+#: Logical-axis annotations (docs/sharding.md). The fused qkv is
+#: column-parallel on its OUTPUT (heads) dim — the head split happens
+#: after the matmul, so sharding the 3h output dim over `heads` IS the
+#: split-heads-before-the-shard Megatron layout (each tensor shard
+#: holds whole heads of each of q/k/v). `relative` must be
+#: column-parallel too: its input is the sin|cos positional concat,
+#: and a concatenate consumed through a sharded matmul contraction
+#: mispartitions on this XLA build (the NOTES.md item 4 root cause,
+#: docs/sharding.md "Root cause") — hence `relpos` (→ None), never
+#: `embed`, on its contraction dim.
+XL_PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    (r"word_embeddings/embedding", ("vocab", "embed")),
+    (r"layer_\d+/attention/query_key_value/kernel", ("embed", "heads")),
+    (r"layer_\d+/attention/relative/kernel", ("relpos", "heads")),
+    (r"layer_\d+/attention/dense/kernel", ("heads", "embed")),
+    (r"layer_\d+/dense_h_to_4h/kernel", ("embed", "mlp")),
+    (r"layer_\d+/dense_4h_to_h/kernel", ("mlp", "embed")),
+    (r".*", (None,)),
 ]
+
+XL_PARTITION_RULES = to_partition_rules(XL_PARAM_LOGICAL_AXES)

@@ -16,17 +16,20 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from fengshen_tpu.ops.embedding import VocabParallelEmbed
-from jax.sharding import PartitionSpec as P
+from fengshen_tpu.sharding import to_partition_rules
 
 from fengshen_tpu.models.bert.modeling_bert import (BertConfig, BertLayer,
                                                     LayerNorm, _dense, _dt)
 
-PARTITION_RULES: list[tuple[str, P]] = [
-    ("(word|ngram)_embeddings/embedding", P("tensor", None)),
-    (r"(query|key|value|intermediate_dense)/kernel", P("fsdp", "tensor")),
-    (r"(attention_output_dense|output_dense)/kernel", P("tensor", "fsdp")),
-    (".*", P(None)),
+PARAM_LOGICAL_AXES: list[tuple[str, tuple]] = [
+    ("(word|ngram)_embeddings/embedding", ("vocab", None)),
+    (r"(query|key|value)/kernel", ("embed", "heads")),
+    (r"intermediate_dense/kernel", ("embed", "mlp")),
+    (r"attention_output_dense/kernel", ("heads", "embed")),
+    (r"output_dense/kernel", ("mlp", "embed")),
+    (".*", (None,)),
 ]
+PARTITION_RULES = to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 @dataclasses.dataclass
@@ -110,7 +113,7 @@ class ZenModel(nn.Module):
         return hidden, pooled
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)
 
 
 class ZenForSequenceClassification(nn.Module):
@@ -129,4 +132,4 @@ class ZenForSequenceClassification(nn.Module):
         return _dense(cfg, cfg.num_labels, "classifier")(pooled)
 
     def partition_rules(self):
-        return PARTITION_RULES
+        return to_partition_rules(PARAM_LOGICAL_AXES)

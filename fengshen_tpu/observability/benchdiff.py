@@ -121,7 +121,11 @@ def _identity(row: dict) -> str:
     undisturbed one. Kernel-bench rows (docs/kernels.md) carry a
     ``kernel`` key with the dispatch decision (``pallas`` | ``xla``):
     a Mosaic-kernel round and a stock-lowering round measure different
-    programs, so they too diff as incomparable."""
+    programs, so they too diff as incomparable. Multimodal rows
+    (docs/serving.md "Multimodal engines") carry an ``engine_type``
+    key (``batch_image`` | ``embedding`` | ``continuous``): a
+    diffusion-serving round and a text-serving round share metric
+    names but measure different engines entirely."""
     parts = [_placement(row)]
     if "replicas" in row:
         parts.append(f"replicas={int(row['replicas'])}")
@@ -131,6 +135,8 @@ def _identity(row: dict) -> str:
         parts.append(f"drill={row['drill']}")
     if "kernel" in row:
         parts.append(f"kernel={row['kernel']}")
+    if "engine_type" in row:
+        parts.append(f"engine_type={row['engine_type']}")
     return "|".join(parts)
 
 

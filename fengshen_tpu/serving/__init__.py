@@ -15,17 +15,25 @@ from fengshen_tpu.serving.engine import (CANCELLED, EXPIRED, FINISHED,
                                          EngineConfig, PromptTooLong,
                                          QueueFull, Request)
 from fengshen_tpu.serving.metrics import EngineMetrics
+from fengshen_tpu.serving.multimodal import (MULTIMODAL_ENGINE_TYPES,
+                                             BatchImageEngine,
+                                             EmbeddingEngine,
+                                             MicroBatchEngine,
+                                             create_multimodal_engine)
 from fengshen_tpu.serving.paged_cache import (NULL_BLOCK, BlockAllocator,
                                               assign_paged,
                                               assign_slot_quantized,
                                               init_pool_cache)
 
 __all__ = [
-    "BlockAllocator", "BucketLadder", "DEFAULT_BUCKETS",
+    "BatchImageEngine", "BlockAllocator", "BucketLadder",
+    "DEFAULT_BUCKETS",
     "ContinuousBatchingEngine", "Draining", "DuplicateRequest",
-    "EngineConfig", "EngineMetrics",
+    "EmbeddingEngine", "EngineConfig", "EngineMetrics",
+    "MULTIMODAL_ENGINE_TYPES", "MicroBatchEngine",
     "NULL_BLOCK", "PromptTooLong", "QueueFull", "Request",
     "assign_paged", "assign_slot", "assign_slot_quantized",
+    "create_multimodal_engine",
     "init_pool_cache", "init_slot_cache", "reset_free_slots",
     "rollback_slots", "QUEUED", "RUNNING", "FINISHED", "CANCELLED",
     "EXPIRED", "REJECTED",

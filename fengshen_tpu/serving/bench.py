@@ -35,10 +35,18 @@ same ratio; the row also carries `acceptance_rate`, both engines'
 tokens/s, and `token_identical` (greedy spec output must equal the
 non-spec engine's).
 
+`SERVE_BENCH_MODE=multimodal` (`make serve-bench-multimodal`) benches
+the **micro-batch multimodal engines** (docs/serving.md "Multimodal
+engines") on the small-test towers: one row per engine type
+(`batch_image`, `embedding`), each carrying `engine_type`; `value` =
+engine requests/s with all requests co-arriving, `vs_baseline` the
+speedup over sequential one-per-call pipeline invocations.
+
 Env knobs (SERVE_BENCH_*): SLOTS, REQUESTS, NEW_TOKENS, VOCAB, HIDDEN,
 INTER, LAYERS, HEADS, BUCKETS (comma list), SEED, MODE, BLOCK_SIZE,
 MAX_SLOTS (paged concurrency cap in parity mode), SPEC_GAMMA,
-SPEC_NGRAM, PROBE (spec-workload candidate count).
+SPEC_NGRAM, PROBE (spec-workload candidate count), MAX_BATCH
+(multimodal micro-batch width).
 
 Why batching wins even here: batch-1 decode is weight-memory-bound —
 every generated token streams the full weight matrices for ONE row.
@@ -190,6 +198,71 @@ def _memory_parity(model, params, config, buckets, new_tokens) -> None:
     })
 
 
+def _multimodal_bench() -> None:
+    """`SERVE_BENCH_MODE=multimodal` (`make serve-bench-multimodal`):
+    the micro-batch engines (docs/serving.md "Multimodal engines") vs
+    the legacy one-call-per-request path, on the small-test towers —
+    no checkpoint or tokenizer dependency. One BENCH row per engine
+    type, each carrying `engine_type` (benchdiff treats rows at
+    different engine types as incomparable, like offload placements).
+    `value` = engine requests/s with all requests co-arriving,
+    `vs_baseline` = speedup over sequential `pipeline(text)` calls —
+    the micro-batching win: co-riders share ONE jitted forward (or
+    denoise loop) instead of paying a batch-1 launch each."""
+    from fengshen_tpu.serving.multimodal import create_multimodal_engine
+
+    n_req = max(_env("REQUESTS", 8), 1)
+    max_batch = max(_env("MAX_BATCH", 4), 1)
+    prompts = [f"多模态 bench prompt {i}" for i in range(n_req)]
+
+    jobs = (("batch_image", "image_generation"),
+            ("embedding", "embedding"))
+    for engine_name, task in jobs:
+        import importlib
+        mod = importlib.import_module(f"fengshen_tpu.pipelines.{task}")
+        pipeline = mod.Pipeline(small_test=True,
+                                seed=_env("SEED", 0))
+
+        # compile both shapes outside the timed windows
+        pipeline.run_batch([pipeline.warmup_input()] * max_batch)
+        pipeline(pipeline.warmup_input())
+
+        t0 = time.perf_counter()
+        for p in prompts:
+            pipeline(p)
+        seq_rps = n_req / (time.perf_counter() - t0)
+
+        engine = create_multimodal_engine(
+            engine_name, pipeline,
+            {"max_batch": max_batch, "gather_ms": 2.0,
+             "max_queue": n_req})
+        engine.start()
+        t0 = time.perf_counter()
+        reqs = [engine.submit(p) for p in prompts]
+        for r in reqs:
+            if not r.wait(timeout=300):
+                raise RuntimeError(f"{engine_name} bench request "
+                                   f"{r.request_id} never finished")
+        eng_rps = n_req / (time.perf_counter() - t0)
+        stats = engine.stats()
+        engine.stop()
+
+        _emit({
+            "metric": f"serving_{engine_name}_requests_per_sec",
+            "value": round(eng_rps, 2),
+            "unit": "requests/s",
+            "vs_baseline": round(eng_rps / seq_rps, 3),
+            "mode": "multimodal",
+            "engine_type": engine_name,
+            "sequential_requests_per_sec": round(seq_rps, 2),
+            "avg_batch": stats["avg_batch"],
+            "batches_total": stats["batches_total"],
+            "requests": n_req,
+            "max_batch": max_batch,
+            "backend": jax.default_backend(),
+        })
+
+
 def committed_per_forward(gamma: int, acceptance_rate: float) -> float:
     """Committed tokens per target forward per lane: every verify
     commits the accepted prefix plus one correction, so the mean is
@@ -279,13 +352,19 @@ def _spec_bench(model, params, config, buckets, new_tokens) -> None:
 
 
 def main() -> None:
+    mode = os.environ.get("SERVE_BENCH_MODE", "throughput")
+    if mode == "multimodal":
+        # no llama tower to build — the multimodal engines bench their
+        # own small-test pipelines
+        _multimodal_bench()
+        return
+
     from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from fengshen_tpu.serving import EngineConfig
 
     slots = _env("SLOTS", 8)
     n_req = _env("REQUESTS", 8)
     new_tokens = _env("NEW_TOKENS", 48)
-    mode = os.environ.get("SERVE_BENCH_MODE", "throughput")
     buckets = tuple(int(b) for b in os.environ.get(
         "SERVE_BENCH_BUCKETS", "32,64").split(","))
     # the spec verify scatters a gamma-wide tail past the cursor, so

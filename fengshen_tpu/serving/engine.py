@@ -82,6 +82,7 @@ from fengshen_tpu.serving.paged_cache import (BlockAllocator,
                                               blocks_for_tokens,
                                               init_pool_cache)
 from fengshen_tpu.serving.metrics import EngineMetrics
+from fengshen_tpu.sharding import rules_fingerprint
 from fengshen_tpu.utils.generate import (_controls_active,
                                          _ngram_propose_lanes,
                                          _prefill_cache, _select_token,
@@ -277,6 +278,10 @@ class ContinuousBatchingEngine:
     replica deserializes yesterday's executables rather than re-paying
     XLA (docs/aot_cache.md).
     """
+
+    #: dispatch discriminator for the API layer and /stats — the
+    #: multimodal engines (serving/multimodal.py) carry their own
+    engine_type = "continuous"
 
     def __init__(self, model: Any, params: Any, config: EngineConfig,
                  log: Optional[Callable[[dict], None]] = None,
@@ -541,8 +546,12 @@ class ContinuousBatchingEngine:
             # table is part of that identity: a pallas-compiled decode
             # must never be replayed on an xla-dispatch process
             # (docs/kernels.md)
+            # the active logical-axis rules table is part of that
+            # identity too: the same model under a different rules
+            # table lowers to differently-partitioned programs
             fp = (f"{model.config!r}::{config!r}"
-                  f"::{kernel_fingerprint()}")
+                  f"::{kernel_fingerprint()}"
+                  f"::{rules_fingerprint()}")
             self._prefill_jit = aot.wrap(prefill_fn, "serving/prefill",
                                          fingerprint_extra=fp)
             self._assign_jit = aot.wrap(assign_fn, "serving/assign",
@@ -1404,7 +1413,10 @@ class ContinuousBatchingEngine:
                 last_error = {
                     "type": self._last_error["type"],
                     "age_s": round(now - self._last_error["at"], 3)}
-            return self.metrics.snapshot(
+            # engine_type EXTENDS the pinned payload (same precedent
+            # as uptime_s/draining): the fleet router and benchdiff
+            # key multimodal-vs-text comparisons on it
+            return dict(self.metrics.snapshot(
                 queue_depth=len(self._queue),
                 slots_active=int(self._active.sum()),
                 num_slots=self.config.num_slots,
@@ -1416,7 +1428,7 @@ class ContinuousBatchingEngine:
                       if self.spec else None),
                 uptime_s=now - self._t0_clock,
                 last_error=last_error,
-                draining=self._draining)
+                draining=self._draining), engine_type=self.engine_type)
 
     # ---- debug introspection (docs/serving.md "Debug endpoints") ----
 

@@ -1,0 +1,313 @@
+"""Multimodal serving engines: micro-batched batch-image and embedding
+(docs/serving.md "Multimodal engines").
+
+The continuous-batching engine (engine.py) is token-autoregressive —
+its slot pool, bucket ladder and per-tick decode make no sense for a
+diffusion UNet or a CLIP text tower, whose unit of work is one whole
+forward (or a fixed denoise loop) per request. What those workloads DO
+want is micro-batching: requests that arrive within a short gather
+window ride one jitted batch instead of compiling/launching per
+request.
+
+`MicroBatchEngine` supplies the shared machinery — bounded queue,
+gather window, worker thread, warmup, drain, `/stats` — and delegates
+the actual model work to the pipeline's `run_batch(inputs) ->
+list[result]` hook (mirroring how the continuous engine delegates
+`encode`/`decode`). Two concrete engine types ride it:
+
+- `BatchImageEngine`  (`engine_type="batch_image"`) — text-to-image
+  diffusion (pipelines/image_generation.py).
+- `EmbeddingEngine`   (`engine_type="embedding"`) — text embeddings
+  (pipelines/embedding.py).
+
+The API layer (api/main.py) dispatches on `engine_type` and maps the
+same backpressure exceptions the continuous engine raises (QueueFull →
+429, Draining → 503, DuplicateRequest → 409), so the fleet router's
+retry contract holds across engine types.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Optional
+
+from fengshen_tpu.serving.engine import (Draining, DuplicateRequest,
+                                         QueueFull)
+
+#: request states (string-valued on purpose — this engine has no
+#: slot/evacuation machinery, so the continuous engine's richer state
+#: constants would be a false equivalence)
+MM_QUEUED = "queued"
+MM_FINISHED = "finished"
+MM_FAILED = "failed"
+MM_CANCELLED = "cancelled"
+
+
+class MMRequest:
+    """One submitted multimodal request; `wait()` blocks the HTTP
+    handler thread until the worker fulfils it."""
+
+    def __init__(self, request_id: str, payload: Any):
+        self.request_id = request_id
+        self.payload = payload
+        self.result: Any = None
+        self.error: Optional[str] = None
+        self.state = MM_QUEUED
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def _finish(self, result: Any) -> None:
+        self.result = result
+        self.state = MM_FINISHED
+        self._done.set()
+
+    def _fail(self, error: str) -> None:
+        self.error = error
+        self.state = MM_FAILED
+        self._done.set()
+
+    def _cancel(self, reason: str) -> None:
+        self.error = reason
+        self.state = MM_CANCELLED
+        self._done.set()
+
+
+class MicroBatchEngine:
+    """Gather-window micro-batching over `pipeline.run_batch`.
+
+    `max_batch` bounds one jitted launch; `gather_ms` is how long the
+    worker waits for co-riders after the first request of a batch
+    lands (0 = take whatever is queued, never sleep for more).
+    `clock` is injectable for deterministic tests.
+    """
+
+    engine_type = "micro_batch"
+
+    def __init__(self, pipeline: Any, max_batch: int = 4,
+                 gather_ms: float = 2.0, max_queue: int = 64,
+                 log=None, clock=time.monotonic):
+        if not hasattr(pipeline, "run_batch"):
+            raise ValueError(
+                f"engine {self.engine_type!r} needs a pipeline exposing "
+                "run_batch(inputs) -> list[result] (tasks "
+                "'image_generation' / 'embedding'), not a per-call "
+                "text pipeline")
+        self.pipeline = pipeline
+        self.max_batch = int(max_batch)
+        self.gather_ms = float(gather_ms)
+        self.max_queue = int(max_queue)
+        self._log = log or (lambda *a, **k: None)
+        self._clock = clock
+        self._t0 = clock()
+        self._cv = threading.Condition()
+        self._queue: list[MMRequest] = []
+        #: request_id → live request (the fleet router's idempotent
+        #: retry dedupe, same 409 contract as the continuous engine)
+        self._live: "OrderedDict[str, MMRequest]" = OrderedDict()
+        self._in_flight = 0
+        self._draining = False
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._counter = 0
+        self._requests_total = 0
+        self._batches_total = 0
+        self._batched_requests = 0
+        self._warmup_s: Optional[float] = None
+
+    # ---- lifecycle --------------------------------------------------
+
+    def warmup(self) -> float:
+        """Compile the batch program(s) before serving: one throwaway
+        run_batch per batch width would be wasteful — a single width-1
+        call compiles the model; jax re-pads/rebuilds per width lazily
+        only if callers vary widths (the engine always pads to
+        max_batch for exactly this reason)."""
+        t0 = time.perf_counter()
+        self.pipeline.run_batch([self.pipeline.warmup_input()]
+                                * self.max_batch)
+        self._warmup_s = time.perf_counter() - t0
+        return self._warmup_s
+
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True,
+            name=f"fstpu-{self.engine_type}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._cv:
+            self._running = False
+            for req in self._queue:
+                req._cancel("engine stopped")
+            self._queue.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # ---- admission --------------------------------------------------
+
+    def submit(self, payload: Any,
+               request_id: Optional[str] = None) -> MMRequest:
+        if payload is None or (isinstance(payload, str)
+                               and not payload.strip()):
+            raise ValueError("empty input")
+        with self._cv:
+            if self._draining:
+                raise Draining("replica draining")
+            if request_id is not None and request_id in self._live:
+                raise DuplicateRequest(
+                    f"request_id {request_id!r} already in flight")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    f"queue full ({self.max_queue} requests)")
+            if request_id is None:
+                self._counter += 1
+                request_id = f"{self.engine_type}-{self._counter}"
+            req = MMRequest(str(request_id), payload)
+            self._queue.append(req)
+            self._live[req.request_id] = req
+            self._requests_total += 1
+            self._cv.notify_all()
+            return req
+
+    def cancel(self, request_id: str) -> bool:
+        with self._cv:
+            req = self._live.get(request_id)
+            if req is None or req.state != MM_QUEUED:
+                return False
+            if req in self._queue:
+                self._queue.remove(req)
+                req._cancel("cancelled")
+                self._live.pop(request_id, None)
+                return True
+            return False    # already picked up by the worker
+
+    # ---- drain / idle (docs/fleet.md contract) ----------------------
+
+    def begin_drain(self) -> None:
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+
+    def idle(self) -> bool:
+        with self._cv:
+            return not self._queue and self._in_flight == 0
+
+    # ---- worker -----------------------------------------------------
+
+    def _take_batch(self) -> list[MMRequest]:
+        """Under _cv: wait for work, then gather up to max_batch. The
+        gather window only ever delays the FIRST rider of a batch —
+        once the window closes the batch launches with whoever came."""
+        while self._running and not self._queue:
+            self._cv.wait(0.05)
+        if not self._running:
+            return []
+        if self.gather_ms > 0 and len(self._queue) < self.max_batch:
+            deadline = self._clock() + self.gather_ms / 1000.0
+            while (self._running
+                   and len(self._queue) < self.max_batch
+                   and self._clock() < deadline):
+                self._cv.wait(self.gather_ms / 1000.0)
+        batch = self._queue[:self.max_batch]
+        del self._queue[:len(batch)]
+        self._in_flight += len(batch)
+        return batch
+
+    def _serve_loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                results = self.pipeline.run_batch(
+                    [r.payload for r in batch])
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"run_batch returned {len(results)} results "
+                        f"for {len(batch)} inputs")
+                for req, res in zip(batch, results):
+                    req._finish(res)
+            except Exception as e:  # noqa: BLE001 — a bad batch must
+                # answer its requests, not kill the worker thread
+                self._log(f"[{self.engine_type}] batch failed: {e}")
+                for req in batch:
+                    req._fail(str(e)[:500])
+            finally:
+                with self._cv:
+                    self._in_flight -= len(batch)
+                    self._batches_total += 1
+                    self._batched_requests += len(batch)
+                    for req in batch:
+                        self._live.pop(req.request_id, None)
+
+    # ---- observability ----------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cv:
+            avg = (self._batched_requests / self._batches_total
+                   if self._batches_total else 0.0)
+            return {
+                "engine": self.engine_type,
+                "engine_type": self.engine_type,
+                "requests_total": self._requests_total,
+                "batches_total": self._batches_total,
+                "avg_batch": round(avg, 3),
+                "max_batch": self.max_batch,
+                "queue_depth": len(self._queue),
+                "in_flight": self._in_flight,
+                "uptime_s": self._clock() - self._t0,
+                "warmup_s": self._warmup_s,
+                "draining": self._draining,
+            }
+
+
+class BatchImageEngine(MicroBatchEngine):
+    """Text-to-image micro-batching (Taiyi Stable Diffusion): each
+    batch is one jitted denoise loop + VAE decode over all riders'
+    prompts (pipelines/image_generation.py)."""
+
+    engine_type = "batch_image"
+
+
+class EmbeddingEngine(MicroBatchEngine):
+    """Text-embedding micro-batching (Taiyi CLIP text tower): each
+    batch is one jitted `get_text_features` over all riders' prompts
+    (pipelines/embedding.py)."""
+
+    engine_type = "embedding"
+
+
+#: api/main.py's engine-name → class table; ServerConfig validates
+#: against exactly these names plus "simple"/"continuous"
+MULTIMODAL_ENGINE_TYPES: dict = {
+    "batch_image": BatchImageEngine,
+    "embedding": EmbeddingEngine,
+}
+
+
+def create_multimodal_engine(engine_name: str, pipeline: Any,
+                             engine_args: Optional[dict] = None,
+                             log=None) -> MicroBatchEngine:
+    """Build (but do not warm or start) the named multimodal engine —
+    the multimodal sibling of api.main.create_continuous_engine.
+    `engine_args` is the config ENGINE block (max_batch, gather_ms,
+    max_queue)."""
+    cls = MULTIMODAL_ENGINE_TYPES.get(engine_name)
+    if cls is None:
+        raise ValueError(
+            f"unknown multimodal engine {engine_name!r}; expected one "
+            f"of {sorted(MULTIMODAL_ENGINE_TYPES)}")
+    return cls(pipeline, log=log, **(engine_args or {}))

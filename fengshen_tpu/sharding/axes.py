@@ -1,0 +1,56 @@
+"""Logical-axis vocabulary: the NAMES parameter/activation dimensions
+carry, independent of how (or whether) the mesh shards them.
+
+This is the declarative half of the sharding subsystem
+(docs/sharding.md). A model annotates each parameter dimension with a
+logical role from this vocabulary — ``vocab``, ``embed``, ``heads``,
+``mlp``, ``conv_out``, … — and ONE rules table
+(:data:`fengshen_tpu.sharding.rules.DEFAULT_LOGICAL_AXIS_RULES`) maps
+each role onto a mesh axis from ``fengshen_tpu/parallel/mesh.py`` (or
+None = replicated). Changing how the whole fleet shards MLPs is then
+one table edit, not a hunt through per-model regex tables — the
+TorchTitan/Megatron argument (PAPERS.md: arxiv 2410.06511, 2104.04473)
+for declarative, composable parallelism.
+
+fslint's ``partition-spec-axes`` rule parses THIS file statically (the
+``LOGICAL_AXES`` tuple below) to validate every rules table and every
+``*PARAM_LOGICAL_AXES`` annotation in the package — an axis name not
+declared here fails the fast lane, it does not silently replicate.
+"""
+
+from __future__ import annotations
+
+# Every logical dimension name the package may use. Keep the tuple
+# flat, literal, and sorted by theme — fslint reads it with `ast`, so
+# no computed entries.
+LOGICAL_AXES: tuple = (
+    # activations
+    "batch",        # examples dim of activations / optimizer-free data
+    "seq",          # sequence/time dim of activations
+    # embeddings / projections
+    "vocab",        # vocabulary rows of embedding + lm_head matrices
+    "embed",        # hidden/model dim (d_model) of weights
+    "heads",        # attention-head product dim (n_head * head_dim):
+                    # Megatron column-parallel attention output
+    "kv",           # key/value head product dim (GQA towers)
+    "mlp",          # feed-forward inner dim (column-parallel in,
+                    # row-parallel out)
+    "expert",       # MoE expert dim of stacked expert weights
+    "layers",       # stacked-layer dim of scan_layers parameter trees
+    # convolutional towers (NHWC kernels are [kh, kw, cin, cout])
+    "conv_kernel",  # spatial kh/kw dims of conv kernels
+    "conv_in",      # input-channel (contraction) dim of conv kernels
+    "conv_out",     # output-channel dim of conv kernels
+    # deliberately-unsharded roles (mapped to None in the default
+    # table; the NAME records why, see docs/sharding.md)
+    "relpos",       # relative/absolute position-embedding feature dim:
+                    # products of iota-derived sin|cos concats must not
+                    # become a sharded matmul contraction (the
+                    # concat-contraction miscompile, docs/sharding.md
+                    # "Root cause")
+    "norm",         # norm scale/bias vectors — stats reduce over the
+                    # full feature dim, never a shard
+)
+
+#: Fast membership checks for the runtime validators.
+LOGICAL_AXIS_SET = frozenset(LOGICAL_AXES)

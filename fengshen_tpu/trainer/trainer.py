@@ -514,6 +514,15 @@ class Trainer:
         policy = getattr(self, "_offload_policy", None)
         placement = policy.fingerprint() \
             if policy is not None and policy.level != "none" else ""
+        # same bargain for the logical-axis rules table
+        # (docs/sharding.md): the DEFAULT table keeps the extra empty
+        # so pre-existing caches stay valid; a custom table changes how
+        # every program is partitioned and must change the key
+        from fengshen_tpu.sharding import (DEFAULT_LOGICAL_AXIS_RULES,
+                                           get_rules, rules_fingerprint)
+        if tuple(get_rules()) != tuple(DEFAULT_LOGICAL_AXIS_RULES):
+            placement = f"{placement}::{rules_fingerprint()}" \
+                if placement else rules_fingerprint()
         return self._aot_setup.wrap(jitted, name, key_extra=placement)
 
     def _build_offloaded_train_step(self, module, state_sh, batch_sh,

@@ -12,3 +12,15 @@ RULES = [
 def shard(mesh, x):
     spec = jax.sharding.PartitionSpec("batch", None)  # not a mesh axis
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+# declarative sharding tables (docs/sharding.md), both malformed
+BAD_PARAM_LOGICAL_AXES = [
+    ("q_proj/kernel", ("embed", "head")),   # typo'd logical axis
+    ("norm", ("nrom",)),                    # typo'd logical axis
+]
+
+BAD_LOGICAL_AXIS_RULES = (
+    ("heads", "tenosr"),                    # typo'd mesh axis
+    ("mpl", "tensor"),                      # typo'd logical axis
+)

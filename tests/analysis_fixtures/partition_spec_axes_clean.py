@@ -19,3 +19,20 @@ def shard(mesh, x, axes):
 
 def stage_spec():
     return P("pipe", ("data", "fsdp"))
+
+
+# declarative sharding tables (docs/sharding.md): logical names from
+# sharding/axes.py, mesh axes literal or imported (imported names are
+# definitionally valid)
+GOOD_PARAM_LOGICAL_AXES = [
+    ("q_proj/kernel", ("embed", "heads")),
+    ("experts_down", ("expert", "mlp", None)),
+    ("norm", ("norm",)),
+    (".*", (None,)),
+]
+
+GOOD_LOGICAL_AXIS_RULES = (
+    ("batch", ("data", "fsdp")),
+    ("heads", "tensor"),
+    ("relpos", None),
+)

@@ -96,6 +96,24 @@ def test_package_is_clean_under_shipped_baseline():
         f"--write-baseline or delete them: {stale}")
 
 
+def test_sharding_rules_are_clean():
+    """The declarative-sharding gate (docs/sharding.md): every
+    `*PARAM_LOGICAL_AXES` / `*LOGICAL_AXIS_RULES` table in the package
+    validates against the vocabularies — with NO baseline escape hatch
+    (a typo'd logical or mesh axis silently replicates a dimension, so
+    these tables must stay clean, not baselined)."""
+    from fengshen_tpu.analysis.rules.partition_spec_axes import (
+        logical_axes, mesh_axes)
+    # the gate is only meaningful if both vocabularies parse
+    assert logical_axes(REPO), "LOGICAL_AXES not parseable from " \
+        "fengshen_tpu/sharding/axes.py"
+    assert mesh_axes(REPO), "mesh axes not parseable from " \
+        "fengshen_tpu/parallel/mesh.py"
+    findings = [f for f in check_paths([PKG], make_rules(), REPO)
+                if f.rule == "partition-spec-axes"]
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
 def _write(tmp_path, name, body):
     path = tmp_path / name
     path.write_text(textwrap.dedent(body), encoding="utf-8")

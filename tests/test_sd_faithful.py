@@ -572,17 +572,18 @@ def test_finetune_over_faithful_towers_e2e(tmp_path, mesh8):
     assert len(losses) == 2 and all(np.isfinite(losses))
 
 
-@pytest.mark.xfail(
-    reason="pre-existing at seed (NOTES.md tier-1 triage): sharded "
-           "UNet forward diverges from replicated (100% mismatch, max "
-           "2.27) on this jax build's virtual 8-dev CPU mesh — a real "
-           "partition/math divergence to root-cause, likely GroupNorm "
-           "stats over a sharded channel axis",
-    strict=False)
 def test_sd_unet_sharded_matches_replicated(mesh8):
     """SD_PARTITION_RULES shard the faithful UNet over fsdp+tensor
     without changing the math (the 860M Taiyi-SD finetune must shard on
-    a pod, not replicate)."""
+    a pod, not replicate).
+
+    Formerly a non-strict xfail (seed NOTES.md item 3): the divergence
+    was GSPMD back-propagating downstream weight shards onto the
+    timestep sin|cos concat / up-block skip concats, whose dims then
+    became sharded matmul contractions — mispartitioned on this XLA
+    build. Fixed by the `with_logical_constraint` replication pins in
+    unet_sd.py (docs/sharding.md "Root cause"); parity is now a hard
+    tight-tolerance assertion."""
     from fengshen_tpu.models.stable_diffusion.unet_sd import (
         SDUNetConfig, SDUNet2DConditionModel)
     from fengshen_tpu.parallel import make_shardings

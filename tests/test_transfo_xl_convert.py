@@ -257,17 +257,17 @@ def test_transfo_xl_denoise_forward_segments_relative(ids):
                if s is not None)
 
 
-@pytest.mark.xfail(
-    reason="pre-existing at seed (NOTES.md tier-1 triage): sharded "
-           "TransfoXL forward diverges from replicated (87% mismatch, "
-           "max 0.125) on this jax build's virtual 8-dev CPU mesh — "
-           "suspect the fused query_key_value split interacting with "
-           "tensor-axis sharding; needs root-cause, not tolerance",
-    strict=False)
 def test_transfo_xl_sharded_matches_replicated(mesh8):
     """XL_PARTITION_RULES shard the relative backbone over fsdp+tensor
     without changing the math (the import path for the published 1.1B
-    checkpoints must run sharded on a pod)."""
+    checkpoints must run sharded on a pod).
+
+    Formerly a non-strict xfail (seed NOTES.md item 4): the fused qkv
+    was innocent — the divergence was the `relative` projection's
+    contraction dim sharded over the sin|cos positional concat (the
+    concat-contraction mispartition, docs/sharding.md "Root cause").
+    `relative` is now column-parallel (`relpos` × `heads` logical
+    axes); parity is a hard tight-tolerance assertion."""
     import jax
     import jax.numpy as jnp
 
