@@ -8,6 +8,7 @@ PY ?= python
 	serve-bench \
 	serve-bench-parity serve-bench-spec serve-bench-fleet \
 	serve-bench-disagg serve-bench-evac serve-bench-multimodal \
+	serve-bench-stream \
 	serve-fleet aot-bench \
 	kernel-bench benchdiff
 
@@ -61,6 +62,15 @@ serve-bench-spec:
 serve-bench-multimodal:
 	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=multimodal \
 		$(PY) -m fengshen_tpu.serving.bench
+
+# streaming-tier microbench (docs/streaming.md): TTFT first-byte vs
+# last-byte at 8 concurrent SSE streams, self-draft committed tokens
+# per target forward vs prompt-lookup on NON-repetitive traffic, and
+# the kill-mid-stream gapless rung through the real fleet router —
+# one BENCH-schema JSON line carrying `stream`/`spec_mode`
+serve-bench-stream:
+	JAX_PLATFORMS=cpu SERVE_BENCH_MODE=stream \
+		$(PY) -m fengshen_tpu.streaming.bench
 
 # fleet-router microbench (docs/fleet.md): aggregate tokens/s over
 # N=3 stdlib api replica subprocesses vs one, plus the

@@ -200,7 +200,8 @@ def _classify_route(path: str, api_route: str) -> str:
         # commit-journal endpoint (docs/fault_tolerance.md "Preemption
         # runbook"), same cardinality rule
         return "/partial/<id>"
-    return path if path in (api_route, "/healthz", "/stats", "/metrics",
+    return path if path in (api_route, f"{api_route}/stream",
+                            "/healthz", "/stats", "/metrics",
                             "/debug/requests", "/debug/dump") else "other"
 
 
@@ -409,6 +410,102 @@ def _engine_generate(engine, pipeline, req: dict, timeout_s: float,
                        "finish_reason": request.finish_reason})
 
 
+def _engine_stream(engine, pipeline, req: dict, timeout_s: float):
+    """`POST /api/<task>/stream` (docs/streaming.md): submit (or
+    reattach to) a request and return its live SSE frame iterator.
+
+    Returns `(code, payload, None)` for refusals — the SAME
+    backpressure → HTTP map as `_engine_generate`, answered as plain
+    JSON before any stream byte is written — or `(200, None, frames)`
+    where `frames` yields ready-to-write SSE byte chunks: one `token`
+    event per committed token (event id = token index), then exactly
+    one terminal `done` / `evacuated` / `timeout` event.
+
+    A body carrying `request_id` + `last_event_id` is the reconnect
+    path (`Last-Event-ID`, lifted into the body by the server layer):
+    no new submission — the journaled request's stream replays from
+    token `last_event_id + 1` and continues live. On `evacuated`, the
+    client re-POSTs the same body to the named adopter."""
+    from fengshen_tpu.observability import parse_traceparent
+    from fengshen_tpu.serving import (Draining, DuplicateRequest,
+                                      PromptTooLong, QueueFull)
+    from fengshen_tpu.streaming import format_event
+    if engine is None or not hasattr(engine, "attach_stream"):
+        return 501, {"error": "streaming requires the continuous "
+                              "batching engine"}, None
+    t0 = time.perf_counter()
+    rid = req.get("request_id")
+    if rid is not None and req.get("last_event_id") is not None:
+        stream = engine.attach_stream(str(rid))
+        if stream is None:
+            return 404, {"error": f"unknown request_id {rid!r}"}, None
+        engine.metrics.record_stream_reconnect()
+        start = int(req["last_event_id"]) + 1
+        request_id = str(rid)
+    else:
+        ctx = parse_traceparent(req.get("traceparent"))
+        try:
+            request = engine.submit(
+                pipeline.encode(req["input_text"]),
+                max_new_tokens=req.get("max_new_tokens"),
+                request_id=None if rid is None else str(rid),
+                trace_id=None if ctx is None else ctx.trace_id,
+                parent_span_id=None if ctx is None else ctx.span_id,
+                resume_tokens=req.get("resume_tokens"),
+                resume_source=req.get("resume_source"),
+                seed=req.get("seed"), stream=True)
+        except Draining as e:
+            return 503, {"error": str(e), "reason": "draining"}, None
+        except DuplicateRequest as e:
+            return 409, {"error": str(e)}, None
+        except QueueFull as e:
+            return 429, {"error": str(e)}, None
+        except PromptTooLong as e:
+            return 413, {"error": str(e)}, None
+        except (ValueError, TypeError) as e:
+            return 422, {"error": str(e)}, None
+        stream = engine.streams.get(request.request_id)
+        start = 0
+        request_id = request.request_id
+
+    def frames():
+        first = True
+        for kind, idx, payload in stream.events(start,
+                                                timeout=timeout_s):
+            if first:
+                # delivery-layer TTFB: received-to-first-byte, the
+                # headline `serve-bench-stream` reads (the engine's
+                # ttft_seconds keeps its commit-time meaning)
+                engine.metrics.record_stream_ttfb(
+                    time.perf_counter() - t0)
+                first = False
+            if kind == "token":
+                yield format_event("token", {"token": payload},
+                                   event_id=idx)
+            elif kind == "evacuated":
+                # the lane moved mid-generation: the terminal event
+                # names the adopter; re-POST the same body there with
+                # last_event_id to continue gaplessly
+                yield format_event(
+                    "evacuated",
+                    {"request_id": request_id, "target": payload},
+                    event_id=idx)
+            elif kind == "timeout":
+                yield format_event(
+                    "timeout",
+                    {"request_id": request_id,
+                     "error": f"no stream event within {timeout_s}s"},
+                    event_id=idx)
+            else:   # done
+                data = {"request_id": request_id,
+                        "finish_reason": payload}
+                if payload in ("eos", "length"):
+                    data["result"] = pipeline.decode(stream.tokens())
+                yield format_event("done", data, event_id=idx)
+
+    return 200, None, frames()
+
+
 def _multimodal_generate(engine, pipeline, req: dict,
                          timeout_s: float) -> tuple[int, dict]:
     """Submit one HTTP request to a micro-batch engine (batch_image /
@@ -495,8 +592,15 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
         # decodes only the remainder; pydantic must not drop these
         resume_tokens: Optional[list] = None
         resume_source: Optional[str] = None
+        # streaming tier (docs/streaming.md): the per-request sampling
+        # seed, and the reconnect cursor (body form of the SSE
+        # `Last-Event-ID` header — the body wins when both arrive);
+        # pydantic must not drop them
+        seed: Optional[int] = None
+        last_event_id: Optional[int] = None
 
     api_route = f"/api/{pipeline_cfg.task}"
+    stream_route = f"{api_route}/stream"
 
     @app.middleware("http")
     async def _time_request(request, call_next):
@@ -545,6 +649,50 @@ def build_app(pipeline_cfg: PipelineConfig, pipeline=None,
             result = pipeline(req.input_text)
         _count_http(api_route, 200)
         return {"result": result}
+
+    class StreamRequest(Request):
+        # a reconnect body carries only request_id + last_event_id —
+        # no prompt — so input_text relaxes to optional HERE ONLY (the
+        # handler 422s a fresh submission without it)
+        input_text: Optional[str] = None
+
+    @app.post(stream_route)
+    def run_stream(req: StreamRequest,
+                   traceparent: Optional[str] = Header(None),
+                   last_event_id: Optional[str] = Header(None)) -> Any:
+        from fastapi.responses import StreamingResponse
+        payload = req.model_dump()
+        if traceparent and not payload.get("traceparent"):
+            payload["traceparent"] = traceparent
+        if last_event_id is not None and \
+                payload.get("last_event_id") is None:
+            # the SSE-standard reconnect header; EventSource clients
+            # send it automatically on reconnection
+            try:
+                payload["last_event_id"] = int(last_event_id)
+            except ValueError:
+                pass
+        reconnect = payload.get("request_id") is not None and \
+            payload.get("last_event_id") is not None
+        if not reconnect and payload.get("input_text") is None:
+            _count_http(stream_route, 422)
+            return JSONResponse(status_code=422,
+                                content={"error": "input_text required"})
+        if draining is not None and draining.is_set() and not reconnect:
+            # reconnects pass through the drain edge: a live lane's
+            # reader must still receive its `evacuated` terminal event
+            _count_http(stream_route, 503)
+            return JSONResponse(
+                status_code=503,
+                content={"error": "replica draining",
+                         "reason": "draining"})
+        code, body, frames = _engine_stream(
+            engine, pipeline, payload, server_cfg.request_timeout_s)
+        _count_http(stream_route, code)
+        if frames is None:
+            return JSONResponse(status_code=code, content=body)
+        return StreamingResponse(frames, media_type="text/event-stream",
+                                 headers={"Cache-Control": "no-cache"})
 
     @app.get("/healthz")
     def healthz():
@@ -708,6 +856,34 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 code, json.dumps(payload, ensure_ascii=False).encode(),
                 "application/json")
 
+        def _send_stream(self, frames) -> None:
+            """SSE response: bypasses `_send_bytes` (no Content-Length
+            — the body length is unknown until the stream ends), writes
+            each frame as it arrives and flushes so tokens reach the
+            client at commit time, then closes the connection (the
+            `Connection: close` EOF is the stream terminator HTTP/1.0
+            clients understand without chunked framing)."""
+            label = _classify_route(self.path, route)
+            _count_http(label, 200)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for chunk in frames:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                # the client went away mid-stream; its tokens stay in
+                # the journal + stream buffer for a Last-Event-ID
+                # reconnect — nothing to clean up here
+                pass
+            t0 = getattr(self, "_t_start", None)
+            if t0 is not None:
+                _observe_http(label, time.perf_counter() - t0)
+
         def do_GET(self):
             self._t_start = time.perf_counter()
             if self.path == "/healthz":
@@ -775,6 +951,9 @@ def build_stdlib_server(server_cfg: ServerConfig,
                     return
                 self._send(200, {"bundle": bundle})
                 return
+            if self.path == f"{route}/stream":
+                self._post_stream()
+                return
             if self.path != route:
                 self._send(404, {"error": "not found"})
                 return
@@ -825,6 +1004,54 @@ def build_stdlib_server(server_cfg: ServerConfig,
                 else:
                     self._send(200,
                                {"result": pipeline(req["input_text"])})
+            except Exception as e:  # noqa: BLE001 — surface, don't die
+                self._send(500, {"error": str(e)[:500]})
+            finally:
+                with inflight_lock:
+                    inflight[0] -= 1
+
+        def _post_stream(self):
+            """`POST /api/<task>/stream` (docs/streaming.md): same
+            admission surface as the plain route, SSE delivery."""
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._send(422, {"error": f"invalid json: {e}"})
+                return
+            tp = self.headers.get("traceparent")
+            if tp and not req.get("traceparent"):
+                req["traceparent"] = tp
+            lei = self.headers.get("Last-Event-ID")
+            if lei is not None and req.get("last_event_id") is None:
+                # the SSE-standard reconnect header, lifted into the
+                # body form _engine_stream reads (body field wins)
+                try:
+                    req["last_event_id"] = int(lei)
+                except ValueError:
+                    pass
+            reconnect = req.get("request_id") is not None and \
+                req.get("last_event_id") is not None
+            if not reconnect and "input_text" not in req:
+                self._send(422, {"error": "input_text required"})
+                return
+            if draining is not None and draining.is_set() and \
+                    not reconnect:
+                # reconnects pass the drain edge: a live lane's reader
+                # must still receive its `evacuated` terminal event
+                self._send(503, {"error": "replica draining",
+                                 "reason": "draining"})
+                return
+            with inflight_lock:
+                inflight[0] += 1
+            try:
+                code, body, frames = _engine_stream(
+                    engine, pipeline, req,
+                    server_cfg.request_timeout_s)
+                if frames is None:
+                    self._send(code, body)
+                else:
+                    self._send_stream(frames)
             except Exception as e:  # noqa: BLE001 — surface, don't die
                 self._send(500, {"error": str(e)[:500]})
             finally:

@@ -72,6 +72,7 @@ skew reported per replica, never hidden.
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import json
 import random
 import threading
@@ -79,13 +80,17 @@ import time
 import urllib.error
 import urllib.request
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 from fengshen_tpu.disagg import policy as disagg_policy
 from fengshen_tpu.observability import (MetricsRegistry, SpanLedger,
                                         TraceContext, TraceIds,
                                         assemble_trace,
                                         parse_traceparent)
+# streaming/ is stdlib-only (no jax), so the no-accelerator-host
+# contract above holds
+from fengshen_tpu.streaming import format_event, iter_sse
 
 # replica rotation states (the fstpu_fleet_replicas{state} label set):
 # "draining" covers every out-by-healthz condition — warming, an
@@ -150,6 +155,48 @@ class UrllibTransport:
         except (TimeoutError, ConnectionError, OSError) as e:
             sent = not isinstance(e, ConnectionRefusedError)
             raise TransportError(str(e), sent=sent) from e
+
+    def stream(self, base_url: str, method: str, path: str,
+               body: Optional[dict], timeout_s: float
+               ) -> Iterator[dict]:
+        """Open an SSE response and yield parsed event dicts
+        ({"event", "id", "data"}) as frames arrive. An HTTP error
+        status yields ONE synthetic {"event": "http_error",
+        "status": code, "data": body} frame and ends — like
+        `request`, a status IS a routing signal, not an exception.
+        Connection-level failures (connect refused, timeout, a reset
+        or truncated read MID-stream — the SIGKILL case) raise
+        TransportError; `sent` follows the same proof rule as
+        `request`, and is always True once bytes have streamed."""
+        url = base_url.rstrip("/") + path
+        data = None if body is None else json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if body is not None and body.get("traceparent"):
+            headers["traceparent"] = str(body["traceparent"])
+        req = urllib.request.Request(
+            url, data=data, method=method, headers=headers)
+        try:
+            r = urllib.request.urlopen(req, timeout=timeout_s)
+        except urllib.error.HTTPError as e:
+            yield {"event": "http_error", "id": None,
+                   "status": e.code, "data": _parse_json(e.read())}
+            return
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", e)
+            sent = not isinstance(reason, ConnectionRefusedError)
+            raise TransportError(str(e), sent=sent) from e
+        except (TimeoutError, ConnectionError, OSError) as e:
+            sent = not isinstance(e, ConnectionRefusedError)
+            raise TransportError(str(e), sent=sent) from e
+        try:
+            with r:
+                for ev in iter_sse(r):
+                    yield ev
+        except (TimeoutError, ConnectionError, OSError,
+                http.client.HTTPException) as e:
+            # IncompleteRead / reset after frames already flowed:
+            # the replica definitely saw the request
+            raise TransportError(str(e), sent=True) from e
 
 
 def _parse_json(raw: bytes) -> dict:
@@ -909,6 +956,304 @@ class FleetRouter:
                    "attempts": len(tried), "status": status,
                    "trace_id": tid})
         return status, dict(resp, trace_id=tid)
+
+    def route_generate_stream(self, body: dict
+                              ) -> Tuple[int, Optional[dict],
+                                         Optional[Iterator[bytes]]]:
+        """Proxy one STREAMING generate request (docs/streaming.md
+        "Through the fleet"): same pick → attempt → retry ladder as
+        `route_generate`, but the 200 answer is a live SSE frame
+        iterator instead of a JSON body. Returns `(status, payload,
+        frames)` — refusals answer as plain JSON before any stream
+        byte (frames None); otherwise `(200, None, frames)` and the
+        server layer writes the chunks verbatim.
+
+        The router guarantees the CONCATENATED client stream is
+        gapless and token-identical across replica failures: a dedupe
+        cursor (`next_idx`) drops replayed token events, an
+        `evacuated` terminal event is followed transparently to the
+        adopter (`last_event_id` reconnect — the client never sees the
+        move), and a mid-stream transport failure consults the fleet's
+        commit journals exactly like `route_generate`: journaled
+        committed tokens past the cursor are emitted immediately, then
+        the retry resubmits with `resume_tokens`. Replayed prefixes on
+        the replacement replica are token-identical even for sampled
+        requests because the engine derives the per-lane RNG key from
+        the pinned `request_id` (or the client's explicit `seed`) —
+        never from placement."""
+        if self._draining:
+            return 503, {"error": "router draining",
+                         "reason": "draining"}, None
+        with self._lock:
+            rid = body.get("request_id")
+            if not rid:
+                rid = f"fleet-{self._id_token}-{self._seq}"
+            self._seq += 1
+        body = dict(body, request_id=str(rid))
+        return 200, None, self._stream_frames(body)
+
+    def _find_replica(self, target: str) -> Optional[Replica]:
+        t = str(target or "").rstrip("/")
+        for r in self.replicas:
+            if r.base_url.rstrip("/") == t or r.name == t:
+                return r
+        return None
+
+    def _stream_frames(self, body: dict) -> Iterator[bytes]:
+        """The frame generator behind `route_generate_stream` — runs
+        on the server layer's writer thread, one attempt ladder per
+        client connection. No disagg planning here: a streamed lane
+        decodes where it prefilled, and the `evacuated` follow path
+        covers every mid-generation move."""
+        t0 = time.perf_counter()
+        rid = body["request_id"]
+        path = f"/api/{self.config.task}/stream"
+        incoming = parse_traceparent(body.get("traceparent"))
+        ctx = self.tracer.start_trace(
+            "fleet/stream",
+            trace_id=None if incoming is None else incoming.trace_id,
+            parent_span_id=None if incoming is None
+            else incoming.span_id,
+            request_id=rid, task=self.config.task)
+        tid, root = ctx.trace_id, ctx.span_id
+        self._c_traces.inc()
+        self._c_requests.inc()
+
+        next_idx = 0  # dedupe cursor: next token index still owed
+        attempts = self.config.max_retries + 1
+        tried: List[Replica] = []
+        follow: Optional[Replica] = None       # evacuation adopter
+        follow_body: Optional[dict] = None     # its reconnect body
+        last_err: dict = {"error": "stream retries exhausted",
+                          "reason": "exhausted"}
+
+        def finish(outcome: str, n_att: int, **attrs) -> None:
+            self.tracer.end_span(tid, root, outcome=outcome,
+                                 attempts=n_att, **attrs)
+            self._h_request.labels(outcome).observe(
+                time.perf_counter() - t0)
+
+        for attempt in range(attempts):
+            if follow is not None:
+                # the previous replica evacuated the lane: pin the
+                # adopter and reconnect from the cursor — the adopter
+                # journals adopted lanes, so `attach_stream` replays
+                # any tokens it committed while we were switching
+                rep, follow = follow, None
+                send, follow_body = follow_body, None
+                with self._lock:
+                    rep.in_flight += 1
+            else:
+                with self._lock:
+                    rep = self._pick_locked(tried)
+                    if rep is not None:
+                        rep.in_flight += 1
+                if rep is None:
+                    break
+                send = body
+            if rep not in tried:
+                tried.append(rep)
+            s_att = self.tracer.start_span(
+                tid, "router/attempt", root, attempt=attempt + 1,
+                replica=rep.name, request_id=rid, stream=True)
+            if s_att is not None:
+                send = dict(send, traceparent=TraceContext(tid, s_att)
+                            .to_traceparent())
+            t_att = time.perf_counter()
+            terminal: Optional[str] = None  # set => frames() returns
+            failure: Optional[TransportError] = None
+            http_err: Optional[Tuple[int, dict]] = None
+            try:
+                for ev in self.transport.stream(
+                        rep.base_url, "POST", path, send,
+                        self.config.request_timeout_s):
+                    kind = ev.get("event")
+                    if kind == "token":
+                        idx = ev.get("id")
+                        if idx is None or int(idx) >= next_idx:
+                            i = next_idx if idx is None else int(idx)
+                            yield format_event("token", ev["data"],
+                                               event_id=i)
+                            next_idx = i + 1
+                        continue
+                    if kind == "evacuated":
+                        target = self._find_replica(
+                            str(ev["data"].get("target") or ""))
+                        if target is not None:
+                            follow = target
+                            follow_body = {
+                                "request_id": rid,
+                                "last_event_id": next_idx - 1}
+                        # unknown adopter: fall through to the journal
+                        # consult below, exactly like a dead replica
+                        failure = TransportError(
+                            "evacuated to unknown target", sent=True) \
+                            if target is None else None
+                        break
+                    if kind in ("done", "timeout"):
+                        yield format_event(
+                            kind, ev["data"], event_id=ev.get("id"))
+                        terminal = kind
+                        break
+                    if kind == "http_error":
+                        http_err = (int(ev["status"]), ev["data"])
+                        break
+                    # ignore keep-alives / unknown event types
+            except TransportError as e:
+                failure = e
+            if terminal is not None:
+                self._finish_attempt(rep, ok=True)
+                outcome = OUTCOME_OK if terminal == "done" \
+                    else OUTCOME_ERROR
+                self._h_attempt.labels(outcome).observe(
+                    time.perf_counter() - t_att)
+                self.tracer.end_span(tid, s_att, outcome=outcome,
+                                     tokens=next_idx)
+                finish(outcome, attempt + 1)
+                if attempt > 0 or terminal == "done":
+                    self._log({"event": "fleet_stream_done",
+                               "request_id": rid, "reason": terminal,
+                               "attempts": attempt + 1,
+                               "tokens": next_idx, "trace_id": tid})
+                return
+            if follow is not None:
+                # an orderly evacuation is a SUCCESS for the source
+                self._finish_attempt(rep, ok=True)
+                self._h_attempt.labels(OUTCOME_OK).observe(
+                    time.perf_counter() - t_att)
+                self.tracer.end_span(tid, s_att, outcome="evacuated",
+                                     target=follow.name)
+                self._log({"event": "fleet_stream_follow",
+                           "request_id": rid, "target": follow.name,
+                           "from_token": next_idx})
+                continue
+            if http_err is not None:
+                status, resp = http_err
+                reason = f"http_{status}"
+                if status >= 500:
+                    self._h_attempt.labels("http_5xx").observe(
+                        time.perf_counter() - t_att)
+                    self._finish_attempt(rep, ok=(status == 503),
+                                         reason=reason,
+                                         detail=f"HTTP {status}")
+                    if status == 503:
+                        with self._lock:
+                            self._mark_out_locked(
+                                rep,
+                                str(resp.get("reason") or reason))
+                    last_err = dict(resp, status=status)
+                    backoff = self._maybe_retry(attempt, attempts,
+                                                reason, rep)
+                    self.tracer.end_span(
+                        tid, s_att, outcome=reason, status=status,
+                        **({} if backoff is None
+                           else {"backoff_s": backoff}))
+                    if backoff is not None:
+                        self._sleep(backoff)
+                    continue
+                # 4xx before any stream byte: the client's to handle
+                self._finish_attempt(rep, ok=True)
+                self._h_attempt.labels(OUTCOME_CLIENT_ERROR).observe(
+                    time.perf_counter() - t_att)
+                self.tracer.end_span(tid, s_att,
+                                     outcome=OUTCOME_CLIENT_ERROR,
+                                     status=status)
+                finish(OUTCOME_CLIENT_ERROR, attempt + 1,
+                       status=status)
+                yield format_event(
+                    "error", dict(resp, status=status,
+                                  request_id=rid, trace_id=tid))
+                return
+            # transport-level failure, an unknown evacuation target,
+            # or a connection that closed without a terminal event (a
+            # clean FIN from a dying replica) — all maybe-executed
+            if failure is None:
+                failure = TransportError(
+                    "stream ended without a terminal event", sent=True)
+            reason = "connect" if not failure.sent else "timeout"
+            self._h_attempt.labels(reason).observe(
+                time.perf_counter() - t_att)
+            self._finish_attempt(rep, ok=False, reason=reason,
+                                 detail=str(failure))
+            last_err = {"error": f"replica {rep.name}: {failure}",
+                        "reason": reason}
+            if failure.sent and not self.config.retry_maybe_executed:
+                self.tracer.end_span(tid, s_att, outcome=reason,
+                                     error=str(failure)[:200],
+                                     retried=False)
+                break
+            backoff = self._maybe_retry(attempt, attempts, reason,
+                                        rep)
+            self.tracer.end_span(
+                tid, s_att, outcome=reason,
+                error=str(failure)[:200],
+                **({} if backoff is None else {"backoff_s": backoff}))
+            if backoff is None:
+                break
+            if failure.sent and self.config.resume_from_journal:
+                found = self._consult_journal(rid, rep)
+                if found is None:
+                    self._c_resume.labels("miss").inc()
+                    # resubmit from scratch: the dedupe cursor plus
+                    # the request-id-derived lane seed keep the
+                    # replayed stream token-identical
+                elif found[0] == "final":
+                    # some replica already finished it: stream the
+                    # journaled remainder, answer, done — no retry
+                    _, payload, name = found
+                    toks = [int(t)
+                            for t in (payload.get("tokens") or [])]
+                    for i in range(next_idx, len(toks)):
+                        yield format_event("token",
+                                           {"token": toks[i]},
+                                           event_id=i)
+                    next_idx = max(next_idx, len(toks))
+                    yield format_event(
+                        "done",
+                        {"request_id": rid,
+                         "finish_reason": payload.get("finish_reason"),
+                         "result": payload.get("result")},
+                        event_id=next_idx)
+                    self._c_resume.labels("recovered").inc()
+                    finish(OUTCOME_OK, attempt + 1)
+                    self._log({"event": "fleet_stream_recovered",
+                               "request_id": rid, "source": name,
+                               "attempts": attempt + 1,
+                               "trace_id": tid})
+                    return
+                else:
+                    # journaled committed prefix: every token in it is
+                    # safe to deliver NOW (commit-time publication),
+                    # and the retry prefills prompt+prefix instead of
+                    # regenerating from token 0
+                    _, tokens, name = found
+                    for i in range(next_idx, len(tokens)):
+                        yield format_event("token",
+                                           {"token": tokens[i]},
+                                           event_id=i)
+                    next_idx = max(next_idx, len(tokens))
+                    body = dict(body, resume_tokens=tokens,
+                                resume_source=name)
+                    self._c_resume.labels("resumed").inc()
+                    self._c_resume_tokens.inc(len(tokens))
+                    self._log({"event": "fleet_stream_resume",
+                               "request_id": rid, "source": name,
+                               "tokens": len(tokens)})
+            self._sleep(backoff)
+
+        # exhausted (or nothing in rotation): one terminal error event
+        n_att = len(tried)
+        if not tried:
+            last_err = self._no_replicas_payload()
+            finish(OUTCOME_UNAVAILABLE, n_att)
+        else:
+            finish(OUTCOME_ERROR, n_att)
+        self._log({"event": "fleet_stream_failed",
+                   "request_id": rid, "attempts": n_att,
+                   "delivered": next_idx, "trace_id": tid})
+        yield format_event(
+            "error", dict(last_err, request_id=rid, trace_id=tid,
+                          delivered=next_idx))
 
     def _collect_redirect(self, tid: str, root: Optional[str],
                           resp: dict) -> Tuple[int, dict]:

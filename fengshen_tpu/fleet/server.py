@@ -5,6 +5,10 @@ Mirrors the replica server's conventions (`api/main.py`):
 - ``POST /api/<task>``: proxied through `FleetRouter.route_generate`
   (the router adds a `request_id` the replica dedupes — see
   docs/fleet.md "Retries and idempotency");
+- ``POST /api/<task>/stream``: the SSE proxy
+  (`FleetRouter.route_generate_stream`, docs/streaming.md "Through
+  the fleet") — token events relayed as they arrive, replica failures
+  retried/resumed mid-stream so the client sees one gapless stream;
 - ``GET /healthz``: 200 `{"ready": true}` iff the router is not
   draining AND at least one replica is in rotation; otherwise 503 with
   `{"ready": false, "reason": "draining" | "no_healthy_replicas"}` —
@@ -56,7 +60,8 @@ def _classify_route(path: str, api_route: str) -> str:
     value per request."""
     if path.startswith("/debug/traces/"):
         return "/debug/traces/<id>"
-    return path if path in (api_route, "/healthz", "/fleet",
+    return path if path in (api_route, f"{api_route}/stream",
+                            "/healthz", "/fleet",
                             "/metrics") else "other"
 
 
@@ -130,18 +135,42 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
             else:
                 self._send(404, {"error": "not found"})
 
+        def _send_stream(self, frames) -> None:
+            """Write an SSE response chunk-by-chunk (the streaming
+            route's 200 path). Mirrors the replica server's writer:
+            headers first, then each frame flushed as it arrives; a
+            client that hangs up mid-stream just ends the generator."""
+            t0 = getattr(self, "_t_start", None)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for chunk in frames:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass        # client went away; the generator cleans up
+            finally:
+                frames.close()
+            if t0 is not None:
+                _observe_http(_classify_route(self.path, api_route),
+                              200, time.perf_counter() - t0)
+
         def do_POST(self):
             self._t_start = time.perf_counter()
             if not self.path.startswith(route_prefix):
                 self._send(404, {"error": "not found"})
                 return
+            stream = self.path == f"{api_route}/stream"
             length = int(self.headers.get("Content-Length", 0))
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError as e:
                 self._send(422, {"error": f"invalid json: {e}"})
                 return
-            if "input_text" not in req:
+            if "input_text" not in req and not stream:
                 self._send(422, {"error": "input_text required"})
                 return
             tp = self.headers.get("traceparent")
@@ -150,6 +179,24 @@ def build_fleet_server(router: FleetRouter, host: str = "0.0.0.0",
                 # first here too; the router JOINS it instead of
                 # minting a fresh trace
                 req["traceparent"] = tp
+            if stream:
+                lei = self.headers.get("Last-Event-ID")
+                if lei is not None and req.get("last_event_id") is None:
+                    try:
+                        req["last_event_id"] = int(lei)
+                    except ValueError:
+                        pass
+                reconnect = (req.get("request_id") is not None
+                             and req.get("last_event_id") is not None)
+                if "input_text" not in req and not reconnect:
+                    self._send(422, {"error": "input_text required"})
+                    return
+                code, body, frames = router.route_generate_stream(req)
+                if frames is None:
+                    self._send(code, body)
+                else:
+                    self._send_stream(frames)
+                return
             code, body = router.route_generate(req)
             self._send(code, body)
 

@@ -137,6 +137,15 @@ def _identity(row: dict) -> str:
         parts.append(f"kernel={row['kernel']}")
     if "engine_type" in row:
         parts.append(f"engine_type={row['engine_type']}")
+    # streaming rows (docs/streaming.md): a token-by-token SSE round
+    # measures a different delivery path than a batch round, and a
+    # self_draft round runs a different decode program than a
+    # prompt_lookup one — both keys join the identity so they only
+    # ever diff against their own kind
+    if "stream" in row:
+        parts.append(f"stream={bool(row['stream'])}")
+    if "spec_mode" in row:
+        parts.append(f"spec_mode={row['spec_mode']}")
     return "|".join(parts)
 
 

@@ -63,6 +63,7 @@ import dataclasses
 import itertools
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Any, Callable, Optional, Sequence
 
@@ -83,10 +84,12 @@ from fengshen_tpu.serving.paged_cache import (BlockAllocator,
                                               init_pool_cache)
 from fengshen_tpu.serving.metrics import EngineMetrics
 from fengshen_tpu.sharding import rules_fingerprint
+from fengshen_tpu.streaming import StreamBook
 from fengshen_tpu.utils.generate import (_controls_active,
                                          _ngram_propose_lanes,
                                          _prefill_cache, _select_token,
                                          _spec_round_tokens,
+                                         _spec_round_tokens_lanes,
                                          apply_logits_controls)
 
 
@@ -150,9 +153,17 @@ class EngineConfig:
     # and verify all of them in ONE jitted forward — >1 committed token
     # per weight stream on repetitive/extractive text, greedy output
     # token-identical to the non-spec engine
-    spec_mode: str = "off"                   # "off" | "prompt_lookup"
+    # "self_draft" swaps the n-gram drafter for a REAL draft tower: the
+    # target's own first spec_draft_layers decoder layers (shared
+    # embedding/norm/head, make_self_draft) run one batched draft pass
+    # per tick — pays off on non-repetitive traffic where prompt
+    # lookup's acceptance collapses, and carries a true proposal
+    # distribution so sampled requests get the paper-exact
+    # rejection-sampling accept rule per lane (docs/streaming.md)
+    spec_mode: str = "off"    # "off" | "prompt_lookup" | "self_draft"
     spec_gamma: int = 4                      # drafted tokens per tick
     spec_ngram: int = 2                      # suffix length to match
+    spec_draft_layers: int = 2               # self-draft tower depth
     # debug introspection (docs/serving.md "Debug endpoints"): how many
     # finished-request timelines the engine retains for
     # `GET /debug/requests` and the flight-recorder bundle
@@ -191,25 +202,31 @@ class EngineConfig:
                 "the continuous engine supports no_repeat_ngram_size of "
                 "0 or 1 only (per-slot cursors cannot drive the n>1 "
                 "window processor)")
-        if self.spec_mode not in ("off", "prompt_lookup"):
+        if self.spec_mode not in ("off", "prompt_lookup", "self_draft"):
             raise ValueError(
-                f"unknown spec_mode {self.spec_mode!r}; expected 'off' "
-                "or 'prompt_lookup'")
+                f"unknown spec_mode {self.spec_mode!r}; expected 'off', "
+                "'prompt_lookup' or 'self_draft'")
         if self.spec_mode != "off":
             if self.spec_gamma < 1:
                 raise ValueError("spec_gamma must be >= 1")
             if self.spec_ngram < 1:
                 raise ValueError("spec_ngram must be >= 1")
-            if self.do_sample:
+            if self.spec_mode == "self_draft" and \
+                    self.spec_draft_layers < 1:
+                raise ValueError("spec_draft_layers must be >= 1")
+            if self.do_sample and self.spec_mode == "prompt_lookup":
                 # the rejection-sampling scheme needs the DRAFTER's
                 # proposal distribution q; prompt lookup has none (its
                 # proposals are copied tokens), so only greedy
-                # accept-while-argmax-agrees is sound here
+                # accept-while-argmax-agrees is sound here. self_draft
+                # DOES carry q — its sampled tick routes through the
+                # per-lane rejection rule (_spec_round_tokens_lanes)
                 raise ValueError(
                     "spec_mode='prompt_lookup' is greedy-only "
                     "(do_sample=False): lookup proposals carry no "
                     "draft distribution for the rejection-sampling "
-                    "accept rule")
+                    "accept rule (use spec_mode='self_draft' for "
+                    "sampled speculation)")
             if (self.repetition_penalty != 1.0 or
                     self.no_repeat_ngram_size > 0 or self.min_length > 0):
                 # the processors are defined at ONE committed cursor;
@@ -247,6 +264,11 @@ class Request:
         self.resume_source: Optional[str] = None
         #: peer URL a live-evacuated lane moved to (handoff.py sets it)
         self.evac_target: Optional[str] = None
+        #: per-request sampling seed (docs/streaming.md "Seed
+        #: semantics"): folded into the engine's base key at admission
+        #: to derive this lane's key ring entry; submit resolves it
+        #: from the client field or the request-id hash
+        self.seed: int = 0
         self._cancel = False
         self._done = threading.Event()
         #: host-side lifecycle events (docs/observability.md "Request
@@ -321,6 +343,7 @@ class ContinuousBatchingEngine:
         self.max_len = int(model.config.max_position_embeddings)
         self.paged = config.kv_layout == "paged"
         self.spec = config.spec_mode != "off"
+        self.self_draft = config.spec_mode == "self_draft"
         # every admission must reserve gamma EXTRA positions: the
         # verify forward scatters the full gamma+1 window before the
         # accept counts are known, so rejected tails land past the
@@ -366,6 +389,25 @@ class ContinuousBatchingEngine:
                 (f" (speculative window needs gamma={self._gamma} "
                  "extra positions)" if self._gamma else ""))
 
+        if self.self_draft:
+            # the self-draft tower (docs/streaming.md "Draft tower"):
+            # the target's own first spec_draft_layers decoder layers
+            # plus its shared embedding/norm/head — make_self_draft's
+            # param leaves ALIAS the target's arrays, no copy. Its KV
+            # pool is always a plain fp32 slot pool sized to this
+            # engine's lane capacity (the tower is small, so paging or
+            # quantizing it would save little and cost congruence with
+            # the target cache's cursors).
+            from fengshen_tpu.models.llama import make_self_draft
+            draft_cfg, self._draft_params = make_self_draft(
+                model.config, params, config.spec_draft_layers)
+            if self.seq_capacity != self.max_len:
+                draft_cfg = dataclasses.replace(
+                    draft_cfg,
+                    max_position_embeddings=self.seq_capacity)
+            self._draft_model = model.clone(config=draft_cfg)
+            self._draft_cache = init_slot_cache(self._draft_model, S)
+
         L = self.seq_capacity
         self._cache = self._init_pool()
         self._kv_bytes = sum(
@@ -389,10 +431,22 @@ class ContinuousBatchingEngine:
         # zero per-tick cost; `partial()` snapshots it for
         # `GET /partial/<id>` (docs/fault_tolerance.md)
         self._journal: "OrderedDict[str, Request]" = OrderedDict()
+        #: live SSE token streams (docs/streaming.md): per-request
+        #: bounded token queues the scheduler thread feeds at commit
+        #: time; an engine that never streams pays one dict lookup of
+        #: overhead per sync call and nothing else
+        self.streams = StreamBook()
         self._draining = False
         self._cv = threading.Condition()
-        self._rng = jax.random.PRNGKey(config.seed)
+        self._base_key = jax.random.PRNGKey(config.seed)
         self._zero_key = jax.random.PRNGKey(0)
+        # per-lane PRNG key ring beside cache_index (docs/streaming.md
+        # "Seed semantics"): one key per lane, installed at admission
+        # from fold_in(base_key, request.seed) and split IN-GRAPH every
+        # tick — a lane's draws are a pure function of its seed and its
+        # tick count since admission, never of pool co-tenancy
+        self._keys = jnp.zeros((S,) + self._zero_key.shape,
+                               self._zero_key.dtype)
         self._thread: Optional[threading.Thread] = None
         self._stop_flag = False
 
@@ -420,6 +474,21 @@ class ContinuousBatchingEngine:
                                 cfg.temperature, cfg.top_k, cfg.top_p)
             return cache, tok.astype(jnp.int32)
 
+        if self.self_draft:
+            # the draft tower primes its OWN cache over the same
+            # prompt in the same program — its cursor starts congruent
+            # with the target's and stays congruent tick over tick
+            # (both advance gamma+1 and roll back gamma-n_r together)
+            draft_model = self._draft_model
+            base_prefill = prefill_fn
+
+            def prefill_fn(params, draft_params, ids, mask, rng):
+                cache, tok = base_prefill(params, ids, mask, rng)
+                position_ids = jnp.clip(mask.cumsum(-1) - 1, 0, None)
+                _, d_cache = _prefill_cache(draft_model, draft_params,
+                                            ids, mask, position_ids)
+                return cache, d_cache, tok
+
         paged = self.paged
         if paged:
             def assign_fn(cache, history, mask, primed, prompt_row,
@@ -443,10 +512,128 @@ class ContinuousBatchingEngine:
                 mask = mask.at[slot].set(mask_row)
                 return cache, history, mask
 
+        if self.self_draft:
+            # the draft pool is a plain slot pool regardless of the
+            # target layout, so its lane assignment is always the
+            # unquantized scatter
+            base_assign = assign_fn
+            if paged:
+                def assign_fn(cache, dpool, history, mask, primed,
+                              d_primed, prompt_row, mask_row, table_row,
+                              slot):
+                    cache, history, mask = base_assign(
+                        cache, history, mask, primed, prompt_row,
+                        mask_row, table_row, slot)
+                    dpool = assign_slot(dpool, d_primed, slot)
+                    return cache, dpool, history, mask
+            else:
+                def assign_fn(cache, dpool, history, mask, primed,
+                              d_primed, prompt_row, mask_row, slot):
+                    cache, history, mask = base_assign(
+                        cache, history, mask, primed, prompt_row,
+                        mask_row, slot)
+                    dpool = assign_slot(dpool, d_primed, slot)
+                    return cache, dpool, history, mask
+
         gamma, ngram = cfg.spec_gamma, cfg.spec_ngram
-        if self.spec:
+        if self.self_draft:
+            draft_model = self._draft_model
+
+            def decode_fn(params, draft_params, cache, dpool, history,
+                          mask, tokens, pos, phys, active, keys):
+                """Self-draft speculative tick: gamma+1 BATCHED draft
+                forwards (a lax.scan over the small tower, all lanes at
+                once) → ONE target verify over [B, gamma+1] → the
+                paper-exact per-lane accept rule, sampled or greedy,
+                keyed from the per-lane ring. Both caches advance and
+                roll back together, so their cursors stay congruent."""
+                n = tokens.shape[0]
+                if paged:
+                    cache = reset_free_slots(cache, active)
+                dpool = reset_free_slots(dpool, active)
+                if cfg.do_sample:
+                    # gamma+3 splits per lane: next ring entry, gamma+1
+                    # draft draws (the +1 is scanned but unused — keeps
+                    # the scan xs rectangular), one verify key
+                    split = jax.vmap(
+                        lambda k: jax.random.split(k, gamma + 3))(keys)
+                    keys_out = split[:, 0]
+                    d_keys = jnp.moveaxis(split[:, 1:gamma + 2], 1, 0)
+                    round_keys = split[:, gamma + 2]
+                else:
+                    keys_out = keys
+                    d_keys = jnp.zeros((gamma + 1,) + keys.shape,
+                                       keys.dtype)
+                    round_keys = keys
+                history = history.at[jnp.arange(n), phys].set(tokens)
+
+                def draft_step(carry, xs):
+                    dcache, cur = carry
+                    i, dkey = xs
+                    dlogits, dmut = draft_model.apply(
+                        {"params": draft_params, "cache": dcache},
+                        cur[:, None], attention_mask=mask,
+                        position_ids=(pos + i)[:, None],
+                        init_cache=True, mutable=["cache"])
+                    step = dlogits[:, -1]
+                    if cfg.do_sample:
+                        # each proposal is an exact draw from the q
+                        # that the accept rule divides by: same
+                        # _filtered_logits, same temp/top-k/top-p
+                        nxt = jax.vmap(
+                            lambda l, k: _select_token(
+                                l, k, True, cfg.temperature,
+                                cfg.top_k, cfg.top_p))(step, dkey)
+                    else:
+                        nxt = step.astype(jnp.float32).argmax(-1)
+                    nxt = nxt.astype(jnp.int32)
+                    return (dmut["cache"], nxt), (nxt, step)
+
+                # gamma+1 steps: the first feeds last tick's committed
+                # token (writing its draft-KV at phys, mirroring the
+                # target verify), the rest extend the proposal chain;
+                # the last proposal is never verified, but its forward
+                # writes the KV the NEXT tick's first step would need
+                # anyway
+                (dpool, _), (props, d_steps) = jax.lax.scan(
+                    draft_step, (dpool, tokens),
+                    (jnp.arange(gamma + 1), d_keys))
+                drafts = jnp.transpose(props[:gamma])
+                d_logits = jnp.moveaxis(d_steps[:gamma], 0, 1)
+                verify = jnp.concatenate([tokens[:, None], drafts],
+                                         axis=1)
+                v_pos = pos[:, None] + jnp.arange(gamma + 1)[None]
+                logits, mutated = model.apply(
+                    {"params": params, "cache": cache}, verify,
+                    attention_mask=mask, position_ids=v_pos,
+                    init_cache=True, mutable=["cache"])
+                n_r, w = _spec_round_tokens_lanes(
+                    logits, d_logits, drafts, round_keys,
+                    do_sample=cfg.do_sample,
+                    temperature=cfg.temperature, top_k=cfg.top_k,
+                    top_p=cfg.top_p)
+                n_r = jnp.where(active, n_r, 0)
+                delta = jnp.where(active, gamma - n_r, 0)
+                # both cursors advanced gamma+1; both roll back the
+                # rejected tail together (the draft pool too — its
+                # stale entries past the cursor are masked, the
+                # _rollback_cache invariant)
+                cache = rollback_slots(mutated["cache"], delta)
+                dpool = rollback_slots(dpool, delta)
+                if not paged:
+                    cache = reset_free_slots(cache, active)
+                c = n_r + 1     # committed this tick (1..gamma+1)
+                win = jnp.where(
+                    jnp.arange(gamma + 1)[None] < c[:, None], w,
+                    cfg.pad_token_id)
+                win = jnp.where(active[:, None], win, cfg.pad_token_id)
+                history = jax.vmap(
+                    lambda row, wrow, p: jax.lax.dynamic_update_slice(
+                        row, wrow, (p,)))(history, win, phys + 1)
+                return cache, dpool, history, keys_out, n_r, win
+        elif self.spec:
             def decode_fn(params, cache, history, mask, tokens, pos,
-                          phys, active, rng):
+                          phys, active, keys):
                 """Speculative tick: per-lane prompt-lookup draft → ONE
                 verify forward over [B, gamma+1] → per-lane greedy
                 accept/commit. Entirely in-graph: the committed-history
@@ -472,8 +659,11 @@ class ContinuousBatchingEngine:
                     init_cache=True, mutable=["cache"])
                 # greedy accept = longest draft==argmax prefix, w = the
                 # per-position corrections: EXACTLY _spec_round_tokens'
-                # rule, shared with speculative_generate
-                n_r, w = _spec_round_tokens(logits, None, drafts, rng,
+                # rule, shared with speculative_generate (prompt-lookup
+                # proposals come from no distribution, so the sampled
+                # accept rule does not apply — greedy only, enforced by
+                # __post_init__)
+                n_r, w = _spec_round_tokens(logits, None, drafts, None,
                                             do_sample=False)
                 n_r = jnp.where(active, n_r, 0)
                 # the verify advanced every lane's cursor by gamma+1;
@@ -498,10 +688,10 @@ class ContinuousBatchingEngine:
                 history = jax.vmap(
                     lambda row, wrow, p: jax.lax.dynamic_update_slice(
                         row, wrow, (p,)))(history, win, phys + 1)
-                return cache, history, n_r, win
+                return cache, history, keys, n_r, win
         else:
             def decode_fn(params, cache, history, mask, tokens, pos,
-                          phys, active, rng):
+                          phys, active, keys):
                 n = tokens.shape[0]
                 if paged:
                     # clamp BEFORE the forward: a reclaimed lane's
@@ -510,6 +700,15 @@ class ContinuousBatchingEngine:
                     # first (the slot layout clamps after — each lane
                     # owns its space)
                     cache = reset_free_slots(cache, active)
+                if cfg.do_sample:
+                    # split IN-GRAPH: the ring entry advances once per
+                    # tick whether or not this lane commits, so a
+                    # lane's draw sequence depends only on (seed, tick
+                    # count) — never on which other lanes are resident
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys_out, tick_keys = split[:, 0], split[:, 1]
+                else:
+                    keys_out, tick_keys = keys, keys
                 # the token selected last tick enters the history at
                 # its physical cursor BEFORE the forward (its K/V are
                 # written at the same position by the cache update)
@@ -525,11 +724,17 @@ class ContinuousBatchingEngine:
                     step_logits = apply_logits_controls(
                         step_logits, history, (phys + 1)[:, None],
                         history_mask=mask, **control_kw)
-                nxt = _select_token(step_logits, rng, cfg.do_sample,
-                                    cfg.temperature, cfg.top_k,
-                                    cfg.top_p)
+                if cfg.do_sample:
+                    nxt = jax.vmap(
+                        lambda l, k: _select_token(
+                            l, k, True, cfg.temperature, cfg.top_k,
+                            cfg.top_p))(step_logits, tick_keys)
+                else:
+                    nxt = _select_token(step_logits, None, False,
+                                        cfg.temperature, cfg.top_k,
+                                        cfg.top_p)
                 nxt = jnp.where(active, nxt, cfg.pad_token_id)
-                return cache, history, nxt.astype(jnp.int32)
+                return cache, history, keys_out, nxt.astype(jnp.int32)
 
         # one compile per bucket width / exactly one for decode — the
         # parity + compile-count tests pin this via _cache_size().
@@ -538,6 +743,15 @@ class ContinuousBatchingEngine:
         # more than the decode itself); every donated arg is reassigned
         # from the outputs wherever these are called.
         self._aot = aot
+        # self-draft programs carry two extra donated buffers (the
+        # draft pool in both, plus the draft params slot shifting the
+        # argnums); the key ring is donated everywhere it is threaded
+        if self.self_draft:
+            assign_donate = (0, 1, 2, 3)
+            decode_donate = (2, 3, 4, 10)
+        else:
+            assign_donate = (0, 1, 2)
+            decode_donate = (1, 2, 8)
         if aot is not None:
             # everything the closures bake into the traced programs
             # beyond argument avals — gates trusted manifest replay
@@ -552,19 +766,25 @@ class ContinuousBatchingEngine:
             fp = (f"{model.config!r}::{config!r}"
                   f"::{kernel_fingerprint()}"
                   f"::{rules_fingerprint()}")
+            if self.self_draft:
+                # the draft tower's shape is baked into the traced
+                # programs too — a manifest compiled at one draft depth
+                # must never replay at another
+                fp += f"::draft={self._draft_model.config!r}"
             self._prefill_jit = aot.wrap(prefill_fn, "serving/prefill",
                                          fingerprint_extra=fp)
             self._assign_jit = aot.wrap(assign_fn, "serving/assign",
-                                        donate_argnums=(0, 1, 2),
+                                        donate_argnums=assign_donate,
                                         fingerprint_extra=fp)
             self._decode_jit = aot.wrap(decode_fn, "serving/decode",
-                                        donate_argnums=(1, 2),
+                                        donate_argnums=decode_donate,
                                         fingerprint_extra=fp)
         else:
             self._prefill_jit = jax.jit(prefill_fn)
             self._assign_jit = jax.jit(assign_fn,
-                                       donate_argnums=(0, 1, 2))
-            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2))
+                                       donate_argnums=assign_donate)
+            self._decode_jit = jax.jit(decode_fn,
+                                       donate_argnums=decode_donate)
 
     def _init_pool(self):
         """Zeros KV pool in the configured (layout, dtype)."""
@@ -602,6 +822,9 @@ class ContinuousBatchingEngine:
         req.timeline.add(self._clock(), "rejected", reason=reason,
                          **attrs)
         self._recent.append(self._request_dict(req))
+        # a rejected request's stream (opened at submit, then e.g.
+        # flushed by begin_drain) must close, not hang its reader
+        self._sync_stream(req)
 
     def _reject_prompt(self, ids: np.ndarray, reason: str,
                        request_id: Optional[str],
@@ -626,7 +849,9 @@ class ContinuousBatchingEngine:
                trace_id: Optional[str] = None,
                parent_span_id: Optional[str] = None,
                resume_tokens: Optional[Sequence[int]] = None,
-               resume_source: Optional[str] = None) -> Request:
+               resume_source: Optional[str] = None,
+               seed: Optional[int] = None,
+               stream: bool = False) -> Request:
         """Queue a prompt. Raises QueueFull (backpressure) or
         PromptTooLong (no bucket / no cache headroom). `deadline_s` is
         seconds from now; an expired request frees its slot and
@@ -646,6 +871,14 @@ class ContinuousBatchingEngine:
         token-identical to the undisturbed run — and only the remaining
         max_new - k tokens are decoded. `max_new_tokens` keeps its
         TOTAL-generation meaning (the resumed prefix counts toward it).
+
+        `seed` pins this request's sampling stream (docs/streaming.md
+        "Seed semantics"): the same prompt + seed reproduces the same
+        sampled tokens run-to-run regardless of pool co-tenancy. When
+        None, the seed derives from the request id, so an explicit-id
+        retry replays the same stream. `stream=True` opens a live
+        token stream the scheduler feeds at commit time
+        (`Engine.streams` / docs/streaming.md).
         """
         if self._draining:
             # checked again under the lock below; this early exit just
@@ -660,13 +893,12 @@ class ContinuousBatchingEngine:
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
         resume = [int(t) for t in resume_tokens] if resume_tokens \
             else []
-        if resume and self.spec:
-            # the verify window's cursor math is defined from a plain
-            # admission; resuming into a spec lane is untested ground —
-            # refuse loudly (422) rather than silently diverge
-            raise ValueError(
-                "resume_tokens is not supported on a speculative "
-                "engine (spec_mode != 'off')")
+        # resume on a speculative engine is sound: the max_new clamp is
+        # gamma-aware, the paged footprint charge includes the gamma
+        # tail, and both drafters read only the committed history —
+        # which admission restores inside the prefill bucket. (This
+        # gate used to reject; streaming retries made spec+resume the
+        # common path, docs/streaming.md "Retry and resume".)
         requested_new = int(max_new_tokens if max_new_tokens is not None
                             else self.config.max_new_tokens)
         if resume and requested_new <= len(resume):
@@ -748,6 +980,12 @@ class ContinuousBatchingEngine:
                       now, epoch=self._wall())
         req.timeline.trace_id = trace_id
         req.timeline.parent_span_id = parent_span_id
+        # resolve the per-request sampling seed: an explicit client
+        # seed wins; otherwise hash the request id, so an explicit-id
+        # retry (the router's resume path) folds to the SAME lane key
+        # and the resumed stream continues the same distribution
+        req.seed = (int(seed) & 0x7FFFFFFF) if seed is not None \
+            else zlib.crc32(req.request_id.encode()) & 0x7FFFFFFF
         if resume:
             # seed the committed prefix NOW: the journal and the debug
             # endpoints must show the true progress from the first
@@ -803,6 +1041,11 @@ class ContinuousBatchingEngine:
                                  tokens=len(resume),
                                  source=resume_source)
             self._journal_add_locked(req)
+            if stream:
+                # open the live stream BEFORE any token can commit so
+                # the reader never misses the head; open() replays
+                # req.tokens, so a resumed stream starts at k, not 0
+                self.streams.open(req)
             self.metrics.count("admitted")
             self._log({"event": "serving_admit",
                        "request_id": req.request_id, "bucket": bucket,
@@ -856,17 +1099,22 @@ class ContinuousBatchingEngine:
         active_idx = np.nonzero(self._active)[0]
         if len(active_idx) == 0:
             return 0
-        if self.config.do_sample:
-            self._rng, key = jax.random.split(self._rng)
-        else:
-            key = self._zero_key
         t0 = time.perf_counter()
         if self.spec:
             with span("serving/decode"):
-                self._cache, self._history, n_r, win = self._decode_jit(
-                    self.params, self._cache, self._history, self._mask,
-                    self._last_tok, self._pos, self._phys, self._active,
-                    key)
+                if self.self_draft:
+                    (self._cache, self._draft_cache, self._history,
+                     self._keys, n_r, win) = self._decode_jit(
+                        self.params, self._draft_params, self._cache,
+                        self._draft_cache, self._history, self._mask,
+                        self._last_tok, self._pos, self._phys,
+                        self._active, self._keys)
+                else:
+                    (self._cache, self._history, self._keys, n_r,
+                     win) = self._decode_jit(
+                        self.params, self._cache, self._history,
+                        self._mask, self._last_tok, self._pos,
+                        self._phys, self._active, self._keys)
                 # host sync: the scheduler needs the accept counts and
                 # the committed window (copies — the device views are
                 # read-only and lanes are overwritten on admission)
@@ -911,6 +1159,7 @@ class ContinuousBatchingEngine:
                 req.timeline.add(t_commit, "commit", n=k,
                                  accepted=min(int(n_r[i]), k),
                                  tick_s=round(dt, 6))
+                self._sync_stream(req)
                 if fin is not None:
                     self._release(i, FINISHED, fin)
                 delivered += k
@@ -925,9 +1174,11 @@ class ContinuousBatchingEngine:
                 accepted_delivered)
             return int(self._active.sum())
         with span("serving/decode"):
-            self._cache, self._history, nxt = self._decode_jit(
-                self.params, self._cache, self._history, self._mask,
-                self._last_tok, self._pos, self._phys, self._active, key)
+            self._cache, self._history, self._keys, nxt = \
+                self._decode_jit(
+                    self.params, self._cache, self._history, self._mask,
+                    self._last_tok, self._pos, self._phys, self._active,
+                    self._keys)
             # host sync: the scheduler needs the tokens (copy — the
             # device view is read-only and lanes are overwritten on
             # admission)
@@ -945,6 +1196,7 @@ class ContinuousBatchingEngine:
             req.tokens.append(tok)
             req.timeline.add(t_commit, "commit", n=1,
                              tick_s=round(dt, 6))
+            self._sync_stream(req)
             if self.config.eos_token_id is not None and \
                     tok == self.config.eos_token_id:
                 self._release(i, FINISHED, "eos")
@@ -1011,16 +1263,29 @@ class ContinuousBatchingEngine:
                 row, mask_row = self.ladder.pad_prompt(
                     prefill_ids, bucket, self.config.pad_token_id)
                 if self.config.do_sample:
-                    self._rng, key = jax.random.split(self._rng)
+                    # per-request key derivation (docs/streaming.md
+                    # "Seed semantics"): fold the request seed into the
+                    # engine base key, then split once — one half seeds
+                    # the prefill draw, the other becomes this lane's
+                    # ring entry. No global RNG is consumed, so a
+                    # request's stream is independent of admission
+                    # order and pool co-tenancy.
+                    base = jax.random.fold_in(self._base_key, req.seed)
+                    key, lane_key = jax.random.split(base)
                 else:
-                    key = self._zero_key
+                    key = lane_key = self._zero_key
                 req.timeline.add(self._clock(), "admitted", slot=slot,
                                  bucket=int(bucket))
                 req.timeline.add(self._clock(), "prefill_start",
                                  bucket=int(bucket))
                 with span("serving/prefill"):
-                    primed, tok = self._prefill_jit(
-                        self.params, row[None], mask_row[None], key)
+                    if self.self_draft:
+                        primed, d_primed, tok = self._prefill_jit(
+                            self.params, self._draft_params, row[None],
+                            mask_row[None], key)
+                    else:
+                        primed, tok = self._prefill_jit(
+                            self.params, row[None], mask_row[None], key)
                     tok = int(np.asarray(tok)[0])
                 self.metrics.record_prefill(bucket)
                 t_first = self._clock()
@@ -1036,6 +1301,7 @@ class ContinuousBatchingEngine:
                     tok = resume[-1]
                 else:
                     req.tokens.append(tok)
+                self._sync_stream(req)
                 if self.config.eos_token_id is not None and \
                         tok == self.config.eos_token_id:
                     if blocks is not None:
@@ -1067,7 +1333,21 @@ class ContinuousBatchingEngine:
                 if blocks is not None:
                     self._allocator.free(blocks)
                 raise
-            if self.paged:
+            if self.self_draft:
+                if self.paged:
+                    self._slot_blocks[slot] = blocks
+                    (self._cache, self._draft_cache, self._history,
+                     self._mask) = self._assign_jit(
+                        self._cache, self._draft_cache, self._history,
+                        self._mask, primed, d_primed, hist_row,
+                        full_mask, table_row, np.int32(slot))
+                else:
+                    (self._cache, self._draft_cache, self._history,
+                     self._mask) = self._assign_jit(
+                        self._cache, self._draft_cache, self._history,
+                        self._mask, primed, d_primed, hist_row,
+                        full_mask, np.int32(slot))
+            elif self.paged:
                 self._slot_blocks[slot] = blocks
                 self._cache, self._history, self._mask = \
                     self._assign_jit(self._cache, self._history,
@@ -1089,6 +1369,10 @@ class ContinuousBatchingEngine:
             # tokens, the same invariant pos = P + len(tokens) - 1
             self._pos[slot] = len(req.prompt) + len(req.tokens) - 1
             self._phys[slot] = bucket           # physical cursor
+            if self.config.do_sample:
+                # install the lane's ring entry; greedy engines keep
+                # the zero ring and never consume it
+                self._keys = self._keys.at[slot].set(lane_key)
         return
 
     def _release(self, slot: int, state: str, reason: str) -> None:
@@ -1124,7 +1408,18 @@ class ContinuousBatchingEngine:
         self._log({"event": "serving_finish",
                    "request_id": req.request_id, "reason": reason,
                    "tokens": len(req.tokens), "ttft_s": req.ttft_s})
+        # terminal stream sync: finish_reason is set, so the stream
+        # (if open) delivers any tail tokens and closes
+        self._sync_stream(req)
         req._done.set()
+
+    def _sync_stream(self, req: Request) -> None:
+        """Push `req`'s committed tokens to its live stream, if one is
+        open. O(1) dict probe when it is not — the cost a non-streaming
+        engine pays per commit. Host-side only, never traced."""
+        n = self.streams.sync(req)
+        if n:
+            self.metrics.record_stream_tokens(n)
 
     # ---- drivers ----------------------------------------------------
 
@@ -1215,6 +1510,10 @@ class ContinuousBatchingEngine:
         self._cache = self._init_pool()
         self._history = jnp.zeros((S, L), jnp.int32)
         self._mask = jnp.zeros((S, L), jnp.int32)
+        self._keys = jnp.zeros((S,) + self._zero_key.shape,
+                               self._zero_key.dtype)
+        if self.self_draft:
+            self._draft_cache = init_slot_cache(self._draft_model, S)
         self._last_tok = np.zeros((S,), np.int32)
         self._pos = np.zeros((S,), np.int32)
         self._phys = np.zeros((S,), np.int32)
@@ -1304,6 +1603,19 @@ class ContinuousBatchingEngine:
                 out["resume_source"] = req.resume_source
             return out
 
+    def attach_stream(self, request_id: str):
+        """(Re)open the live token stream of a journaled request — the
+        `Last-Event-ID` reconnect path (docs/streaming.md "Reconnect").
+        Idempotent: a stream already open is returned as-is; a request
+        that already finished yields a stream that replays its tokens
+        and closes immediately. None when the id never ran here or
+        aged out of the journal ring."""
+        with self._cv:
+            req = self._journal.get(request_id)
+            if req is None:
+                return None
+            return self.streams.open(req)
+
     # ---- observability ----------------------------------------------
 
     def warmup(self) -> float:
@@ -1336,12 +1648,24 @@ class ContinuousBatchingEngine:
                         continue
                     ids = np.ones((1, bucket), np.int32)
                     mask = np.ones((1, bucket), np.int32)
-                    self._prefill_jit.warm(self.params, ids, mask,
-                                           self._zero_key)
-                self._decode_jit.warm(
-                    self.params, self._cache, self._history,
-                    self._mask, self._last_tok, self._pos, self._phys,
-                    self._active, self._zero_key)
+                    if self.self_draft:
+                        self._prefill_jit.warm(
+                            self.params, self._draft_params, ids, mask,
+                            self._zero_key)
+                    else:
+                        self._prefill_jit.warm(self.params, ids, mask,
+                                               self._zero_key)
+                if self.self_draft:
+                    self._decode_jit.warm(
+                        self.params, self._draft_params, self._cache,
+                        self._draft_cache, self._history, self._mask,
+                        self._last_tok, self._pos, self._phys,
+                        self._active, self._keys)
+                else:
+                    self._decode_jit.warm(
+                        self.params, self._cache, self._history,
+                        self._mask, self._last_tok, self._pos,
+                        self._phys, self._active, self._keys)
         else:
             with self._cv:
                 for bucket in self.ladder.buckets:
@@ -1352,19 +1676,33 @@ class ContinuousBatchingEngine:
                     # warmup compiles under _cv on purpose: no request
                     # may tick mid-warmup or it would pay (and double-
                     # compile) the very programs being primed
-                    jax.block_until_ready(self._prefill_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
-                        self.params, ids, mask, self._zero_key))
-                # cache/history are donated, so reassign them; with
-                # every lane free the warmup tick is a no-op on pool
-                # state (free lanes write at index 0 and are fully
-                # overwritten by the next assignment anyway); the spec
-                # tick returns (cache, history, n_r, win), the plain
-                # one (cache, history, nxt) — slice the shared prefix
-                out = self._decode_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
-                    self.params, self._cache, self._history, self._mask,
-                    self._last_tok, self._pos, self._phys, self._active,
-                    self._zero_key)
-                self._cache, self._history = out[0], out[1]
+                    if self.self_draft:
+                        jax.block_until_ready(self._prefill_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
+                            self.params, self._draft_params, ids, mask,
+                            self._zero_key))
+                    else:
+                        jax.block_until_ready(self._prefill_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
+                            self.params, ids, mask, self._zero_key))
+                # cache/history/keys (and the draft pool) are donated,
+                # so reassign them; with every lane free the warmup
+                # tick is a no-op on pool state (free lanes write at
+                # index 0 and are fully overwritten by the next
+                # assignment anyway) and on the zero key ring
+                if self.self_draft:
+                    out = self._decode_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
+                        self.params, self._draft_params, self._cache,
+                        self._draft_cache, self._history, self._mask,
+                        self._last_tok, self._pos, self._phys,
+                        self._active, self._keys)
+                    (self._cache, self._draft_cache, self._history,
+                     self._keys) = out[0], out[1], out[2], out[3]
+                else:
+                    out = self._decode_jit(  # fslint: disable=blocking-under-lock; warmup must exclude ticks
+                        self.params, self._cache, self._history,
+                        self._mask, self._last_tok, self._pos,
+                        self._phys, self._active, self._keys)
+                    self._cache, self._history, self._keys = \
+                        out[0], out[1], out[2]
                 jax.block_until_ready(self._cache)  # fslint: disable=blocking-under-lock; warmup must exclude ticks
         dt = time.perf_counter() - t0
         self.metrics.warmup_compile_s = round(dt, 3)
@@ -1426,6 +1764,10 @@ class ContinuousBatchingEngine:
                 spec=({"mode": self.config.spec_mode,
                        "gamma": self.config.spec_gamma}
                       if self.spec else None),
+                # same pattern for streams: an engine that never
+                # streamed keeps the exact pre-streaming payload shape
+                streams=({"active": self.streams.active()}
+                         if self.streams.ever_opened else None),
                 uptime_s=now - self._t0_clock,
                 last_error=last_error,
                 draining=self._draining), engine_type=self.engine_type)

@@ -471,5 +471,9 @@ def detach_lane(engine, request_id: str,
                      else "serving_handoff",
                      "request_id": req.request_id,
                      "tokens": len(req.tokens), "target": target})
+        # terminal stream sync AFTER evac_target is stamped: a live
+        # SSE reader gets any tail tokens plus the `evacuated` event
+        # pointing at the adopter (docs/streaming.md "Reconnect")
+        engine._sync_stream(req)
         req._done.set()
         return True

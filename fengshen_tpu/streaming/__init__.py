@@ -1,0 +1,18 @@
+"""Streaming tier (docs/streaming.md): token-by-token delivery for the
+continuous-batching engine.
+
+Two halves, both host-side (nothing here is ever traced):
+
+- `stream`: `TokenStream` / `StreamBook` — per-request bounded token
+  queues the engine's scheduler thread feeds at commit time and API
+  worker threads drain, with replay-from-index so `Last-Event-ID`
+  reconnects and resume-from-token-k retries pick up mid-stream;
+- `sse`: the Server-Sent-Events wire framing (event ids = token
+  index) shared by both API paths and parsed back by the fleet
+  router's streaming transport.
+"""
+
+from fengshen_tpu.streaming.sse import format_event, iter_sse
+from fengshen_tpu.streaming.stream import StreamBook, TokenStream
+
+__all__ = ["StreamBook", "TokenStream", "format_event", "iter_sse"]

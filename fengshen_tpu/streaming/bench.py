@@ -1,0 +1,373 @@
+"""Streaming-tier microbench (docs/streaming.md): token-by-token SSE
+delivery vs wait-for-last-byte, the self-draft tower's speculative
+yield on NON-repetitive traffic, and the kill-mid-stream gapless rung.
+
+    make serve-bench-stream
+    STREAM_BENCH_NEW_TOKENS=64 python -m fengshen_tpu.streaming.bench
+
+Three rungs, one BENCH-schema JSON line:
+
+1. **TTFT first-byte vs last-byte** at `WIDTH` concurrent streamed
+   requests on the continuous engine: per-request wall time from
+   submit to the FIRST delivered token event (`ttfb_avg_s`) vs to the
+   terminal event (`ttlb_avg_s`). Streaming's whole point is the gap
+   between the two — the client reads tokens while the lane is still
+   decoding, so first-byte latency is a per-token commit away from
+   admission instead of a full generation away.
+
+2. **Self-draft committed/forward** on a non-repetitive workload
+   (uniform random prompts — the regime where prompt-lookup's ngram
+   copy finds nothing): `value` = the self-draft engine's committed
+   tokens per target forward, `vs_baseline` the same number over the
+   prompt-lookup engine on IDENTICAL traffic. The draft tower shares
+   the target's embedding and first `SPEC_DRAFT_LAYERS` blocks, so it
+   predicts the target's own distribution rather than copying the
+   prompt — the bar is `vs_baseline > 1` with `value > 1.5` at
+   gamma=4.
+
+3. **Kill-mid-stream** (`kill` section): two fake SSE replicas (pure
+   stdlib, deterministic token function, shared commit journal)
+   behind a real `FleetRouter.route_generate_stream`; replica A's
+   connection dies abruptly after `KILL_AFTER` tokens with no
+   terminal event. The rung passes only when the client-visible
+   concatenated stream is GAPLESS (event ids exactly 0..n-1, no
+   duplicates) and token-identical to an undisturbed run — the
+   router's dedupe cursor + journal resume doing their job.
+
+The row carries ``stream`` and ``spec_mode`` — benchdiff folds both
+into the comparison identity, so a streamed self-draft round never
+diffs against a batch or prompt-lookup round. Env knobs
+(STREAM_BENCH_*): WIDTH, REQUESTS, NEW_TOKENS, SLOTS, SPEC_GAMMA,
+SPEC_DRAFT_LAYERS, KILL_AFTER, SEED, and the serve-bench model shape
+knobs VOCAB / HIDDEN / INTER / LAYERS / HEADS / BUCKETS.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+def _env(name: str, default: int) -> int:
+    return int(os.environ.get(f"STREAM_BENCH_{name}", default))
+
+
+def _buckets() -> Tuple[int, ...]:
+    return tuple(int(b) for b in os.environ.get(
+        "STREAM_BENCH_BUCKETS", "32,64").split(","))
+
+
+def _emit(row: dict) -> None:
+    from fengshen_tpu.observability import JsonlSink
+    if os.environ.get("BENCH_DEGRADED", "0") == "1":
+        row["degraded"] = True
+    JsonlSink(stream=sys.stdout, only_process_zero=False)(row)
+
+
+# ---- rung 1: first-byte vs last-byte at WIDTH concurrent ------------
+
+def _ttfb_rung(model, params, prompts, new_tokens: int,
+               slots: int, buckets) -> dict:
+    from fengshen_tpu.serving import ContinuousBatchingEngine, EngineConfig
+    engine = ContinuousBatchingEngine(model, params, EngineConfig(
+        num_slots=slots, buckets=buckets, max_new_tokens=new_tokens,
+        max_queue=len(prompts), eos_token_id=None, pad_token_id=0))
+    engine.warmup()
+
+    ttfb: List[float] = [0.0] * len(prompts)
+    ttlb: List[float] = [0.0] * len(prompts)
+
+    def consume(i: int, stream, t0: float) -> None:
+        first = True
+        for kind, _idx, _tok in stream.events(0, timeout=300.0):
+            if kind == "token" and first:
+                ttfb[i] = time.perf_counter() - t0
+                first = False
+            elif kind != "token":
+                ttlb[i] = time.perf_counter() - t0
+                return
+
+    threads = []
+    t_start = time.perf_counter()
+    for i, p in enumerate(prompts):
+        req = engine.submit(p, stream=True)
+        stream = engine.streams.get(req.request_id)
+        t = threading.Thread(target=consume,
+                             args=(i, stream, time.perf_counter()),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    engine.run_until_idle()
+    for t in threads:
+        t.join(timeout=300.0)
+    dt = time.perf_counter() - t_start
+    return {
+        "ttfb_avg_s": round(sum(ttfb) / len(ttfb), 4),
+        "ttlb_avg_s": round(sum(ttlb) / len(ttlb), 4),
+        "ttfb_max_s": round(max(ttfb), 4),
+        "first_vs_last_byte": round(
+            (sum(ttlb) / max(sum(ttfb), 1e-9)), 2),
+        "tokens_per_sec": round(
+            len(prompts) * new_tokens / dt, 1),
+    }
+
+
+# ---- rung 2: self-draft vs prompt-lookup on non-repetitive text -----
+
+def _spec_rung(model, params, prompts, new_tokens: int, slots: int,
+               buckets, gamma: int, draft_layers: int) -> dict:
+    from fengshen_tpu.serving import ContinuousBatchingEngine, EngineConfig
+    from fengshen_tpu.serving.bench import committed_per_forward
+
+    out = {}
+    for mode, extra in (("prompt_lookup", {}),
+                        ("self_draft",
+                         {"spec_draft_layers": draft_layers})):
+        engine = ContinuousBatchingEngine(model, params, EngineConfig(
+            num_slots=slots, buckets=buckets,
+            max_new_tokens=new_tokens, max_queue=len(prompts),
+            eos_token_id=None, pad_token_id=0,
+            spec_mode=mode, spec_gamma=gamma, **extra))
+        engine.warmup()
+        t0 = time.perf_counter()
+        outs = engine.generate_all(prompts)
+        dt = time.perf_counter() - t0
+        st = engine.stats()
+        out[mode] = {
+            "committed_per_forward": round(committed_per_forward(
+                gamma, st["spec_acceptance_rate"]), 3),
+            "acceptance_rate": st["spec_acceptance_rate"],
+            "tokens_per_sec": round(
+                sum(len(t) for t in outs) / dt, 1),
+            "outputs": outs,
+        }
+    out["token_identical"] = (out["self_draft"].pop("outputs") ==
+                              out["prompt_lookup"].pop("outputs"))
+    return out
+
+
+# ---- rung 3: kill mid-stream through the real router ----------------
+
+def _fake_tokens(rid: str, n: int, vocab: int = 997) -> List[int]:
+    s = sum(ord(c) for c in rid)
+    return [(s * 31 + i * 7) % vocab for i in range(n)]
+
+
+def start_fake_stream_replica(journal: dict, new_tokens: int,
+                              token_s: float,
+                              die_after: Optional[int] = None,
+                              host: str = "127.0.0.1", port: int = 0):
+    """Fake SSE replica: POST /api/text_generation/stream emits
+    `new_tokens` deterministic token events (id = token index), each
+    committed into the SHARED `journal` first (the fake analog of the
+    engine's commit-then-publish order; any surviving peer can serve
+    `GET /partial/<rid>` from it, like an evacuation adopter would).
+    `die_after=k` aborts the connection after k token events with no
+    terminal frame — the SIGKILL-mid-stream analog."""
+    from fengshen_tpu.streaming import format_event
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok", "ready": True})
+            elif self.path == "/stats":
+                self._send(200, {"slots_active": 0, "queue_depth": 0,
+                                 "num_slots": 4, "draining": False})
+            elif self.path.startswith("/partial/"):
+                rid = self.path[len("/partial/"):]
+                toks = journal.get(rid)
+                if toks is None:
+                    self._send(404, {"error": "unknown"})
+                else:
+                    self._send(200, {"state": "running",
+                                     "tokens": list(toks)})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if not self.path.endswith("/stream"):
+                self._send(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            rid = str(req.get("request_id"))
+            toks = _fake_tokens(rid, new_tokens)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            for i, t in enumerate(toks):
+                if die_after is not None and i >= die_after:
+                    # abrupt death: no terminal event, the socket
+                    # just stops — exactly what a SIGKILL leaves
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                journal.setdefault(rid, [])
+                if i >= len(journal[rid]):
+                    journal[rid].append(t)
+                self.wfile.write(format_event(
+                    "token", {"token": t}, event_id=i))
+                self.wfile.flush()
+                time.sleep(token_s)
+            self.wfile.write(format_event(
+                "done", {"request_id": rid, "finish_reason": "length",
+                         "result": " ".join(str(t) for t in toks)},
+                event_id=new_tokens))
+            self.wfile.flush()
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
+
+
+def _kill_rung(new_tokens: int, kill_after: int) -> dict:
+    from fengshen_tpu.fleet import FleetConfig, FleetRouter
+    from fengshen_tpu.streaming import iter_sse
+
+    def run(die_after: Optional[int]) -> List[dict]:
+        journal: dict = {}
+        servers = []
+        try:
+            a, _ = start_fake_stream_replica(
+                journal, new_tokens, token_s=0.001,
+                die_after=die_after)
+            b, _ = start_fake_stream_replica(
+                journal, new_tokens, token_s=0.001)
+            servers = [a, b]
+            targets = ["127.0.0.1:%d" % s.server_address[1]
+                       for s in servers]
+            router = FleetRouter(FleetConfig(
+                replicas=targets, max_retries=3, recovery_probes=1,
+                backoff_base_s=0.01, request_timeout_s=30.0))
+            router.poll_once()
+            # pin the doomed replica as first pick by occupancy tie →
+            # lowest index; both idle, so A serves the fresh stream
+            code, _body, frames = router.route_generate_stream(
+                {"input_text": "kill rung", "request_id": "kill-1"})
+            assert code == 200, code
+            raw = b"".join(frames)
+            router.stop()
+            return list(iter_sse(raw.decode().splitlines()))
+        finally:
+            for s in servers:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except OSError:
+                    pass
+
+    clean = run(die_after=None)
+    killed = run(die_after=kill_after)
+
+    def token_ids(events):
+        return [(e["id"], e["data"]["token"]) for e in events
+                if e["event"] == "token"]
+
+    kt = token_ids(killed)
+    gapless = [i for i, _ in kt] == list(range(new_tokens))
+    return {
+        "enabled": True,
+        "after_tokens": kill_after,
+        "gapless": gapless,
+        "token_identical": kt == token_ids(clean),
+        "terminal": killed[-1]["event"] if killed else None,
+        "delivered": len(kt),
+    }
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fengshen_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    width = max(_env("WIDTH", 8), 1)
+    slots = _env("SLOTS", 8)
+    n_req = max(_env("REQUESTS", width), width)
+    new_tokens = _env("NEW_TOKENS", 48)
+    gamma = _env("SPEC_GAMMA", 4)
+    draft_layers = _env("SPEC_DRAFT_LAYERS", 2)
+    kill_after = _env("KILL_AFTER", max(new_tokens // 3, 1))
+    buckets = _buckets()
+
+    config = LlamaConfig(
+        vocab_size=_env("VOCAB", 4096),
+        hidden_size=_env("HIDDEN", 1024),
+        intermediate_size=_env("INTER", 2816),
+        num_hidden_layers=_env("LAYERS", 4),
+        num_attention_heads=_env("HEADS", 8),
+        # gamma-wide verify tail past the cursor, like serve-bench-spec
+        max_position_embeddings=buckets[-1] + new_tokens + gamma,
+        dtype="float32")
+    model = LlamaForCausalLM(config)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(
+        jax.random.PRNGKey(_env("SEED", 0)))
+
+    # NON-repetitive traffic: uniform random prompts — prompt-lookup's
+    # worst case and the draft tower's home turf
+    rng = np.random.RandomState(_env("SEED", 0))
+    prompt_len = max(buckets[0] // 2, 1)
+    prompts = [rng.randint(3, config.vocab_size - 1,
+                           prompt_len).astype(np.int32)
+               for _ in range(n_req)]
+
+    ttfb = _ttfb_rung(model, params, prompts[:width], new_tokens,
+                      slots, buckets)
+    spec = _spec_rung(model, params, prompts, new_tokens, slots,
+                      buckets, gamma, draft_layers)
+    kill = _kill_rung(new_tokens, kill_after)
+
+    cpf_self = spec["self_draft"]["committed_per_forward"]
+    cpf_lookup = spec["prompt_lookup"]["committed_per_forward"]
+    _emit({
+        "metric": "streaming_self_draft_committed_per_forward",
+        "value": cpf_self,
+        "unit": "tokens/forward",
+        "vs_baseline": round(cpf_self / cpf_lookup, 3)
+        if cpf_lookup > 0 else 0.0,
+        "mode": "stream",
+        # the comparison identity keys (benchdiff `_identity`)
+        "stream": True,
+        "spec_mode": "self_draft",
+        "spec_gamma": gamma,
+        "spec_draft_layers": draft_layers,
+        "committed_per_forward_lookup": cpf_lookup,
+        "acceptance_rate": spec["self_draft"]["acceptance_rate"],
+        "acceptance_rate_lookup":
+            spec["prompt_lookup"]["acceptance_rate"],
+        "tokens_per_sec": spec["self_draft"]["tokens_per_sec"],
+        "tokens_per_sec_lookup":
+            spec["prompt_lookup"]["tokens_per_sec"],
+        "token_identical": spec["token_identical"],
+        "concurrent_streams": width,
+        "requests": n_req,
+        "new_tokens": new_tokens,
+        "num_slots": slots,
+        "prompt_tokens": prompt_len,
+        **{f"stream_{k}": v for k, v in ttfb.items()},
+        "kill": kill,
+        "backend": jax.default_backend(),
+    })
+
+
+if __name__ == "__main__":
+    main()

@@ -337,6 +337,30 @@ def _spec_round_tokens(t_logits, d_logits, d, rng, *, do_sample,
     return n_r, w
 
 
+def _spec_round_tokens_lanes(t_logits, d_logits, d, keys, *, do_sample,
+                             temperature=1.0, top_k=0, top_p=0.0):
+    """Per-lane keyed variant of `_spec_round_tokens` for the serving
+    engine's slot pool: each lane carries its OWN PRNG key (the
+    engine's per-lane key ring), so a lane's accept/resample draws are
+    a pure function of its request seed — independent of which other
+    requests co-tenant the pool. `keys` is [B, 2] uint32 (one key per
+    lane). Greedy delegates straight to the shared single-key path
+    (the rng is unused there); sampling vmaps the SAME accept rule
+    over lanes so there is exactly one implementation of the
+    rejection-sampling math."""
+    if not do_sample:
+        return _spec_round_tokens(t_logits, None, d, None,
+                                  do_sample=False)
+
+    def per_lane(tl, dl, dd, key):
+        n_r, w = _spec_round_tokens(
+            tl[None], dl[None], dd[None], key, do_sample=True,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+        return n_r[0], w[0]
+
+    return jax.vmap(per_lane)(t_logits, d_logits, d, keys)
+
+
 def _spec_early_return(input_ids, max_new_tokens, return_stats):
     """Shared no-op path for max_new_tokens <= 0 (None = proceed)."""
     if max_new_tokens > 0:

@@ -1,0 +1,111 @@
+"""known-clean fixture: the streaming-tier idiom (ISSUE 20,
+docs/streaming.md) — token-by-token delivery is HOST work on the
+scheduler and reader threads, while the per-lane RNG that makes
+sampled streams reproducible lives entirely INSIDE the decode graph.
+The per-tick key split is traced (`jax.vmap(jax.random.split)` over
+the lane-key ring — a pure function of the carried keys, no host
+randomness under trace), the commit-then-publish order runs on the
+scheduler thread under plain locks (journal append, then stream
+publish under a per-stream condition), and SSE framing + the TTFB
+observation happen on the reader's delivery thread. The tempting
+regressions this fixture guards: publishing stream tokens or bumping
+the `fstpu_stream_*` counters inside the traced tick
+(metrics-in-traced-code), writing SSE bytes to a socket from traced
+code (blocking-transfer), branching traced code on a host-side stream
+state flag (host-divergence), or seeding the lane key from a host
+`random.random()` under trace (nondet — the lane key must fold from
+the pinned request seed so a retried request replays byte-identical).
+
+Mirrors `fengshen_tpu/streaming/stream.py`'s publish/events split and
+`fengshen_tpu/serving/engine.py`'s key ring + `_sync_stream`: if a
+rule fires here, it would also flag the real modules and block the
+merge gate.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from fengshen_tpu.observability import get_registry
+
+REG = get_registry()
+STREAM_TOKENS = REG.counter("fx_stream_tokens_total",
+                            "tokens published to live streams")
+STREAM_TTFB = REG.histogram("fx_stream_ttfb_seconds",
+                            "submit-to-first-delivered-byte")
+
+
+@jax.jit
+def decode_tick(cache, tokens, keys):
+    """The per-tick decode body: the lane-key ring splits IN-GRAPH
+    (carried state, pure function of the folded request seeds) — the
+    stream publish, the SSE write, and every counter stay OUT of
+    here."""
+    split = jax.vmap(jax.random.split)(keys)
+    keys_out, tick_keys = split[:, 0], split[:, 1]
+    n = tokens.shape[0]
+    cache = cache.at[jnp.arange(n)].set(tokens)
+    nxt = jax.vmap(
+        lambda k, t: jax.random.categorical(
+            k, jnp.ones((8,)) * t.astype(jnp.float32)))(
+        tick_keys, tokens)
+    return cache, nxt.astype(jnp.int32), keys_out
+
+
+def admission_key(base_key, request_seed):
+    """Host-side lane-key derivation at admission: fold the PINNED
+    request seed into the engine's base key — placement-independent,
+    so a fleet retry under the same request id replays the same
+    stream."""
+    base = jax.random.fold_in(base_key, request_seed)
+    _consume, lane_key = jax.random.split(base)
+    return lane_key
+
+
+class LiveStream:
+    """One request's feed: scheduler publishes under a plain condition
+    AFTER the commit journal append; the reader drains on its own
+    thread — a stalled client never blocks the scheduler."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._tokens = []
+        self.closed = False
+
+    def publish(self, snapshot, closed=False):
+        with self._cond:
+            new = snapshot[len(self._tokens):]
+            self._tokens.extend(int(t) for t in new)
+            self.closed = self.closed or closed
+            if new or closed:
+                self._cond.notify_all()
+        if new:
+            STREAM_TOKENS.inc(len(new))
+        return len(new)
+
+    def drain_from(self, pos):
+        with self._cond:
+            while len(self._tokens) <= pos and not self.closed:
+                self._cond.wait(timeout=1.0)
+            return self._tokens[pos:], self.closed
+
+
+def deliver_sse(stream, write, clock, t_submit):
+    """The reader thread's delivery loop: byte framing and the TTFB
+    observation are host work BETWEEN jit boundaries; the blocking
+    socket write happens here, never under trace and never under the
+    stream's condition."""
+    pos, first = 0, True
+    while True:
+        batch, closed = stream.drain_from(pos)
+        if batch and first:
+            STREAM_TTFB.observe(clock() - t_submit)
+            first = False
+        for tok in batch:
+            write(b"id: %d\nevent: token\ndata: {\"token\": %d}\n\n"
+                  % (pos, tok))
+            pos += 1
+        if closed:
+            write(b"event: done\ndata: {}\n\n")
+            return pos

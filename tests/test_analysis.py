@@ -583,6 +583,59 @@ def test_evac_internals_are_clean():
     assert not hits, "\n".join(f.render() for f in hits)
 
 
+def test_streaming_internals_are_clean():
+    """Regression fixture for the streaming tier (ISSUE 20,
+    docs/streaming.md): the per-lane key ring splits IN-GRAPH inside
+    the jitted tick (reproducibility is a property of the carried
+    keys, not of host randomness), the commit-then-publish stream sync
+    is plain-lock host work on the scheduler thread, and SSE framing +
+    the blocking socket write + the TTFB observation live on the
+    reader's delivery thread — neither `metrics-in-traced-code`,
+    `blocking-transfer` nor `host-divergence` may fire on the fixture
+    or on the real modules (the streaming package, the serving engine
+    that owns the ring + `_sync_stream`, and the api/fleet layers that
+    frame and proxy the wire). A hit means a publish, a socket write,
+    or a counter leaked into a traced program (a real hazard:
+    streaming must add ZERO per-token compiled work) or a rule lost
+    precision.
+
+    The same gate pins api-surface parity for the new wire: the
+    `/stream` route must be visible to `extract_routes` on BOTH
+    surfaces of api/main.py — fastapi decorator and stdlib dispatcher
+    — so `api-surface-parity` keeps diffing it (a BinOp-concatenated
+    path would silently drop out of the extractor and the rule would
+    stop guarding the route)."""
+    fixture = os.path.join(FIXTURES, "streaming_clean.py")
+    findings = check_file(fixture, make_rules(), REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    paths = [os.path.join(PKG, "streaming"),
+             os.path.join(PKG, "serving"),
+             os.path.join(PKG, "api"),
+             os.path.join(PKG, "fleet")]
+    findings = check_paths(paths, make_rules(), REPO)
+    hits = [f for f in findings
+            if f.rule in ("metrics-in-traced-code", "blocking-transfer",
+                          "host-divergence")]
+    assert not hits, "\n".join(f.render() for f in hits)
+
+    # the SSE route is on both surfaces of the dual-stack api module,
+    # in extractor-visible form, and the parity rule stays green
+    import ast as _ast
+    from fengshen_tpu.analysis.dataflow import extract_routes
+    api_main = os.path.join(PKG, "api", "main.py")
+    with open(api_main, encoding="utf-8") as fp:
+        tree = _ast.parse(fp.read())
+    routes = extract_routes(tree)
+    stream_surfaces = {s for (s, method, path, _l, _c) in routes
+                       if method == "POST" and path.endswith("*")}
+    assert stream_surfaces == {"fastapi", "stdlib"}, routes
+    parity = check_paths([os.path.join(PKG, "api")],
+                         make_rules(select=["api-surface-parity"]),
+                         REPO)
+    assert not parity, "\n".join(f.render() for f in parity)
+
+
 def test_trace_context_internals_are_clean():
     """Regression fixture for the distributed-tracing tier (ISSUE 11,
     docs/observability.md "Distributed tracing"): trace/span ids come
